@@ -1,0 +1,85 @@
+"""deepspeed_tpu: a TPU-native large-model training framework.
+
+Capability parity with DeepSpeed v0.3.10 (``deepspeed/__init__.py``), built
+idiomatically on JAX/XLA/Pallas/pjit: ``initialize()`` returns an engine that
+wraps a user model with data/ZeRO/pipeline/model parallelism over a device
+mesh, mixed precision with (dynamic) loss scaling, fused TPU kernels, and
+checkpointing.
+"""
+
+from deepspeed_tpu.version import __version__
+
+version = __version__
+
+
+def initialize(args=None, model=None, optimizer=None, model_parameters=None,
+               training_data=None, lr_scheduler=None, mpu=None, dist_init_required=None,
+               collate_fn=None, config=None, config_params=None):
+    """Initialize the DeepSpeedTPU engine (parity: reference deepspeed/__init__.py:50).
+
+    Arguments mirror the reference. ``model`` is a deepspeed_tpu model spec (a
+    flax/``Module``-like object or a ``PipelineModule``); returns a tuple of
+    ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    """
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+    from deepspeed_tpu.utils.logging import log_dist
+
+    log_dist(f"DeepSpeedTPU info: version={__version__}", ranks=[0])
+
+    if isinstance(model, PipelineModule):
+        engine = PipelineEngine(
+            args=args,
+            model=model,
+            optimizer=optimizer,
+            model_parameters=model_parameters,
+            training_data=training_data,
+            lr_scheduler=lr_scheduler,
+            mpu=model.mpu(),
+            dist_init_required=dist_init_required,
+            collate_fn=collate_fn,
+            config=config,
+            config_params=config_params,
+        )
+    else:
+        engine = DeepSpeedEngine(
+            args=args,
+            model=model,
+            optimizer=optimizer,
+            model_parameters=model_parameters,
+            training_data=training_data,
+            lr_scheduler=lr_scheduler,
+            mpu=mpu,
+            dist_init_required=dist_init_required,
+            collate_fn=collate_fn,
+            config=config,
+            config_params=config_params,
+        )
+
+    return_items = [engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler]
+    return tuple(return_items)
+
+
+def add_config_arguments(parser):
+    """Add DeepSpeed-style arguments to an argparse parser
+    (parity: reference deepspeed/__init__.py:193 and :142-190)."""
+    group = parser.add_argument_group("DeepSpeedTPU", "DeepSpeedTPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeedTPU (helper flag for user code, no impact on library behavior)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeedTPU json configuration.")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated enable flag (kept for config compatibility)")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated config path (kept for config compatibility)")
+    group.add_argument("--deepspeed_mpi", default=False, action="store_true",
+                       help="Run via MPI; this flag will cause distributed env discovery through MPI.")
+    return parser
+
+
+def init_distributed(dist_backend=None, auto_mpi_discovery=True, distributed_port=None,
+                     verbose=True, timeout=None, init_method=None):
+    from deepspeed_tpu.utils.distributed import init_distributed as _init
+    return _init(dist_backend=dist_backend, auto_mpi_discovery=auto_mpi_discovery,
+                 distributed_port=distributed_port, verbose=verbose)

@@ -1,0 +1,16 @@
+from deepspeed_tpu.elasticity.elasticity import (
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+    get_candidate_batch_sizes,
+    get_valid_gpus,
+    get_best_candidates,
+    _get_compatible_gpus_v01,
+    HCN_LIST,
+)
+from deepspeed_tpu.elasticity.config import (
+    ElasticityConfig,
+    ElasticityError,
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+)

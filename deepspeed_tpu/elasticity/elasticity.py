@@ -1,0 +1,285 @@
+"""Elastic batch-size algebra.
+
+Capability parity with the reference's ``deepspeed/elasticity/elasticity.py``:
+compute a total train batch size that stays valid across many accelerator
+counts, from ``{max_train_batch_size, micro_batch_sizes, min_gpus, max_gpus}``,
+using highly-composite-number candidates (reference elasticity.py:19-171), plus
+a consistency check against a scheduler-provided config in the
+``DEEPSPEED_ELASTICITY_CONFIG`` env var (reference elasticity.py:207-237).
+
+All functions are pure math — no device code — and are shared by the config
+system, the ``ds_elastic`` CLI, and tests.
+"""
+
+import json
+import os
+
+from deepspeed_tpu.elasticity.config import (
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+)
+from deepspeed_tpu.elasticity.constants import (
+    ELASTICITY,
+    ENABLED,
+    ENABLED_DEFAULT,
+    LATEST_ELASTICITY_VERSION,
+    MINIMUM_DEEPSPEED_VERSION,
+    DEEPSPEED_ELASTICITY_CONFIG,
+)
+from deepspeed_tpu.utils.logging import logger
+
+# Highly composite numbers list: these have the most divisors of any number
+# below them, so a batch built from them divides evenly across the most
+# accelerator counts (same candidate-generation idea as the reference).
+HCN_LIST = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260, 1680,
+    2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360, 50400, 55440,
+    83160, 110880, 166320, 221760, 277200, 332640, 498960, 554400, 665280,
+    720720, 1081080, 1441440, 2162160, 2882880, 3603600, 4324320, 6486480,
+    7207200, 8648640, 10810800, 14414400, 17297280, 21621600, 32432400,
+]
+
+
+def get_candidate_batch_sizes(base_list, max_acceptable_batch_size):
+    """For each micro batch, the largest HCN multiple that fits the cap."""
+    candidate_batch_size = []
+    for base in base_list:
+        if base >= max_acceptable_batch_size:
+            candidate_batch_size.append(base)
+        else:
+            value = max_acceptable_batch_size // base
+            index = _find_index_nearest_below(HCN_LIST, value)
+            candidate_batch_size.append(HCN_LIST[index] * base)
+    return list(set(candidate_batch_size))
+
+
+def _find_index_nearest_below(sorted_list, target):
+    """Index of the largest element <= target (list is sorted ascending)."""
+    lo, hi = 0, len(sorted_list) - 1
+    best = 0
+    while lo <= hi:
+        mid = (lo + hi) // 2
+        if sorted_list[mid] <= target:
+            best = mid
+            lo = mid + 1
+        else:
+            hi = mid - 1
+    return best
+
+
+def get_valid_gpus(batch_size, micro_batches, min_valid_gpus, max_valid_gpus):
+    """All accelerator counts in range that evenly consume ``batch_size``."""
+    valid_gpus = []
+    for micro_batch in micro_batches:
+        if batch_size % micro_batch == 0:
+            max_gpus = batch_size // micro_batch
+            if max_gpus >= min_valid_gpus and max_gpus <= max_valid_gpus:
+                valid_gpus.append(max_gpus)
+            for i in range(1, max_gpus // 2 + 1):
+                if max_gpus % i == 0:
+                    if i >= min_valid_gpus and i <= max_valid_gpus:
+                        valid_gpus.append(i)
+    valid_gpus = set(valid_gpus)
+    valid_gpus = sorted(list(valid_gpus))
+    return valid_gpus
+
+
+def get_best_candidates(candidate_batch_sizes, micro_batches, min_gpus, max_gpus, prefer_larger):
+    max_valid_gpus = 0
+    valid_gpus = None
+    final_batch_size = int(min(micro_batches))
+
+    for batch_size in candidate_batch_sizes:
+        current_valid_gpus = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
+        if len(current_valid_gpus) > max_valid_gpus or (
+            len(current_valid_gpus) == max_valid_gpus
+            and (
+                (prefer_larger and batch_size > final_batch_size)
+                or (not prefer_larger and batch_size < final_batch_size)
+            )
+        ):
+            max_valid_gpus = len(current_valid_gpus)
+            valid_gpus = current_valid_gpus
+            final_batch_size = batch_size
+
+    return final_batch_size, valid_gpus
+
+
+def _get_compatible_gpus_v01(
+    micro_batches, max_acceptable_batch_size, min_gpus=None, max_gpus=None, prefer_larger=True
+):
+    """Get valid accelerator counts (and the final batch size) for an elastic config.
+
+    Returns (final_batch_size, valid_gpus).
+    """
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ValueError(
+            f"All micro batches must be less than or equal to max_acceptable_batch_size: {max_acceptable_batch_size}"
+        )
+
+    # Also consider the LCM of the micro batches as a candidate base: a batch
+    # built on it is divisible by every configured micro batch at once.
+    lcm = _lcm_list(micro_batches)
+    base_list = list(micro_batches)
+    if lcm <= max_acceptable_batch_size:
+        base_list.append(lcm)
+
+    candidate_batch_sizes = get_candidate_batch_sizes(base_list, max_acceptable_batch_size)
+    final_batch, valid_gpus = get_best_candidates(
+        candidate_batch_sizes, micro_batches, min_gpus, max_gpus, prefer_larger
+    )
+    if valid_gpus is None or len(valid_gpus) == 0:
+        raise ElasticityError(
+            "Unable to find any valid accelerator counts for the given elastic config: "
+            f"micro_batches={micro_batches}, max_acceptable_batch_size={max_acceptable_batch_size}, "
+            f"min_gpus={min_gpus}, max_gpus={max_gpus}"
+        )
+    return final_batch, valid_gpus
+
+
+def _lcm_list(values):
+    from math import gcd
+
+    lcm = 1
+    for v in values:
+        lcm = lcm * v // gcd(lcm, v)
+    return lcm
+
+
+def _parse_version(version_str):
+    parts = str(version_str).split(".")
+    return tuple(int("".join(c for c in p if c.isdigit()) or 0) for p in parts[:3])
+
+
+def elasticity_enabled(ds_config):
+    if ELASTICITY not in ds_config:
+        return False
+    return ds_config[ELASTICITY].get(ENABLED, ENABLED_DEFAULT)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict):
+    """If the resource scheduler exported an elastic config via env, the runtime
+    config must match it exactly (reference elasticity.py:207-237)."""
+    if DEEPSPEED_ELASTICITY_CONFIG not in os.environ:
+        logger.warning(
+            f"Unable to find {DEEPSPEED_ELASTICITY_CONFIG} environment variable, "
+            "cannot guarantee resource scheduler will scale this job using compatible accelerator counts."
+        )
+    if DEEPSPEED_ELASTICITY_CONFIG in os.environ:
+        scheduler_elastic_config_dict = json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG])
+        scheduler_elastic_config = ElasticityConfig(scheduler_elastic_config_dict)
+        runtime_elastic_config = ElasticityConfig(runtime_elastic_config_dict)
+        err_str = (
+            "Elastic config '{}={}' seems to have changed since run was launched. "
+            "Scheduler saw '{}={}' but runtime now sees '{}={}'"
+        )
+        if runtime_elastic_config.max_acceptable_batch_size != scheduler_elastic_config.max_acceptable_batch_size:
+            raise ElasticityConfigError(
+                err_str.format(
+                    "max_acceptable_batch_size",
+                    runtime_elastic_config.max_acceptable_batch_size,
+                    "max_acceptable_batch_size",
+                    scheduler_elastic_config.max_acceptable_batch_size,
+                    "max_acceptable_batch_size",
+                    runtime_elastic_config.max_acceptable_batch_size,
+                )
+            )
+        if runtime_elastic_config.micro_batches != scheduler_elastic_config.micro_batches:
+            raise ElasticityConfigError(
+                err_str.format(
+                    "micro_batches",
+                    runtime_elastic_config.micro_batches,
+                    "micro_batches",
+                    scheduler_elastic_config.micro_batches,
+                    "micro_batches",
+                    runtime_elastic_config.micro_batches,
+                )
+            )
+        if runtime_elastic_config.version != scheduler_elastic_config.version:
+            raise ElasticityConfigError(
+                err_str.format(
+                    "version",
+                    runtime_elastic_config.version,
+                    "version",
+                    scheduler_elastic_config.version,
+                    "version",
+                    runtime_elastic_config.version,
+                )
+            )
+
+
+def compute_elastic_config(ds_config, target_deepspeed_version, world_size=0):
+    """Core elastic-config computation.
+
+    Args:
+        ds_config: full config dict containing an ``elasticity`` section.
+        target_deepspeed_version: version string of this library (compat check).
+        world_size: if nonzero, also validate/choose a micro batch for it.
+
+    Returns:
+        (final_batch_size, valid_gpus[, micro_batch_size if world_size given])
+    """
+    if not isinstance(ds_config, dict):
+        raise ValueError("Expected ds_config to be a dictionary but received " f"a {type(ds_config)}, containing: {ds_config}")
+
+    if ELASTICITY not in ds_config:
+        raise ElasticityConfigError(
+            f"'{ELASTICITY}' is missing from config json, please add it if running an elastic training job."
+        )
+
+    elastic_config_dict = ds_config[ELASTICITY]
+    if not elasticity_enabled(ds_config):
+        raise ElasticityError("Elasticity is not enabled, please enable it in the config")
+
+    elastic_config = ElasticityConfig(elastic_config_dict)
+
+    if float(elastic_config.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(
+            "Attempting to run elasticity version "
+            f"{elastic_config.version} but runtime only supports up "
+            f"to {LATEST_ELASTICITY_VERSION}"
+        )
+
+    if _parse_version(target_deepspeed_version) < _parse_version(MINIMUM_DEEPSPEED_VERSION):
+        raise ElasticityError(
+            f"Unable to run elasticity on target deepspeed version of "
+            f"{target_deepspeed_version}, currently {MINIMUM_DEEPSPEED_VERSION} is minimum version supported."
+        )
+
+    if float(elastic_config.version) == 0.1:
+        final_batch_size, valid_gpus = _get_compatible_gpus_v01(
+            micro_batches=elastic_config.micro_batches,
+            max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,
+            min_gpus=elastic_config.min_gpus,
+            max_gpus=elastic_config.max_gpus,
+            prefer_larger=elastic_config.prefer_larger_batch_size,
+        )
+        final_batch_size = int(final_batch_size)
+    else:
+        raise NotImplementedError(f"Unable to find elastic logic for version: {elastic_config.version}")
+
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"World size ({world_size}) is not valid with the current list of valid accelerator counts: {valid_gpus}"
+            )
+        # Pick the best-fitting micro batch for this world size.
+        micro_batch_size = None
+        sorted_micro_batches = sorted(elastic_config.micro_batches, reverse=elastic_config.prefer_larger_batch_size)
+        for mbsz in sorted_micro_batches:
+            if final_batch_size // world_size % mbsz == 0:
+                micro_batch_size = mbsz
+                break
+        assert micro_batch_size is not None, (
+            "Unable to find divisible micro batch size"
+            f" world_size={world_size}, final_batch_size={final_batch_size}, and "
+            f" micro_batches={elastic_config.micro_batches}."
+        )
+        return final_batch_size, valid_gpus, micro_batch_size
+
+    return final_batch_size, valid_gpus

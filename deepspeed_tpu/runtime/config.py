@@ -1,0 +1,548 @@
+"""Top-level typed config.
+
+Capability parity with the reference's ``deepspeed/runtime/config.py``
+(``DeepSpeedConfig(json_file, mpu=None, param_dict=None)``): JSON file or dict in,
+typed config out; validates/infers the batch-size triple
+``train_batch = micro_batch x grad_accum x dp_world_size`` (reference
+config.py:655-721); sub-configs for ZeRO, activation checkpointing, flops
+profiler; sparse-attention mode dispatch (config.py:192-213); pipeline section
+(config.py:363-374); elasticity override of batch params (config.py:538-588).
+
+The ``world_size`` here is the *data-parallel* world size: number of mesh devices
+divided by model- and pipeline-parallel degrees.
+"""
+
+import json
+import os
+
+from deepspeed_tpu.runtime.constants import *
+from deepspeed_tpu.runtime.config_utils import (
+    get_scalar_param,
+    dict_raise_error_on_duplicate_keys,
+)
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.constants import (
+    ZERO_OPTIMIZATION_DISABLED,
+    MAX_STAGE_ZERO_OPTIMIZATION,
+)
+from deepspeed_tpu.runtime.activation_checkpointing.config import DeepSpeedActivationCheckpointingConfig
+from deepspeed_tpu.profiling.config import DeepSpeedFlopsProfilerConfig
+from deepspeed_tpu.utils.logging import logger
+
+TENSOR_CORE_ALIGN_SIZE = 8
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+def get_fp16_enabled(param_dict):
+    if FP16 in param_dict:
+        return get_scalar_param(param_dict[FP16], FP16_ENABLED, FP16_ENABLED_DEFAULT)
+    return False
+
+
+def get_bfloat16_enabled(param_dict):
+    if BFLOAT16 in param_dict:
+        return get_scalar_param(param_dict[BFLOAT16], BFLOAT16_ENABLED, BFLOAT16_ENABLED_DEFAULT)
+    return False
+
+
+def get_loss_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        return get_scalar_param(param_dict[FP16], FP16_LOSS_SCALE, FP16_LOSS_SCALE_DEFAULT)
+    return FP16_LOSS_SCALE_DEFAULT
+
+
+def get_initial_dynamic_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        initial_scale_power = get_scalar_param(
+            param_dict[FP16], FP16_INITIAL_SCALE_POWER, FP16_INITIAL_SCALE_POWER_DEFAULT
+        )
+    else:
+        initial_scale_power = FP16_INITIAL_SCALE_POWER_DEFAULT
+    return 2**initial_scale_power
+
+
+def get_dynamic_loss_scale_args(param_dict):
+    loss_scale_args = None
+    if get_fp16_enabled(param_dict):
+        fp16_dict = param_dict[FP16]
+        dynamic_props = [FP16_INITIAL_SCALE_POWER, FP16_LOSS_SCALE_WINDOW, FP16_MIN_LOSS_SCALE, FP16_HYSTERESIS]
+        if any(d in fp16_dict for d in dynamic_props):
+            init_scale = get_scalar_param(fp16_dict, FP16_INITIAL_SCALE_POWER, FP16_INITIAL_SCALE_POWER_DEFAULT)
+            scale_window = get_scalar_param(fp16_dict, FP16_LOSS_SCALE_WINDOW, FP16_LOSS_SCALE_WINDOW_DEFAULT)
+            delayed_shift = get_scalar_param(fp16_dict, FP16_HYSTERESIS, FP16_HYSTERESIS_DEFAULT)
+            min_loss_scale = get_scalar_param(fp16_dict, FP16_MIN_LOSS_SCALE, FP16_MIN_LOSS_SCALE_DEFAULT)
+            loss_scale_args = {
+                "init_scale": 2**init_scale,
+                "scale_window": scale_window,
+                "delayed_shift": delayed_shift,
+                "min_scale": min_loss_scale,
+            }
+    return loss_scale_args
+
+
+def get_amp_enabled(param_dict):
+    if AMP in param_dict:
+        return get_scalar_param(param_dict[AMP], AMP_ENABLED, AMP_ENABLED_DEFAULT)
+    return False
+
+
+def get_amp_params(param_dict):
+    if AMP in param_dict:
+        amp_params = dict(param_dict[AMP])
+        amp_params.pop(AMP_ENABLED, None)
+        return amp_params
+    return False
+
+
+def get_gradient_accumulation_steps(param_dict):
+    return get_scalar_param(param_dict, GRADIENT_ACCUMULATION_STEPS, GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+
+
+def get_sparse_gradients_enabled(param_dict):
+    return get_scalar_param(param_dict, SPARSE_GRADIENTS, SPARSE_GRADIENTS_DEFAULT)
+
+
+def get_zero_optimization(param_dict):
+    return get_scalar_param(param_dict, "zero_optimization", None) is not None
+
+
+def get_gradient_clipping(param_dict):
+    return get_scalar_param(param_dict, GRADIENT_CLIPPING, GRADIENT_CLIPPING_DEFAULT)
+
+
+def get_sparse_attention(param_dict):
+    if SPARSE_ATTENTION in param_dict:
+        sparsity = param_dict[SPARSE_ATTENTION]
+        mode = get_scalar_param(sparsity, SPARSE_MODE, SPARSE_MODE_DEFAULT)
+        if mode == SPARSE_DENSE_MODE:
+            return get_sparse_dense_config(sparsity)
+        elif mode == SPARSE_FIXED_MODE:
+            return get_sparse_fixed_config(sparsity)
+        elif mode == SPARSE_VARIABLE_MODE:
+            return get_sparse_variable_config(sparsity)
+        elif mode == SPARSE_BIGBIRD_MODE:
+            return get_sparse_bigbird_config(sparsity)
+        elif mode == SPARSE_BSLONGFORMER_MODE:
+            return get_sparse_bslongformer_config(sparsity)
+        else:
+            raise NotImplementedError(f"Given sparsity mode, {mode}, has not been implemented yet!")
+    return None
+
+
+def get_sparse_dense_config(sparsity):
+    block = get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT)
+    return {SPARSE_MODE: SPARSE_DENSE_MODE, SPARSE_BLOCK: block}
+
+
+def get_sparse_fixed_config(sparsity):
+    return {
+        SPARSE_MODE: SPARSE_FIXED_MODE,
+        SPARSE_BLOCK: get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT),
+        SPARSE_DIFFERENT_LAYOUT_PER_HEAD: get_scalar_param(
+            sparsity, SPARSE_DIFFERENT_LAYOUT_PER_HEAD, SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT
+        ),
+        SPARSE_NUM_LOCAL_BLOCKS: get_scalar_param(sparsity, SPARSE_NUM_LOCAL_BLOCKS, SPARSE_NUM_LOCAL_BLOCKS_DEFAULT),
+        SPARSE_NUM_GLOBAL_BLOCKS: get_scalar_param(sparsity, SPARSE_NUM_GLOBAL_BLOCKS, SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+        SPARSE_ATTENTION_TYPE: get_scalar_param(sparsity, SPARSE_ATTENTION_TYPE, SPARSE_ATTENTION_TYPE_DEFAULT),
+        SPARSE_HORIZONTAL_GLOBAL_ATTENTION: get_scalar_param(
+            sparsity, SPARSE_HORIZONTAL_GLOBAL_ATTENTION, SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT
+        ),
+        SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS: get_scalar_param(
+            sparsity, SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS, SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS_DEFAULT
+        ),
+    }
+
+
+def get_sparse_variable_config(sparsity):
+    return {
+        SPARSE_MODE: SPARSE_VARIABLE_MODE,
+        SPARSE_BLOCK: get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT),
+        SPARSE_DIFFERENT_LAYOUT_PER_HEAD: get_scalar_param(
+            sparsity, SPARSE_DIFFERENT_LAYOUT_PER_HEAD, SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT
+        ),
+        SPARSE_NUM_RANDOM_BLOCKS: get_scalar_param(sparsity, SPARSE_NUM_RANDOM_BLOCKS, SPARSE_NUM_RANDOM_BLOCKS_DEFAULT),
+        SPARSE_LOCAL_WINDOW_BLOCKS: get_scalar_param(
+            sparsity, SPARSE_LOCAL_WINDOW_BLOCKS, SPARSE_LOCAL_WINDOW_BLOCKS_DEFAULT
+        ),
+        SPARSE_GLOBAL_BLOCK_INDICES: get_scalar_param(
+            sparsity, SPARSE_GLOBAL_BLOCK_INDICES, SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT
+        ),
+        SPARSE_GLOBAL_BLOCK_END_INDICES: get_scalar_param(
+            sparsity, SPARSE_GLOBAL_BLOCK_END_INDICES, SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT
+        ),
+        SPARSE_ATTENTION_TYPE: get_scalar_param(sparsity, SPARSE_ATTENTION_TYPE, SPARSE_ATTENTION_TYPE_DEFAULT),
+        SPARSE_HORIZONTAL_GLOBAL_ATTENTION: get_scalar_param(
+            sparsity, SPARSE_HORIZONTAL_GLOBAL_ATTENTION, SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT
+        ),
+    }
+
+
+def get_sparse_bigbird_config(sparsity):
+    return {
+        SPARSE_MODE: SPARSE_BIGBIRD_MODE,
+        SPARSE_BLOCK: get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT),
+        SPARSE_DIFFERENT_LAYOUT_PER_HEAD: get_scalar_param(
+            sparsity, SPARSE_DIFFERENT_LAYOUT_PER_HEAD, SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT
+        ),
+        SPARSE_NUM_RANDOM_BLOCKS: get_scalar_param(sparsity, SPARSE_NUM_RANDOM_BLOCKS, SPARSE_NUM_RANDOM_BLOCKS_DEFAULT),
+        SPARSE_NUM_SLIDING_WINDOW_BLOCKS: get_scalar_param(
+            sparsity, SPARSE_NUM_SLIDING_WINDOW_BLOCKS, SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT
+        ),
+        SPARSE_NUM_GLOBAL_BLOCKS: get_scalar_param(sparsity, SPARSE_NUM_GLOBAL_BLOCKS, SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+    }
+
+
+def get_sparse_bslongformer_config(sparsity):
+    return {
+        SPARSE_MODE: SPARSE_BSLONGFORMER_MODE,
+        SPARSE_BLOCK: get_scalar_param(sparsity, SPARSE_BLOCK, SPARSE_BLOCK_DEFAULT),
+        SPARSE_DIFFERENT_LAYOUT_PER_HEAD: get_scalar_param(
+            sparsity, SPARSE_DIFFERENT_LAYOUT_PER_HEAD, SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT
+        ),
+        SPARSE_NUM_SLIDING_WINDOW_BLOCKS: get_scalar_param(
+            sparsity, SPARSE_NUM_SLIDING_WINDOW_BLOCKS, SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT
+        ),
+        SPARSE_GLOBAL_BLOCK_INDICES: get_scalar_param(
+            sparsity, SPARSE_GLOBAL_BLOCK_INDICES, SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT
+        ),
+        SPARSE_GLOBAL_BLOCK_END_INDICES: get_scalar_param(
+            sparsity, SPARSE_GLOBAL_BLOCK_END_INDICES, SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT
+        ),
+    }
+
+
+def get_pipeline_config(param_dict):
+    """Pipeline section with defaults (reference config.py:363-374)."""
+    pipeline = {
+        PIPELINE_STAGES: PIPELINE_STAGES_DEFAULT,
+        PIPELINE_PARTITION: PIPELINE_PARTITION_DEFAULT,
+        PIPELINE_SEED_LAYERS: PIPELINE_SEED_LAYERS_DEFAULT,
+        PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL: PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT,
+    }
+    if PIPELINE in param_dict:
+        pipeline.update(param_dict[PIPELINE])
+    return pipeline
+
+
+def get_optimizer_name(param_dict):
+    if OPTIMIZER in param_dict and TYPE in param_dict[OPTIMIZER]:
+        return param_dict[OPTIMIZER][TYPE]
+    return OPTIMIZER_TYPE_DEFAULT
+
+
+def get_optimizer_params(param_dict):
+    if get_optimizer_name(param_dict) is not None and OPTIMIZER_PARAMS in param_dict[OPTIMIZER]:
+        return param_dict[OPTIMIZER][OPTIMIZER_PARAMS]
+    return None
+
+
+def get_optimizer_gradient_clipping(param_dict):
+    optimizer_params = get_optimizer_params(param_dict)
+    if optimizer_params is not None and MAX_GRAD_NORM in optimizer_params:
+        return optimizer_params[MAX_GRAD_NORM]
+    return None
+
+
+def get_optimizer_legacy_fusion(param_dict):
+    if OPTIMIZER in param_dict and LEGACY_FUSION in param_dict[OPTIMIZER]:
+        return param_dict[OPTIMIZER][LEGACY_FUSION]
+    return LEGACY_FUSION_DEFAULT
+
+
+def get_scheduler_name(param_dict):
+    if SCHEDULER in param_dict and TYPE in param_dict[SCHEDULER]:
+        return param_dict[SCHEDULER][TYPE]
+    return SCHEDULER_TYPE_DEFAULT
+
+
+def get_scheduler_params(param_dict):
+    if get_scheduler_name(param_dict) is not None and SCHEDULER_PARAMS in param_dict[SCHEDULER]:
+        return param_dict[SCHEDULER][SCHEDULER_PARAMS]
+    return None
+
+
+def get_train_batch_size(param_dict):
+    return get_scalar_param(param_dict, TRAIN_BATCH_SIZE, TRAIN_BATCH_SIZE_DEFAULT)
+
+
+def get_train_micro_batch_size_per_gpu(param_dict):
+    return get_scalar_param(param_dict, TRAIN_MICRO_BATCH_SIZE_PER_GPU, TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+
+
+def get_wall_clock_breakdown(param_dict):
+    return get_scalar_param(param_dict, WALL_CLOCK_BREAKDOWN, WALL_CLOCK_BREAKDOWN_DEFAULT)
+
+
+def get_memory_breakdown(param_dict):
+    return get_scalar_param(param_dict, MEMORY_BREAKDOWN, MEMORY_BREAKDOWN_DEFAULT)
+
+
+def get_tensorboard_enabled(param_dict):
+    if TENSORBOARD in param_dict:
+        return get_scalar_param(param_dict[TENSORBOARD], TENSORBOARD_ENABLED, TENSORBOARD_ENABLED_DEFAULT)
+    return False
+
+
+def get_tensorboard_output_path(param_dict):
+    if get_tensorboard_enabled(param_dict):
+        return get_scalar_param(param_dict[TENSORBOARD], TENSORBOARD_OUTPUT_PATH, TENSORBOARD_OUTPUT_PATH_DEFAULT)
+    return TENSORBOARD_OUTPUT_PATH_DEFAULT
+
+
+def get_tensorboard_job_name(param_dict):
+    if get_tensorboard_enabled(param_dict):
+        return get_scalar_param(param_dict[TENSORBOARD], TENSORBOARD_JOB_NAME, TENSORBOARD_JOB_NAME_DEFAULT)
+    return TENSORBOARD_JOB_NAME_DEFAULT
+
+
+def get_progressive_layer_drop(param_dict):
+    pld_dict = param_dict.get(PROGRESSIVE_LAYER_DROP, {})
+    enabled = get_scalar_param(pld_dict, PLD_ENABLED, PLD_ENABLED_DEFAULT)
+    theta = get_scalar_param(pld_dict, PLD_THETA, PLD_THETA_DEFAULT)
+    gamma = get_scalar_param(pld_dict, PLD_GAMMA, PLD_GAMMA_DEFAULT)
+    return enabled, theta, gamma
+
+
+class DeepSpeedConfig:
+    def __init__(self, json_file_or_dict, mpu=None, param_dict=None, world_size=None):
+        if param_dict is None:
+            if isinstance(json_file_or_dict, dict):
+                self._param_dict = json_file_or_dict
+            else:
+                if not os.path.exists(json_file_or_dict):
+                    raise DeepSpeedConfigError(f"DeepSpeed config file not found: {json_file_or_dict}")
+                with open(json_file_or_dict, "r") as f:
+                    self._param_dict = json.load(f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        else:
+            self._param_dict = param_dict
+
+        # Data-parallel world size: devices / (model_parallel * pipe_parallel).
+        if world_size is not None:
+            self.world_size = world_size
+        elif mpu is not None:
+            self.world_size = mpu.get_data_parallel_world_size()
+        else:
+            try:
+                import jax
+
+                self.world_size = jax.device_count()
+            except Exception:
+                self.world_size = 1
+
+        # Elasticity may override batch parameters before inference runs.
+        self.elasticity_enabled = False
+        self._configure_elasticity()
+
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    def _configure_elasticity(self):
+        from deepspeed_tpu.elasticity import (
+            elasticity_enabled,
+            compute_elastic_config,
+            ensure_immutable_elastic_config,
+        )
+        from deepspeed_tpu.elasticity.constants import (
+            ELASTICITY,
+            IGNORE_NON_ELASTIC_BATCH_INFO,
+            IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT,
+        )
+        from deepspeed_tpu.version import __version__
+
+        if not elasticity_enabled(self._param_dict):
+            return
+
+        elastic_dict = self._param_dict[ELASTICITY]
+        ensure_immutable_elastic_config(runtime_elastic_config_dict=elastic_dict)
+
+        self.elastic_model_parallel_size = elastic_dict.get("model_parallel_size", 1)
+        self.num_gpus_per_node = elastic_dict.get("num_gpus_per_node", 1)
+
+        ignore_non_elastic_batch_info = elastic_dict.get(
+            IGNORE_NON_ELASTIC_BATCH_INFO, IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT
+        )
+        if not ignore_non_elastic_batch_info:
+            batch_params = [TRAIN_BATCH_SIZE, TRAIN_MICRO_BATCH_SIZE_PER_GPU, GRADIENT_ACCUMULATION_STEPS]
+            if any(p in self._param_dict for p in batch_params):
+                from deepspeed_tpu.elasticity.config import ElasticityConfigError
+
+                raise ElasticityConfigError(
+                    "One or more batch related parameters were found in your ds_config "
+                    f"({TRAIN_BATCH_SIZE}, {TRAIN_MICRO_BATCH_SIZE_PER_GPU}, and/or "
+                    f"{GRADIENT_ACCUMULATION_STEPS}). These parameters *will not be used* since elastic "
+                    "training is enabled, which takes control of these parameters. "
+                    f"If you want to suppress this error (the parameters will be silently ignored) "
+                    f'please set "{IGNORE_NON_ELASTIC_BATCH_INFO}":true in your elasticity config.'
+                )
+
+        final_batch_size, valid_gpus, micro_batch_size = compute_elastic_config(
+            ds_config=self._param_dict, target_deepspeed_version=__version__, world_size=self.world_size
+        )
+        self.elastic_valid_gpus = valid_gpus
+
+        self._param_dict[TRAIN_BATCH_SIZE] = final_batch_size
+        self._param_dict[TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch_size
+        self._param_dict[GRADIENT_ACCUMULATION_STEPS] = final_batch_size // (micro_batch_size * self.world_size)
+        self.elasticity_enabled = True
+
+    def _initialize_params(self, param_dict):
+        self.train_batch_size = get_train_batch_size(param_dict)
+        self.train_micro_batch_size_per_gpu = get_train_micro_batch_size_per_gpu(param_dict)
+        self.gradient_accumulation_steps = get_gradient_accumulation_steps(param_dict)
+        self.steps_per_print = get_scalar_param(param_dict, STEPS_PER_PRINT, STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get_scalar_param(param_dict, DUMP_STATE, DUMP_STATE_DEFAULT)
+
+        self.disable_allgather = get_scalar_param(param_dict, DISABLE_ALLGATHER, DISABLE_ALLGATHER_DEFAULT)
+        self.allreduce_always_fp32 = get_scalar_param(param_dict, ALLREDUCE_ALWAYS_FP32, ALLREDUCE_ALWAYS_FP32_DEFAULT)
+        self.prescale_gradients = get_scalar_param(param_dict, PRESCALE_GRADIENTS, PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = get_scalar_param(
+            param_dict, GRADIENT_PREDIVIDE_FACTOR, GRADIENT_PREDIVIDE_FACTOR_DEFAULT
+        )
+        self.sparse_gradients_enabled = get_sparse_gradients_enabled(param_dict)
+
+        self.zero_config = DeepSpeedZeroConfig(param_dict)
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > ZERO_OPTIMIZATION_DISABLED
+
+        self.activation_checkpointing_config = DeepSpeedActivationCheckpointingConfig(param_dict)
+        self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
+
+        self.fp16_enabled = get_fp16_enabled(param_dict)
+        self.bfloat16_enabled = get_bfloat16_enabled(param_dict)
+        self.amp_enabled = get_amp_enabled(param_dict)
+        self.amp_params = get_amp_params(param_dict)
+        self.loss_scale = get_loss_scale(param_dict)
+        self.initial_dynamic_scale = get_initial_dynamic_scale(param_dict)
+        self.dynamic_loss_scale_args = get_dynamic_loss_scale_args(param_dict)
+
+        self.gradient_clipping = get_gradient_clipping(param_dict)
+
+        self.optimizer_name = get_optimizer_name(param_dict)
+        if self.optimizer_name is not None and self.optimizer_name.lower() in DEEPSPEED_OPTIMIZERS:
+            self.optimizer_name = self.optimizer_name.lower()
+        self.optimizer_params = get_optimizer_params(param_dict)
+        self.optimizer_legacy_fusion = get_optimizer_legacy_fusion(param_dict)
+
+        self.scheduler_name = get_scheduler_name(param_dict)
+        self.scheduler_params = get_scheduler_params(param_dict)
+
+        self.wall_clock_breakdown = get_wall_clock_breakdown(param_dict)
+        self.memory_breakdown = get_memory_breakdown(param_dict)
+        self.tensorboard_enabled = get_tensorboard_enabled(param_dict)
+        self.tensorboard_output_path = get_tensorboard_output_path(param_dict)
+        self.tensorboard_job_name = get_tensorboard_job_name(param_dict)
+
+        self.sparse_attention = get_sparse_attention(param_dict)
+        self.pipeline = get_pipeline_config(param_dict)
+
+        (
+            self.pld_enabled,
+            self.pld_theta,
+            self.pld_gamma,
+        ) = get_progressive_layer_drop(param_dict)
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal"
+            " to micro_batch_per_gpu * gradient_acc_step * world_size"
+            f" {train_batch} != {micro_batch} * {grad_acc} * {self.world_size}"
+        )
+
+    def _set_batch_related_parameters(self):
+        """Infer missing members of the batch triple (reference config.py:675-721)."""
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        # All three set: just check.
+        if all(x is not None for x in [train_batch, micro_batch, grad_acc]):
+            return
+
+        # Two of three.
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= self.world_size
+            self.gradient_accumulation_steps = grad_acc
+
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // self.world_size
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+
+        elif micro_batch is not None and grad_acc is not None:
+            self.train_batch_size = micro_batch * grad_acc * self.world_size
+
+        # One of three.
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // self.world_size
+
+        elif micro_batch is not None:
+            self.train_batch_size = micro_batch * self.world_size
+            self.gradient_accumulation_steps = 1
+
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu needs to be provided"
+            )
+
+    def _configure_train_batch_size(self):
+        self._set_batch_related_parameters()
+        self._batch_assertion()
+
+    def _do_sanity_check(self):
+        self._do_error_check()
+        self._do_warning_check()
+
+    def _do_error_check(self):
+        if self.fp16_enabled and self.bfloat16_enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 modes cannot both be enabled")
+        assert (
+            self.train_micro_batch_size_per_gpu
+        ), f"DeepSpeedConfig: {TRAIN_MICRO_BATCH_SIZE_PER_GPU} is not defined"
+        assert (
+            self.gradient_accumulation_steps
+        ), f"DeepSpeedConfig: {GRADIENT_ACCUMULATION_STEPS} is not defined"
+        if self.zero_enabled:
+            assert (
+                self.zero_optimization_stage <= MAX_STAGE_ZERO_OPTIMIZATION
+            ), f"DeepSpeedConfig: Maximum supported ZeRO stage is {MAX_STAGE_ZERO_OPTIMIZATION}"
+
+    def _do_warning_check(self):
+        fp16_enabled = self.fp16_enabled or self.zero_enabled
+        vocabulary_size = self._param_dict.get("vocabulary_size", None)
+        if vocabulary_size and vocabulary_size % TENSOR_CORE_ALIGN_SIZE != 0:
+            logger.warning(
+                f"DeepSpeedConfig: vocabulary size {vocabulary_size} is not aligned to "
+                f"{TENSOR_CORE_ALIGN_SIZE}, may import performance penalty"
+            )
+        if self.optimizer_params is not None and MAX_GRAD_NORM in self.optimizer_params and self.optimizer_params[MAX_GRAD_NORM] > 0:
+            if fp16_enabled:
+                logger.warning(
+                    f"DeepSpeedConfig: In FP16 mode, DeepSpeed will pass {MAX_GRAD_NORM}:"
+                    f"{self.optimizer_params[MAX_GRAD_NORM]} to FP16 wrapper"
+                )
+            else:
+                logger.warning(
+                    f"DeepSpeedConfig: In FP32 mode, DeepSpeed does not permit MAX_GRAD_NORM "
+                    "in the optimizer config. Please use gradient_clipping instead."
+                )
+
+    def print(self, name):
+        logger.info(f"{name}:")
+        for arg in sorted(vars(self)):
+            if arg != "_param_dict":
+                dots = "." * (29 - len(arg))
+                logger.info(f"  {arg} {dots} {getattr(self, arg)}")
+        logger.info(f"  json = {json.dumps(self._param_dict, sort_keys=True, indent=4)}")

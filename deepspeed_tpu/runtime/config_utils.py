@@ -1,0 +1,49 @@
+"""Helpers for reading typed scalar/list/dict params out of a raw config dict.
+
+Capability parity with the reference's ``deepspeed/runtime/config_utils.py``.
+"""
+
+import json
+
+
+def get_scalar_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys while parsing JSON (reference config.py:520-523)."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {keys}")
+    return d
+
+
+class ScientificNotationEncoder(json.JSONEncoder):
+    """JSON encoder that renders large numbers in scientific notation, so dumped
+    configs stay readable (e.g. bucket sizes like 5e8)."""
+
+    def iterencode(self, o, _one_shot=False):
+        def reformat(obj):
+            if isinstance(obj, bool):
+                return obj
+            if isinstance(obj, (int, float)) and abs(obj) >= 1e5:
+                return f"{obj:e}"
+            if isinstance(obj, dict):
+                return {k: reformat(v) for k, v in obj.items()}
+            if isinstance(obj, (list, tuple)):
+                return [reformat(v) for v in obj]
+            return obj
+
+        return super().iterencode(reformat(o), _one_shot=_one_shot)

@@ -1,0 +1,88 @@
+"""Typed ZeRO sub-config (parity: reference ``deepspeed/runtime/zero/config.py``).
+
+On TPU, ZeRO stages map to shardings of the flattened fp32 master state along the
+``data`` mesh axis; the bucket-size knobs bound chunked collective sizes.
+"""
+
+from deepspeed_tpu.runtime.config_utils import get_scalar_param
+from deepspeed_tpu.runtime.zero.constants import *
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedZeroConfig:
+    def __init__(self, param_dict):
+        self.stage = None
+        self.contiguous_gradients = None
+        self.reduce_scatter = None
+        self.reduce_bucket_size = None
+        self.allgather_partitions = None
+        self.allgather_bucket_size = None
+        self.overlap_comm = None
+        self.cpu_offload = None
+        self.elastic_checkpoint = None
+
+        if ZERO_OPTIMIZATION in param_dict:
+            zero_config_dict = param_dict[ZERO_OPTIMIZATION]
+            if isinstance(zero_config_dict, bool):
+                zero_config_dict = self.read_zero_config_deprecated(param_dict)
+        else:
+            zero_config_dict = ZERO_OPTIMIZATION_DEFAULT
+        self._initialize(zero_config_dict)
+
+    def read_zero_config_deprecated(self, param_dict):
+        zero_config_dict = {}
+        zero_config_dict[ZERO_OPTIMIZATION_STAGE] = 1 if param_dict[ZERO_OPTIMIZATION] else 0
+        if zero_config_dict[ZERO_OPTIMIZATION_STAGE] > 0:
+            zero_config_dict[ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE] = get_scalar_param(
+                param_dict,
+                ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED,
+                ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT,
+            )
+        logger.warning(
+            "DeepSpeedConfig: this format of ZeRO optimization setup is deprecated. "
+            f"Please use the following format: {ZERO_FORMAT}"
+        )
+        return zero_config_dict
+
+    def _initialize(self, zero_config_dict):
+        self.stage = get_scalar_param(zero_config_dict, ZERO_OPTIMIZATION_STAGE, ZERO_OPTIMIZATION_STAGE_DEFAULT)
+        self.contiguous_gradients = get_scalar_param(
+            zero_config_dict, ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS, ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS_DEFAULT
+        )
+        self.reduce_bucket_size = get_scalar_param(
+            zero_config_dict, ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE, ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE_DEFAULT
+        )
+        self.reduce_scatter = get_scalar_param(
+            zero_config_dict, ZERO_OPTIMIZATION_REDUCE_SCATTER, ZERO_OPTIMIZATION_REDUCE_SCATTER_DEFAULT
+        )
+        self.overlap_comm = get_scalar_param(
+            zero_config_dict, ZERO_OPTIMIZATION_OVERLAP_COMM, ZERO_OPTIMIZATION_OVERLAP_COMM_DEFAULT
+        )
+        self.allgather_partitions = get_scalar_param(
+            zero_config_dict, ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS, ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS_DEFAULT
+        )
+        self.allgather_bucket_size = get_scalar_param(
+            zero_config_dict, ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE, ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT
+        )
+        self.cpu_offload = get_scalar_param(
+            zero_config_dict, ZERO_OPTIMIZATION_CPU_OFFLOAD, ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT
+        )
+        self.elastic_checkpoint = get_scalar_param(
+            zero_config_dict, ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT, ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT
+        )
+
+    def repr(self):
+        return dict(
+            stage=self.stage,
+            contiguous_gradients=self.contiguous_gradients,
+            reduce_scatter=self.reduce_scatter,
+            reduce_bucket_size=self.reduce_bucket_size,
+            allgather_partitions=self.allgather_partitions,
+            allgather_bucket_size=self.allgather_bucket_size,
+            overlap_comm=self.overlap_comm,
+            cpu_offload=self.cpu_offload,
+            elastic_checkpoint=self.elastic_checkpoint,
+        )
+
+    def __repr__(self):
+        return str(self.repr())

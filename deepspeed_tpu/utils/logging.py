@@ -1,0 +1,63 @@
+"""Rank-aware logging.
+
+Capability parity with the reference's ``deepspeed/utils/logging.py`` (single
+framework logger plus ``log_dist(msg, ranks)`` rank-filtered logging), built on
+JAX process indices instead of torch.distributed ranks.
+"""
+
+import logging
+import os
+import sys
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+    @staticmethod
+    def create_logger(name=None, level=logging.INFO):
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(filename)s:%(lineno)d:%(funcName)s] %(message)s"
+        )
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(
+    name="DeepSpeedTPU", level=log_levels.get(os.environ.get("DSTPU_LOG_LEVEL", "info"), logging.INFO)
+)
+
+
+def _get_rank():
+    # Prefer the launcher-provided rank (set before jax.distributed init);
+    # fall back to jax.process_index() when jax is already initialized.
+    rank = os.environ.get("RANK")
+    if rank is not None:
+        return int(rank)
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the given process ranks (None or [-1] = all)."""
+    my_rank = _get_rank()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")

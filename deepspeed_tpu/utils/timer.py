@@ -1,0 +1,155 @@
+"""Wall-clock and throughput timers.
+
+Capability parity with the reference's ``deepspeed/utils/timer.py``:
+``SynchronizedWallClockTimer`` (named timers bracketed by device sync, ``.log()``
+prints elapsed ms) and ``ThroughputTimer`` (samples/sec with warmup). Device
+synchronization is a barrier on outstanding JAX async dispatch rather than
+``cuda.synchronize``.
+"""
+
+import time
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _device_sync():
+    try:
+        import jax
+
+        # Block on all outstanding async computations.
+        (jax.device_put(0.0) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Group of named wall-clock timers, each bracketed by a device sync."""
+
+    class Timer:
+        def __init__(self, name):
+            self.name_ = name
+            self.elapsed_ = 0.0
+            self.started_ = False
+            self.start_time = 0.0
+
+        def start(self, sync=True):
+            assert not self.started_, f"timer {self.name_} has already been started"
+            if sync:
+                _device_sync()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, sync=True, reset=False):
+            assert self.started_, f"timer {self.name_} is not started"
+            if sync:
+                _device_sync()
+            if reset:
+                self.elapsed_ = time.time() - self.start_time
+            else:
+                self.elapsed_ += time.time() - self.start_time
+            self.started_ = False
+
+        def reset(self):
+            self.elapsed_ = 0.0
+            self.started_ = False
+
+        def elapsed(self, reset=True):
+            started = self.started_
+            if started:
+                self.stop()
+            elapsed_ = self.elapsed_
+            if reset:
+                self.reset()
+            if started:
+                self.start()
+            return elapsed_
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    @staticmethod
+    def memory_usage():
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0) / (1024**3)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+            return f"MemAllocated={in_use:.3f} GB PeakAllocated={peak:.3f} GB"
+        except Exception:
+            return "MemAllocated=? PeakAllocated=?"
+
+    def log(self, names, normalizer=1.0, reset=True, ranks=None, memory_breakdown=False):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed_time:.2f}"
+        if memory_breakdown:
+            string += " | " + self.memory_usage()
+        log_dist(string, ranks=ranks or [0])
+
+
+class ThroughputTimer:
+    """Samples/sec timer with a warmup window (reference: ThroughputTimer)."""
+
+    def __init__(self, batch_size, num_workers=1, start_step=2, steps_per_output=50, monitor_memory=False, logging_fn=None):
+        self.start_time = 0
+        self.end_time = 0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.num_workers = num_workers
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or print
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self.start_time = time.time()
+
+    def stop(self, report_speed=True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        self.global_step_count += 1
+        if self.start_time > 0:
+            _device_sync()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            if report_speed and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"{self.global_step_count}/{self.micro_step_count}, "
+                    f"SamplesPerSec={self.avg_samples_per_sec():.2f}"
+                )
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            samples_per_step = self.batch_size * self.num_workers
+            total_step_offset = self.global_step_count - self.start_step
+            avg_time_per_step = self.total_elapsed_time / total_step_offset
+            return samples_per_step / avg_time_per_step
+        return float("-inf")

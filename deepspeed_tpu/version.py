@@ -1,0 +1,3 @@
+__version__ = "0.1.0"
+git_hash = None
+git_branch = None

@@ -1,0 +1,182 @@
+"""Config-system tests (model: reference tests/unit/test_config.py + test_ds_config.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def _base_dict():
+    return {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.001}},
+        "fp16": {"enabled": True},
+    }
+
+
+def test_batch_triple_all_given():
+    cfg = DeepSpeedConfig(_base_dict(), world_size=8)
+    assert cfg.train_batch_size == 16
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triple_mismatch_raises():
+    d = _base_dict()
+    d["train_batch_size"] = 32
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(d, world_size=8)
+
+
+def test_infer_grad_acc():
+    d = {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 2}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_infer_micro_batch():
+    d = {"train_batch_size": 64, "gradient_accumulation_steps": 4}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_infer_train_batch():
+    d = {"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 4}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.train_batch_size == 64
+
+
+def test_only_train_batch():
+    d = {"train_batch_size": 64}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 8
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_only_micro_batch():
+    d = {"train_micro_batch_size_per_gpu": 8}
+    cfg = DeepSpeedConfig(d, world_size=4)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_no_batch_info_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=8)
+
+
+def test_json_file_load(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps(_base_dict()))
+    cfg = DeepSpeedConfig(str(p), world_size=8)
+    assert cfg.fp16_enabled is True
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params == {"lr": 0.001}
+
+
+def test_duplicate_json_keys_raise(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), world_size=8)
+
+
+def test_fp16_and_bf16_conflict():
+    d = _base_dict()
+    d["bf16"] = {"enabled": True}
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(d, world_size=8)
+
+
+def test_zero_config_defaults():
+    d = _base_dict()
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.zero_enabled is False
+    assert cfg.zero_optimization_stage == 0
+
+
+def test_zero_stage2_config():
+    d = _base_dict()
+    d["zero_optimization"] = {
+        "stage": 2,
+        "cpu_offload": True,
+        "reduce_bucket_size": 1000000,
+    }
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 2
+    assert cfg.zero_config.cpu_offload is True
+    assert cfg.zero_config.reduce_bucket_size == 1000000
+    assert cfg.zero_config.reduce_scatter is True
+
+
+def test_zero_deprecated_bool_format():
+    d = _base_dict()
+    d["zero_optimization"] = True
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 1
+
+
+def test_dynamic_loss_scale_args():
+    d = _base_dict()
+    d["fp16"] = {
+        "enabled": True,
+        "initial_scale_power": 16,
+        "loss_scale_window": 500,
+        "hysteresis": 4,
+        "min_loss_scale": 0.25,
+    }
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.dynamic_loss_scale_args["init_scale"] == 2**16
+    assert cfg.dynamic_loss_scale_args["scale_window"] == 500
+    assert cfg.dynamic_loss_scale_args["delayed_shift"] == 4
+    assert cfg.dynamic_loss_scale_args["min_scale"] == 0.25
+
+
+def test_static_loss_scale():
+    d = _base_dict()
+    d["fp16"] = {"enabled": True, "loss_scale": 128.0}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.loss_scale == 128.0
+
+
+def test_sparse_attention_fixed_mode():
+    d = _base_dict()
+    d["sparse_attention"] = {"mode": "fixed", "block": 32, "num_local_blocks": 8}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.sparse_attention["mode"] == "fixed"
+    assert cfg.sparse_attention["block"] == 32
+    assert cfg.sparse_attention["num_local_blocks"] == 8
+
+
+def test_sparse_attention_bad_mode():
+    d = _base_dict()
+    d["sparse_attention"] = {"mode": "nonsense"}
+    with pytest.raises(NotImplementedError):
+        DeepSpeedConfig(d, world_size=8)
+
+
+def test_pipeline_section_defaults():
+    cfg = DeepSpeedConfig(_base_dict(), world_size=8)
+    assert cfg.pipeline["stages"] is None
+    assert cfg.pipeline["partition"] == "best"
+
+
+def test_gradient_clipping():
+    d = _base_dict()
+    d["gradient_clipping"] = 1.0
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.gradient_clipping == 1.0
+
+
+def test_pld_config():
+    d = _base_dict()
+    d["progressive_layer_drop"] = {"enabled": True, "theta": 0.5, "gamma": 0.01}
+    cfg = DeepSpeedConfig(d, world_size=8)
+    assert cfg.pld_enabled
+    assert cfg.pld_theta == 0.5
+    assert cfg.pld_gamma == 0.01

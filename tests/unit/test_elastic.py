@@ -1,0 +1,138 @@
+"""Elasticity batch-algebra tests (model: reference tests/unit/test_elastic.py)."""
+
+import pytest
+
+import deepspeed_tpu.elasticity as ds_elasticity
+from deepspeed_tpu.elasticity.config import (
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+)
+from deepspeed_tpu.version import __version__
+
+base_ds_config = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_basic_10k():
+    ds_config = {"elasticity": dict(base_ds_config["elasticity"])}
+    final_batch_size, valid_gpus = ds_elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=__version__
+    )
+    for gpu_num in valid_gpus:
+        assert final_batch_size % gpu_num == 0, f"Batch {final_batch_size} is not divisible by GPU count {gpu_num}"
+        batch_per_gpu = final_batch_size // gpu_num
+        found_valid_mbsize = any(batch_per_gpu % mb == 0 for mb in ds_config["elasticity"]["micro_batch_sizes"])
+        assert found_valid_mbsize, f"No valid mb sizes for batch {batch_per_gpu}"
+
+
+def test_world_size_in_valid_gpus():
+    ds_config = {"elasticity": dict(base_ds_config["elasticity"])}
+    final_batch_size, valid_gpus = ds_elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=__version__
+    )
+    ws = valid_gpus[0]
+    fb, vg, mbsize = ds_elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=__version__, world_size=ws
+    )
+    assert fb == final_batch_size
+    assert (fb // ws) % mbsize == 0
+
+
+def test_invalid_world_size():
+    ds_config = {"elasticity": dict(base_ds_config["elasticity"])}
+    _, valid_gpus = ds_elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=__version__
+    )
+    bad_ws = max(valid_gpus) + 1
+    while bad_ws in valid_gpus:
+        bad_ws += 1
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        ds_elasticity.compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version=__version__, world_size=bad_ws
+        )
+
+
+def test_missing_max_batch():
+    ds_config = {"elasticity": {"enabled": True, "micro_batch_sizes": [1, 2]}}
+    with pytest.raises(ElasticityConfigError):
+        ds_elasticity.compute_elastic_config(ds_config=ds_config, target_deepspeed_version=__version__)
+
+
+def test_missing_micro_batches():
+    ds_config = {"elasticity": {"enabled": True, "max_train_batch_size": 4}}
+    with pytest.raises(ElasticityConfigError):
+        ds_elasticity.compute_elastic_config(ds_config=ds_config, target_deepspeed_version=__version__)
+
+
+def test_non_list_micro_batches():
+    ds_config = {"elasticity": {"enabled": True, "max_train_batch_size": 4, "micro_batch_sizes": 4}}
+    with pytest.raises(ElasticityConfigError):
+        ds_elasticity.compute_elastic_config(ds_config=ds_config, target_deepspeed_version=__version__)
+
+
+def test_future_version_rejected():
+    ds_config = {"elasticity": dict(base_ds_config["elasticity"])}
+    ds_config["elasticity"]["version"] = 0.2
+    with pytest.raises(ElasticityConfigError):
+        ds_elasticity.compute_elastic_config(ds_config=ds_config, target_deepspeed_version=__version__)
+
+
+def test_disabled_raises():
+    ds_config = {"elasticity": dict(base_ds_config["elasticity"])}
+    ds_config["elasticity"]["enabled"] = False
+    with pytest.raises(ds_elasticity.ElasticityError):
+        ds_elasticity.compute_elastic_config(ds_config=ds_config, target_deepspeed_version=__version__)
+
+
+def test_invalid_gpu_ranges():
+    for bad in [{"min_gpus": 0}, {"max_gpus": -1}, {"min_gpus": 100, "max_gpus": 4}]:
+        ds_config = {"elasticity": dict(base_ds_config["elasticity"])}
+        ds_config["elasticity"].update(bad)
+        with pytest.raises(ElasticityConfigError):
+            ds_elasticity.compute_elastic_config(ds_config=ds_config, target_deepspeed_version=__version__)
+
+
+def test_config_batch_params_conflict():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    ds_config = {
+        "train_batch_size": 16,
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 10000,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 8,
+            "version": 0.1,
+        },
+    }
+    with pytest.raises(ElasticityConfigError):
+        DeepSpeedConfig(ds_config, world_size=4)
+
+
+def test_config_elastic_override():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    ds_config = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 10000,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 8,
+            "version": 0.1,
+        },
+    }
+    cfg = DeepSpeedConfig(ds_config, world_size=4)
+    assert cfg.elasticity_enabled
+    assert cfg.train_batch_size % 4 == 0
+    assert cfg.train_batch_size == cfg.train_micro_batch_size_per_gpu * cfg.gradient_accumulation_steps * 4
