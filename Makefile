@@ -1,0 +1,18 @@
+# Developer entry points.
+
+.PHONY: test test-fast ops bench
+
+# Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
+# kept out of test processes (see tests/conftest.py).
+test:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -x -q
+
+test-fast:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -x -q -m "not slow"
+
+ops:
+	$(MAKE) -C csrc
+
+# Benchmark on the real TPU chip (default platform).
+bench:
+	python bench.py

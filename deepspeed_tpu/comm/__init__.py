@@ -1,0 +1,9 @@
+from deepspeed_tpu.comm.comm import (
+    all_reduce,
+    all_gather,
+    reduce_scatter,
+    broadcast,
+    ppermute_send_recv,
+    barrier,
+    ReduceOp,
+)

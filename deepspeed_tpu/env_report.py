@@ -1,0 +1,56 @@
+"""Environment report CLI (parity: reference ``deepspeed/env_report.py`` +
+``bin/ds_report``): op install/compatibility matrix plus jax/TPU topology info
+in place of torch/cuda/nvcc versions."""
+
+from deepspeed_tpu.ops.op_builder import op_report
+from deepspeed_tpu.version import __version__
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+
+
+def debug_report():
+    lines = []
+    lines.append("-" * 60)
+    lines.append("DeepSpeedTPU C++ op report")
+    lines.append("-" * 60)
+    lines.append(op_report())
+    lines.append("-" * 60)
+    lines.append("DeepSpeedTPU general environment info:")
+    lines.append("-" * 60)
+    lines.append(f"deepspeed_tpu version ......... {__version__}")
+    try:
+        import jax
+
+        lines.append(f"jax version ................... {jax.__version__}")
+        try:
+            devices = jax.devices()
+            lines.append(f"jax backend ................... {devices[0].platform if devices else 'none'}")
+            lines.append(f"device count .................. {len(devices)}")
+            lines.append(f"process count ................. {jax.process_count()}")
+            for d in devices[:8]:
+                lines.append(f"  device ...................... {d}")
+        except Exception as e:
+            lines.append(f"devices ....................... unavailable ({e})")
+    except ImportError:
+        lines.append("jax ........................... NOT INSTALLED")
+    try:
+        import flax
+
+        lines.append(f"flax version .................. {flax.__version__}")
+    except ImportError:
+        lines.append("flax .......................... NOT INSTALLED")
+    import shutil
+
+    lines.append(f"g++ ........................... {'found' if shutil.which('g++') else 'MISSING'}")
+    return "\n".join(lines)
+
+
+def main():
+    print(debug_report())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""Per-node launcher.
+
+Capability parity with the reference's ``deepspeed/launcher/launch.py``
+(``main:65``: decode world info, compute global rank mapping, set
+``CUDA_VISIBLE_DEVICES``/``MASTER_*``/``RANK``/``LOCAL_RANK``, spawn one
+process per local rank) — adapted to the TPU process model: ONE process per
+host drives all local chips (jax single-controller-per-host), so this sets
+``RANK`` = node rank, ``WORLD_SIZE`` = number of hosts, exports
+``MASTER_ADDR/PORT`` for ``jax.distributed``, restricts visible chips when a
+slot subset was requested, and execs the user script.
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.launcher.runner import decode_world_info
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world_info", default="e30=", type=str,
+                        help="base64-encoded world layout dictionary")
+    parser.add_argument("--node_rank", default=0, type=str,
+                        help="Rank of this node in the job (or 'OMPI' to read from mpirun env)")
+    parser.add_argument("--master_addr", default="127.0.0.1", type=str)
+    parser.add_argument("--master_port", default=29500, type=int)
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    world_info = decode_world_info(args.world_info)
+    assert len(world_info) > 0, "got no world info"
+
+    if args.node_rank == "OMPI":
+        node_rank = int(os.environ.get("OMPI_COMM_WORLD_RANK", "0"))
+    else:
+        node_rank = int(args.node_rank)
+
+    hosts = list(world_info.keys())
+    num_nodes = len(hosts)
+    this_host = hosts[node_rank]
+    local_slots = world_info[this_host]
+
+    current_env = os.environ.copy()
+    current_env["MASTER_ADDR"] = args.master_addr
+    current_env["MASTER_PORT"] = str(args.master_port)
+    current_env["WORLD_SIZE"] = str(num_nodes)
+    current_env["RANK"] = str(node_rank)
+    current_env["LOCAL_RANK"] = "0"
+    current_env["NODE_RANK"] = str(node_rank)
+    if local_slots:
+        # Restrict visible TPU chips (TPU_VISIBLE_CHIPS is the libtpu analogue
+        # of CUDA_VISIBLE_DEVICES).
+        current_env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, local_slots))
+
+    logger.info(
+        f"launch: node_rank={node_rank}/{num_nodes} host={this_host} "
+        f"slots={local_slots} master={args.master_addr}:{args.master_port}"
+    )
+
+    cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
+    process = subprocess.Popen(cmd, env=current_env)
+
+    def sig_handler(signum, frame):
+        process.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGTERM, sig_handler)
+    process.wait()
+    if process.returncode != 0:
+        raise subprocess.CalledProcessError(returncode=process.returncode, cmd=cmd)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,255 @@
+"""Multi-node launcher front-end.
+
+Capability parity with the reference's ``deepspeed/launcher/runner.py``
+(``bin/deepspeed``): parse an MPI-style hostfile (``worker-0 slots=4``),
+``--include/--exclude`` node:slot filters, encode the world layout as base64,
+discover the master address, and dispatch per-node launch commands over
+pdsh/ssh — except the per-node payload initializes ``jax.distributed`` (one
+process per host driving all local TPU chips) instead of one process per GPU.
+"""
+
+import argparse
+import base64
+import json
+import os
+import subprocess
+import sys
+from collections import OrderedDict
+from shlex import split
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHON", "PATH", "LD_LIBRARY_PATH", "TPU", "JAX", "XLA"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+DEEPSPEED_ENVIRONMENT_PATHS = [".", os.path.expanduser("~")]
+PDSH_MAX_FAN_OUT = 1024
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="DeepSpeedTPU runner to help launch distributed multi-node/multi-chip training jobs"
+    )
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="Hostfile path (MPI-style) that defines the resource pool, e.g. 'worker-0 slots=4'")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="Specify hardware resources to use as 'host1:0,2@host2'.")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="Specify hardware resources to exclude, mutually exclusive with --include.")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="Total number of worker nodes to run on, this will use the top N hosts from the hostfile.")
+    parser.add_argument("--num_gpus", "--num_chips", type=int, default=-1, dest="num_gpus",
+                        help="Max number of accelerator chips to use on each node.")
+    parser.add_argument("--master_port", type=int, default=29500,
+                        help="Port used by jax.distributed during distributed training.")
+    parser.add_argument("--master_addr", type=str, default="",
+                        help="IP address of node 0; will be inferred via hostfile if not specified.")
+    parser.add_argument("--launcher", type=str, default="pdsh",
+                        help="Multi-node launcher backend: pdsh, openmpi, ssh.")
+    parser.add_argument("--launcher_args", type=str, default="",
+                        help="Flags to pass to the chosen launcher backend.")
+    parser.add_argument("--force_multi", action="store_true",
+                        help="Force multi-node mode even when only one node is specified.")
+    parser.add_argument("user_script", type=str, help="User script to launch")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path):
+    """Parse 'hostname slots=N' lines into an ordered {host: slots} dict
+    (reference runner.py:115-143)."""
+    if not os.path.isfile(hostfile_path):
+        logger.warning(f"Unable to find hostfile, will proceed with training with local resources only.")
+        return None
+
+    resource_pool = OrderedDict()
+    with open(hostfile_path, "r") as fd:
+        for line in fd.readlines():
+            line = line.strip()
+            if line == "" or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                key, slot_count = slots.split("=")
+                if key != "slots":
+                    raise ValueError(f"expected 'slots=N', got '{slots}'")
+                slot_count = int(slot_count)
+            except ValueError as err:
+                logger.error(f"Hostfile is not formatted correctly, unable to proceed with training.")
+                raise err
+            if hostname in resource_pool:
+                logger.error(f"Hostfile contains duplicate hosts, unable to proceed with training.")
+                raise ValueError(f"host {hostname} is already defined")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def _parse_hostfile_filter(filter_str):
+    """'host1:0,2@host2' -> {'host1': [0,2], 'host2': []} ([] = all slots)."""
+    mapping = OrderedDict()
+    for node_config in filter_str.split("@"):
+        if node_config == "":
+            continue
+        if ":" in node_config:
+            hostname, slots = node_config.split(":")
+            slot_list = [int(x) for x in slots.split(",")]
+        else:
+            hostname, slot_list = node_config, []
+        if hostname in mapping:
+            raise ValueError(f"Hostname '{hostname}' found multiple times in filter")
+        mapping[hostname] = slot_list
+    return mapping
+
+
+def parse_resource_filter(host_info, include_str="", exclude_str=""):
+    """Apply --include/--exclude filters (reference runner.py:146-235).
+
+    Returns the filtered {host: [slot_ids]} ordered dict.
+    """
+    if include_str and exclude_str:
+        raise ValueError("include_str and exclude_str are mutually exclusive.")
+
+    # Expand pool to explicit slot lists.
+    pool = OrderedDict((host, list(range(slots))) for host, slots in host_info.items())
+
+    if include_str:
+        include = _parse_hostfile_filter(include_str)
+        filtered = OrderedDict()
+        for hostname, slots in include.items():
+            if hostname not in pool:
+                raise ValueError(f"Hostname '{hostname}' not found in hostfile")
+            for s in slots:
+                if s not in pool[hostname]:
+                    raise ValueError(f"No slot '{s}' specified on host '{hostname}'")
+            filtered[hostname] = slots if slots else pool[hostname]
+        return filtered
+
+    if exclude_str:
+        exclude = _parse_hostfile_filter(exclude_str)
+        filtered = OrderedDict()
+        for hostname, slots in pool.items():
+            if hostname not in exclude:
+                filtered[hostname] = slots
+            else:
+                excl = exclude[hostname]
+                if not excl:
+                    continue  # whole host excluded
+                for s in excl:
+                    if s not in pool[hostname]:
+                        raise ValueError(f"No slot '{s}' specified on host '{hostname}'")
+                keep = [s for s in pool[hostname] if s not in excl]
+                if keep:
+                    filtered[hostname] = keep
+        return filtered
+
+    return pool
+
+
+def parse_inclusion_exclusion(resource_pool, inclusion, exclusion):
+    active_resources = OrderedDict()
+    for hostname, slots in resource_pool.items():
+        active_resources[hostname] = slots
+    return parse_resource_filter(active_resources, include_str=inclusion, exclude_str=exclusion)
+
+
+def encode_world_info(world_info):
+    """base64(json) world layout passed to each node (reference runner.py:248-251)."""
+    world_info_json = json.dumps(world_info).encode("utf-8")
+    return base64.urlsafe_b64encode(world_info_json).decode("utf-8")
+
+
+def decode_world_info(encoded):
+    return json.loads(base64.urlsafe_b64decode(encoded.encode("utf-8")).decode("utf-8"))
+
+
+def fetch_master_addr(resource_pool, requested=""):
+    """First host's first reported IP via ssh (reference runner.py:281-288)."""
+    if requested:
+        return requested
+    first_host = list(resource_pool.keys())[0]
+    if first_host in ("localhost", "127.0.0.1"):
+        return "127.0.0.1"
+    try:
+        hostname_cmd = [f"ssh {first_host} hostname -I"]
+        result = subprocess.check_output(hostname_cmd, shell=True)
+        return result.decode("utf-8").split()[0]
+    except Exception:
+        logger.warning(f"Unable to ssh {first_host} for master addr, using hostname directly")
+        return first_host
+
+
+def collect_env_exports():
+    """Env vars to propagate (reference .deepspeed_env + prefix list)."""
+    exports = {}
+    for var, val in os.environ.items():
+        if any(var.startswith(pfx) for pfx in EXPORT_ENVS):
+            exports[var] = val
+    for basedir in DEEPSPEED_ENVIRONMENT_PATHS:
+        env_file = os.path.join(basedir, DEEPSPEED_ENVIRONMENT_NAME)
+        if os.path.isfile(env_file):
+            with open(env_file, "r") as fd:
+                for line in fd.readlines():
+                    line = line.strip()
+                    if line and "=" in line:
+                        key, val = line.split("=", 1)
+                        exports[key] = val
+    return exports
+
+
+def main(args=None):
+    args = parse_args(args)
+
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if not resource_pool:
+        # Single node, all local chips, no ssh: exec launch module directly.
+        # Empty slot list = use every local chip (launch.py only restricts
+        # TPU_VISIBLE_CHIPS when an explicit subset is given).
+        world_info = {"localhost": []}
+        cmd = [
+            sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+            f"--world_info={encode_world_info(world_info)}",
+            "--node_rank=0",
+            f"--master_addr=127.0.0.1",
+            f"--master_port={args.master_port}",
+            args.user_script,
+        ] + args.user_args
+        result = subprocess.Popen(cmd, env=os.environ.copy())
+        result.wait()
+        return result.returncode
+
+    active_resources = parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
+
+    if args.num_nodes > 0:
+        updated = OrderedDict()
+        for count, (host, slots) in enumerate(active_resources.items()):
+            if count >= args.num_nodes:
+                break
+            updated[host] = slots
+        active_resources = updated
+
+    if args.num_gpus > 0:
+        active_resources = OrderedDict(
+            (host, slots[: args.num_gpus]) for host, slots in active_resources.items()
+        )
+
+    master_addr = fetch_master_addr(active_resources, args.master_addr)
+    world_info = encode_world_info({h: s for h, s in active_resources.items()})
+
+    from deepspeed_tpu.launcher.multinode_runner import PDSHRunner, OpenMPIRunner, SSHRunner
+
+    runner_cls = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner, "ssh": SSHRunner}.get(args.launcher.lower())
+    if runner_cls is None:
+        raise ValueError(f"Unknown launcher {args.launcher}")
+    runner = runner_cls(args, world_info, master_addr, collect_env_exports())
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend '{args.launcher}' not installed")
+    cmd = runner.get_cmd()
+    logger.info(f"cmd = {' '.join(cmd)}")
+    result = subprocess.Popen(cmd, env=os.environ.copy())
+    result.wait()
+    return result.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

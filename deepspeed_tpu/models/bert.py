@@ -1,0 +1,192 @@
+"""BERT model family for pretraining/fine-tuning on TPU.
+
+Role parity with the reference's vendored BERT models
+(``tests/unit/modeling.py`` post-LN / ``modelingpreln.py`` pre-LN, used as the
+kernel ground truth and the BERT-large pretraining benchmark subject,
+``docs/_posts/2020-05-28-fastest-bert-training.md``). Built on
+``DeepSpeedTransformerLayer`` with a scanned, optionally-rematerialized encoder
+stack — the idiomatic XLA shape for a deep uniform transformer (one compiled
+layer body, stacked params; plays directly into pipeline stage sharding).
+"""
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30528  # padded to x128 for TPU-friendly embedding matmuls
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    pre_layer_norm: bool = True
+    checkpoint_activations: bool = False
+
+    @staticmethod
+    def bert_large(**kw):
+        return BertConfig(**kw)
+
+    @staticmethod
+    def bert_base(**kw):
+        d = dict(hidden_size=768, num_hidden_layers=12, num_attention_heads=12, intermediate_size=3072)
+        d.update(kw)
+        return BertConfig(**d)
+
+    def layer_config(self, training=True):
+        return DeepSpeedTransformerConfig(
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            heads=self.num_attention_heads,
+            attn_dropout_ratio=self.attention_probs_dropout_prob,
+            hidden_dropout_ratio=self.hidden_dropout_prob,
+            num_hidden_layers=self.num_hidden_layers,
+            initializer_range=self.initializer_range,
+            pre_layer_norm=self.pre_layer_norm,
+            training=training,
+        )
+
+
+class BertEmbeddings(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, deterministic):
+        cfg = self.config
+        init = nn.initializers.normal(stddev=cfg.initializer_range)
+        word = nn.Embed(cfg.vocab_size, cfg.hidden_size, embedding_init=init, name="word_embeddings")
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, embedding_init=init, name="position_embeddings")
+        typ = nn.Embed(cfg.type_vocab_size, cfg.hidden_size, embedding_init=init, name="token_type_embeddings")
+        seq_len = input_ids.shape[1]
+        positions = jnp.arange(seq_len)[None, :]
+        h = word(input_ids) + pos(positions) + typ(token_type_ids)
+        h = nn.LayerNorm(name="LayerNorm")(h)
+        h = nn.Dropout(rate=cfg.hidden_dropout_prob)(h, deterministic=deterministic)
+        return h, word.embedding
+
+
+class _ScannedLayer(nn.Module):
+    """Scan body: one transformer layer; params stack along the scan axis."""
+
+    layer_cfg: DeepSpeedTransformerConfig
+
+    @nn.compact
+    def __call__(self, carry, _):
+        h, mask, deterministic = carry
+        h = DeepSpeedTransformerLayer(self.layer_cfg)(h, mask, deterministic=deterministic)
+        return (h, mask, deterministic), None
+
+
+class BertEncoder(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask, deterministic):
+        cfg = self.config
+        body = _ScannedLayer
+        if cfg.checkpoint_activations:
+            # Activation checkpointing: recompute each layer in backward
+            # (reference runtime/activation_checkpointing/checkpointing.py).
+            body = nn.remat(body, prevent_cse=False, static_argnums=())
+        ScanStack = nn.scan(
+            body,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=cfg.num_hidden_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        (h, _, _), _ = ScanStack(cfg.layer_config())((hidden_states, attention_mask, deterministic), None)
+        return h
+
+
+class BertModel(nn.Module):
+    config: BertConfig
+    needs_rng = True
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None, deterministic=False):
+        cfg = self.config
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids)
+        # additive mask [B,1,1,S]
+        add_mask = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * -10000.0
+
+        h, embed_table = BertEmbeddings(cfg, name="embeddings")(input_ids, token_type_ids, deterministic)
+        add_mask = add_mask.astype(h.dtype)
+        h = BertEncoder(cfg, name="encoder")(h, add_mask, deterministic)
+        pooled = nn.tanh(nn.Dense(cfg.hidden_size, name="pooler")(h[:, 0]))
+        return h, pooled, embed_table
+
+
+def cross_entropy(logits, labels, ignore_index=-1):
+    """Masked CE in fp32; labels==ignore_index contribute 0."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    denom = jnp.maximum(valid.sum(), 1)
+    return nll.sum() / denom
+
+
+class BertForPreTraining(nn.Module):
+    """MLM + NSP pretraining head; forward(batch...) returns scalar loss."""
+
+    config: BertConfig
+    needs_rng = True
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, attention_mask,
+                 masked_lm_labels=None, next_sentence_label=None, deterministic=False):
+        cfg = self.config
+        h, pooled, word_table = BertModel(cfg, name="bert")(
+            input_ids, token_type_ids, attention_mask, deterministic
+        )
+
+        # MLM head: transform + tied decoder (weight tying with word embeddings).
+        t = nn.Dense(cfg.hidden_size, name="mlm_transform")(h)
+        t = nn.gelu(t, approximate=False)
+        t = nn.LayerNorm(name="mlm_ln")(t)
+        mlm_logits = t @ word_table.T.astype(t.dtype) + self.param(
+            "mlm_bias", nn.initializers.zeros, (cfg.vocab_size,)
+        ).astype(t.dtype)
+
+        nsp_logits = nn.Dense(2, name="nsp_head")(pooled)
+
+        if masked_lm_labels is None:
+            return mlm_logits, nsp_logits
+
+        mlm_loss = cross_entropy(mlm_logits, masked_lm_labels, ignore_index=-1)
+        if next_sentence_label is not None:
+            nsp_loss = cross_entropy(nsp_logits, next_sentence_label, ignore_index=-1)
+        else:
+            nsp_loss = 0.0
+        return mlm_loss + nsp_loss
+
+
+def init_bert(config, batch_size=2, seq_len=128, seed=0, dtype=jnp.float32):
+    model = BertForPreTraining(config)
+    ids = jnp.zeros((batch_size, seq_len), jnp.int32)
+    labels = jnp.full((batch_size, seq_len), -1, jnp.int32)
+    nsl = jnp.zeros((batch_size,), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(seed), "dropout": jax.random.PRNGKey(seed + 1)},
+        ids, ids, jnp.ones((batch_size, seq_len), jnp.int32), labels, nsl,
+    )
+    return model, params

@@ -1,0 +1,134 @@
+"""GPT-2 model family.
+
+Role parity with the reference's Megatron GPT-2 benchmark subject
+(``tests/model/Megatron_GPT2``, ZeRO-2 + pipeline configs; BASELINE.json's
+"GPT-2 1.5B tokens/sec under ZeRO-2+pipe"). Decoder-only transformer with
+causal masking, built on the same scanned/remat encoder machinery as BERT so
+the stack shards cleanly across pipe stages and the params stack maps onto
+per-stage shardings.
+"""
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.models.bert import cross_entropy
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)
+
+
+@dataclass
+class GPT2Config:
+    vocab_size: int = 50304  # padded to x128
+    hidden_size: int = 1600
+    num_hidden_layers: int = 48
+    num_attention_heads: int = 25
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
+    checkpoint_activations: bool = False
+
+    @staticmethod
+    def gpt2_xl(**kw):
+        """~1.5B params (the reference's Megatron GPT-2 benchmark size)."""
+        return GPT2Config(**kw)
+
+    @staticmethod
+    def gpt2_small(**kw):
+        d = dict(hidden_size=768, num_hidden_layers=12, num_attention_heads=12)
+        d.update(kw)
+        return GPT2Config(**d)
+
+    @property
+    def intermediate_size(self):
+        return 4 * self.hidden_size
+
+    def layer_config(self, training=True):
+        return DeepSpeedTransformerConfig(
+            hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            heads=self.num_attention_heads,
+            attn_dropout_ratio=self.attention_probs_dropout_prob,
+            hidden_dropout_ratio=self.hidden_dropout_prob,
+            num_hidden_layers=self.num_hidden_layers,
+            initializer_range=self.initializer_range,
+            pre_layer_norm=True,
+            training=training,
+        )
+
+
+def causal_mask(seq_len, dtype=jnp.float32):
+    """Additive [1,1,S,S] causal mask."""
+    mask = jnp.tril(jnp.ones((seq_len, seq_len), bool))
+    return jnp.where(mask, 0.0, -1e9).astype(dtype)[None, None, :, :]
+
+
+class _ScannedDecoderLayer(nn.Module):
+    layer_cfg: DeepSpeedTransformerConfig
+
+    @nn.compact
+    def __call__(self, carry, _):
+        h, mask, deterministic = carry
+        h = DeepSpeedTransformerLayer(self.layer_cfg)(h, mask, deterministic=deterministic)
+        return (h, mask, deterministic), None
+
+
+class GPT2Model(nn.Module):
+    config: GPT2Config
+    needs_rng = True
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic=False):
+        cfg = self.config
+        init = nn.initializers.normal(stddev=cfg.initializer_range)
+        word = nn.Embed(cfg.vocab_size, cfg.hidden_size, embedding_init=init, name="wte")
+        pos = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, embedding_init=init, name="wpe")
+
+        S = input_ids.shape[1]
+        h = word(input_ids) + pos(jnp.arange(S)[None, :])
+        h = nn.Dropout(rate=cfg.hidden_dropout_prob)(h, deterministic=deterministic)
+
+        mask = causal_mask(S, h.dtype)
+        body = _ScannedDecoderLayer
+        if cfg.checkpoint_activations:
+            body = nn.remat(body, prevent_cse=False)
+        ScanStack = nn.scan(
+            body,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=cfg.num_hidden_layers,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )
+        (h, _, _), _ = ScanStack(cfg.layer_config())((h, mask, deterministic), None)
+        h = nn.LayerNorm(name="ln_f")(h)
+        logits = h @ word.embedding.T.astype(h.dtype)
+        return logits
+
+
+class GPT2LMHeadModel(nn.Module):
+    """Language modeling objective: forward(input_ids, labels) -> scalar loss."""
+
+    config: GPT2Config
+    needs_rng = True
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, deterministic=False):
+        logits = GPT2Model(self.config, name="transformer")(input_ids, deterministic)
+        if labels is None:
+            return logits
+        # next-token prediction
+        return cross_entropy(logits[:, :-1], labels[:, 1:], ignore_index=-1)
+
+
+def init_gpt2(config, batch_size=1, seq_len=64, seed=0):
+    model = GPT2LMHeadModel(config)
+    ids = jnp.zeros((batch_size, seq_len), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(seed), "dropout": jax.random.PRNGKey(seed + 1)}, ids, ids
+    )
+    return model, params

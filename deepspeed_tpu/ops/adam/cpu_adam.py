@@ -1,0 +1,115 @@
+"""DeepSpeedCPUAdam: host-side Adam for ZeRO-Offload.
+
+Capability parity with the reference's ``deepspeed/ops/adam/cpu_adam.py`` +
+``csrc/adam/cpu_adam.cpp`` (SIMD/OpenMP Adam over the fp32 master shard,
+5-7x over a naive host Adam). The kernel lives in ``csrc/cpu_adam.cpp``,
+compiled to ``deepspeed_tpu/ops/lib/libdstpu_cpu.so`` and loaded via ctypes
+(the op_builder JIT-compiles it on first use if missing); a pure-numpy fallback
+keeps the feature available without a toolchain.
+
+It also implements the device-path optimizer interface (init/update) by
+delegating to FusedAdam so the same config runs with or without offload.
+"""
+
+import ctypes
+import os
+
+import numpy as np
+
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+from deepspeed_tpu.utils.logging import logger
+
+_LIB = None
+_LIB_TRIED = False
+
+
+def _load_lib():
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    path = os.path.join(os.path.dirname(__file__), "..", "lib", "libdstpu_cpu.so")
+    path = os.path.abspath(path)
+    if not os.path.exists(path):
+        try:
+            from deepspeed_tpu.ops.op_builder import CPUAdamBuilder
+
+            path = CPUAdamBuilder().load_path()
+        except Exception as e:
+            logger.warning(f"cpu_adam native kernel unavailable ({e}); using numpy fallback")
+            return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.ds_adam_step.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        _LIB = lib
+    except OSError as e:
+        logger.warning(f"failed to load cpu_adam native kernel: {e}; using numpy fallback")
+    return _LIB
+
+
+class HostAdamState:
+    __slots__ = ("step", "exp_avg", "exp_avg_sq")
+
+    def __init__(self, n):
+        self.step = 0
+        self.exp_avg = np.zeros(n, np.float32)
+        self.exp_avg_sq = np.zeros(n, np.float32)
+
+
+class DeepSpeedCPUAdam(FusedAdam):
+    """Adam that can step on host memory (the ZeRO-Offload optimizer)."""
+
+    optimizer_id = 0
+
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, amsgrad=False, adam_w_mode=True, **kwargs):
+        super().__init__(lr=lr, bias_correction=bias_correction, betas=betas, eps=eps,
+                         weight_decay=weight_decay, adam_w_mode=adam_w_mode, amsgrad=amsgrad)
+        self._host_state = None
+
+    # -- host path --------------------------------------------------------
+    def init_host(self, flat_master):
+        self._host_state = HostAdamState(flat_master.shape[0])
+        return self._host_state
+
+    def step_host(self, master, grads, lr=None):
+        """In-place Adam step over the host fp32 master (numpy arrays)."""
+        st = self._host_state
+        assert st is not None, "call init_host first"
+        st.step += 1
+        lr = float(self.lr if lr is None else lr)
+        lib = _load_lib()
+        beta1, beta2 = self.betas
+        if lib is not None:
+            fp = ctypes.POINTER(ctypes.c_float)
+            lib.ds_adam_step(
+                master.ctypes.data_as(fp), grads.ctypes.data_as(fp),
+                st.exp_avg.ctypes.data_as(fp), st.exp_avg_sq.ctypes.data_as(fp),
+                ctypes.c_int64(master.shape[0]), ctypes.c_float(lr),
+                ctypes.c_float(beta1), ctypes.c_float(beta2), ctypes.c_float(self.eps),
+                ctypes.c_float(self.weight_decay), ctypes.c_int(1 if self.adam_w_mode else 0),
+                ctypes.c_int(st.step), ctypes.c_int(1 if self.bias_correction else 0),
+            )
+        else:
+            g = grads
+            if self.weight_decay and not self.adam_w_mode:
+                g = g + self.weight_decay * master
+            np.multiply(st.exp_avg, beta1, out=st.exp_avg)
+            st.exp_avg += (1 - beta1) * g
+            np.multiply(st.exp_avg_sq, beta2, out=st.exp_avg_sq)
+            st.exp_avg_sq += (1 - beta2) * np.square(g)
+            if self.bias_correction:
+                bc1 = 1 - beta1**st.step
+                bc2 = 1 - beta2**st.step
+                update = (st.exp_avg / bc1) / (np.sqrt(st.exp_avg_sq / bc2) + self.eps)
+            else:
+                update = st.exp_avg / (np.sqrt(st.exp_avg_sq) + self.eps)
+            if self.weight_decay and self.adam_w_mode:
+                update = update + self.weight_decay * master
+            master -= lr * update
+        return master

@@ -1,0 +1,82 @@
+"""Fused Adam/AdamW.
+
+Capability parity with the reference's ``FusedAdam`` (``deepspeed/ops/adam/
+fused_adam.py`` + ``csrc/adam/multi_tensor_adam.cu``): one fused update over
+many tensors. On TPU the XLA compiler fuses the elementwise Adam math across a
+pytree into few kernels, and ZeRO runs it over a single flat fp32 shard — both
+give the multi-tensor-apply behavior without a hand-rolled kernel; a Pallas
+variant can slot in behind the same interface if profiling warrants.
+
+The optimizer is functional: ``init(params) -> state``, ``update(grads, state,
+params, lr) -> (new_params, new_state)``. The learning rate is an argument so
+schedules can feed it from inside a jitted step.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class AdamState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: object  # pytree like params
+    exp_avg_sq: object  # pytree like params
+
+
+class FusedAdam:
+    def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adam_w_mode=True, amsgrad=False, **kwargs):
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return AdamState(
+            step=jnp.asarray(0, jnp.int32),
+            exp_avg=jax.tree_util.tree_map(zeros, params),
+            exp_avg_sq=jax.tree_util.tree_map(zeros, params),
+        )
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        beta1, beta2 = self.betas
+        step = state.step + 1
+
+        def upd(g, m, v, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay != 0.0 and not self.adam_w_mode:
+                g = g + self.weight_decay * p32
+            m_new = beta1 * m + (1 - beta1) * g
+            v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+            if self.bias_correction:
+                bc1 = 1 - beta1**step.astype(jnp.float32)
+                bc2 = 1 - beta2**step.astype(jnp.float32)
+                denom = jnp.sqrt(v_new / bc2) + self.eps
+                update = (m_new / bc1) / denom
+            else:
+                update = m_new / (jnp.sqrt(v_new) + self.eps)
+            if self.weight_decay != 0.0 and self.adam_w_mode:
+                update = update + self.weight_decay * p32
+            return (p32 - lr * update).astype(p.dtype), m_new, v_new
+
+        flat = jax.tree_util.tree_map(upd, grads, state.exp_avg, state.exp_avg_sq, params)
+        new_params = jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=lambda x: isinstance(x, tuple))
+        new_m = jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=lambda x: isinstance(x, tuple))
+        new_v = jax.tree_util.tree_map(lambda t: t[2], flat, is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, AdamState(step=step, exp_avg=new_m, exp_avg_sq=new_v)
+
+    # Reference name used by engine optimizer matrix.
+    @property
+    def name(self):
+        return "adamw" if self.adam_w_mode else "adam"
+
+    def state_dict_shapes(self, params):
+        return {"exp_avg": params, "exp_avg_sq": params}

@@ -1,0 +1,69 @@
+"""Native-op build system.
+
+Capability parity with the reference's ``op_builder/`` (``OpBuilder.load()``:
+import a pre-built library or ninja-JIT-compile it on first use,
+builder.py:170-220). Here ops are plain C shared libraries compiled with g++
+and loaded via ctypes; AOT builds go through ``csrc/Makefile`` or setup.py.
+"""
+
+import os
+import shutil
+import subprocess
+
+from deepspeed_tpu.utils.logging import logger
+
+CSRC = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", "csrc"))
+LIBDIR = os.path.join(os.path.dirname(__file__), "lib")
+
+
+class OpBuilder:
+    NAME = "base"
+    SOURCES = []  # relative to csrc/
+    EXTRA_FLAGS = []
+
+    def lib_path(self):
+        return os.path.join(LIBDIR, f"libdstpu_{self.NAME}.so")
+
+    def is_compatible(self):
+        return shutil.which("g++") is not None
+
+    def command(self, out):
+        srcs = [os.path.join(CSRC, s) for s in self.SOURCES]
+        return ["g++", "-O3", "-march=native", "-fopenmp", "-fPIC", "-shared", "-o", out] + srcs + self.EXTRA_FLAGS
+
+    def load_path(self):
+        """Return path to the built .so, JIT-compiling if needed."""
+        out = self.lib_path()
+        srcs = [os.path.join(CSRC, s) for s in self.SOURCES]
+        if os.path.exists(out) and all(
+            os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs if os.path.exists(s)
+        ):
+            return out
+        if not self.is_compatible():
+            raise RuntimeError(f"no C++ compiler available to build op {self.NAME}")
+        os.makedirs(LIBDIR, exist_ok=True)
+        cmd = self.command(out)
+        logger.info(f"JIT-building op {self.NAME}: {' '.join(cmd)}")
+        subprocess.check_call(cmd)
+        return out
+
+
+class CPUAdamBuilder(OpBuilder):
+    NAME = "cpu"
+    SOURCES = ["cpu_adam.cpp"]
+
+
+ALL_OPS = {
+    "cpu_adam": CPUAdamBuilder,
+}
+
+
+def op_report():
+    """Install/compatibility matrix (reference env_report.py op_report)."""
+    lines = ["op name " + "." * 20 + " installed .. compatible", "-" * 60]
+    for name, builder_cls in ALL_OPS.items():
+        b = builder_cls()
+        installed = os.path.exists(b.lib_path())
+        compatible = b.is_compatible()
+        lines.append(f"{name:<28} {'[YES]' if installed else '[NO] '} ...... {'[OKAY]' if compatible else '[NO]'}")
+    return "\n".join(lines)

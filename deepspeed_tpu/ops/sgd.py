@@ -1,0 +1,45 @@
+"""Plain SGD with momentum — the 'torch.optim fallback' slot in the engine's
+optimizer matrix (reference engine.py:585-617 falls back to torch.optim)."""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SGDState(NamedTuple):
+    step: jnp.ndarray
+    momentum_buf: object
+
+
+class SGD:
+    def __init__(self, lr=1e-3, momentum=0.0, weight_decay=0.0, nesterov=False, **kwargs):
+        self.lr = lr
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return SGDState(step=jnp.asarray(0, jnp.int32), momentum_buf=jax.tree_util.tree_map(zeros, params))
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+
+        def upd(g, buf, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p32
+            buf_new = self.momentum * buf + g if self.momentum else g
+            step_dir = g + self.momentum * buf_new if self.nesterov else buf_new
+            return (p32 - lr * step_dir).astype(p.dtype), buf_new
+
+        out = jax.tree_util.tree_map(upd, grads, state.momentum_buf, params)
+        new_params = jax.tree_util.tree_map(lambda t: t[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_buf = jax.tree_util.tree_map(lambda t: t[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, SGDState(step=state.step + 1, momentum_buf=new_buf)
+
+    @property
+    def name(self):
+        return "sgd"

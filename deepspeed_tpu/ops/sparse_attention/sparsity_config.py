@@ -1,0 +1,334 @@
+"""Block-sparsity layout generators.
+
+Capability parity with the reference's ``deepspeed/ops/sparse_attention/
+sparsity_config.py`` (Dense / Fixed / Variable / BigBird / BSLongformer
+layouts). A layout is an int array ``[num_heads, num_blocks, num_blocks]``
+where 1 marks a block of the attention matrix that is computed. The generators
+are pure numpy (layouts are host-side metadata); the TPU kernels consume them
+as gather indices / LUTs.
+
+Implementations are written from the pattern definitions (local windows +
+global tokens + random blocks, sliding windows a la Longformer/BigBird), not
+transcribed.
+"""
+
+import random
+
+import numpy as np
+
+
+class SparsityConfig:
+    """Base: carries head count and block size (reference sparsity_config.py:9)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False):
+        self.num_heads = num_heads
+        self.block = block
+        self.different_layout_per_head = different_layout_per_head
+        self.num_layout_heads = num_heads if different_layout_per_head else 1
+
+    def setup_layout(self, seq_len):
+        if seq_len % self.block != 0:
+            raise ValueError(
+                f"Sequence Length, {seq_len}, needs to be dividable by Block size {self.block}!"
+            )
+        num_blocks = seq_len // self.block
+        return np.zeros((self.num_heads, num_blocks, num_blocks), dtype=np.int64)
+
+    def check_and_propagate_first_head_layout(self, layout):
+        if not self.different_layout_per_head:
+            layout[1:] = layout[0]
+        return layout
+
+    def make_layout(self, seq_len):
+        raise NotImplementedError
+
+
+class DenseSparsityConfig(SparsityConfig):
+    """All blocks present — dense attention expressed in the same format
+    (reference sparsity_config.py:63)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False):
+        super().__init__(num_heads, block, different_layout_per_head)
+
+    def make_layout(self, seq_len):
+        layout = self.setup_layout(seq_len)
+        layout[:] = 1
+        return layout
+
+
+class FixedSparsityConfig(SparsityConfig):
+    """Fixed pattern (reference sparsity_config.py:94): blocks attend within
+    their local window of ``num_local_blocks``; the last ``num_global_blocks``
+    of each window are global (attended by all later blocks; with
+    ``horizontal_global_attention`` they also attend to everything).
+    """
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_local_blocks=4, num_global_blocks=1, attention="bidirectional",
+                 horizontal_global_attention=False, num_different_global_patterns=1):
+        super().__init__(num_heads, block, different_layout_per_head)
+        if num_local_blocks % num_global_blocks != 0:
+            raise ValueError(
+                f"Number of local blocks, {num_local_blocks}, must be dividable by "
+                f"number of global blocks, {num_global_blocks}!"
+            )
+        self.num_local_blocks = num_local_blocks
+        self.num_global_blocks = num_global_blocks
+        if attention not in ("unidirectional", "bidirectional"):
+            raise NotImplementedError('only "uni/bi-directional" attentions are supported for now!')
+        self.attention = attention
+        if attention != "bidirectional" and horizontal_global_attention:
+            raise ValueError('only "bi-directional" attentions can support horizontal global attention!')
+        self.horizontal_global_attention = horizontal_global_attention
+        if num_different_global_patterns > 1 and not different_layout_per_head:
+            raise ValueError(
+                "Number of different layouts cannot be more than one when you have set a single layout for all heads!"
+            )
+        if num_different_global_patterns > (num_local_blocks // num_global_blocks):
+            raise ValueError(
+                f"Number of layout versions (num_different_global_patterns), {num_different_global_patterns}, "
+                f"cannot be larger than number of local window blocks divided by number of global blocks, "
+                f"{num_local_blocks // num_global_blocks}!"
+            )
+        self.num_different_global_patterns = num_different_global_patterns
+
+    def _set_local(self, layout, h):
+        num_blocks = layout.shape[1]
+        for start in range(0, num_blocks, self.num_local_blocks):
+            end = min(start + self.num_local_blocks, num_blocks)
+            for r in range(start, end):
+                upto = (r + 1) if self.attention == "unidirectional" else end
+                layout[h, r, start:upto] = 1
+        return layout
+
+    def _global_band(self, h):
+        """Which blocks inside each local window are global, for this head's
+        pattern version."""
+        version = (h // max(1, self.num_heads // self.num_different_global_patterns)
+                   ) % self.num_different_global_patterns
+        # version v uses the v-th group (from the end) of global blocks
+        first = self.num_local_blocks - (version + 1) * self.num_global_blocks
+        return first
+
+    def _set_global(self, layout, h):
+        num_blocks = layout.shape[1]
+        first_g = self._global_band(h)
+        for start in range(0, num_blocks, self.num_local_blocks):
+            g_lo = start + first_g
+            g_hi = min(g_lo + self.num_global_blocks, num_blocks)
+            if g_lo >= num_blocks:
+                continue
+            # vertical: later blocks (or all, if bidirectional) attend to globals
+            attend_from = 0 if self.attention == "bidirectional" else g_lo
+            if self.attention == "unidirectional":
+                layout[h, g_lo:, g_lo:g_hi] = 1
+            else:
+                layout[h, :, g_lo:g_hi] = 1
+            # horizontal: globals attend to everything
+            if self.horizontal_global_attention:
+                layout[h, g_lo:g_hi, :] = 1
+        if self.attention == "unidirectional":
+            # keep causality
+            tri = np.tril(np.ones((num_blocks, num_blocks), dtype=layout.dtype))
+            layout[h] *= tri
+        return layout
+
+    def make_layout(self, seq_len):
+        layout = self.setup_layout(seq_len)
+        for h in range(self.num_layout_heads):
+            layout = self._set_local(layout, h)
+            layout = self._set_global(layout, h)
+        return self.check_and_propagate_first_head_layout(layout)
+
+
+class VariableSparsityConfig(SparsityConfig):
+    """Variable pattern (reference sparsity_config.py:243): user-listed local
+    window sizes (last size repeats), explicit global block indices (optionally
+    ranges), plus random blocks."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_random_blocks=0, local_window_blocks=None,
+                 global_block_indices=None, global_block_end_indices=None,
+                 attention="bidirectional", horizontal_global_attention=False):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.local_window_blocks = local_window_blocks or [4]
+        self.global_block_indices = global_block_indices if global_block_indices is not None else [0]
+        if global_block_end_indices is not None:
+            if len(self.global_block_indices) != len(global_block_end_indices):
+                raise ValueError(
+                    f"Global block start indices length, {len(self.global_block_indices)}, must be same as "
+                    f"global block end indices length, {len(global_block_end_indices)}!"
+                )
+            for _, (start_idx, end_idx) in enumerate(zip(self.global_block_indices, global_block_end_indices)):
+                if start_idx >= end_idx:
+                    raise ValueError(
+                        f"Global block start index, {start_idx}, must be smaller than global block end index, {end_idx}!"
+                    )
+        self.global_block_end_indices = global_block_end_indices
+        if attention not in ("unidirectional", "bidirectional"):
+            raise NotImplementedError('only "uni/bi-directional" attentions are supported for now!')
+        self.attention = attention
+        if attention != "bidirectional" and horizontal_global_attention:
+            raise ValueError('only "bi-directional" attentions can support horizontal global attention!')
+        self.horizontal_global_attention = horizontal_global_attention
+
+    def _set_random(self, layout, h, num_blocks):
+        if self.num_random_blocks == 0:
+            return layout
+        for r in range(num_blocks):
+            rand_cols = random.sample(range(num_blocks), min(self.num_random_blocks, num_blocks))
+            for c in rand_cols:
+                if self.attention == "bidirectional" or c <= r:
+                    layout[h, r, c] = 1
+        return layout
+
+    def _set_local(self, layout, h, num_blocks):
+        windows = list(self.local_window_blocks)
+        start = 0
+        w_i = 0
+        while start < num_blocks:
+            w = windows[min(w_i, len(windows) - 1)]
+            end = min(start + w, num_blocks)
+            for r in range(start, end):
+                upto = (r + 1) if self.attention == "unidirectional" else end
+                layout[h, r, start:upto] = 1
+            start = end
+            w_i += 1
+        return layout
+
+    def _set_global(self, layout, h, num_blocks):
+        if self.global_block_end_indices is None:
+            targets = [(i, i + 1) for i in self.global_block_indices]
+        else:
+            targets = list(zip(self.global_block_indices, self.global_block_end_indices))
+        for lo, hi in targets:
+            lo, hi = min(lo, num_blocks), min(hi, num_blocks)
+            if lo >= hi:
+                continue
+            layout[h, :, lo:hi] = 1
+            if self.horizontal_global_attention:
+                layout[h, lo:hi, :] = 1
+        if self.attention == "unidirectional":
+            tri = np.tril(np.ones((num_blocks, num_blocks), dtype=layout.dtype))
+            layout[h] *= tri
+        return layout
+
+    def make_layout(self, seq_len):
+        layout = self.setup_layout(seq_len)
+        num_blocks = layout.shape[1]
+        for h in range(self.num_layout_heads):
+            layout = self._set_random(layout, h, num_blocks)
+            layout = self._set_local(layout, h, num_blocks)
+            layout = self._set_global(layout, h, num_blocks)
+        return self.check_and_propagate_first_head_layout(layout)
+
+
+class BigBirdSparsityConfig(SparsityConfig):
+    """BigBird (reference sparsity_config.py:421): random + sliding window +
+    global (first/last blocks)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_random_blocks=1, num_sliding_window_blocks=3, num_global_blocks=1):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.num_global_blocks = num_global_blocks
+
+    def _set_random(self, layout, h, num_blocks):
+        if num_blocks < self.num_random_blocks:
+            raise ValueError(
+                f"Number of random blocks, {self.num_random_blocks}, must be smaller than overall number "
+                f"of blocks in a row, {num_blocks}!"
+            )
+        for r in range(num_blocks):
+            rand_cols = random.sample(range(num_blocks), self.num_random_blocks)
+            layout[h, r, rand_cols] = 1
+        return layout
+
+    def _set_sliding(self, layout, h, num_blocks):
+        if num_blocks < self.num_sliding_window_blocks:
+            raise ValueError(
+                f"Number of sliding window blocks, {self.num_sliding_window_blocks}, must be smaller than "
+                f"overall number of blocks in a row, {num_blocks}!"
+            )
+        half = self.num_sliding_window_blocks // 2
+        for r in range(num_blocks):
+            lo = max(0, r - half)
+            hi = min(num_blocks, r + half + 1)
+            layout[h, r, lo:hi] = 1
+        return layout
+
+    def _set_global(self, layout, h, num_blocks):
+        if num_blocks < self.num_global_blocks:
+            raise ValueError(
+                f"Number of global blocks, {self.num_global_blocks}, must be smaller than overall number "
+                f"of blocks in a row, {num_blocks}!"
+            )
+        g = self.num_global_blocks
+        layout[h, 0:g, :] = 1
+        layout[h, :, 0:g] = 1
+        layout[h, -g:, :] = 1
+        layout[h, :, -g:] = 1
+        return layout
+
+    def make_layout(self, seq_len):
+        layout = self.setup_layout(seq_len)
+        num_blocks = layout.shape[1]
+        for h in range(self.num_layout_heads):
+            layout = self._set_random(layout, h, num_blocks)
+            layout = self._set_sliding(layout, h, num_blocks)
+            layout = self._set_global(layout, h, num_blocks)
+        return self.check_and_propagate_first_head_layout(layout)
+
+
+class BSLongformerSparsityConfig(SparsityConfig):
+    """Block-sparse Longformer (reference sparsity_config.py:544): sliding
+    window + user-chosen global blocks (bidirectional)."""
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_sliding_window_blocks=3, global_block_indices=None, global_block_end_indices=None):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.global_block_indices = global_block_indices if global_block_indices is not None else [0]
+        if global_block_end_indices is not None:
+            if len(self.global_block_indices) != len(global_block_end_indices):
+                raise ValueError(
+                    f"Global block start indices length, {len(self.global_block_indices)}, must be same as "
+                    f"global block end indices length, {len(global_block_end_indices)}!"
+                )
+            for _, (start_idx, end_idx) in enumerate(zip(self.global_block_indices, global_block_end_indices)):
+                if start_idx >= end_idx:
+                    raise ValueError(
+                        f"Global block start index, {start_idx}, must be smaller than global block end index, {end_idx}!"
+                    )
+        self.global_block_end_indices = global_block_end_indices
+
+    def _set_sliding(self, layout, h, num_blocks):
+        half = self.num_sliding_window_blocks // 2
+        for r in range(num_blocks):
+            lo = max(0, r - half)
+            hi = min(num_blocks, r + half + 1)
+            layout[h, r, lo:hi] = 1
+        return layout
+
+    def _set_global(self, layout, h, num_blocks):
+        if self.global_block_end_indices is None:
+            targets = [(i, i + 1) for i in self.global_block_indices]
+        else:
+            targets = list(zip(self.global_block_indices, self.global_block_end_indices))
+        for lo, hi in targets:
+            lo, hi = min(lo, num_blocks), min(hi, num_blocks)
+            if lo >= hi:
+                continue
+            layout[h, :, lo:hi] = 1
+            layout[h, lo:hi, :] = 1
+        return layout
+
+    def make_layout(self, seq_len):
+        layout = self.setup_layout(seq_len)
+        num_blocks = layout.shape[1]
+        for h in range(self.num_layout_heads):
+            layout = self._set_sliding(layout, h, num_blocks)
+            layout = self._set_global(layout, h, num_blocks)
+        return self.check_and_propagate_first_head_layout(layout)

@@ -1,0 +1,94 @@
+"""Device-mesh construction: the TPU-native replacement for the reference's
+process-group zoo.
+
+The reference builds NCCL process groups per parallel dimension
+(``deepspeed/runtime/pipe/topology.py``, ``engine.py:69-85``). Here a single
+``jax.sharding.Mesh`` with named axes ``('pipe', 'data', 'model')`` — mirroring
+``PipeModelDataParallelTopology`` (pipe/topology.py:246) — carries all of that:
+collectives take axis names, shardings are ``PartitionSpec``s over the axes,
+and XLA lays collectives onto ICI.
+
+Axis order is (pipe, data, model): model innermost so tensor-parallel
+collectives ride the fastest ICI links, data next for reduce-scatter locality,
+pipe outermost (lowest-bandwidth traffic).
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def create_mesh(data_parallel_size=None, model_parallel_size=1, pipe_parallel_size=1, devices=None):
+    """Build the ('pipe','data','model') mesh over the given (or all) devices.
+
+    ``data_parallel_size=None`` means "all remaining devices".
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if data_parallel_size is None:
+        assert n % (model_parallel_size * pipe_parallel_size) == 0, (
+            f"device count {n} not divisible by model_parallel={model_parallel_size} "
+            f"x pipe_parallel={pipe_parallel_size}"
+        )
+        data_parallel_size = n // (model_parallel_size * pipe_parallel_size)
+    expected = data_parallel_size * model_parallel_size * pipe_parallel_size
+    assert expected == n, f"mesh wants {expected} devices, have {n}"
+    dev_array = np.asarray(devices).reshape(pipe_parallel_size, data_parallel_size, model_parallel_size)
+    return Mesh(dev_array, (PIPE_AXIS, DATA_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh, ndim, batch_axis=0):
+    """NamedSharding that splits ``batch_axis`` across the data axis."""
+    spec = [None] * ndim
+    spec[batch_axis] = DATA_AXIS
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated_sharding(mesh):
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def dp_world_size(mesh):
+    return mesh.shape[DATA_AXIS]
+
+
+def mp_world_size(mesh):
+    return mesh.shape[MODEL_AXIS]
+
+
+def pp_world_size(mesh):
+    return mesh.shape[PIPE_AXIS]
+
+
+class MeshMpu:
+    """mpu-compatible accessor facade over a mesh (reference honors an external
+    Megatron ``mpu`` object everywhere; this is the native equivalent)."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def get_model_parallel_world_size(self):
+        return mp_world_size(self.mesh)
+
+    def get_data_parallel_world_size(self):
+        return dp_world_size(self.mesh)
+
+    def get_pipe_parallel_world_size(self):
+        return pp_world_size(self.mesh)
+
+    def get_model_parallel_rank(self):
+        return 0  # per-device rank is only meaningful inside shard_map
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_group(self):
+        return MODEL_AXIS
+
+    def get_data_parallel_group(self):
+        return DATA_AXIS

@@ -404,6 +404,9 @@ class DeepSpeedConfig:
         )
         self.sparse_gradients_enabled = get_sparse_gradients_enabled(param_dict)
 
+        self.zero_allow_untested_optimizer = get_scalar_param(
+            param_dict, ZERO_ALLOW_UNTESTED_OPTIMIZER, ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT
+        )
         self.zero_config = DeepSpeedZeroConfig(param_dict)
         self.zero_optimization_stage = self.zero_config.stage
         self.zero_enabled = self.zero_optimization_stage > ZERO_OPTIMIZATION_DISABLED

@@ -1,0 +1,117 @@
+"""Data loading.
+
+Capability parity with the reference's ``deepspeed/runtime/dataloader.py``:
+``DeepSpeedDataLoader`` (distributed-sampled batches sized for the local
+micro-batch x data-parallel devices, throughput-timed) and ``RepeatingLoader``
+(infinite wrapper used by pipelines). Datasets are anything indexable returning
+tuples of numpy-convertible arrays (torch Datasets work unchanged).
+"""
+
+import numpy as np
+
+from deepspeed_tpu.utils.timer import ThroughputTimer
+
+
+class DistributedSampler:
+    """Deterministic strided sampler over dataset indices for one dp rank."""
+
+    def __init__(self, num_samples, num_replicas, rank, shuffle=True, seed=0):
+        self.num_samples = num_samples
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.samples_per_replica = int(np.ceil(num_samples / num_replicas))
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            indices = rng.permutation(self.num_samples)
+        else:
+            indices = np.arange(self.num_samples)
+        # Pad to make evenly divisible, then take this rank's strided slice.
+        total = self.samples_per_replica * self.num_replicas
+        if total > len(indices):
+            indices = np.concatenate([indices, indices[: total - len(indices)]])
+        return iter(indices[self.rank : total : self.num_replicas])
+
+    def __len__(self):
+        return self.samples_per_replica
+
+
+class DeepSpeedDataLoader:
+    """Batches a dataset for the local data-parallel shard group.
+
+    In the single-controller JAX model one process drives all local devices, so
+    the loader yields batches of ``micro_batch_size x local_dp_world`` samples
+    (the engine shards them along the ``data`` mesh axis). Across hosts the
+    sampler partitions by process.
+    """
+
+    def __init__(self, dataset, batch_size, local_rank=0, tput_timer=None, collate_fn=None,
+                 num_replicas=1, rank=0, data_sampler=None, shuffle=False, seed=1234):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn
+        self.tput_timer = tput_timer or ThroughputTimer(batch_size=batch_size, start_step=2)
+        if data_sampler is None:
+            data_sampler = DistributedSampler(
+                num_samples=len(dataset), num_replicas=num_replicas, rank=rank, shuffle=shuffle, seed=seed
+            )
+        self.data_sampler = data_sampler
+        self.len = len(self.data_sampler) // batch_size
+        self.data_iterator = None
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self):
+        self.data_iterator = self._create_iterator()
+        return self
+
+    def __next__(self):
+        if self.data_iterator is None:
+            self.data_iterator = self._create_iterator()
+        if self.tput_timer:
+            self.tput_timer.start()
+        return next(self.data_iterator)
+
+    def _default_collate(self, samples):
+        first = samples[0]
+        if isinstance(first, (tuple, list)):
+            return tuple(np.stack([np.asarray(s[i]) for s in samples]) for i in range(len(first)))
+        if isinstance(first, dict):
+            return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in first}
+        return np.stack([np.asarray(s) for s in samples])
+
+    def _create_iterator(self):
+        collate = self.collate_fn or self._default_collate
+        batch = []
+        for idx in self.data_sampler:
+            batch.append(self.dataset[int(idx)])
+            if len(batch) == self.batch_size:
+                yield collate(batch)
+                batch = []
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference dataloader.py:10)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            batch = next(self.data_iter)
+        return batch

@@ -1,0 +1,915 @@
+"""DeepSpeedEngine: the core training runtime.
+
+Capability parity with the reference's ``deepspeed/runtime/engine.py``
+(``DeepSpeedEngine``: forward/backward/step, optimizer selection matrix,
+FP16/ZeRO wrapper selection, grad-accum loss scaling, bucketed allreduce,
+lr-scheduler step-on-boundary with overflow skip, checkpoint save/load,
+throughput/timers, progressive layer drop) — redesigned TPU-first:
+
+- The user-facing micro-step API (``loss = engine(batch); engine.backward(loss);
+  engine.step()``) is preserved, but under the hood each forward computes
+  ``(loss, grads)`` in ONE jitted+sharded program (``jax.value_and_grad``), so
+  there is no eager autograd tape or backward-hook machinery. ``backward()``
+  accumulates the cached grads; ``step()`` runs a jitted update with the
+  overflow-skip as ``lax.cond`` on device.
+- Data parallelism is a mesh sharding: the batch is sharded along the ``data``
+  axis, params are replicated, and XLA inserts the grad all-reduce over ICI —
+  replacing the reference's bucketed NCCL allreduce (engine.py:1111-1184).
+- Mixed precision keeps fp32 master params and casts to bf16/fp16 inside the
+  loss function; dynamic loss scaling state lives on device.
+- ZeRO stages 1/2 swap in a sharded step (see runtime/zero/) behind the same
+  engine API.
+"""
+
+import os
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.constants import (
+    ADAM_OPTIMIZER,
+    ADAMW_OPTIMIZER,
+    LAMB_OPTIMIZER,
+    ONEBIT_ADAM_OPTIMIZER,
+    SGD_OPTIMIZER,
+    ROUTE_TRAIN,
+)
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    DynamicScalerState,
+    init_dynamic_scaler_state,
+    update_scaler,
+)
+from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.runtime.utils import clip_grad_norm_, global_norm, has_overflow
+from deepspeed_tpu.parallel.mesh import (
+    DATA_AXIS,
+    create_mesh,
+    dp_world_size,
+    mp_world_size,
+)
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from deepspeed_tpu.utils import distributed as dist
+
+MEMORY_OPT_ALLREDUCE_SIZE = 500000000
+
+ZERO_SUPPORTED_OPTIMIZERS = [ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER]
+
+
+def split_half_float_double_csr(tensors):
+    """Kept for API parity; dtype bucketing is a no-op under XLA fusion."""
+    return [("all", tensors)]
+
+
+class DeepSpeedEngine:
+    """Wraps a user model for distributed mixed-precision training on TPU."""
+
+    def __init__(self, args=None, model=None, optimizer=None, model_parameters=None,
+                 training_data=None, lr_scheduler=None, mpu=None, dist_init_required=None,
+                 collate_fn=None, config=None, config_params=None, dont_change_device=False):
+        self.client_optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+        self.training_data = training_data
+        self.collate_fn = collate_fn
+        self.mpu = mpu
+        self.global_steps = 0
+        self.global_samples = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self.loaded_checkpoint_dp_world_size = None
+        self.training = True
+        self.warn_unscaled_loss = True
+
+        if dist_init_required is None or dist_init_required:
+            dist.init_distributed()
+
+        # --- config -------------------------------------------------------
+        if config is None and args is not None and getattr(args, "deepspeed_config", None) is not None:
+            config = args.deepspeed_config
+        if config_params is not None and config is None:
+            config = config_params
+        assert config is not None, "DeepSpeed requires --deepspeed_config to specify configuration file"
+
+        # --- mesh ---------------------------------------------------------
+        mp_size = mpu.get_model_parallel_world_size() if mpu is not None else 1
+        self.mesh = create_mesh(model_parallel_size=mp_size, pipe_parallel_size=1)
+        self.dp_world_size = dp_world_size(self.mesh)
+        self.mp_world_size = mp_world_size(self.mesh)
+
+        self._config = DeepSpeedConfig(config, mpu, world_size=self.dp_world_size)
+        self._do_args_sanity_check(args)
+
+        self.enable_backward_allreduce = True
+        self.progressive_layer_drop = None
+        if self.pld_enabled():
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=self.pld_theta(), gamma=self.pld_gamma()
+            )
+
+        # --- model --------------------------------------------------------
+        self.module = model
+        self._configure_distributed_model(model, model_parameters)
+
+        # --- timers -------------------------------------------------------
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_micro_batch_size_per_gpu(),
+            num_workers=self.dp_world_size,
+            steps_per_output=self.steps_per_print(),
+        )
+
+        # --- dataloader ---------------------------------------------------
+        self.training_dataloader = self.deepspeed_io(training_data) if training_data else None
+
+        # --- optimizer / zero / fp16 --------------------------------------
+        self.optimizer = None
+        self.zero_optimizer = None
+        self._configure_optimizer(optimizer, model_parameters)
+        self._configure_lr_scheduler(lr_scheduler)
+
+        # --- loss scaling state -------------------------------------------
+        self._configure_loss_scaler()
+
+        self._jit_cache = {}
+        self._cached_grads = None
+        self._acc_grads = None
+        self._step_rng = jax.random.PRNGKey(self._config._param_dict.get("seed", 42))
+
+        if self.global_rank == 0:
+            self._config.print("DeepSpeedEngine configuration")
+
+    # ------------------------------------------------------------------
+    # config accessors (parity with reference engine accessors)
+    # ------------------------------------------------------------------
+    @property
+    def global_rank(self):
+        return dist.get_rank()
+
+    def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self._config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self._config.gradient_accumulation_steps
+
+    def steps_per_print(self):
+        return self._config.steps_per_print
+
+    def fp16_enabled(self):
+        return self._config.fp16_enabled
+
+    def bfloat16_enabled(self):
+        return self._config.bfloat16_enabled
+
+    def loss_scale(self):
+        if self.fp16_enabled():
+            return float(jax.device_get(self.scaler_state.cur_scale)) if self.dynamic_loss_scale() else self._config.loss_scale
+        return 1.0
+
+    def dynamic_loss_scale(self):
+        return self._config.loss_scale == 0 and self.fp16_enabled()
+
+    def initial_dynamic_scale(self):
+        return self._config.initial_dynamic_scale
+
+    def dynamic_loss_scale_args(self):
+        return self._config.dynamic_loss_scale_args
+
+    def gradient_clipping(self):
+        return self._config.gradient_clipping
+
+    def zero_optimization(self):
+        return self._config.zero_enabled
+
+    def zero_optimization_stage(self):
+        return self._config.zero_optimization_stage
+
+    def zero_cpu_offload(self):
+        return self._config.zero_config.cpu_offload
+
+    def zero_reduce_bucket_size(self):
+        return self._config.zero_config.reduce_bucket_size
+
+    def zero_allgather_bucket_size(self):
+        return self._config.zero_config.allgather_bucket_size
+
+    def zero_overlap_comm(self):
+        return self._config.zero_config.overlap_comm
+
+    def zero_reduce_scatter(self):
+        return self._config.zero_config.reduce_scatter
+
+    def zero_contiguous_gradients(self):
+        return self._config.zero_config.contiguous_gradients
+
+    def zero_elastic_checkpoint(self):
+        return self._config.zero_config.elastic_checkpoint
+
+    def allreduce_always_fp32(self):
+        return self._config.allreduce_always_fp32
+
+    def postscale_gradients(self):
+        return not self._config.prescale_gradients
+
+    def gradient_predivide_factor(self):
+        return self._config.gradient_predivide_factor
+
+    def wall_clock_breakdown(self):
+        return self._config.wall_clock_breakdown
+
+    def memory_breakdown(self):
+        return self._config.memory_breakdown
+
+    def sparse_gradients_enabled(self):
+        return self._config.sparse_gradients_enabled
+
+    def optimizer_name(self):
+        return self.client_optimizer.__class__.__name__ if self.client_optimizer else self._config.optimizer_name
+
+    def optimizer_params(self):
+        return self._config.optimizer_params
+
+    def optimizer_legacy_fusion(self):
+        return self._config.optimizer_legacy_fusion
+
+    def scheduler_name(self):
+        return self._config.scheduler_name
+
+    def scheduler_params(self):
+        return self._config.scheduler_params
+
+    def pld_enabled(self):
+        return self._config.pld_enabled
+
+    def pld_theta(self):
+        return self._config.pld_theta
+
+    def pld_gamma(self):
+        return self._config.pld_gamma
+
+    def elasticity_enabled(self):
+        return self._config.elasticity_enabled
+
+    def train(self, mode=True):
+        self.training = mode
+
+    def eval(self):
+        self.training = False
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+    def _do_args_sanity_check(self, args):
+        if args is not None and hasattr(args, "deepscale_config") and args.deepscale_config is not None:
+            logger.warning("************ --deepscale_config is deprecated, please use --deepspeed_config ************")
+
+    def _configure_distributed_model(self, model, model_parameters):
+        """Normalize the model to (apply_fn, params); replicate params on the mesh
+        (the reference broadcasts from rank 0, engine.py:501-506 — here a
+        replicated device_put is the same contract)."""
+        if model is None:
+            raise ValueError("deepspeed_tpu.initialize requires a model")
+
+        if hasattr(model, "apply") and callable(model.apply):
+            self.apply_fn = model.apply
+        elif callable(model):
+            self.apply_fn = model
+        else:
+            raise TypeError("model must be a flax-style module with .apply or a callable(params, *batch)")
+
+        if model_parameters is None:
+            model_parameters = getattr(model, "params", None)
+        assert model_parameters is not None, (
+            "model_parameters (the initial parameter pytree) is required: "
+            "pass the result of module.init(...)"
+        )
+
+        # fp32 master copy, replicated across the mesh.
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+        self.params = jax.device_put(
+            jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), model_parameters), replicated
+        )
+
+        if self.fp16_enabled():
+            self.compute_dtype = jnp.float16
+        elif self.bfloat16_enabled():
+            self.compute_dtype = jnp.bfloat16
+        else:
+            self.compute_dtype = jnp.float32
+
+    def _configure_optimizer(self, client_optimizer, model_parameters):
+        if client_optimizer is not None:
+            basic_optimizer = client_optimizer
+            log_dist("Using client Optimizer as basic optimizer", ranks=[0])
+        else:
+            basic_optimizer = self._configure_basic_optimizer()
+            log_dist(f"Using DeepSpeed Optimizer param name {self.optimizer_name()} as basic optimizer", ranks=[0])
+
+        if self.zero_optimization():
+            if self.optimizer_name() is not None and not self._is_supported_optimizer(self.optimizer_name()):
+                assert self._config.zero_allow_untested_optimizer, (
+                    f"You are using an untested ZeRO Optimizer. Please add "
+                    f'"zero_allow_untested_optimizer": true in the DeepSpeed JSON config.'
+                )
+                if self.global_rank == 0:
+                    logger.warning("**** You are using ZeRO with an untested optimizer, proceeding with caution ****")
+            self.optimizer = self._configure_zero_optimizer(basic_optimizer)
+        else:
+            self.optimizer = basic_optimizer
+
+        self.basic_optimizer = basic_optimizer
+        self.opt_state = None  # built lazily with params
+
+    def _is_supported_optimizer(self, name):
+        return (name or "").lower() in ZERO_SUPPORTED_OPTIMIZERS or (
+            self.client_optimizer is not None
+            and getattr(self.client_optimizer, "name", "") in ZERO_SUPPORTED_OPTIMIZERS
+        )
+
+    def _configure_basic_optimizer(self):
+        """Optimizer selection matrix (reference engine.py:577-617)."""
+        from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+        from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+        from deepspeed_tpu.ops.sgd import SGD
+
+        name = self.optimizer_name()
+        params = dict(self.optimizer_params() or {})
+        params.pop("max_grad_norm", None)  # reference forbids/strips this here
+
+        if name is None:
+            raise ValueError(
+                "'optimizer' was not specified in the config and no optimizer instance was passed"
+            )
+        name = name.lower()
+        if name in (ADAM_OPTIMIZER, ADAMW_OPTIMIZER):
+            if self.zero_cpu_offload():
+                from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+
+                return DeepSpeedCPUAdam(adam_w_mode=(name == ADAMW_OPTIMIZER), **params)
+            return FusedAdam(adam_w_mode=(name == ADAMW_OPTIMIZER), **params)
+        elif name == LAMB_OPTIMIZER:
+            return FusedLamb(**params)
+        elif name == SGD_OPTIMIZER:
+            return SGD(**params)
+        elif name == ONEBIT_ADAM_OPTIMIZER:
+            from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdam
+
+            return OnebitAdam(engine=self, **params)
+        else:
+            raise ValueError(f"Unknown optimizer name {name}")
+
+    def _configure_zero_optimizer(self, basic_optimizer):
+        from deepspeed_tpu.runtime.zero.sharded_optimizer import ZeroShardedOptimizer
+
+        stage = self.zero_optimization_stage()
+        log_dist(f"Creating ZeRO stage {stage} optimizer", ranks=[0])
+        return ZeroShardedOptimizer(
+            basic_optimizer,
+            stage=stage,
+            mesh=self.mesh,
+            cpu_offload=self.zero_cpu_offload(),
+            reduce_scatter=self.zero_reduce_scatter(),
+            reduce_bucket_size=self.zero_reduce_bucket_size(),
+            allgather_bucket_size=self.zero_allgather_bucket_size(),
+            elastic_checkpoint=self.zero_elastic_checkpoint(),
+            clip_grad=self.gradient_clipping(),
+        )
+
+    def _configure_lr_scheduler(self, client_lr_scheduler):
+        scheduler_name = self.scheduler_name()
+        if scheduler_name is not None:
+            if client_lr_scheduler is not None:
+                raise ValueError("Found both scheduler in config and lr_scheduler passed to initialize")
+            self.lr_scheduler = get_lr_schedule(scheduler_name, self.scheduler_params())
+            log_dist(f"DeepSpeed using configured LR scheduler = {scheduler_name}", ranks=[0])
+        else:
+            self.lr_scheduler = client_lr_scheduler
+        # torch-style init step: lr for step k is set at the end of step k-1,
+        # so prime the scheduler once (keeps the overflow-skip semantics exact:
+        # a skipped step leaves the lr untouched).
+        if self.lr_scheduler is not None and getattr(self.lr_scheduler, "last_batch_iteration", 0) < 0:
+            self.lr_scheduler.step()
+        log_dist(f"DeepSpeed LR Scheduler = {self.lr_scheduler}", ranks=[0])
+
+    def _configure_loss_scaler(self):
+        if self.fp16_enabled():
+            if self.dynamic_loss_scale():
+                args = self.dynamic_loss_scale_args() or {}
+                self.scaler_state = init_dynamic_scaler_state(
+                    init_scale=args.get("init_scale", self.initial_dynamic_scale()),
+                    delayed_shift=args.get("delayed_shift", 2),
+                )
+                self._scaler_kwargs = dict(
+                    scale_window=args.get("scale_window", 1000),
+                    min_scale=args.get("min_scale", 1.0),
+                    delayed_shift=args.get("delayed_shift", 2),
+                )
+            else:
+                self.scaler_state = init_dynamic_scaler_state(init_scale=self._config.loss_scale)
+                self._scaler_kwargs = None  # static: never updated
+        else:
+            self.scaler_state = init_dynamic_scaler_state(init_scale=1.0)
+            self._scaler_kwargs = None
+
+    def deepspeed_io(self, dataset, batch_size=None, route=ROUTE_TRAIN, pin_memory=None,
+                     data_sampler=None, collate_fn=None, num_local_io_workers=None):
+        if batch_size is None:
+            # Each process loads the batch for ITS local dp shards; the sampler
+            # partitions samples across processes.
+            local_dp = max(1, self.dp_world_size // dist.get_world_size())
+            batch_size = self.train_micro_batch_size_per_gpu() * local_dp
+        return DeepSpeedDataLoader(
+            dataset=dataset,
+            batch_size=batch_size,
+            collate_fn=collate_fn or self.collate_fn,
+            num_replicas=dist.get_world_size(),
+            rank=dist.get_rank(),
+            data_sampler=data_sampler,
+            tput_timer=self.tput_timer if route == ROUTE_TRAIN else None,
+        )
+
+    # ------------------------------------------------------------------
+    # jitted programs
+    # ------------------------------------------------------------------
+    def _get_fwd_bwd(self, needs_rng):
+        key = ("fwd_bwd", needs_rng)
+        if key not in self._jit_cache:
+            compute_dtype = self.compute_dtype
+            apply_fn = self.apply_fn
+            pld = self.progressive_layer_drop is not None
+
+            def fwd_bwd(params, scale, rng, theta, *batch):
+                def loss_fn(p):
+                    p_c = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p)
+                    kwargs = {}
+                    if needs_rng:
+                        kwargs["rngs"] = {"dropout": rng}
+                    if pld:
+                        kwargs["progressive_layer_drop"] = True
+                        kwargs["pld_theta"] = theta
+                    out = apply_fn(p_c, *batch, **kwargs)
+                    loss = out[0] if isinstance(out, tuple) else out
+                    return (loss.astype(jnp.float32) * scale, out)
+
+                (scaled_loss, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                return scaled_loss / scale, out, grads
+
+            self._jit_cache[key] = jax.jit(fwd_bwd)
+        return self._jit_cache[key]
+
+    def _get_fwd_only(self, needs_rng):
+        """Inference path: dropout disabled (deterministic=True when the module
+        accepts it; no dropout rng otherwise)."""
+        key = ("fwd", needs_rng, self._module_accepts_deterministic())
+        if key not in self._jit_cache:
+            compute_dtype = self.compute_dtype
+            apply_fn = self.apply_fn
+            pass_det = self._module_accepts_deterministic()
+
+            def fwd(params, *batch):
+                p_c = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), params)
+                kwargs = {"deterministic": True} if pass_det else {}
+                return apply_fn(p_c, *batch, **kwargs)
+
+            self._jit_cache[key] = jax.jit(fwd)
+        return self._jit_cache[key]
+
+    def _module_accepts_deterministic(self):
+        import inspect
+
+        target = getattr(self.module, "__call__", self.module)
+        try:
+            return "deterministic" in inspect.signature(target).parameters
+        except (TypeError, ValueError):
+            return False
+
+    def _get_accumulate(self):
+        if "acc" not in self._jit_cache:
+
+            def acc(acc_grads, grads, factor):
+                return jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32) * factor, acc_grads, grads
+                )
+
+            self._jit_cache["acc"] = jax.jit(acc)
+        return self._jit_cache["acc"]
+
+    def _get_step_fn(self):
+        """Jitted optimizer step with on-device overflow skip (lax.cond)."""
+        if "step" in self._jit_cache:
+            return self._jit_cache["step"]
+
+        optimizer = self.optimizer
+        clip = self.gradient_clipping()
+        fp16 = self.fp16_enabled()
+        dynamic = self.dynamic_loss_scale()
+        scaler_kwargs = self._scaler_kwargs or {}
+
+        def step_fn(params, opt_state, acc_grads, scaler_state, lr):
+            scale = scaler_state.cur_scale
+            overflow = has_overflow(acc_grads) if fp16 else jnp.asarray(False)
+
+            def do_step(operand):
+                params, opt_state, grads = operand
+                grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+                if clip > 0:
+                    grads, gnorm = clip_grad_norm_(grads, clip)
+                else:
+                    gnorm = global_norm(grads)
+                new_params, new_opt_state = optimizer.update(grads, opt_state, params, lr=lr)
+                return new_params, new_opt_state, gnorm
+
+            def skip_step(operand):
+                params, opt_state, _ = operand
+                return params, opt_state, jnp.asarray(-1.0, jnp.float32)
+
+            new_params, new_opt_state, gnorm = jax.lax.cond(
+                overflow, skip_step, do_step, (params, opt_state, acc_grads)
+            )
+            if dynamic:
+                new_scaler = update_scaler(scaler_state, overflow, **scaler_kwargs)
+            else:
+                new_scaler = scaler_state._replace(cur_iter=scaler_state.cur_iter + 1)
+            zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc_grads)
+            return new_params, new_opt_state, new_scaler, overflow, gnorm, zeroed
+
+        self._jit_cache["step"] = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        return self._jit_cache["step"]
+
+    def _ensure_opt_state(self):
+        if self.opt_state is None:
+            self.opt_state = self.optimizer.init(self.params)
+            if self.zero_optimization() and self.compute_dtype != jnp.float32:
+                # The fp32 master now lives (sharded) inside the ZeRO state;
+                # keep only the compute-dtype copy replicated for forward.
+                self.params = jax.tree_util.tree_map(
+                    lambda p: p.astype(self.compute_dtype), self.params
+                )
+                self._jit_cache.pop("step", None)
+
+    def _next_rng(self):
+        self._step_rng, sub = jax.random.split(self._step_rng)
+        return sub
+
+    def _module_needs_rng(self):
+        # flax modules that use dropout need an rng; detect once via attribute,
+        # fall back to config hint.
+        return bool(getattr(self.module, "needs_rng", False))
+
+    # ------------------------------------------------------------------
+    # training API (parity: engine.forward/backward/step)
+    # ------------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        """Run forward. In training mode this computes loss AND grads in one
+        fused jitted program; grads are cached for backward()."""
+        if self.wall_clock_breakdown():
+            self.timers("forward_microstep").start()
+            self.timers("forward").start(sync=False)
+
+        batch = tuple(self._shard_batch(x) for x in inputs)
+        needs_rng = self._module_needs_rng()
+
+        if self.training:
+            fwd_bwd = self._get_fwd_bwd(needs_rng)
+            theta = jnp.asarray(
+                self.progressive_layer_drop.get_theta() if self.progressive_layer_drop else 1.0,
+                jnp.float32,
+            )
+            loss, out, grads = fwd_bwd(self.params, self.scaler_state.cur_scale, self._next_rng(), theta, *batch)
+            self._cached_grads = grads
+            result = loss
+        else:
+            fwd = self._get_fwd_only(needs_rng)
+            result = fwd(self.params, *batch)
+
+        if self.progressive_layer_drop:
+            self.progressive_layer_drop.update_state(self.global_steps)
+
+        if self.wall_clock_breakdown():
+            self.timers("forward").stop(sync=False)
+            self.timers("forward_microstep").stop()
+        return result
+
+    __call__ = forward
+
+    def _shard_batch(self, x):
+        x = jnp.asarray(x)
+        if x.ndim == 0:
+            return x
+        try:
+            sharding = NamedSharding(self.mesh, PartitionSpec(DATA_AXIS, *([None] * (x.ndim - 1))))
+            return jax.device_put(x, sharding)
+        except Exception:
+            return x
+
+    def backward(self, loss, allreduce_gradients=True):
+        """Accumulate the grads computed in forward (already averaged over the
+        data axis by sharding semantics). Scaling parity: grads accumulate as
+        grad/gas like the reference's grad-accum loss scaling (engine.py:862)."""
+        assert self._cached_grads is not None, "must run engine.forward(...) in training mode before backward()"
+
+        if self.wall_clock_breakdown():
+            self.timers("backward_microstep").start()
+            self.timers("backward").start(sync=False)
+
+        gas = self.gradient_accumulation_steps()
+        if self._acc_grads is None:
+            self._acc_grads = jax.tree_util.tree_map(
+                lambda g: jnp.zeros_like(g, dtype=jnp.float32), self._cached_grads
+            )
+        factor = 1.0 / gas if self.postscale_gradients() else 1.0 / (gas * self.gradient_predivide_factor())
+        self._acc_grads = self._get_accumulate()(self._acc_grads, self._cached_grads, factor)
+        self._cached_grads = None
+        self.micro_steps += 1
+
+        if self.wall_clock_breakdown():
+            self.timers("backward").stop(sync=False)
+            self.timers("backward_microstep").stop()
+        return loss
+
+    def is_gradient_accumulation_boundary(self):
+        return self.micro_steps % self.gradient_accumulation_steps() == 0
+
+    def allreduce_gradients(self, bucket_size=MEMORY_OPT_ALLREDUCE_SIZE):
+        """No-op under sharded jit: XLA already placed the grad reduction over
+        ICI inside the forward/backward program. Kept for API parity."""
+        pass
+
+    def step(self):
+        """Apply the accumulated gradients at a grad-accum boundary; overflow
+        skips the update AND the lr-scheduler step (reference engine.py:951-987)."""
+        if self.wall_clock_breakdown():
+            self.timers("step_microstep").start()
+            self.timers("step").start(sync=False)
+
+        report_progress = False
+        if self.is_gradient_accumulation_boundary() and self.micro_steps > 0 and self._acc_grads is not None:
+            self._take_model_step()
+            report_progress = self.global_steps % self.steps_per_print() == 0
+
+        self.tput_timer.stop(report_progress)
+
+        if report_progress:
+            self._report_progress(self.global_steps)
+
+        if self.wall_clock_breakdown():
+            self.timers("step").stop(sync=False)
+            self.timers("step_microstep").stop()
+            if self.global_steps % self.steps_per_print() == 0:
+                self.timers.log([
+                    "forward_microstep", "backward_microstep", "step_microstep",
+                ])
+
+    def _take_model_step(self):
+        self._ensure_opt_state()
+        lr = self.get_lr()[0] if self.lr_scheduler is not None else None
+        if self.zero_optimization() and self.zero_cpu_offload():
+            self._take_model_step_host(lr)
+            return
+        step_fn = self._get_step_fn()
+        self.params, self.opt_state, self.scaler_state, overflow, gnorm, self._acc_grads = step_fn(
+            self.params, self.opt_state, self._acc_grads, self.scaler_state, jnp.asarray(lr if lr is not None else self._optimizer_base_lr(), jnp.float32)
+        )
+        overflow = bool(jax.device_get(overflow))
+        self._last_overflow = overflow
+        if overflow:
+            self.skipped_steps += 1
+            if self.dynamic_loss_scale() and self.global_rank == 0:
+                logger.info(
+                    "[deepspeed_tpu] OVERFLOW! Skipping step. Attempted loss scale: "
+                    f"{float(jax.device_get(self.scaler_state.cur_scale) * 2)}, reducing to "
+                    f"{float(jax.device_get(self.scaler_state.cur_scale))}"
+                )
+        else:
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+
+    def _take_model_step_host(self, lr):
+        """ZeRO-Offload step: overflow/clip on host, C++/numpy Adam over the
+        host-resident master, updated params H2D (reference stage2.py:1416-1437)."""
+        scale = float(jax.device_get(self.scaler_state.cur_scale))
+        grads = self._acc_grads
+        overflow = bool(jax.device_get(has_overflow(grads))) if self.fp16_enabled() else False
+        if not overflow:
+            if scale != 1.0:
+                grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+            if self.gradient_clipping() > 0:
+                grads, _ = clip_grad_norm_(grads, self.gradient_clipping())
+            self.params, self.opt_state = self.optimizer.update_host(
+                grads, self.opt_state, self.params,
+                lr=lr if lr is not None else self._optimizer_base_lr(),
+            )
+            if self.compute_dtype != jnp.float32:
+                self.params = jax.tree_util.tree_map(lambda p: p.astype(self.compute_dtype), self.params)
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+        else:
+            self.skipped_steps += 1
+        if self.dynamic_loss_scale():
+            self.scaler_state = update_scaler(self.scaler_state, overflow, **(self._scaler_kwargs or {}))
+        self._last_overflow = overflow
+        self._acc_grads = jax.tree_util.tree_map(jnp.zeros_like, self._acc_grads)
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+
+    def _optimizer_base_lr(self):
+        return getattr(self.basic_optimizer, "lr", 1e-3)
+
+    def get_lr(self):
+        if self.lr_scheduler is not None:
+            try:
+                return self.lr_scheduler.get_last_lr()
+            except AssertionError:
+                # Not stepped yet: peek without mutating scheduler state.
+                if hasattr(self.lr_scheduler, "get_lr"):
+                    return self.lr_scheduler.get_lr()
+                return [self._optimizer_base_lr()]
+        return [self._optimizer_base_lr()]
+
+    def get_mom(self):
+        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "get_mom"):
+            return self.lr_scheduler.get_mom()
+        return [getattr(self.basic_optimizer, "betas", (0.9,))[0]]
+
+    def _report_progress(self, step):
+        lr = self.get_lr()
+        mom = self.get_mom()
+        log_dist(
+            f"step={step}, skipped={self.skipped_steps}, lr={lr}, mom={mom}",
+            ranks=[0],
+        )
+
+    def train_batch(self, data_iter=None):
+        """Fused convenience: run gas micro-steps + optimizer step, return mean loss."""
+        if data_iter is None:
+            assert self.training_dataloader is not None
+            data_iter = iter(self.training_dataloader)
+        total = 0.0
+        for _ in range(self.gradient_accumulation_steps()):
+            batch = next(data_iter)
+            if not isinstance(batch, (tuple, list)):
+                batch = (batch,)
+            loss = self.forward(*batch)
+            self.backward(loss)
+            total += float(jax.device_get(loss))
+            self.step()
+        return total / self.gradient_accumulation_steps()
+
+    # ------------------------------------------------------------------
+    # checkpointing (parity: engine.py:1271-1561)
+    # ------------------------------------------------------------------
+    def _get_ckpt_name(self, checkpoints_path, tag):
+        mp_rank = 0 if self.mpu is None else self.mpu.get_model_parallel_rank()
+        return os.path.join(checkpoints_path, str(tag), f"mp_rank_{mp_rank:02d}_model_states.pt")
+
+    def _get_zero_ckpt_name(self, checkpoints_path, tag, pp_rank):
+        mp_rank = 0 if self.mpu is None else self.mpu.get_model_parallel_rank()
+        return os.path.join(
+            checkpoints_path, str(tag), f"zero_pp_rank_{pp_rank}_mp_rank_{mp_rank:02d}optim_states.pt"
+        )
+
+    def module_state_dict(self):
+        return jax.device_get(self.params)
+
+    def load_module_state_dict(self, state_dict, strict=True):
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+        self.params = jax.device_put(
+            jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), state_dict), replicated
+        )
+
+    def optimizer_state_dict(self):
+        self._ensure_opt_state()
+        return jax.device_get(self.opt_state)
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        client_state = client_state or {}
+
+        os.makedirs(os.path.join(save_dir, str(tag)), exist_ok=True)
+        if self.global_rank == 0:
+            state = dict(
+                module=self.module_state_dict(),
+                optimizer=None if self.zero_optimization() else self.optimizer_state_dict(),
+                lr_scheduler=self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
+                scaler=jax.device_get(self.scaler_state),
+                skipped_steps=self.skipped_steps,
+                global_steps=self.global_steps,
+                global_samples=self.global_samples,
+                dp_world_size=self.dp_world_size,
+                mp_world_size=self.mp_world_size,
+            )
+            state.update(client_state)
+            with open(self._get_ckpt_name(save_dir, tag), "wb") as f:
+                pickle.dump(state, f)
+            log_dist(f"Saving model checkpoint: {self._get_ckpt_name(save_dir, tag)}", ranks=[0])
+
+        if self.zero_optimization():
+            self._save_zero_checkpoint(save_dir, tag)
+
+        if save_latest and self.global_rank == 0:
+            with open(os.path.join(save_dir, "latest"), "w") as fd:
+                fd.write(str(tag))
+        return True
+
+    def _save_zero_checkpoint(self, save_path, tag):
+        """Every dp shard gets its own optim-states file (reference engine.py:1557)."""
+        self._ensure_opt_state()
+        shards = self.optimizer.shard_state_dicts(self.opt_state)
+        for pp_rank, shard in enumerate(shards):
+            with open(self._get_zero_ckpt_name(save_path, tag, pp_rank), "wb") as f:
+                pickle.dump(shard, f)
+        log_dist(f"Saved {len(shards)} zero checkpoint shards under tag {tag}", ranks=[0])
+
+    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
+                        load_optimizer_states=True, load_lr_scheduler_states=True):
+        if tag is None:
+            latest_path = os.path.join(load_dir, "latest")
+            if os.path.isfile(latest_path):
+                with open(latest_path, "r") as fd:
+                    tag = fd.read().strip()
+            else:
+                logger.warning(f"Unable to find latest file at {latest_path}, if trying to load latest "
+                               "checkpoint please pass a valid tag")
+                return None, {}
+
+        ckpt_name = self._get_ckpt_name(load_dir, tag)
+        if not os.path.exists(ckpt_name):
+            logger.warning(f"Client provided checkpoint load path: {ckpt_name} does not exist")
+            return None, {}
+
+        with open(ckpt_name, "rb") as f:
+            checkpoint = pickle.load(f)
+
+        self.load_module_state_dict(checkpoint["module"], strict=load_module_strict)
+
+        if load_optimizer_states:
+            if self.zero_optimization():
+                self._load_zero_checkpoint(load_dir, tag)
+            elif checkpoint.get("optimizer") is not None:
+                self._ensure_opt_state()
+                self.opt_state = _restore_like(self.opt_state, checkpoint["optimizer"])
+
+        if load_lr_scheduler_states and self.lr_scheduler is not None and checkpoint.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(checkpoint["lr_scheduler"])
+
+        if checkpoint.get("scaler") is not None:
+            s = checkpoint["scaler"]
+            self.scaler_state = DynamicScalerState(
+                cur_scale=jnp.asarray(s.cur_scale), cur_iter=jnp.asarray(s.cur_iter),
+                last_overflow_iter=jnp.asarray(s.last_overflow_iter), cur_hysteresis=jnp.asarray(s.cur_hysteresis),
+            )
+
+        self.global_steps = checkpoint.get("global_steps", 0)
+        self.global_samples = checkpoint.get("global_samples", self.global_steps * self.train_batch_size())
+        self.skipped_steps = checkpoint.get("skipped_steps", 0)
+        self.loaded_checkpoint_dp_world_size = checkpoint.get("dp_world_size", None)
+
+        deepspeed_states = [
+            "module", "optimizer", "lr_scheduler", "scaler", "skipped_steps",
+            "global_steps", "global_samples", "dp_world_size", "mp_world_size",
+        ]
+        client_state = {k: v for k, v in checkpoint.items() if k not in deepspeed_states}
+        log_dist(f"Loaded checkpoint {ckpt_name} at global step {self.global_steps}", ranks=[0])
+        return ckpt_name, client_state
+
+    def _load_zero_checkpoint(self, load_dir, tag):
+        """Load ALL saved dp shards and re-partition for the current dp degree
+        (elastic checkpoints, reference engine.py:1376-1442)."""
+        saved_dp = self.loaded_checkpoint_dp_world_size or self.dp_world_size
+        shards = []
+        pp_rank = 0
+        while True:
+            name = self._get_zero_ckpt_name(load_dir, tag, pp_rank)
+            if not os.path.exists(name):
+                break
+            with open(name, "rb") as f:
+                shards.append(pickle.load(f))
+            pp_rank += 1
+        if not shards:
+            logger.warning(f"No zero checkpoint shards found in {load_dir}/{tag}")
+            return
+        self._ensure_opt_state()
+        self.opt_state = self.optimizer.load_shard_state_dicts(self.opt_state, shards)
+        log_dist(f"Loaded {len(shards)} zero shards (saved dp={saved_dp}, current dp={self.dp_world_size})", ranks=[0])
+
+
+def _restore_like(template, data):
+    """Rebuild ``data`` with the treedef/dtypes of ``template``. Arrays are left
+    uncommitted so the next jitted step places them per its sharding spec."""
+    t_leaves, treedef = jax.tree_util.tree_flatten(template)
+    d_leaves = jax.tree_util.tree_leaves(data)
+    assert len(t_leaves) == len(d_leaves), "optimizer state structure mismatch on load"
+    restored = [jnp.asarray(np.asarray(d), t.dtype) for t, d in zip(t_leaves, d_leaves)]
+    return jax.tree_util.tree_unflatten(treedef, restored)

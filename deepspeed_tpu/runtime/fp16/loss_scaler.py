@@ -1,0 +1,176 @@
+"""Static and dynamic loss scaling.
+
+Capability parity with the reference's ``deepspeed/runtime/fp16/loss_scaler.py``
+(``LossScaler``, ``DynamicLossScaler``: init 2^32, x2 growth / /2 backoff,
+scale_window=1000, hysteresis via ``delayed_shift``, ``min_scale``).
+
+Two forms are provided:
+
+- A **functional core** (``DynamicScalerState`` + ``update_scaler``) whose state
+  is a small jnp pytree, so the overflow-skip control flow can live *inside* a
+  jitted train step (``lax.cond``-based, no host sync) — this is the TPU-native
+  path.
+- **Class wrappers** (``LossScaler``/``DynamicLossScaler``) with the reference's
+  host-side API for user code and tests.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class DynamicScalerState(NamedTuple):
+    """Device-side scaler state (all 0-d arrays so it can live under jit)."""
+
+    cur_scale: jnp.ndarray  # float32
+    cur_iter: jnp.ndarray  # int32
+    last_overflow_iter: jnp.ndarray  # int32
+    cur_hysteresis: jnp.ndarray  # int32
+
+
+def init_dynamic_scaler_state(init_scale=2**32, delayed_shift=1):
+    return DynamicScalerState(
+        cur_scale=jnp.asarray(init_scale, jnp.float32),
+        cur_iter=jnp.asarray(0, jnp.int32),
+        last_overflow_iter=jnp.asarray(-1, jnp.int32),
+        cur_hysteresis=jnp.asarray(delayed_shift, jnp.int32),
+    )
+
+
+def update_scaler(state: DynamicScalerState, overflow, *, scale_factor=2.0, scale_window=1000,
+                  min_scale=1.0, delayed_shift=1, consecutive_hysteresis=False) -> DynamicScalerState:
+    """Pure function: next scaler state given whether this step overflowed.
+
+    Semantics match the reference's ``DynamicLossScaler.update_scale``
+    (loss_scaler.py:151-166): on overflow, backoff by ``scale_factor`` (respecting
+    hysteresis); after ``scale_window`` clean steps, grow by ``scale_factor``.
+    Works under jit (branchless jnp.where form).
+    """
+    overflow = jnp.asarray(overflow, bool)
+
+    # Overflow path.
+    hysteresis_exhausted = state.cur_hysteresis <= 1
+    backoff_scale = jnp.maximum(state.cur_scale / scale_factor, min_scale)
+    of_scale = jnp.where(hysteresis_exhausted | (delayed_shift == 1), backoff_scale, state.cur_scale)
+    of_hysteresis = jnp.where(hysteresis_exhausted | (delayed_shift == 1), state.cur_hysteresis, state.cur_hysteresis - 1)
+
+    # Clean path.
+    window_elapsed = ((state.cur_iter - state.last_overflow_iter) % scale_window) == 0
+    ok_scale = jnp.where(window_elapsed, state.cur_scale * scale_factor, state.cur_scale)
+    ok_hysteresis = jnp.where(
+        window_elapsed & (not consecutive_hysteresis), jnp.asarray(delayed_shift, jnp.int32), state.cur_hysteresis
+    )
+
+    return DynamicScalerState(
+        cur_scale=jnp.where(overflow, of_scale, ok_scale),
+        cur_iter=state.cur_iter + 1,
+        last_overflow_iter=jnp.where(overflow, state.cur_iter, state.last_overflow_iter),
+        cur_hysteresis=jnp.where(overflow, of_hysteresis, ok_hysteresis).astype(jnp.int32),
+    )
+
+
+class LossScalerBase:
+    def __init__(self, cur_scale):
+        self.cur_scale = cur_scale
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        import jax
+
+        return jax.tree_util.tree_map(lambda g: g * self.loss_scale, grads)
+
+    def update_scale(self, overflow):
+        pass
+
+    def backward(self, loss):
+        return loss * self.loss_scale
+
+
+class LossScaler(LossScalerBase):
+    """Static loss scaler (reference loss_scaler.py:56)."""
+
+    def __init__(self, scale=1):
+        super().__init__(scale)
+
+    def has_overflow(self, params):
+        return False
+
+    @staticmethod
+    def _has_inf_or_nan(x):
+        return False
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Host-side dynamic loss scaler (reference loss_scaler.py:79)."""
+
+    def __init__(self, init_scale=2**32, scale_factor=2.0, scale_window=1000, min_scale=1,
+                 delayed_shift=1, consecutive_hysteresis=False):
+        super().__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.cur_hysteresis = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    @staticmethod
+    def _has_inf_or_nan(x):
+        import numpy as np
+
+        try:
+            cpu_sum = float(np.sum(np.asarray(x, dtype=np.float64)))
+        except RuntimeError:
+            return True
+        if cpu_sum in (float("inf"), -float("inf")) or cpu_sum != cpu_sum:
+            return True
+        return False
+
+    def has_overflow_serial(self, params):
+        import jax
+
+        for p in jax.tree_util.tree_leaves(params):
+            if self._has_inf_or_nan(p):
+                return True
+        return False
+
+    has_overflow = has_overflow_serial
+
+    def update_scale(self, overflow):
+        if overflow:
+            if self.delayed_shift == 1 or self.cur_hysteresis == 1:
+                self.cur_scale = max(self.cur_scale / self.scale_factor, self.min_scale)
+            else:
+                self.cur_hysteresis -= 1
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if self.consecutive_hysteresis:
+                self.cur_hysteresis = self.delayed_shift
+            if (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+                if not self.consecutive_hysteresis:
+                    self.cur_hysteresis = self.delayed_shift
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+
+def CreateLossScaler(static_loss_scale=None, dynamic_scaling=False, dynamic_loss_args=None):
+    """Factory mirroring how the reference engine picks its scaler."""
+    if dynamic_scaling:
+        if dynamic_loss_args is None:
+            return DynamicLossScaler()
+        return DynamicLossScaler(
+            init_scale=dynamic_loss_args.get(INITIAL_LOSS_SCALE, 2**32),
+            scale_window=dynamic_loss_args.get(SCALE_WINDOW, 1000),
+            delayed_shift=dynamic_loss_args.get(DELAYED_SHIFT, 1),
+            min_scale=dynamic_loss_args.get(MIN_LOSS_SCALE, 1),
+        )
+    return LossScaler(scale=static_loss_scale if static_loss_scale else 1.0)

@@ -1,0 +1,13 @@
+"""PipelineEngine (full implementation lands with the pipeline milestone).
+
+Parity target: reference ``deepspeed/runtime/pipe/engine.py``.
+"""
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+
+class PipelineEngine(DeepSpeedEngine):
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "PipelineEngine arrives with the pipeline-parallel milestone"
+        )

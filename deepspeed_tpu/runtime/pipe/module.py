@@ -1,0 +1,49 @@
+"""PipelineModule and LayerSpec (full implementation lands with the pipe engine).
+
+Parity target: reference ``deepspeed/runtime/pipe/module.py`` (LayerSpec
+deferred construction, TiedLayerSpec weight tying, uniform/parameters/type:regex
+partitioning, tied-weight groups, per-layer checkpoint files).
+"""
+
+
+class LayerSpec:
+    """Deferred layer construction (reference pipe/module.py:23-68)."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not issubclass(typename, object):
+            raise RuntimeError("LayerSpec only supports classes")
+
+    def build(self, log=False):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        from deepspeed_tpu.runtime.utils import call_to_str
+
+        return call_to_str(self.typename.__name__, *self.module_args, **self.module_kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """LayerSpec whose parameters are shared with all other specs carrying the
+    same ``key`` (reference pipe/module.py:71)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None, tied_weight_attr="embedding", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """Placeholder until the pipeline engine milestone; isinstance() dispatch in
+    deepspeed_tpu.initialize() relies on this class existing."""
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "PipelineModule execution arrives with the pipeline-parallel engine milestone"
+        )
+
+    def mpu(self):
+        return None

@@ -1,0 +1,249 @@
+"""Pipeline instruction schedules.
+
+Capability parity with the reference's ``deepspeed/runtime/pipe/schedule.py``:
+generator-based instruction streams with the same instruction taxonomy
+(``OptimizerStep``, ``ReduceGrads``, ``ReduceTiedGrads``, ``LoadMicroBatch``,
+``ForwardPass``, ``BackwardPass``, ``SendActivation``, ``RecvActivation``,
+``SendGrad``, ``RecvGrad``) driving ``TrainSchedule`` (1F1B / PipeDream-flush
+interleave), ``InferenceSchedule``, and ``DataParallelSchedule``.
+
+The schedule math here is an independent implementation of the standard 1F1B
+ordering: each stage runs ``min(stages - stage_id - 1, micro_batches)`` warmup
+forwards, then alternates one-forward-one-backward in the steady state, then
+drains the remaining backwards. The engine interprets these instruction streams
+(eager per-instruction dispatch of jitted stage programs over the mesh); the
+fully-fused scanned/ppermute executor shares the same ordering.
+"""
+
+from deepspeed_tpu.runtime.utils import call_to_str
+
+
+# ---------------------------------------------------------------------------
+# Instructions
+# ---------------------------------------------------------------------------
+
+class PipeInstruction:
+    """A single engine action, with kwargs recorded as attributes."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for key, val in kwargs.items():
+            setattr(self, key, val)
+
+    def __repr__(self):
+        return call_to_str(self.name, **self.kwargs)
+
+    def __eq__(self, other):
+        return self.name == getattr(other, "name", None) and self.kwargs == getattr(other, "kwargs", None)
+
+
+class OptimizerStep(PipeInstruction):
+    """Apply the optimizer at the end of a train batch."""
+
+
+class ReduceGrads(PipeInstruction):
+    """Data-parallel gradient reduction within the stage."""
+
+
+class ReduceTiedGrads(PipeInstruction):
+    """Reduce gradients of tied modules across their pipe-group."""
+
+
+class BufferOpInstruction(PipeInstruction):
+    def __init__(self, buffer_id, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    """Load a micro-batch into a buffer (first/last stage only)."""
+
+
+class ForwardPass(BufferOpInstruction):
+    """Run forward on the buffer's activations."""
+
+
+class BackwardPass(BufferOpInstruction):
+    """Run backward for the buffer's micro-batch."""
+
+
+class SendActivation(BufferOpInstruction):
+    """Send activations to the next stage."""
+
+
+class RecvActivation(BufferOpInstruction):
+    """Receive activations from the previous stage."""
+
+
+class SendGrad(BufferOpInstruction):
+    """Send input-activation grads to the previous stage."""
+
+
+class RecvGrad(BufferOpInstruction):
+    """Receive output-activation grads from the next stage."""
+
+
+# ---------------------------------------------------------------------------
+# Schedules
+# ---------------------------------------------------------------------------
+
+class PipeSchedule:
+    """Base: yields lists of PipeInstructions, one list per engine step."""
+
+    def __init__(self, micro_batches, stages, stage_id):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = self.stage_id - 1
+        self.next_stage = self.stage_id + 1
+
+    def steps(self):
+        raise NotImplementedError
+
+    def num_pipe_buffers(self):
+        """How many activation buffers this stage needs."""
+        return self.micro_batches
+
+    def _valid_micro_batch(self, micro_batch_id):
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _valid_stage(self, stage_id):
+        return 0 <= stage_id < self.stages
+
+    @property
+    def stage(self):
+        return self.stage_id
+
+    @property
+    def num_stages(self):
+        return self.stages
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def __iter__(self):
+        self.it = iter(self.steps())
+        return self.it
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only conveyor: microbatch m enters stage s at tick s + m."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            cmds = []
+            micro_batch_id = step_id - self.stage_id
+            if self._valid_micro_batch(micro_batch_id):
+                if self.is_first_stage or self.is_last_stage:
+                    cmds.append(LoadMicroBatch(self._buffer_idx(micro_batch_id)))
+                if not self.is_first_stage:
+                    cmds.append(RecvActivation(self._buffer_idx(micro_batch_id)))
+                cmds.append(ForwardPass(self._buffer_idx(micro_batch_id)))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(self._buffer_idx(micro_batch_id)))
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return min(2, self.micro_batches)
+
+    def _buffer_idx(self, micro_batch_id):
+        return micro_batch_id % self.num_pipe_buffers()
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B (PipeDream-flush): warmup forwards, steady 1F1B, drain backwards,
+    then ReduceTiedGrads -> ReduceGrads -> OptimizerStep.
+
+    Per-stage phase ordering (independent derivation of the standard schedule):
+      warmup   = min(stages - stage_id - 1, micro_batches)
+      steady   = micro_batches - warmup alternations of (fwd m_f, bwd m_b)
+      drain    = remaining backwards
+    """
+
+    def steps(self):
+        warmup = min(self.stages - self.stage_id - 1, self.micro_batches)
+        fwd_id = 0
+        bwd_id = 0
+        # Idle ticks before this stage's first forward can start.
+        for _ in range(self.stage_id):
+            yield []
+
+        # Warmup forwards.
+        for _ in range(warmup):
+            yield self._forward_cmds(fwd_id)
+            fwd_id += 1
+
+        # Steady state: one forward + one backward per tick-pair.
+        while fwd_id < self.micro_batches:
+            yield self._forward_cmds(fwd_id)
+            fwd_id += 1
+            yield self._backward_cmds(bwd_id)
+            bwd_id += 1
+
+        # Drain backwards.
+        while bwd_id < self.micro_batches:
+            yield self._backward_cmds(bwd_id)
+            bwd_id += 1
+
+        # Batch-end reductions + step.
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+    def _forward_cmds(self, micro_batch_id):
+        cmds = []
+        buf = self._buffer_idx(micro_batch_id)
+        if self.is_first_stage or self.is_last_stage:
+            cmds.append(LoadMicroBatch(buf))
+        if not self.is_first_stage:
+            cmds.append(RecvActivation(buf))
+        cmds.append(ForwardPass(buf))
+        if not self.is_last_stage:
+            cmds.append(SendActivation(buf))
+        return cmds
+
+    def _backward_cmds(self, micro_batch_id):
+        cmds = []
+        buf = self._buffer_idx(micro_batch_id)
+        if not self.is_last_stage:
+            cmds.append(RecvGrad(buf))
+        cmds.append(BackwardPass(buf))
+        if not self.is_first_stage:
+            cmds.append(SendGrad(buf))
+        return cmds
+
+    def num_pipe_buffers(self):
+        """In-flight microbatches never exceed warmup+1 (reference keeps
+        min(stages - stage_id + 1, micro_batches), pipe/schedule.py:243-247)."""
+        buffers = min(self.stages - self.stage_id + 1, self.micro_batches)
+        return max(2, buffers)
+
+    def _buffer_idx(self, micro_batch_id):
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Pure DP schedule expressed in pipeline instructions."""
+
+    def steps(self):
+        for step_id in range(self.micro_batches):
+            cmds = [
+                LoadMicroBatch(buffer_id=0),
+                ForwardPass(buffer_id=0),
+                BackwardPass(buffer_id=0),
+            ]
+            if step_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self):
+        return 1

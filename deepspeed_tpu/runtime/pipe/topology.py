@@ -1,0 +1,249 @@
+"""N-D process/device topology with named axes.
+
+Capability parity with the reference's ``deepspeed/runtime/pipe/topology.py``:
+``ProcessTopology`` (cartesian rank<->coordinate mapping over named axes),
+``PipeDataParallelTopology`` (['pipe','data']), ``PipeModelDataParallelTopology``
+(['pipe','data','model']), and ``PipelineParallelGrid`` (per-axis group views
+with mpu-compatible accessors). On TPU the "groups" are views into a
+``jax.sharding.Mesh`` — collectives take axis *names* — but the coordinate
+algebra is identical and is used by the pipeline module partitioner, checkpoint
+naming, and tests.
+"""
+
+from collections import namedtuple
+from itertools import product
+
+
+class ProcessTopology:
+    """Maps n-dimensional cartesian coordinates to linear ranks (axis-major,
+    first axis slowest — same convention as the reference)."""
+
+    def __init__(self, axes, dims):
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+        self.mapping = {}
+        ranges = [range(d) for d in self.dims]
+        for global_rank, coord in enumerate(product(*ranges)):
+            key = dict(zip(self.axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = global_rank
+
+    def get_rank(self, **coord_kwargs):
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() needs all axes {self.axes}, got {list(coord_kwargs)}")
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"coord {key} not in topology"
+        return self.mapping[key]
+
+    def get_axis_names(self):
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("data",), inner_sep="_", outer_sep="-"):
+        """String like 'pipe_00-model_00' naming the non-DP coordinates of a rank
+        (used by checkpoint file naming, reference topology.py)."""
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.axes if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis):
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank):
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError(f"rank {rank} not found in topology")
+
+    def get_axis_comm_lists(self, axis):
+        """Lists of ranks that differ only along ``axis`` — the communication
+        groups for that axis (reference topology.py get_axis_comm_lists)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for combo in product(*ranges):
+            other_coord = dict(zip(other_axes, combo))
+            group = [
+                self.get_rank(**{axis: i, **other_coord}) for i in range(self.get_dim(axis))
+            ]
+            lists.append(group)
+        return lists
+
+    def filter_match(self, **filter_kwargs):
+        """All ranks whose coordinates match the given axis values."""
+
+        def _match(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+
+        return sorted(rank for coord, rank in self.mapping.items() if _match(coord))
+
+    def get_axis_list(self, axis, idx):
+        """Ranks at position ``idx`` of ``axis``, sorted."""
+        return sorted(rank for coord, rank in self.mapping.items() if getattr(coord, axis) == idx)
+
+    def world_size(self):
+        size = 1
+        for d in self.dims:
+            size *= d
+        return size
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+def _prime_factors(N):
+    """Prime factorization in ascending order."""
+    assert N >= 1
+    primes = []
+    n = N
+    p = 2
+    while p * p <= n:
+        while n % p == 0:
+            primes.append(p)
+            n //= p
+        p += 1
+    if n > 1:
+        primes.append(n)
+    return primes
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """Hybrid pipeline+data topology; DP innermost so its collectives ride the
+    fastest links (reference topology.py:235)."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """3D pipe/data/model topology (reference topology.py:246)."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Per-axis group views with mpu-compatible accessors
+    (reference topology.py:252-455). ``global_rank`` defaults to the calling
+    process; in single-controller JAX the grid is mostly consulted for shapes
+    and comm lists rather than live process groups.
+    """
+
+    def __init__(self, topology=None, process_group=None, world_size=None, global_rank=0):
+        if topology is None:
+            assert world_size is not None
+            num_pp = 1
+            num_dp = world_size
+            topology = PipeDataParallelTopology(num_pp, num_dp)
+
+        self._topo = topology
+        self.global_rank = global_rank
+        self.world_size = topology.world_size()
+
+        self.data_parallel_size = max(topology.get_dim("data"), 1)
+        self.pipe_parallel_size = max(topology.get_dim("pipe"), 1)
+        self.model_parallel_size = max(topology.get_dim("model"), 1)
+        self.slice_parallel_size = self.model_parallel_size
+        assert self._is_grid_valid(), "Invalid Grid"
+
+        self.stage_id = self.get_stage_id()
+        self.data_parallel_id = self.get_data_parallel_id()
+
+        self.ds_model_proc_group_ranks = self._build_model_group_ranks()
+        self.dp_group_ranks = self._topo.get_axis_comm_lists("data")
+        self.pp_group_ranks = self._topo.get_axis_comm_lists("pipe")
+        self.slice_group_ranks = (
+            self._topo.get_axis_comm_lists("model") if "model" in self._topo.get_axis_names() else [[r] for r in range(self.world_size)]
+        )
+
+        self.p2p_groups = self._build_p2p_groups()
+
+    def _build_model_group_ranks(self):
+        """A "model group" = all ranks composing one model replica (same data
+        coord): the pipe x model plane."""
+        groups = []
+        for dp_id in range(self.data_parallel_size):
+            ranks = sorted(self._topo.filter_match(data=dp_id))
+            groups.append(ranks)
+        return groups
+
+    def _build_p2p_groups(self):
+        """Adjacent-stage rank pairs along the pipe axis (reference p2p groups)."""
+        pairs = []
+        for pipe_list in self.pp_group_ranks:
+            for a, b in zip(pipe_list, pipe_list[1:]):
+                pairs.append([a, b])
+            if len(pipe_list) > 1:
+                pairs.append([pipe_list[-1], pipe_list[0]])  # wraparound for embedding-tied grads
+        return pairs
+
+    def _is_grid_valid(self):
+        return self.data_parallel_size * self.pipe_parallel_size * self.model_parallel_size == self.world_size
+
+    # -- pipeline accessors -------------------------------------------------
+    def get_stage_id(self, rank=None):
+        rank = self.global_rank if rank is None else rank
+        return getattr(self._topo.get_coord(rank), "pipe", 0)
+
+    def get_data_parallel_id(self, rank=None):
+        rank = self.global_rank if rank is None else rank
+        return getattr(self._topo.get_coord(rank), "data", 0)
+
+    def stage_to_global(self, stage_id, **kwargs):
+        me = self._topo.get_coord(self.global_rank)._asdict()
+        me.update(kwargs)
+        me["pipe"] = stage_id
+        return self._topo.get_rank(**me)
+
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    def is_last_stage(self):
+        return self.stage_id == self.pipe_parallel_size - 1
+
+    # -- mpu-compatible accessors (reference topology.py:405-455) -----------
+    def get_global_rank(self):
+        return self.global_rank
+
+    def get_pipe_parallel_rank(self):
+        return self.get_stage_id()
+
+    def get_pipe_parallel_world_size(self):
+        return self.pipe_parallel_size
+
+    def get_pipe_parallel_group(self):
+        return "pipe"
+
+    def get_data_parallel_rank(self):
+        return self.get_data_parallel_id()
+
+    def get_data_parallel_world_size(self):
+        return self.data_parallel_size
+
+    def get_data_parallel_group(self):
+        return "data"
+
+    def get_model_parallel_rank(self):
+        return getattr(self._topo.get_coord(self.global_rank), "model", 0)
+
+    def get_model_parallel_world_size(self):
+        return self.model_parallel_size
+
+    def get_model_parallel_group(self):
+        return "model"
+
+    def get_slice_parallel_rank(self):
+        return self.get_model_parallel_rank()
+
+    def get_slice_parallel_world_size(self):
+        return self.slice_parallel_size
+
+    def get_slice_parallel_group(self):
+        return "model"

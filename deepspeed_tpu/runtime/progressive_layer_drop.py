@@ -1,0 +1,21 @@
+"""Progressive Layer Drop (parity: reference ``deepspeed/runtime/progressive_layer_drop.py``):
+keep-probability schedule theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar,
+passed to the model each forward."""
+
+import numpy as np
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        self.current_theta = (1.0 - self.theta) * np.exp(-self.gamma * global_step) + self.theta

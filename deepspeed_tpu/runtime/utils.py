@@ -1,0 +1,286 @@
+"""Runtime helpers.
+
+Capability parity with the reference's ``deepspeed/runtime/utils.py``:
+overflow checking, global grad/weight norms with model-parallel awareness,
+balanced layer partitioners (prefix-sum + binary search), ``PartitionedTensor``
+(flat 1-D shard + metadata + all-gather ``full()``), memory reporting, and
+seeding. Device math is jnp (works under jit); partitioners are pure Python.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def set_random_seed(seed):
+    """Seed host-side RNGs and return a jax PRNG key (reference utils.py:33)."""
+    import random
+
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+# ---------------------------------------------------------------------------
+# Overflow checking
+# ---------------------------------------------------------------------------
+
+def has_overflow(grads, axis_name=None):
+    """True if any grad leaf contains inf/nan. Works under jit; if ``axis_name``
+    is given, the flag is OR-reduced across that mesh axis (the reference's
+    cross-rank overflow allreduce, engine CheckOverflow)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(False)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(l))) for l in leaves]
+    flag = jnp.any(jnp.stack(flags))
+    if axis_name is not None:
+        flag = jax.lax.pmax(flag.astype(jnp.float32), axis_name) > 0
+    return flag
+
+
+class CheckOverflow:
+    """Host-side overflow checker over a param/grad pytree (reference utils.py:41)."""
+
+    def __init__(self, param_groups=None, mpu=None):
+        self.mpu = mpu
+        self.params = param_groups
+
+    def check_using_norm(self, norm_group):
+        overflow = -1 in [float(n) for n in norm_group] or any(
+            not np.isfinite(float(n)) for n in norm_group
+        )
+        return overflow
+
+    def check(self, param_groups=None):
+        params = param_groups if param_groups is not None else self.params
+        return self.has_overflow(params)
+
+    def has_overflow(self, params):
+        return bool(jax.device_get(has_overflow(params)))
+
+
+# ---------------------------------------------------------------------------
+# Norms and clipping
+# ---------------------------------------------------------------------------
+
+def global_norm(tree):
+    """L2 norm over all leaves of a pytree (fp32 accumulate). Works under jit."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+    return jnp.sqrt(sq)
+
+
+def get_grad_norm(grads, mpu=None, norm_type=2):
+    """Global grad norm (reference utils.py:148). With ``mpu`` (model parallel),
+    the caller is responsible for having already reduced over the model axis —
+    under pjit/shard_map, XLA inserts that collective from shardings."""
+    if norm_type == float("inf"):
+        leaves = jax.tree_util.tree_leaves(grads)
+        return jnp.max(jnp.stack([jnp.max(jnp.abs(l)) for l in leaves]))
+    return global_norm(grads)
+
+
+def get_weight_norm(params, mpu=None, norm_type=2):
+    return get_grad_norm(params, mpu=mpu, norm_type=norm_type)
+
+
+def clip_grad_norm_(grads, max_norm, global_grad_norm=None):
+    """Scale grads so their global norm is at most ``max_norm``. Returns
+    (clipped_grads, total_norm). Pure/functional (jit-safe); mirrors the
+    combined get_grad_norm + clip_coef application in the reference step path."""
+    total_norm = global_grad_norm if global_grad_norm is not None else global_norm(grads)
+    clip_coef = jnp.minimum(1.0, max_norm / (total_norm + 1e-6))
+    clipped = jax.tree_util.tree_map(lambda g: (g * clip_coef).astype(g.dtype), grads)
+    return clipped, total_norm
+
+
+# ---------------------------------------------------------------------------
+# Balanced partitioning (pure Python; reference utils.py:289-370)
+# ---------------------------------------------------------------------------
+
+def partition_uniform(num_items, num_parts):
+    """Evenly split [0, num_items) into num_parts contiguous ranges; returns
+    num_parts+1 boundary indices."""
+    parts = [0] * (num_parts + 1)
+    if num_items <= num_parts:
+        for p in range(num_parts + 1):
+            parts[p] = min(p, num_items)
+        return parts
+    chunksize = num_items // num_parts
+    for p in range(num_parts):
+        parts[p] = min(chunksize * p, num_items)
+    parts[num_parts] = num_items
+    return parts
+
+
+def _lprobe(weights, num_parts, bottleneck):
+    """Check whether ``weights`` can be split into num_parts contiguous chunks
+    each with sum <= bottleneck; returns (parts, success)."""
+    num_items = len(weights)
+    total_weight = weights[-1]
+    parts = [0] * (num_parts + 1)
+    bsum = bottleneck
+    chunk_idx = 1
+    for p in range(1, num_parts):
+        # First index whose prefix sum exceeds the current budget.
+        while chunk_idx < num_items and weights[chunk_idx] <= bsum:
+            chunk_idx += 1
+        parts[p] = chunk_idx
+        if chunk_idx == num_items:
+            # Ran out of items; remaining parts are empty.
+            for q in range(p + 1, num_parts):
+                parts[q] = num_items
+            break
+        bsum += bottleneck
+    parts[num_parts] = num_items
+    return parts, bsum >= total_weight
+
+
+def _rb_partition_balanced(weights, num_parts, eps):
+    """Binary search the bottleneck over prefix sums (reference utils.py:355)."""
+    total = weights[-1]
+    lower = total / num_parts
+    upper = total
+    while upper > lower + eps:
+        mid = lower + ((upper - lower) / 2)
+        _, success = _lprobe(weights, num_parts, mid)
+        if success:
+            upper = mid
+        else:
+            lower = mid
+    return upper
+
+
+def partition_balanced(weights, num_parts, eps=1e-3):
+    """Partition items with the given weights into num_parts contiguous chunks
+    minimizing the heaviest chunk (prefix-sum + binary search)."""
+    num_items = len(weights)
+    if num_items <= num_parts:
+        return partition_uniform(num_items, num_parts)
+
+    weights_ = [0.0] * num_items
+    running = 0.0
+    for i, w in enumerate(weights):
+        running += w
+        weights_[i] = running
+    bottleneck = _rb_partition_balanced(weights_, num_parts, eps=eps)
+    parts, success = _lprobe(weights_, num_parts, bottleneck)
+    assert success
+    return parts
+
+
+def prefix_sum_inc(weights):
+    """Inclusive prefix sum (reference utils.py helper)."""
+    out = list(weights)
+    for i in range(1, len(out)):
+        out[i] += out[i - 1]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# PartitionedTensor (reference utils.py:373-476)
+# ---------------------------------------------------------------------------
+
+class PartitionedTensor:
+    """A tensor partitioned 1-D across a mesh axis group.
+
+    The reference uses this to shard large pipeline activations across the
+    tensor-slice group. Here each rank holds a padded flat shard plus metadata
+    describing the original shape; ``full()`` all-gathers the shards (under jit,
+    via ``jax.lax.all_gather`` over the named axis; on host, by concatenation).
+    """
+
+    def __init__(self, tensor=None, group_size=1, rank=0, axis_name=None, _meta=None, _local=None):
+        self.axis_name = axis_name
+        self.group_size = group_size
+        if tensor is not None:
+            self.orig_shape = tuple(tensor.shape)
+            self.orig_dtype = tensor.dtype
+            flat = tensor.reshape(-1)
+            numel = flat.shape[0]
+            padded = int(np.ceil(numel / group_size)) * group_size
+            pad = padded - numel
+            if pad:
+                flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+            self.part_size = padded // group_size
+            self.local_data = jax.lax.dynamic_slice(flat, (rank * self.part_size,), (self.part_size,))
+        else:
+            self.orig_shape = tuple(_meta["orig_shape"])
+            self.orig_dtype = _meta["orig_dtype"]
+            self.part_size = _meta["part_size"]
+            self.local_data = _local
+
+    def to_meta(self):
+        """Metadata dict for the shape handshake (reference encodes as a tensor)."""
+        return {
+            "orig_shape": list(self.orig_shape),
+            "orig_dtype": self.orig_dtype,
+            "part_size": self.part_size,
+            "group_size": self.group_size,
+        }
+
+    @classmethod
+    def from_meta(cls, meta, local_part, group_size=None, axis_name=None):
+        return cls(
+            group_size=group_size or meta["group_size"],
+            axis_name=axis_name,
+            _meta=meta,
+            _local=local_part,
+        )
+
+    def data(self):
+        return self.local_data
+
+    def full(self, gathered=None):
+        """Reassemble the full tensor. Under jit inside shard_map, pass nothing
+        and the all-gather happens over ``axis_name``; otherwise pass the list
+        of shards explicitly."""
+        numel = int(np.prod(self.orig_shape))
+        if gathered is None:
+            assert self.axis_name is not None, "need axis_name for collective gather"
+            flat = jax.lax.all_gather(self.local_data, self.axis_name, tiled=True)
+        else:
+            flat = jnp.concatenate(list(gathered))
+        return flat[:numel].reshape(self.orig_shape).astype(self.orig_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Memory reporting (reference utils.py:483-536)
+# ---------------------------------------------------------------------------
+
+def memory_status(msg="", print_rank=0):
+    from deepspeed_tpu.utils.logging import log_dist
+
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        in_use = stats.get("bytes_in_use", 0) / (1024**3)
+        peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+        limit = stats.get("bytes_limit", 0) / (1024**3)
+        log_dist(
+            f"MEMSTATS {msg} device={in_use:.2f}GB peak={peak:.2f}GB limit={limit:.2f}GB",
+            ranks=[print_rank],
+        )
+    except Exception:
+        pass
+
+
+def see_memory_usage(message, force=False):
+    if force:
+        memory_status(message)
+
+
+def call_to_str(base, *args, **kwargs):
+    """Human-readable call string, e.g. for schedule debugging (reference helper)."""
+    name = f"{base}("
+    if args:
+        name += ", ".join(str(arg) for arg in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{key}={arg}" for key, arg in kwargs.items())
+    name += ")"
+    return name

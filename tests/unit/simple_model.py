@@ -1,0 +1,71 @@
+"""Fixture models for unit tests (model: reference tests/unit/simple_model.py)."""
+
+import json
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class SimpleModel(nn.Module):
+    """Linear stack + CE-ish loss; forward(x, y) returns scalar loss."""
+
+    hidden_dim: int
+    empty_grad: bool = False
+
+    @nn.compact
+    def __call__(self, x, y):
+        h = nn.Dense(self.hidden_dim)(x)
+        h = nn.relu(h)
+        h = nn.Dense(self.hidden_dim)(h)
+        return jnp.mean(jnp.square(h - y))
+
+
+def create_simple_model(hidden_dim, seed=123):
+    model = SimpleModel(hidden_dim=hidden_dim)
+    x = jnp.ones((4, hidden_dim), jnp.float32)
+    y = jnp.ones((4, hidden_dim), jnp.float32)
+    params = model.init(jax.random.PRNGKey(seed), x, y)
+    return model, params
+
+
+class RandomDataset:
+    """Indexable dataset of (x, y) pairs."""
+
+    def __init__(self, total_samples, hidden_dim, seed=0, dtype=np.float32):
+        rng = np.random.default_rng(seed)
+        self.x = rng.normal(size=(total_samples, hidden_dim)).astype(dtype)
+        self.y = rng.normal(size=(total_samples, hidden_dim)).astype(dtype)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+
+def random_dataloader(model_engine, total_samples, hidden_dim, seed=0, dtype=np.float32):
+    batch_size = model_engine.train_micro_batch_size_per_gpu() * model_engine.dp_world_size
+    dataset = RandomDataset(total_samples, hidden_dim, seed=seed, dtype=dtype)
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+    return DeepSpeedDataLoader(dataset, batch_size=batch_size)
+
+
+def args_from_dict(tmpdir, config_dict):
+    """Write config json + build an args namespace (reference simple_model.py:157)."""
+    import argparse
+
+    config_path = os.path.join(str(tmpdir), "ds_config.json")
+    with open(config_path, "w") as f:
+        json.dump(config_dict, f)
+    parser = argparse.ArgumentParser()
+    args = parser.parse_args([])
+    args.deepspeed = True
+    args.deepspeed_config = config_path
+    args.local_rank = 0
+    args.deepscale_config = None
+    return args

@@ -1,0 +1,154 @@
+"""Checkpoint save/load round-trips (model: reference tests/unit/test_checkpointing.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from tests.unit.simple_model import args_from_dict, create_simple_model, random_dataloader
+
+
+def _cfg(zero_stage=0, fp16=False, scheduler=False):
+    cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+    }
+    if fp16:
+        cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    if zero_stage:
+        cfg["zero_optimization"] = {"stage": zero_stage}
+    if scheduler:
+        cfg["scheduler"] = {"type": "WarmupLR", "params": {"warmup_min_lr": 0, "warmup_max_lr": 0.01, "warmup_num_steps": 10}}
+    return cfg
+
+
+def _make_engine(tmpdir, cfg, seed=5):
+    model, params = create_simple_model(hidden_dim=16, seed=seed)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params
+    )
+    return engine
+
+
+def _train_steps(engine, steps, seed=3):
+    loader = random_dataloader(engine, total_samples=steps * engine.train_batch_size(), hidden_dim=16, seed=seed)
+    for x, y in loader:
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    return loss
+
+
+def _tree_equal(a, b):
+    fa = jax.tree_util.tree_leaves(jax.device_get(a))
+    fb = jax.tree_util.tree_leaves(jax.device_get(b))
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_allclose(np.asarray(x, np.float32), np.asarray(y, np.float32), rtol=1e-6)
+
+
+@pytest.mark.parametrize("zero_stage,fp16", [(0, False), (0, True), (1, True), (2, True)])
+def test_checkpoint_roundtrip(tmpdir, zero_stage, fp16):
+    save_dir = str(tmpdir.join("ckpt"))
+    cfg = _cfg(zero_stage=zero_stage, fp16=fp16)
+
+    engine = _make_engine(tmpdir, cfg)
+    _train_steps(engine, 4)
+    engine.save_checkpoint(save_dir)
+    saved_params = jax.device_get(engine.params)
+    saved_steps = engine.global_steps
+
+    engine2 = _make_engine(tmpdir, cfg, seed=99)  # different init
+    tag, client = engine2.load_checkpoint(save_dir)
+    assert tag is not None
+    assert engine2.global_steps == saved_steps
+    _tree_equal(engine2.params, saved_params)
+
+    # Continued training from the two engines must match exactly.
+    l1 = _train_steps(engine, 3, seed=17)
+    l2 = _train_steps(engine2, 3, seed=17)
+    np.testing.assert_allclose(float(jax.device_get(l1)), float(jax.device_get(l2)), rtol=1e-5)
+
+
+def test_checkpoint_latest_tag(tmpdir):
+    save_dir = str(tmpdir.join("ckpt"))
+    engine = _make_engine(tmpdir, _cfg())
+    _train_steps(engine, 2)
+    engine.save_checkpoint(save_dir, tag="tag_a")
+    _train_steps(engine, 2)
+    engine.save_checkpoint(save_dir, tag="tag_b")
+    with open(f"{save_dir}/latest") as f:
+        assert f.read().strip() == "tag_b"
+    engine2 = _make_engine(tmpdir, _cfg(), seed=42)
+    name, _ = engine2.load_checkpoint(save_dir)
+    assert "tag_b" in name
+
+
+def test_checkpoint_client_state(tmpdir):
+    save_dir = str(tmpdir.join("ckpt"))
+    engine = _make_engine(tmpdir, _cfg())
+    _train_steps(engine, 2)
+    engine.save_checkpoint(save_dir, client_state={"epoch": 7, "note": "hello"})
+    engine2 = _make_engine(tmpdir, _cfg(), seed=42)
+    _, client = engine2.load_checkpoint(save_dir)
+    assert client["epoch"] == 7
+    assert client["note"] == "hello"
+
+
+def test_checkpoint_lr_scheduler(tmpdir):
+    save_dir = str(tmpdir.join("ckpt"))
+    cfg = _cfg(scheduler=True)
+    engine = _make_engine(tmpdir, cfg)
+    _train_steps(engine, 4)
+    it = engine.lr_scheduler.last_batch_iteration
+    engine.save_checkpoint(save_dir)
+    engine2 = _make_engine(tmpdir, cfg, seed=42)
+    engine2.load_checkpoint(save_dir)
+    assert engine2.lr_scheduler.last_batch_iteration == it
+
+
+def test_checkpoint_missing_dir(tmpdir):
+    engine = _make_engine(tmpdir, _cfg())
+    name, client = engine.load_checkpoint(str(tmpdir.join("nope")))
+    assert name is None
+    assert client == {}
+
+
+def test_zero_offload_checkpoint_roundtrip(tmpdir):
+    """Offload checkpoints must capture the HOST master, and training must
+    continue identically after reload."""
+    save_dir = str(tmpdir.join("ckpt"))
+    cfg = _cfg(zero_stage=2, fp16=True)
+    cfg["zero_optimization"]["cpu_offload"] = True
+
+    engine = _make_engine(tmpdir, cfg)
+    _train_steps(engine, 4)
+    engine.save_checkpoint(save_dir)
+
+    engine2 = _make_engine(tmpdir, cfg, seed=99)
+    engine2.load_checkpoint(save_dir)
+    _tree_equal(engine2.params, jax.device_get(engine.params))
+
+    l1 = _train_steps(engine, 3, seed=21)
+    l2 = _train_steps(engine2, 3, seed=21)
+    np.testing.assert_allclose(float(jax.device_get(l1)), float(jax.device_get(l2)), rtol=1e-4)
+
+
+def test_zero_checkpoint_save_before_step(tmpdir):
+    """Saving immediately after initialize (before any step) must work."""
+    save_dir = str(tmpdir.join("ckpt"))
+    engine = _make_engine(tmpdir, _cfg(zero_stage=1, fp16=True))
+    assert engine.save_checkpoint(save_dir)
+
+
+def test_zero_checkpoint_shard_files(tmpdir):
+    save_dir = str(tmpdir.join("ckpt"))
+    engine = _make_engine(tmpdir, _cfg(zero_stage=2, fp16=True))
+    _train_steps(engine, 2)
+    engine.save_checkpoint(save_dir, tag="z")
+    import glob
+
+    shards = glob.glob(f"{save_dir}/z/zero_pp_rank_*optim_states.pt")
+    assert len(shards) == engine.dp_world_size

@@ -1,0 +1,75 @@
+"""Dynamic loss-scale schedule tests (model: reference tests/unit/test_dynamic_loss_scale.py)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    DynamicLossScaler,
+    init_dynamic_scaler_state,
+    update_scaler,
+)
+
+
+def test_fused_no_overflow_growth():
+    s = DynamicLossScaler(init_scale=2**8, scale_window=2)
+    expected = 2**8
+    for i in range(10):
+        assert s.loss_scale == expected
+        s.update_scale(False)
+        if (i + 1) % 2 == 0:
+            expected *= 2
+
+
+def test_overflow_halves():
+    s = DynamicLossScaler(init_scale=2**8, scale_window=2)
+    s.update_scale(True)
+    assert s.loss_scale == 2**7
+    s.update_scale(True)
+    assert s.loss_scale == 2**6
+
+
+def test_min_scale():
+    s = DynamicLossScaler(init_scale=4, min_scale=1, scale_window=2)
+    for _ in range(5):
+        s.update_scale(True)
+    assert s.loss_scale == 1
+
+
+def test_hysteresis():
+    s = DynamicLossScaler(init_scale=2**8, delayed_shift=3, scale_window=1000)
+    s.update_scale(True)  # hysteresis 3->2, no change
+    assert s.loss_scale == 2**8
+    s.update_scale(True)  # hysteresis 2->1, no change
+    assert s.loss_scale == 2**8
+    s.update_scale(True)  # now halves
+    assert s.loss_scale == 2**7
+
+
+def test_some_overflow_resets_window():
+    s = DynamicLossScaler(init_scale=2**8, scale_window=4)
+    s.update_scale(False)
+    s.update_scale(False)
+    s.update_scale(True)  # overflow at iter 2
+    assert s.loss_scale == 2**7
+    # window restarts from overflow iter: growth only after 4 clean steps
+    for _ in range(3):
+        s.update_scale(False)
+        assert s.loss_scale == 2**7
+    s.update_scale(False)
+    assert s.loss_scale == 2**8
+
+
+def test_functional_matches_host_class():
+    """The jit-side functional scaler must track the host-side class exactly."""
+    rng = np.random.default_rng(0)
+    overflows = rng.random(200) < 0.1
+
+    host = DynamicLossScaler(init_scale=2**16, scale_window=10, delayed_shift=2, min_scale=1)
+    dev = init_dynamic_scaler_state(init_scale=2**16, delayed_shift=2)
+    for of in overflows:
+        host.update_scale(bool(of))
+        dev = update_scaler(dev, bool(of), scale_window=10, min_scale=1, delayed_shift=2)
+        assert float(dev.cur_scale) == host.cur_scale, (
+            f"diverged at iter {host.cur_iter}: dev={float(dev.cur_scale)} host={host.cur_scale}"
+        )

@@ -1,0 +1,147 @@
+"""Engine precision/optimizer matrix tests (model: reference tests/unit/test_fp16.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from tests.unit.simple_model import SimpleModel, args_from_dict, create_simple_model, random_dataloader
+
+
+def _train(engine, hidden_dim, steps=10, seed=0):
+    loader = random_dataloader(engine, total_samples=steps * engine.train_batch_size(), hidden_dim=hidden_dim, seed=seed)
+    losses = []
+    for i, (x, y) in enumerate(loader):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    return losses
+
+
+def _base_config(optimizer="Adam", fp16=True, zero_stage=0, cpu_offload=False, static_scale=None):
+    cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 100,
+        "optimizer": {"type": optimizer, "params": {"lr": 0.01}},
+        "gradient_clipping": 1.0,
+    }
+    if fp16:
+        cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+        if static_scale is not None:
+            cfg["fp16"] = {"enabled": True, "loss_scale": static_scale}
+    if zero_stage:
+        cfg["zero_optimization"] = {"stage": zero_stage, "cpu_offload": cpu_offload}
+    return cfg
+
+
+@pytest.mark.parametrize("optimizer", ["Adam", "AdamW", "Lamb", "SGD"])
+def test_optimizer_matrix_fp32(tmpdir, optimizer):
+    cfg = _base_config(optimizer=optimizer, fp16=False)
+    model, params = create_simple_model(hidden_dim=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params
+    )
+    losses = _train(engine, hidden_dim=16)
+    assert losses[-1] < losses[0], f"no learning: {losses}"
+
+
+@pytest.mark.parametrize("optimizer", ["Adam", "Lamb"])
+def test_optimizer_matrix_fp16(tmpdir, optimizer):
+    cfg = _base_config(optimizer=optimizer, fp16=True)
+    model, params = create_simple_model(hidden_dim=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params
+    )
+    losses = _train(engine, hidden_dim=16)
+    assert losses[-1] < losses[0]
+
+
+def test_static_loss_scale(tmpdir):
+    cfg = _base_config(fp16=True, static_scale=128.0)
+    model, params = create_simple_model(hidden_dim=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params
+    )
+    assert engine.loss_scale() == 128.0
+    losses = _train(engine, hidden_dim=16)
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("zero_stage", [1, 2])
+def test_zero_stages(tmpdir, zero_stage):
+    cfg = _base_config(fp16=True, zero_stage=zero_stage)
+    model, params = create_simple_model(hidden_dim=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params
+    )
+    losses = _train(engine, hidden_dim=16)
+    assert losses[-1] < losses[0], f"zero stage {zero_stage} no learning: {losses}"
+
+
+def test_zero_offload(tmpdir):
+    cfg = _base_config(fp16=True, zero_stage=2, cpu_offload=True)
+    model, params = create_simple_model(hidden_dim=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params
+    )
+    losses = _train(engine, hidden_dim=16)
+    assert losses[-1] < losses[0], f"offload no learning: {losses}"
+
+
+def test_zero_vs_dp_equivalence(tmpdir):
+    """ZeRO sharding must not change the math: same seeds => same losses as DP."""
+    losses = {}
+    for stage in [0, 2]:
+        cfg = _base_config(fp16=False, zero_stage=stage)
+        model, params = create_simple_model(hidden_dim=16, seed=7)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params
+        )
+        losses[stage] = _train(engine, hidden_dim=16, seed=11)
+    np.testing.assert_allclose(losses[0], losses[2], rtol=2e-4)
+
+
+def test_zero_untested_optimizer_rejected(tmpdir):
+    cfg = _base_config(optimizer="SGD", fp16=True, zero_stage=1)
+    model, params = create_simple_model(hidden_dim=16)
+    with pytest.raises(AssertionError):
+        deepspeed_tpu.initialize(args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params)
+
+
+def test_zero_allow_untested_optimizer(tmpdir):
+    cfg = _base_config(optimizer="SGD", fp16=True, zero_stage=1)
+    cfg["zero_allow_untested_optimizer"] = True
+    model, params = create_simple_model(hidden_dim=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params
+    )
+    losses = _train(engine, hidden_dim=16)
+    assert losses[-1] < losses[0]
+
+
+def test_grad_accumulation(tmpdir):
+    cfg = _base_config(fp16=False)
+    cfg["train_batch_size"] = 16
+    cfg["gradient_accumulation_steps"] = 2
+    model, params = create_simple_model(hidden_dim=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params
+    )
+    assert engine.train_micro_batch_size_per_gpu() * 2 * engine.dp_world_size == 16
+    losses = _train(engine, hidden_dim=16, steps=6)
+    # steps only applied at boundaries
+    assert engine.global_steps * 2 == engine.micro_steps
+    assert losses[-1] < losses[0]
+
+
+def test_bf16(tmpdir):
+    cfg = _base_config(fp16=False)
+    cfg["bf16"] = {"enabled": True}
+    model, params = create_simple_model(hidden_dim=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params
+    )
+    losses = _train(engine, hidden_dim=16)
+    assert losses[-1] < losses[0]

@@ -1,0 +1,92 @@
+"""LR schedule tests (model: reference tests/unit/test_lr_schedulers.py)."""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest,
+    OneCycle,
+    WarmupDecayLR,
+    WarmupLR,
+    get_lr_schedule,
+)
+
+
+def test_warmup_lr_reaches_max():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10)
+    lrs = [s.step()[0] for _ in range(20)]
+    assert lrs[0] < lrs[5] < lrs[9]
+    assert lrs[10] == pytest.approx(0.1)
+    assert lrs[19] == pytest.approx(0.1)
+
+
+def test_warmup_decay_lr_decays_to_zero():
+    s = WarmupDecayLR(total_num_steps=20, warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10)
+    lrs = [s.step()[0] for _ in range(21)]
+    assert max(lrs) == pytest.approx(0.1)
+    assert lrs[-1] == pytest.approx(0.0, abs=1e-6)
+    # monotone decay after warmup
+    assert all(a >= b for a, b in zip(lrs[10:], lrs[11:]))
+
+
+def test_warmup_is_log_shaped():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=100)
+    assert s.step()[0] == pytest.approx(0.0)  # log(1) = 0
+    assert s.step()[0] == pytest.approx(math.log(2) / math.log(100), rel=1e-6)
+
+
+def test_lr_range_test_continuous():
+    s = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=10, lr_range_test_step_rate=1.0)
+    lrs = [s.step()[0] for _ in range(30)]
+    assert lrs[0] == pytest.approx(0.01 * (1 + 1 / 10))
+    assert lrs[-1] > lrs[0]
+
+
+def test_lr_range_test_staircase():
+    s = LRRangeTest(
+        lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+        lr_range_test_step_rate=1.0, lr_range_test_staircase=True,
+    )
+    lrs = [s.step()[0] for _ in range(25)]
+    # interval = floor((iter+1)/step_size): first stair spans iters 0..8
+    assert len(set(lrs[:9])) == 1
+    assert len(set(lrs[9:19])) == 1
+    assert lrs[9] > lrs[0]
+
+
+def test_one_cycle_shape():
+    s = OneCycle(cycle_min_lr=0.0, cycle_max_lr=0.1, cycle_first_step_size=10)
+    lrs = [s.step()[0] for _ in range(30)]
+    peak_idx = lrs.index(max(lrs))
+    assert 8 <= peak_idx <= 11
+    assert lrs[0] < lrs[peak_idx]
+    assert lrs[-1] < lrs[peak_idx]
+
+
+def test_one_cycle_momentum_opposes_lr():
+    s = OneCycle(cycle_min_lr=0.0, cycle_max_lr=0.1, cycle_first_step_size=10,
+                 cycle_min_mom=0.8, cycle_max_mom=0.9)
+    s.step()
+    mom_start = s.get_mom()[0]
+    for _ in range(9):
+        s.step()
+    mom_peak = s.get_mom()[0]
+    assert mom_peak < mom_start  # momentum dips as lr peaks
+
+
+def test_get_lr_schedule_by_name():
+    s = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.5})
+    assert isinstance(s, WarmupLR)
+    with pytest.raises(ValueError):
+        get_lr_schedule("Nonsense", {})
+
+
+def test_state_dict_roundtrip():
+    s = WarmupDecayLR(total_num_steps=100, warmup_max_lr=0.1, warmup_num_steps=10)
+    for _ in range(7):
+        s.step()
+    sd = s.state_dict()
+    s2 = WarmupDecayLR(total_num_steps=100, warmup_max_lr=0.1, warmup_num_steps=10)
+    s2.load_state_dict(sd)
+    assert s2.step()[0] == s.step()[0]

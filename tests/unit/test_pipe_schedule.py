@@ -1,0 +1,109 @@
+"""Pipeline schedule invariants (model: reference tests/unit/test_pipe_schedule.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe import schedule as S
+
+
+def _flat(sched):
+    return [cmd for step in sched.steps() for cmd in step]
+
+
+def test_inference_all_microbatches_forwarded():
+    sched = S.InferenceSchedule(micro_batches=4, stages=2, stage_id=0)
+    fwds = [c for c in _flat(sched) if isinstance(c, S.ForwardPass)]
+    assert len(fwds) == 4
+
+
+def test_inference_conveyor_timing():
+    # stage s first forward happens at tick s
+    for s in range(3):
+        sched = S.InferenceSchedule(micro_batches=2, stages=3, stage_id=s)
+        steps = list(sched.steps())
+        first_fwd_tick = next(i for i, step in enumerate(steps) if any(isinstance(c, S.ForwardPass) for c in step))
+        assert first_fwd_tick == s
+
+
+@pytest.mark.parametrize("micro_batches,stages", [(1, 1), (4, 2), (8, 4), (3, 4)])
+def test_train_schedule_counts(micro_batches, stages):
+    for stage_id in range(stages):
+        sched = S.TrainSchedule(micro_batches=micro_batches, stages=stages, stage_id=stage_id)
+        cmds = _flat(sched)
+        fwd = [c for c in cmds if isinstance(c, S.ForwardPass)]
+        bwd = [c for c in cmds if isinstance(c, S.BackwardPass)]
+        assert len(fwd) == micro_batches
+        assert len(bwd) == micro_batches
+        assert sum(isinstance(c, S.OptimizerStep) for c in cmds) == 1
+        assert sum(isinstance(c, S.ReduceGrads) for c in cmds) == 1
+        assert sum(isinstance(c, S.ReduceTiedGrads) for c in cmds) == 1
+
+
+def test_train_schedule_send_recv_pairing():
+    """Every SendActivation on stage s must have a matching RecvActivation on
+    stage s+1, in the same order (the cross-stage contract)."""
+    M, Stg = 4, 3
+    scheds = [S.TrainSchedule(M, Stg, s) for s in range(Stg)]
+    for s in range(Stg - 1):
+        sends = [c.buffer_id for c in _flat(scheds[s]) if isinstance(c, S.SendActivation)]
+        recvs = [c.buffer_id for c in _flat(scheds[s + 1]) if isinstance(c, S.RecvActivation)]
+        assert len(sends) == len(recvs) == M
+        grad_sends = [c.buffer_id for c in _flat(scheds[s + 1]) if isinstance(c, S.SendGrad)]
+        grad_recvs = [c.buffer_id for c in _flat(scheds[s]) if isinstance(c, S.RecvGrad)]
+        assert len(grad_sends) == len(grad_recvs) == M
+
+
+def test_train_schedule_backward_after_forward():
+    sched = S.TrainSchedule(micro_batches=4, stages=2, stage_id=0)
+    seen_fwd = set()
+    for step in sched.steps():
+        for cmd in step:
+            if isinstance(cmd, S.ForwardPass):
+                seen_fwd.add(cmd.buffer_id)
+            if isinstance(cmd, S.BackwardPass):
+                # backward for a microbatch only after its forward
+                assert cmd.buffer_id in seen_fwd
+
+
+def test_train_schedule_1f1b_warmup():
+    """First stage of a deep pipe runs (stages-1) forwards before any backward."""
+    sched = S.TrainSchedule(micro_batches=8, stages=4, stage_id=0)
+    cmds = _flat(sched)
+    first_bwd = next(i for i, c in enumerate(cmds) if isinstance(c, S.BackwardPass))
+    n_fwd_before = sum(isinstance(c, S.ForwardPass) for c in cmds[:first_bwd])
+    # warmup (stages-1) plus the leading forward of the first 1F1B pair
+    assert n_fwd_before == 4
+
+
+def test_last_stage_alternates_immediately():
+    sched = S.TrainSchedule(micro_batches=4, stages=4, stage_id=3)
+    cmds = [c for c in _flat(sched) if isinstance(c, (S.ForwardPass, S.BackwardPass))]
+    kinds = [type(c).__name__ for c in cmds]
+    assert kinds == ["ForwardPass", "BackwardPass"] * 4
+
+
+def test_num_pipe_buffers_bounded():
+    for stages in [2, 4]:
+        for stage_id in range(stages):
+            sched = S.TrainSchedule(micro_batches=8, stages=stages, stage_id=stage_id)
+            n = sched.num_pipe_buffers()
+            assert 2 <= n <= 8
+            # all buffer ids used must be < n
+            for c in _flat(sched):
+                if hasattr(c, "buffer_id"):
+                    assert c.buffer_id < n
+
+
+def test_data_parallel_schedule():
+    sched = S.DataParallelSchedule(micro_batches=3, stages=1, stage_id=0)
+    steps = list(sched.steps())
+    assert len(steps) == 3
+    assert any(isinstance(c, S.OptimizerStep) for c in steps[-1])
+    assert not any(isinstance(c, S.OptimizerStep) for c in steps[0])
+
+
+def test_instruction_repr_and_eq():
+    a = S.ForwardPass(buffer_id=1)
+    b = S.ForwardPass(buffer_id=1)
+    c = S.ForwardPass(buffer_id=2)
+    assert a == b and a != c
+    assert "ForwardPass" in repr(a)

@@ -1,0 +1,103 @@
+"""Launcher hostfile/filter parsing tests (model: reference tests/unit/test_run.py)."""
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (
+    decode_world_info,
+    encode_world_info,
+    fetch_hostfile,
+    parse_resource_filter,
+)
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text(
+        """
+worker-0 slots=4
+worker-1 slots=4
+# comment line
+worker-2 slots=2
+""".strip()
+    )
+    return str(p)
+
+
+def test_fetch_hostfile(hostfile):
+    pool = fetch_hostfile(hostfile)
+    assert list(pool.keys()) == ["worker-0", "worker-1", "worker-2"]
+    assert pool["worker-0"] == 4
+    assert pool["worker-2"] == 2
+
+
+def test_fetch_hostfile_missing():
+    assert fetch_hostfile("/nonexistent/hostfile") is None
+
+
+def test_fetch_hostfile_bad_format(tmp_path):
+    p = tmp_path / "bad"
+    p.write_text("worker-0 gpus=4\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(str(p))
+
+
+def test_fetch_hostfile_duplicate(tmp_path):
+    p = tmp_path / "dup"
+    p.write_text("worker-0 slots=4\nworker-0 slots=2\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(str(p))
+
+
+def _pool():
+    return {"worker-0": 4, "worker-1": 4}
+
+
+def test_no_filter():
+    out = parse_resource_filter(_pool())
+    assert out == {"worker-0": [0, 1, 2, 3], "worker-1": [0, 1, 2, 3]}
+
+
+def test_include_whole_host():
+    out = parse_resource_filter(_pool(), include_str="worker-1")
+    assert out == {"worker-1": [0, 1, 2, 3]}
+
+
+def test_include_slots():
+    out = parse_resource_filter(_pool(), include_str="worker-0:0,2")
+    assert out == {"worker-0": [0, 2]}
+
+
+def test_include_multi_host():
+    out = parse_resource_filter(_pool(), include_str="worker-0:1@worker-1:3")
+    assert out == {"worker-0": [1], "worker-1": [3]}
+
+
+def test_exclude_whole_host():
+    out = parse_resource_filter(_pool(), exclude_str="worker-0")
+    assert out == {"worker-1": [0, 1, 2, 3]}
+
+
+def test_exclude_slots():
+    out = parse_resource_filter(_pool(), exclude_str="worker-1:1,2")
+    assert out == {"worker-0": [0, 1, 2, 3], "worker-1": [0, 3]}
+
+
+def test_include_and_exclude_conflict():
+    with pytest.raises(ValueError):
+        parse_resource_filter(_pool(), include_str="worker-0", exclude_str="worker-1")
+
+
+def test_include_unknown_host():
+    with pytest.raises(ValueError):
+        parse_resource_filter(_pool(), include_str="worker-9")
+
+
+def test_include_unknown_slot():
+    with pytest.raises(ValueError):
+        parse_resource_filter(_pool(), include_str="worker-0:7")
+
+
+def test_world_info_roundtrip():
+    info = {"worker-0": [0, 1], "worker-1": [0]}
+    assert decode_world_info(encode_world_info(info)) == info

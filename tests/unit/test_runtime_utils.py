@@ -1,0 +1,122 @@
+"""Runtime-utils tests (model: reference tests/unit/test_runtime_utils.py + test_partition.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.utils import (
+    CheckOverflow,
+    PartitionedTensor,
+    clip_grad_norm_,
+    global_norm,
+    has_overflow,
+    partition_balanced,
+    partition_uniform,
+    prefix_sum_inc,
+)
+from deepspeed_tpu.ops.utils_op import (
+    flatten_dense_tensors,
+    pad_to_multiple,
+    tree_spec,
+    unflatten_dense_tensors,
+)
+
+
+def test_partition_uniform():
+    parts = partition_uniform(10, 5)
+    assert parts == [0, 2, 4, 6, 8, 10]
+    parts = partition_uniform(3, 5)
+    assert parts[-1] == 3
+    assert len(parts) == 6
+
+
+def test_partition_balanced_equal_weights():
+    parts = partition_balanced([1] * 10, 2)
+    assert parts == [0, 5, 10]
+
+
+def test_partition_balanced_skewed():
+    weights = [10, 1, 1, 1, 1, 1, 1, 1, 1, 1]
+    parts = partition_balanced(weights, 2)
+    # first part should just hold the heavy item
+    assert parts[1] <= 2
+    assert parts[-1] == 10
+
+
+def test_partition_balanced_bounds():
+    for n, p in [(10, 3), (7, 7), (20, 4), (5, 8)]:
+        weights = list(np.random.default_rng(n).integers(1, 10, n))
+        parts = partition_balanced(weights, p)
+        assert len(parts) == p + 1
+        assert parts[0] == 0 and parts[-1] == n
+        assert all(a <= b for a, b in zip(parts, parts[1:]))
+
+
+def test_prefix_sum():
+    assert prefix_sum_inc([1, 2, 3]) == [1, 3, 6]
+
+
+def test_flatten_unflatten_roundtrip():
+    tree = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3), "b": {"c": jnp.ones((4,), jnp.bfloat16)}}
+    treedef, shapes, dtypes, sizes = tree_spec(tree)
+    flat = flatten_dense_tensors(tree)
+    assert flat.shape[0] == sum(sizes)
+    back = unflatten_dense_tensors(flat, treedef, shapes, dtypes)
+    for a, b in zip(jax.tree_util.tree_leaves(tree), jax.tree_util.tree_leaves(back)):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b, np.float32))
+
+
+def test_pad_to_multiple():
+    flat = jnp.arange(10, dtype=jnp.float32)
+    padded, n = pad_to_multiple(flat, 8)
+    assert padded.shape[0] == 16
+    assert n == 10
+    np.testing.assert_allclose(padded[10:], 0)
+
+
+def test_has_overflow():
+    good = {"w": jnp.ones((4,))}
+    bad = {"w": jnp.asarray([1.0, jnp.inf, 0.0, 2.0])}
+    nan = {"w": jnp.asarray([1.0, jnp.nan, 0.0, 2.0])}
+    assert not bool(has_overflow(good))
+    assert bool(has_overflow(bad))
+    assert bool(has_overflow(nan))
+    assert CheckOverflow().has_overflow(bad)
+
+
+def test_clip_grad_norm():
+    grads = {"w": jnp.full((100,), 1.0)}
+    clipped, norm = clip_grad_norm_(grads, 1.0)
+    assert float(norm) == pytest.approx(10.0)
+    assert float(global_norm(clipped)) == pytest.approx(1.0, rel=1e-4)
+    # under the limit: untouched
+    small = {"w": jnp.full((4,), 0.01)}
+    clipped, _ = clip_grad_norm_(small, 1.0)
+    np.testing.assert_allclose(np.asarray(clipped["w"]), 0.01, rtol=1e-5)
+
+
+def test_partitioned_tensor_host_roundtrip():
+    x = jnp.arange(10, dtype=jnp.float32).reshape(2, 5)
+    parts = [PartitionedTensor(x, group_size=4, rank=r) for r in range(4)]
+    full = parts[0].full(gathered=[p.local_data for p in parts])
+    np.testing.assert_allclose(np.asarray(full), np.asarray(x))
+    meta = parts[0].to_meta()
+    assert tuple(meta["orig_shape"]) == (2, 5)
+
+
+def test_partitioned_tensor_collective():
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("data",))
+    x = jnp.arange(12, dtype=jnp.float32).reshape(3, 4)
+
+    def f(_):
+        pt = PartitionedTensor(x, group_size=4, rank=jax.lax.axis_index("data"), axis_name="data")
+        return pt.full()
+
+    out = jax.shard_map(f, mesh=mesh, in_specs=(P("data"),), out_specs=P(), check_vma=False)(jnp.zeros((4,)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))

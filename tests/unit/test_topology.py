@@ -1,0 +1,93 @@
+"""Topology grid math tests (model: reference tests/unit/test_topology.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.pipe.topology import (
+    PipeDataParallelTopology,
+    PipeModelDataParallelTopology,
+    PipelineParallelGrid,
+    ProcessTopology,
+)
+
+
+def test_topology_2d():
+    topo = ProcessTopology(axes=["row", "col"], dims=[2, 2])
+    assert topo.world_size() == 4
+    assert topo.get_rank(row=0, col=0) == 0
+    assert topo.get_rank(row=0, col=1) == 1
+    assert topo.get_rank(row=1, col=0) == 2
+    assert topo.get_rank(row=1, col=1) == 3
+    assert topo.get_coord(2) == topo.ProcessCoord(row=1, col=0)
+
+
+def test_topology_missing_axis():
+    topo = ProcessTopology(axes=["a", "b"], dims=[2, 3])
+    with pytest.raises(ValueError):
+        topo.get_rank(a=0)
+
+
+def test_topology_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    # data lists: ranks differing only in data coord
+    data_lists = topo.get_axis_comm_lists("data")
+    assert [0, 1] in data_lists and [2, 3] in data_lists
+    pipe_lists = topo.get_axis_comm_lists("pipe")
+    assert [0, 2] in pipe_lists and [1, 3] in pipe_lists
+
+
+def test_topology_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    assert topo.world_size() == 8
+    ranks = topo.filter_match(pipe=0)
+    assert len(ranks) == 4
+    assert all(topo.get_coord(r).pipe == 0 for r in ranks)
+
+
+def test_topology_axis_list():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    lst = topo.get_axis_list("pipe", 1)
+    assert len(lst) == 4
+    assert all(topo.get_coord(r).pipe == 1 for r in lst)
+
+
+def test_rank_repr():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=1)
+    s = topo.get_rank_repr(rank=0)
+    assert "pipe_00" in s and "model_00" in s
+
+
+def test_grid_pipe_data():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, global_rank=0)
+    assert grid.pipe_parallel_size == 2
+    assert grid.data_parallel_size == 2
+    assert grid.model_parallel_size == 1
+    assert grid.get_stage_id() == 0
+    assert not grid.is_last_stage()
+    # stage_to_global from rank 0 (pipe 0, data 0) to stage 1 keeps data coord
+    assert grid.stage_to_global(1) == topo.get_rank(pipe=1, data=0)
+
+
+def test_grid_3d():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    grid = PipelineParallelGrid(topology=topo, global_rank=3)
+    assert grid.world_size == 8
+    assert grid.get_slice_parallel_world_size() == 2
+    # model groups cover all ranks exactly once
+    seen = sorted(r for g in grid.slice_group_ranks for r in g)
+    assert seen == list(range(8))
+
+
+def test_grid_default_topology():
+    grid = PipelineParallelGrid(world_size=4)
+    assert grid.data_parallel_size == 4
+    assert grid.pipe_parallel_size == 1
+
+
+def test_p2p_groups():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=1)
+    grid = PipelineParallelGrid(topology=topo, global_rank=0)
+    assert [0, 1] in grid.p2p_groups
+    assert [1, 2] in grid.p2p_groups
+    assert [2, 3] in grid.p2p_groups
+    assert [3, 0] in grid.p2p_groups  # wraparound
