@@ -79,15 +79,19 @@ class BertEmbeddings(nn.Module):
 
 
 class _ScannedLayer(nn.Module):
-    """Scan body: one transformer layer; params stack along the scan axis."""
+    """Scan body: one transformer layer; params stack along the scan axis.
+
+    ``deterministic`` is a static field (NOT part of the scan carry — a traced
+    bool there would break the Python-level dropout branch in the layer)."""
 
     layer_cfg: DeepSpeedTransformerConfig
+    deterministic: bool = False
 
     @nn.compact
     def __call__(self, carry, _):
-        h, mask, deterministic = carry
-        h = DeepSpeedTransformerLayer(self.layer_cfg)(h, mask, deterministic=deterministic)
-        return (h, mask, deterministic), None
+        h, mask = carry
+        h = DeepSpeedTransformerLayer(self.layer_cfg)(h, mask, deterministic=self.deterministic)
+        return (h, mask), None
 
 
 class BertEncoder(nn.Module):
@@ -108,7 +112,7 @@ class BertEncoder(nn.Module):
             length=cfg.num_hidden_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        (h, _, _), _ = ScanStack(cfg.layer_config())((hidden_states, attention_mask, deterministic), None)
+        (h, _), _ = ScanStack(cfg.layer_config(), deterministic)((hidden_states, attention_mask), None)
         return h
 
 

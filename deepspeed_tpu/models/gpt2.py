@@ -69,13 +69,17 @@ def causal_mask(seq_len, dtype=jnp.float32):
 
 
 class _ScannedDecoderLayer(nn.Module):
+    """``deterministic`` is a static field, NOT scan carry (a traced bool there
+    would break the Python-level dropout branch in the layer)."""
+
     layer_cfg: DeepSpeedTransformerConfig
+    deterministic: bool = False
 
     @nn.compact
     def __call__(self, carry, _):
-        h, mask, deterministic = carry
-        h = DeepSpeedTransformerLayer(self.layer_cfg)(h, mask, deterministic=deterministic)
-        return (h, mask, deterministic), None
+        h, mask = carry
+        h = DeepSpeedTransformerLayer(self.layer_cfg)(h, mask, deterministic=self.deterministic)
+        return (h, mask), None
 
 
 class GPT2Model(nn.Module):
@@ -104,7 +108,7 @@ class GPT2Model(nn.Module):
             length=cfg.num_hidden_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        (h, _, _), _ = ScanStack(cfg.layer_config())((h, mask, deterministic), None)
+        (h, _), _ = ScanStack(cfg.layer_config(), deterministic)((h, mask), None)
         h = nn.LayerNorm(name="ln_f")(h)
         logits = h @ word.embedding.T.astype(h.dtype)
         return logits

