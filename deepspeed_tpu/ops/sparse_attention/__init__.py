@@ -1,0 +1,14 @@
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    SparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    VariableSparsityConfig,
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+)
+from deepspeed_tpu.ops.sparse_attention.matmul import MatMul, Softmax
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import SparseSelfAttention
+from deepspeed_tpu.ops.sparse_attention.bert_sparse_self_attention import (
+    BertSparseSelfAttention,
+)
+from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import SparseAttentionUtils

@@ -1,0 +1,48 @@
+"""BertSparseSelfAttention: BERT-style QKV projection + SparseSelfAttention.
+
+Capability parity with the reference ``deepspeed/ops/sparse_attention/
+bert_sparse_self_attention.py:9`` as a flax module.
+"""
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import SparseSelfAttention
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import FixedSparsityConfig
+
+
+class BertSparseSelfAttention(nn.Module):
+    """Drop-in sparse replacement for a BERT self-attention block.
+
+    Config carries hidden_size / num_attention_heads (reference takes a BERT
+    config object); ``sparsity_config`` picks the layout family.
+    """
+
+    hidden_size: int
+    num_attention_heads: int
+    sparsity_config: object = None
+
+    def setup(self):
+        if self.hidden_size % self.num_attention_heads != 0:
+            raise ValueError(
+                f"The hidden size ({self.hidden_size}) is not a multiple of "
+                f"the number of attention heads ({self.num_attention_heads})"
+            )
+        self.attention_head_size = self.hidden_size // self.num_attention_heads
+        self.query = nn.Dense(self.hidden_size)
+        self.key = nn.Dense(self.hidden_size)
+        self.value = nn.Dense(self.hidden_size)
+        cfg = self.sparsity_config or FixedSparsityConfig(num_heads=self.num_attention_heads)
+        self.sparse_self_attention = SparseSelfAttention(cfg)
+
+    def _transpose_for_scores(self, x):
+        B, S, _ = x.shape
+        return x.reshape(B, S, self.num_attention_heads, self.attention_head_size).transpose(0, 2, 1, 3)
+
+    def __call__(self, hidden_states, attention_mask=None):
+        q = self._transpose_for_scores(self.query(hidden_states))
+        k = self._transpose_for_scores(self.key(hidden_states))
+        v = self._transpose_for_scores(self.value(hidden_states))
+        ctx = self.sparse_self_attention(q, k, v, key_padding_mask=attention_mask)
+        B, H, S, D = ctx.shape
+        return ctx.transpose(0, 2, 1, 3).reshape(B, S, H * D)

@@ -1,0 +1,168 @@
+"""Block-sparse matmul ops (sdd / dsd / dds).
+
+Capability parity with the reference's Triton block-sparse ``MatMul``
+(``deepspeed/ops/sparse_attention/matmul.py`` + ``trsrc/matmul.tr``): the three
+sparse x dense product modes over a [H, S/B, S/B] block layout:
+
+- ``sdd``: dense @ dense -> sparse blocks (only layout-nonzero blocks computed)
+- ``dsd``: sparse @ dense -> dense
+- ``dds``: dense @ sparse -> dense
+
+TPU-first: the hot path (attention) uses the FUSED kernel in
+``ops/transformer/attention.py`` — on TPU separately materializing sparse
+score blocks then softmax then PV wastes HBM round-trips that the fused
+online-softmax kernel avoids. These standalone ops exist for API parity and
+for non-attention uses; they compute via gather/einsum over layout blocks,
+which XLA fuses into batched MXU matmuls over the nnz block list.
+
+Sparse operand format: [B, nnz, block, block] where nnz enumerates the
+layout's nonzero (h, i, j) blocks in row-major order (the reference's same
+packing).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class MatMul:
+    """Block-sparse matmul bound to a fixed layout (reference matmul.py)."""
+
+    def __init__(self, layout, block, mode, trans_a=False, trans_b=False):
+        if mode not in ("sdd", "dsd", "dds"):
+            raise NotImplementedError(f"Supported modes are: sdd, dsd, dds; got {mode}")
+        self.layout = np.asarray(layout)
+        self.block = block
+        self.mode = mode
+        self.trans_a = trans_a
+        self.trans_b = trans_b
+        H, self.nb_q, self.nb_k = self.layout.shape
+        self.num_heads = H
+        hh, ii, jj = np.nonzero(self.layout)
+        self.blocks_h = jnp.asarray(hh, jnp.int32)
+        self.blocks_i = jnp.asarray(ii, jnp.int32)
+        self.blocks_j = jnp.asarray(jj, jnp.int32)
+        self.nnz = len(hh)
+
+    def _split_blocks(self, x):
+        """[B, H, S, T] -> per-block gather [B, nnz, blk, blk_t]."""
+        B, H, S, T = x.shape
+        blk = self.block
+        xb = x.reshape(B, H, S // blk, blk, T // blk, blk).transpose(0, 1, 2, 4, 3, 5)
+        return xb[:, self.blocks_h, self.blocks_i, self.blocks_j]  # [B, nnz, blk, blk]
+
+    def _merge_blocks(self, vals, B, S, T):
+        """[B, nnz, blk, blk] -> dense [B, H, S, T] with zeros elsewhere."""
+        blk = self.block
+        out = jnp.zeros((B, self.num_heads, S // blk, T // blk, blk, blk), vals.dtype)
+        out = out.at[:, self.blocks_h, self.blocks_i, self.blocks_j].set(vals)
+        return out.transpose(0, 1, 2, 4, 3, 5).reshape(B, self.num_heads, S, T)
+
+    def __call__(self, a, b):
+        blk = self.block
+        if self.mode == "sdd":
+            # C_block(h,i,j) = op(a)[h, rows i] @ op(b)[h, cols j]
+            if self.trans_a:
+                a = jnp.swapaxes(a, -1, -2)
+            if self.trans_b:
+                b = jnp.swapaxes(b, -1, -2)
+            B = a.shape[0]
+            K = a.shape[-1]
+            a_blk = a.reshape(B, self.num_heads, self.nb_q, blk, K)
+            b_blk = b.reshape(B, self.num_heads, K, self.nb_k, blk)
+            a_sel = a_blk[:, self.blocks_h, self.blocks_i]          # [B, nnz, blk, K]
+            b_sel = b_blk[:, self.blocks_h, :, self.blocks_j]       # [nnz, B, K, blk]
+            b_sel = jnp.moveaxis(b_sel, 0, 1)                       # [B, nnz, K, blk]
+            return jnp.einsum("bnik,bnkj->bnij", a_sel, b_sel)
+        elif self.mode == "dsd":
+            # a sparse [B,nnz,blk,blk], b dense [B,H,S,D] -> dense [B,H,S,D]
+            if self.trans_a:
+                a = jnp.swapaxes(a, -1, -2)
+                rows, cols = self.blocks_j, self.blocks_i
+            else:
+                rows, cols = self.blocks_i, self.blocks_j
+            B = b.shape[0]
+            D = b.shape[-1]
+            nb_rows = self.nb_k if self.trans_a else self.nb_q
+            b_blk = b.reshape(B, self.num_heads, b.shape[2] // blk, blk, D)
+            b_sel = b_blk[:, self.blocks_h, cols]            # [B, nnz, blk, D]
+            prod = jnp.einsum("bnij,bnjd->bnid", a, b_sel)   # [B, nnz, blk, D]
+            out = jnp.zeros((B, self.num_heads, nb_rows, blk, D), prod.dtype)
+            out = out.at[:, self.blocks_h, rows].add(prod)
+            return out.reshape(B, self.num_heads, nb_rows * blk, D)
+        else:  # dds
+            if self.trans_b:
+                b = jnp.swapaxes(b, -1, -2)
+                rows, cols = self.blocks_j, self.blocks_i
+            else:
+                rows, cols = self.blocks_i, self.blocks_j
+            B = a.shape[0]
+            S = a.shape[2]
+            a_blk = a  # [B, H, S, K]
+            nb_cols = self.nb_q if self.trans_b else self.nb_k
+            a_split = a_blk.reshape(B, self.num_heads, S, a.shape[-1] // blk, blk)
+            a_sel = a_split[:, self.blocks_h, :, rows]        # [nnz? ...]
+            a_sel = jnp.moveaxis(a_sel, 0, 1)                 # [B, nnz, S, blk]
+            prod = jnp.einsum("bnsj,bnjk->bnsk", a_sel, b)    # [B, nnz, S, blk]
+            out = jnp.zeros((B, self.num_heads, S, nb_cols, blk), prod.dtype)
+            out = out.at[:, self.blocks_h, :, cols].add(jnp.moveaxis(prod, 1, 0))
+            return out.reshape(B, self.num_heads, S, nb_cols * blk)
+
+
+class Softmax:
+    """Block-sparse softmax over sparse score blocks (reference softmax.py:
+    rpe / key-padding / attention masks, scale)."""
+
+    def __init__(self, layout, block):
+        self.layout = np.asarray(layout)
+        self.block = block
+        H, self.nb_q, self.nb_k = self.layout.shape
+        self.num_heads = H
+        hh, ii, jj = np.nonzero(self.layout)
+        self.blocks_h = jnp.asarray(hh, jnp.int32)
+        self.blocks_i = jnp.asarray(ii, jnp.int32)
+        self.blocks_j = jnp.asarray(jj, jnp.int32)
+        self.nnz = len(hh)
+
+    def __call__(self, x, scale=1.0, rpe=None, key_padding_mask=None, attn_mask=None,
+                 key_padding_mask_mode="add", attn_mask_mode="add"):
+        """x: sparse blocks [B, nnz, blk, blk]; softmax over each row's
+        nonzero-union, computed via segment-wise max/sum across a row's blocks."""
+        blk = self.block
+        B = x.shape[0]
+        x = x.astype(jnp.float32) * scale
+
+        if rpe is not None:
+            rpe_blk = rpe.reshape(self.num_heads, self.nb_q, blk, self.nb_k, blk)
+            x = x + rpe_blk.transpose(0, 1, 3, 2, 4)[self.blocks_h, self.blocks_i, self.blocks_j][None]
+        if key_padding_mask is not None:
+            kp = key_padding_mask.reshape(B, self.nb_k, blk)
+            kp_sel = kp[:, self.blocks_j]                       # [B, nnz, blk]
+            if key_padding_mask_mode == "add":
+                x = x + kp_sel[:, :, None, :].astype(jnp.float32)
+            else:
+                x = jnp.where(kp_sel[:, :, None, :] != 0, x, -1e30)
+        if attn_mask is not None:
+            am_blk = attn_mask.reshape(self.nb_q, blk, self.nb_k, blk).transpose(0, 2, 1, 3)
+            am_sel = am_blk[self.blocks_i, self.blocks_j][None]
+            if attn_mask_mode == "add":
+                x = x + am_sel.astype(jnp.float32)
+            else:
+                x = jnp.where(am_sel != 0, x, -1e30)
+
+        # Row-wise online max/sum across each (h, i) row's blocks via segment ops.
+        seg_ids = self.blocks_h * self.nb_q + self.blocks_i     # [nnz]
+        n_seg = self.num_heads * self.nb_q
+        row_max_blk = jnp.max(x, axis=-1)                        # [B, nnz, blk]
+        seg_max = jax.ops.segment_max(
+            jnp.moveaxis(row_max_blk, 1, 0), seg_ids, num_segments=n_seg
+        )                                                        # [nseg, B, blk]? — moveaxis: [nnz, B, blk]
+        m = seg_max[seg_ids]                                     # [nnz, B, blk]
+        p = jnp.exp(x - jnp.moveaxis(m, 0, 1)[:, :, :, None])
+        row_sum_blk = jnp.sum(p, axis=-1)                        # [B, nnz, blk]
+        seg_sum = jax.ops.segment_sum(
+            jnp.moveaxis(row_sum_blk, 1, 0), seg_ids, num_segments=n_seg
+        )
+        l = jnp.moveaxis(seg_sum[seg_ids], 0, 1)[:, :, :, None]  # [B, nnz, blk, 1]
+        return (p / jnp.where(l > 0, l, 1.0)).astype(x.dtype)

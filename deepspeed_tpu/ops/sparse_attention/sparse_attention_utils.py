@@ -1,0 +1,86 @@
+"""Helpers to adopt sparse attention in HF-style transformer models.
+
+Capability parity with the reference ``deepspeed/ops/sparse_attention/
+sparse_attention_utils.py:13``: position-embedding extension, input padding to
+a block multiple, and swapping a model's self-attention for
+``BertSparseSelfAttention``.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention.bert_sparse_self_attention import (
+    BertSparseSelfAttention,
+)
+
+
+class SparseAttentionUtils:
+    """Static helpers (reference keeps the same static-class shape)."""
+
+    @staticmethod
+    def extend_position_embedding(params, max_position):
+        """Extend a position-embedding table to ``max_position`` rows by tiling
+        the trained rows (reference extends HF bert/roberta tables)."""
+
+        def extend(table):
+            cur = table.shape[0]
+            if cur >= max_position:
+                return table
+            reps = int(np.ceil(max_position / cur))
+            return jnp.tile(table, (reps, 1))[:max_position]
+
+        return extend(params) if hasattr(params, "shape") else jnp.asarray(params)
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position):
+        tokenizer.model_max_length = max_position
+        if hasattr(tokenizer, "init_kwargs"):
+            tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+        model_config, sparsity_config
+    ):
+        """Return a BertSparseSelfAttention factory for the model's shape; the
+        flax idiom is construct-time substitution rather than the reference's
+        in-place module surgery (module_inject does the recursive swap)."""
+        return BertSparseSelfAttention(
+            hidden_size=model_config.hidden_size,
+            num_attention_heads=model_config.num_attention_heads,
+            sparsity_config=sparsity_config,
+        )
+
+    @staticmethod
+    def pad_to_block_size(block_size, input_ids, attention_mask=None,
+                          token_type_ids=None, position_ids=None,
+                          inputs_embeds=None, pad_token_id=0, model_embeddings=None):
+        """Pad sequence length up to a block multiple (reference :138): returns
+        (pad_len, padded tensors...)."""
+        B, S = input_ids.shape[:2]
+        pad_len = (block_size - S % block_size) % block_size
+        if pad_len == 0:
+            return 0, input_ids, attention_mask, token_type_ids, position_ids, inputs_embeds
+
+        def pad(x, value=0):
+            if x is None:
+                return None
+            widths = [(0, 0)] * x.ndim
+            widths[1] = (0, pad_len)
+            return jnp.pad(x, widths, constant_values=value)
+
+        return (
+            pad_len,
+            pad(input_ids, pad_token_id),
+            pad(attention_mask, 0),
+            pad(token_type_ids, 0),
+            pad(position_ids, 0),
+            pad(inputs_embeds, 0),
+        )
+
+    @staticmethod
+    def unpad_sequence_output(pad_len, sequence_output):
+        if pad_len > 0:
+            sequence_output = sequence_output[:, :-pad_len]
+        return sequence_output

@@ -1,0 +1,113 @@
+"""Fused/block-sparse attention kernel numerics.
+
+Mirrors the reference's kernel-vs-dense-reference strategy
+(tests/unit/test_sparse_attention.py, test_cuda_forward.py): the Pallas kernel
+(interpret mode on CPU) must match the dense jnp reference, under dense,
+sparse-layout, masked, and causal configurations.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer.attention import (
+    _attention_pallas,
+    _attention_reference,
+    _dense_lut,
+    _expand_layout_mask,
+    flash_attention,
+    layout_to_lut,
+)
+
+
+def rand_qkv(B=2, H=2, S=256, D=64, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.3
+    return mk(), mk(), mk()
+
+
+def test_dense_kernel_matches_reference():
+    q, k, v = rand_qkv()
+    B, H, S, D = q.shape
+    bias = jnp.zeros((B, S), jnp.float32)
+    lut, counts = _dense_lut(H, S // 128, S // 128)
+    out_k = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
+                              causal=False, interpret=True)
+    out_r = _attention_reference(q, k, v, bias, None, causal=False)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r), atol=2e-5, rtol=2e-5)
+
+
+def test_masked_kernel_matches_reference():
+    q, k, v = rand_qkv(seed=1)
+    B, H, S, D = q.shape
+    rng = np.random.RandomState(2)
+    pad = rng.rand(B, S) < 0.2
+    bias = jnp.asarray(np.where(pad, -10000.0, 0.0).astype(np.float32))
+    lut, counts = _dense_lut(H, S // 128, S // 128)
+    out_k = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
+                              causal=False, interpret=True)
+    out_r = _attention_reference(q, k, v, bias, None, causal=False)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r), atol=2e-5, rtol=2e-5)
+
+
+def test_causal_kernel_matches_reference():
+    q, k, v = rand_qkv(seed=3)
+    B, H, S, D = q.shape
+    bias = jnp.zeros((B, S), jnp.float32)
+    lut, counts = _dense_lut(H, S // 128, S // 128)
+    out_k = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
+                              causal=True, interpret=True)
+    out_r = _attention_reference(q, k, v, bias, None, causal=True)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r), atol=2e-5, rtol=2e-5)
+
+
+def test_sparse_layout_kernel_matches_masked_reference():
+    q, k, v = rand_qkv(seed=4)
+    B, H, S, D = q.shape
+    nb = S // 128
+    rng = np.random.RandomState(5)
+    layout = (rng.rand(H, nb, nb) < 0.5).astype(np.int64)
+    layout[:, :, 0] = 1  # keep every row alive
+    bias = jnp.zeros((B, S), jnp.float32)
+    lut, counts = layout_to_lut(layout)
+    out_k = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
+                              causal=False, interpret=True)
+    out_r = _attention_reference(q, k, v, bias, _expand_layout_mask(layout, S, 128),
+                                 causal=False)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r), atol=2e-5, rtol=2e-5)
+
+
+def test_empty_rows_give_zero():
+    q, k, v = rand_qkv(seed=6)
+    B, H, S, D = q.shape
+    nb = S // 128
+    layout = np.ones((H, nb, nb), np.int64)
+    layout[0, 1, :] = 0  # head 0, q-block 1 attends to nothing
+    bias = jnp.zeros((B, S), jnp.float32)
+    lut, counts = layout_to_lut(layout)
+    out_k = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
+                              causal=False, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out_k[:, 0, 128:256, :]), 0.0)
+
+
+def test_flash_attention_grads():
+    """Public entry must be differentiable (rematerialized backward)."""
+    q, k, v = rand_qkv(B=1, H=2, S=128, D=32, seed=7)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for t in g:
+        assert np.isfinite(np.asarray(t)).all()
+
+    # matches autodiff through the reference math
+    def loss_ref(q, k, v):
+        bias = jnp.zeros((q.shape[0], q.shape[2]), jnp.float32)
+        return jnp.sum(_attention_reference(q, k, v, bias, None, causal=False) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5)

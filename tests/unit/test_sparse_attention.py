@@ -1,0 +1,131 @@
+"""Block-sparse attention ops vs dense reference.
+
+Mirrors the reference tests/unit/test_sparse_attention.py (349): sdd/dsd
+matmuls and the sparse softmax must equal dense computation restricted to the
+layout; SparseSelfAttention must equal dense attention masked by the layout.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BertSparseSelfAttention,
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    MatMul,
+    Softmax,
+    SparseSelfAttention,
+    VariableSparsityConfig,
+)
+from deepspeed_tpu.ops.transformer.attention import _attention_reference, _expand_layout_mask
+
+BLOCK = 16
+H, S, D = 2, 128, 32
+NB = S // BLOCK
+
+
+def rand_layout(seed=0, density=0.5):
+    rng = np.random.RandomState(seed)
+    layout = (rng.rand(H, NB, NB) < density).astype(np.int64)
+    layout[:, :, 0] = 1
+    return layout
+
+
+def blocks_to_dense(vals, layout, B, S, T):
+    """[B,nnz,blk,blk] -> dense with zeros at absent blocks."""
+    hh, ii, jj = np.nonzero(layout)
+    out = np.zeros((B, H, S, T), np.float32)
+    for n, (h, i, j) in enumerate(zip(hh, ii, jj)):
+        out[:, h, i * BLOCK:(i + 1) * BLOCK, j * BLOCK:(j + 1) * BLOCK] = vals[:, n]
+    return out
+
+
+def test_sdd_matmul_matches_dense():
+    layout = rand_layout()
+    rng = np.random.RandomState(1)
+    a = rng.randn(2, H, S, D).astype(np.float32)
+    b = rng.randn(2, H, S, D).astype(np.float32)
+    mm = MatMul(layout, BLOCK, "sdd", trans_b=True)
+    sparse = np.asarray(mm(jnp.asarray(a), jnp.asarray(b)))
+    dense = np.einsum("bhsd,bhtd->bhst", a, b)
+    got = blocks_to_dense(sparse, layout, 2, S, S)
+    mask = np.repeat(np.repeat(layout, BLOCK, 1), BLOCK, 2).astype(bool)
+    np.testing.assert_allclose(got, np.where(mask[None], dense, 0.0), atol=1e-4)
+
+
+def test_dsd_matmul_matches_dense():
+    layout = rand_layout(seed=2)
+    rng = np.random.RandomState(3)
+    probs_dense = rng.rand(2, H, S, S).astype(np.float32)
+    mask = np.repeat(np.repeat(layout, BLOCK, 1), BLOCK, 2).astype(bool)
+    probs_dense = np.where(mask[None], probs_dense, 0.0)
+    v = rng.randn(2, H, S, D).astype(np.float32)
+
+    # pack dense probs into sparse block format
+    hh, ii, jj = np.nonzero(layout)
+    sparse = np.stack(
+        [probs_dense[:, h, i * BLOCK:(i + 1) * BLOCK, j * BLOCK:(j + 1) * BLOCK]
+         for h, i, j in zip(hh, ii, jj)], axis=1
+    )
+    mm = MatMul(layout, BLOCK, "dsd")
+    got = np.asarray(mm(jnp.asarray(sparse), jnp.asarray(v)))
+    np.testing.assert_allclose(got, probs_dense @ v, atol=1e-4)
+
+
+def test_sparse_softmax_matches_masked_dense():
+    layout = rand_layout(seed=4)
+    rng = np.random.RandomState(5)
+    scores = rng.randn(2, H, S, S).astype(np.float32)
+    mask = np.repeat(np.repeat(layout, BLOCK, 1), BLOCK, 2).astype(bool)
+
+    hh, ii, jj = np.nonzero(layout)
+    sparse = np.stack(
+        [scores[:, h, i * BLOCK:(i + 1) * BLOCK, j * BLOCK:(j + 1) * BLOCK]
+         for h, i, j in zip(hh, ii, jj)], axis=1
+    )
+    sm = Softmax(layout, BLOCK)
+    got_sparse = np.asarray(sm(jnp.asarray(sparse), scale=0.5))
+    got = blocks_to_dense(got_sparse, layout, 2, S, S)
+
+    dense_masked = np.where(mask[None], scores * 0.5, -1e30)
+    ref = np.asarray(jax.nn.softmax(jnp.asarray(dense_masked), axis=-1))
+    ref = np.where(mask[None], ref, 0.0)
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("cfg_cls", [
+    DenseSparsityConfig, FixedSparsityConfig, VariableSparsityConfig,
+    BigBirdSparsityConfig, BSLongformerSparsityConfig,
+])
+def test_sparse_self_attention_runs(cfg_cls):
+    cfg = cfg_cls(num_heads=H, block=BLOCK)
+    attn = SparseSelfAttention(cfg)
+    rng = np.random.RandomState(6)
+    mk = lambda: jnp.asarray(rng.randn(2, H, S, D).astype(np.float32)) * 0.3
+    q, k, v = mk(), mk(), mk()
+    out = attn(q, k, v)
+    assert out.shape == (2, H, S, D)
+    # equals dense attention masked by the layout
+    layout = attn.get_layout(S)
+    ref = _attention_reference(
+        q, k, v, jnp.zeros((2, S), jnp.float32),
+        _expand_layout_mask(layout, S, BLOCK), causal=False,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_bert_sparse_self_attention_module():
+    m = BertSparseSelfAttention(
+        hidden_size=H * D, num_attention_heads=H,
+        sparsity_config=FixedSparsityConfig(num_heads=H, block=BLOCK),
+    )
+    x = jnp.asarray(np.random.RandomState(7).randn(2, S, H * D).astype(np.float32))
+    params = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(params, x)
+    assert out.shape == (2, S, H * D)
+    assert np.isfinite(np.asarray(out)).all()
