@@ -1,0 +1,218 @@
+"""1-bit Adam: error-compensated sign-compressed momentum communication.
+
+Capability parity with the reference ``deepspeed/runtime/fp16/onebit_adam.py``
+(``OnebitAdam:18``, ``Compressed_Allreduce:104``, ``step:230``) and its MPI
+``custom_collectives.py``: after ``freeze_step`` warmup steps of plain Adam,
+the variance (exp_avg_sq) freezes and the momentum update communicates only
+the SIGN of each element plus one scale per worker — with worker- and
+server-side error feedback so compression error is carried, not lost.
+
+TPU-first redesign: the two-phase MPI gather/allgather becomes XLA collectives
+inside ``shard_map`` over the ``data`` mesh axis:
+
+- phase 1 (reference gather_cuda/gather_host): ``all_to_all`` routes each
+  worker's packed sign chunk for segment s to the worker that owns s; the
+  owner decompresses and sums (the "server" reduction).
+- phase 2 (reference allgather): the owner re-compresses its reduced segment
+  (server error feedback) and ``all_gather`` broadcasts the packed result.
+
+Signs pack 8-to-a-byte in uint8 (the reference packbits), so per-step comm is
+~1/32 of fp32 allreduce plus two scalars per worker — the source of the
+reference's claimed 5x comm reduction.
+"""
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_POWERS = 2 ** np.arange(8, dtype=np.uint8)
+
+
+def pack_signs(x):
+    """x: [n] float -> packed uint8 [n/8] of sign bits (1 = non-negative)."""
+    n = x.shape[0]
+    assert n % 8 == 0, "pack_signs needs n % 8 == 0"
+    bits = (x >= 0).astype(jnp.uint8).reshape(n // 8, 8)
+    return jnp.sum(bits * jnp.asarray(_POWERS), axis=1, dtype=jnp.uint8)
+
+
+def unpack_signs(packed, n):
+    """packed uint8 [n/8] -> [-1, +1] float32 [n]."""
+    # bit order matches pack: bit k of byte b is element 8*b + k
+    bits = (packed[:, None] >> jnp.arange(8, dtype=jnp.uint8)[None, :]) & 1
+    return (bits.astype(jnp.float32) * 2.0 - 1.0).reshape(n)
+
+
+def compress(x):
+    """Sign+scale compression (reference: scale = norm / sqrt(n), :137-151).
+
+    Returns (packed_signs, scale, error) with error = x - decompress."""
+    n = x.shape[0]
+    scale = jnp.linalg.norm(x) / jnp.sqrt(n).astype(jnp.float32)
+    signs = jnp.where(x >= 0, 1.0, -1.0)
+    decompressed = scale * signs
+    return pack_signs(x), scale, x - decompressed
+
+
+def compressed_allreduce(x, worker_error, server_error, axis_name):
+    """Error-compensated 1-bit allreduce (average) of ``x`` across
+    ``axis_name``. MUST run inside shard_map/pmap over that axis.
+
+    ``x``: [n] local tensor; ``worker_error``: [n]; ``server_error``: [n/W]
+    (this worker's server segment). Returns (avg, new_worker_error,
+    new_server_error).
+    """
+    W = jax.lax.psum(1, axis_name)
+    n = x.shape[0]
+    seg = n // W
+    assert n % (8 * W) == 0, f"1-bit Adam needs numel % (8*world) == 0, got {n} % {8 * W}"
+
+    # -- worker compression with error feedback --------------------------
+    corrected = x + worker_error
+    packed, scale, new_worker_error = compress(corrected)
+
+    # -- phase 1: route sign chunks to segment owners (all_to_all) -------
+    my_chunks = packed.reshape(W, seg // 8)
+    # after all_to_all: row w holds worker w's chunk for MY segment
+    recv = jax.lax.all_to_all(my_chunks, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    scales = jax.lax.all_gather(scale, axis_name)           # [W]
+
+    signs = jax.vmap(lambda p: unpack_signs(p, seg))(recv)  # [W, seg]
+    seg_sum = jnp.sum(signs * scales[:, None], axis=0) / W  # server average
+
+    # -- phase 2: server compression + allgather -------------------------
+    seg_corrected = seg_sum + server_error
+    seg_packed, seg_scale, new_server_error = compress(seg_corrected)
+    all_packed = jax.lax.all_gather(seg_packed, axis_name)  # [W, seg/8]
+    all_scales = jax.lax.all_gather(seg_scale, axis_name)   # [W]
+    result = (
+        jax.vmap(lambda p: unpack_signs(p, seg))(all_packed) * all_scales[:, None]
+    ).reshape(n)
+    return result, new_worker_error, new_server_error
+
+
+class OnebitAdamState(NamedTuple):
+    step: jnp.ndarray
+    exp_avg: object
+    exp_avg_sq: object
+    worker_error: object   # flat, only used on the compressed path
+    server_error: object
+
+
+class OnebitAdam:
+    """Adam that freezes the variance after ``freeze_step`` and (in the
+    distributed shard_map path) communicates 1-bit compressed momentum.
+
+    Functional interface matches FusedAdam (engine optimizer matrix,
+    runtime/engine.py). In the engine's default jit path XLA has already
+    reduced the gradients, so ``update`` applies the frozen-variance Adam
+    math; ``update_local`` + ``compressed_allreduce`` compose the full
+    compressed pipeline inside shard_map (see tests/unit/test_onebit_adam.py).
+    """
+
+    def __init__(self, engine=None, lr=1e-3, freeze_step=100000, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, max_grad_norm=0.0,
+                 amsgrad=False, cuda_aware=False, **kwargs):
+        if amsgrad:
+            raise RuntimeError("1-bit Adam does not support the AMSGrad variant.")
+        self.lr = lr
+        self.freeze_step = freeze_step
+        self.bias_correction = bias_correction
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.name = "onebitadam"
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return OnebitAdamState(
+            step=jnp.asarray(0, jnp.int32),
+            exp_avg=jax.tree_util.tree_map(zeros, params),
+            exp_avg_sq=jax.tree_util.tree_map(zeros, params),
+            worker_error=None,
+            server_error=None,
+        )
+
+    def update(self, grads, state, params, lr=None):
+        """Engine path: grads are already averaged across data parallel. Adam
+        with variance frozen after freeze_step (the reference's compression
+        phase keeps exp_avg_sq fixed, :306-318)."""
+        lr = self.lr if lr is None else lr
+        beta1, beta2 = self.betas
+        step = state.step + 1
+        frozen = step > self.freeze_step
+
+        def upd(g, m, v, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m_new = beta1 * m + (1 - beta1) * g
+            v_new = jnp.where(frozen, v, beta2 * v + (1 - beta2) * jnp.square(g))
+            if self.bias_correction:
+                bc1 = 1 - beta1 ** step.astype(jnp.float32)
+                bc2 = 1 - beta2 ** step.astype(jnp.float32)
+                upd_val = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
+            else:
+                upd_val = m_new / (jnp.sqrt(v_new) + self.eps)
+            if self.weight_decay != 0.0:
+                upd_val = upd_val + self.weight_decay * p32
+            return (p32 - lr * upd_val).astype(p.dtype), m_new, v_new
+
+        out = jax.tree_util.tree_map(upd, grads, state.exp_avg, state.exp_avg_sq, params)
+        pick = lambda i: jax.tree_util.tree_map(
+            lambda t: t[i], out, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        return pick(0), OnebitAdamState(
+            step=step, exp_avg=pick(1), exp_avg_sq=pick(2),
+            worker_error=state.worker_error, server_error=state.server_error,
+        )
+
+    # -- distributed compressed path (inside shard_map) -------------------
+    def init_flat(self, flat_params, world_size):
+        n = flat_params.shape[0]
+        return OnebitAdamState(
+            step=jnp.asarray(0, jnp.int32),
+            exp_avg=jnp.zeros((n,), jnp.float32),
+            exp_avg_sq=jnp.zeros((n,), jnp.float32),
+            worker_error=jnp.zeros((n,), jnp.float32),
+            server_error=jnp.zeros((n // world_size,), jnp.float32),
+        )
+
+    def update_flat(self, local_grad, state, flat_params, axis_name, lr=None):
+        """Full 1-bit pipeline over a FLAT fp32 param vector, inside shard_map:
+        warmup -> dense psum Adam; frozen -> local momentum + compressed
+        allreduce of the momentum (reference step:230-372)."""
+        lr = self.lr if lr is None else lr
+        beta1, beta2 = self.betas
+        step = state.step + 1
+        frozen = step > self.freeze_step
+
+        def warmup(_):
+            g = jax.lax.pmean(local_grad, axis_name)
+            m = beta1 * state.exp_avg + (1 - beta1) * g
+            v = beta2 * state.exp_avg_sq + (1 - beta2) * jnp.square(g)
+            return m, v, state.worker_error, state.server_error
+
+        def compressed(_):
+            m_local = beta1 * state.exp_avg + (1 - beta1) * local_grad
+            m_avg, we, se = compressed_allreduce(
+                m_local, state.worker_error, state.server_error, axis_name
+            )
+            return m_avg, state.exp_avg_sq, we, se
+
+        m_new, v_new, we, se = jax.lax.cond(frozen, compressed, warmup, None)
+
+        if self.bias_correction:
+            bc1 = 1 - beta1 ** step.astype(jnp.float32)
+            bc2 = 1 - beta2 ** step.astype(jnp.float32)
+            update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.eps)
+        else:
+            update = m_new / (jnp.sqrt(v_new) + self.eps)
+        if self.weight_decay != 0.0:
+            update = update + self.weight_decay * flat_params
+        new_params = flat_params - lr * update
+        return new_params, OnebitAdamState(
+            step=step, exp_avg=m_new, exp_avg_sq=v_new, worker_error=we, server_error=se
+        )
