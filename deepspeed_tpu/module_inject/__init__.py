@@ -1,0 +1,7 @@
+from deepspeed_tpu.module_inject.replace_module import (
+    convert_hf_layer_params,
+    replace_module,
+    replace_transformer_layer,
+    revert_hf_layer_params,
+    revert_transformer_layer,
+)

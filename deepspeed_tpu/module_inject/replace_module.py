@@ -1,0 +1,133 @@
+"""Module injection: swap HF/BERT-style attention layers for the fused
+DeepSpeedTransformerLayer, copying weights (and back).
+
+Capability parity with the reference ``deepspeed/module_inject/replace_module.py``
+(``replace_transformer_layer:6``, ``replace_module:160``). The torch version
+mutates ``nn.Module`` graphs in place; the flax idiom is a pure function over
+the PARAM TREE: HF-layout params convert to DeepSpeedTransformerLayer-layout
+params (qkv fusion, LN renames) and the model swaps its layer class at
+construction. ``revert_transformer_layer`` is the inverse mapping.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)
+
+
+def _get(tree, *path):
+    for p in path:
+        tree = tree[p]
+    return tree
+
+
+def convert_hf_layer_params(hf_layer_params):
+    """HF FlaxBertLayer params -> DeepSpeedTransformerLayer params.
+
+    HF layout: attention.self.{query,key,value}, attention.output.dense,
+    attention.output.LayerNorm, intermediate.dense, output.dense,
+    output.LayerNorm. Ours fuses q/k/v into one qkv GEMM
+    (reference copies qkv weights the same way, replace_module.py:35-90).
+    """
+    a = hf_layer_params["attention"]
+    q = a["self"]["query"]; k = a["self"]["key"]; v = a["self"]["value"]
+    qkv_kernel = jnp.concatenate([q["kernel"], k["kernel"], v["kernel"]], axis=1)
+    qkv_bias = jnp.concatenate([q["bias"], k["bias"], v["bias"]], axis=0)
+    return {
+        "params": {
+            "qkv": {"kernel": qkv_kernel, "bias": qkv_bias},
+            "attn_out": {"kernel": a["output"]["dense"]["kernel"],
+                         "bias": a["output"]["dense"]["bias"]},
+            "ln_attn": {"scale": a["output"]["LayerNorm"]["scale"],
+                        "bias": a["output"]["LayerNorm"]["bias"]},
+            "ff1": {"kernel": hf_layer_params["intermediate"]["dense"]["kernel"],
+                    "bias": hf_layer_params["intermediate"]["dense"]["bias"]},
+            "ff2": {"kernel": hf_layer_params["output"]["dense"]["kernel"],
+                    "bias": hf_layer_params["output"]["dense"]["bias"]},
+            "ln_ffn": {"scale": hf_layer_params["output"]["LayerNorm"]["scale"],
+                       "bias": hf_layer_params["output"]["LayerNorm"]["bias"]},
+        }
+    }
+
+
+def revert_hf_layer_params(ds_layer_params, hidden_size):
+    """DeepSpeedTransformerLayer params -> HF FlaxBertLayer params (inverse of
+    ``convert_hf_layer_params``; reference's revert path in
+    ops/module_inject.py)."""
+    p = ds_layer_params["params"]
+    qkv_k = p["qkv"]["kernel"]; qkv_b = p["qkv"]["bias"]
+    H = hidden_size
+    return {
+        "attention": {
+            "self": {
+                "query": {"kernel": qkv_k[:, :H], "bias": qkv_b[:H]},
+                "key": {"kernel": qkv_k[:, H:2 * H], "bias": qkv_b[H:2 * H]},
+                "value": {"kernel": qkv_k[:, 2 * H:], "bias": qkv_b[2 * H:]},
+            },
+            "output": {
+                "dense": dict(p["attn_out"]),
+                "LayerNorm": dict(p["ln_attn"]),
+            },
+        },
+        "intermediate": {"dense": dict(p["ff1"])},
+        "output": {"dense": dict(p["ff2"]), "LayerNorm": dict(p["ln_ffn"])},
+    }
+
+
+def replace_transformer_layer(orig_layer_impl=None, model=None, model_params=None,
+                              micro_batch_size=-1, config=None, seed=-1,
+                              max_seq_length=-1, hidden_size=-1, heads=-1,
+                              intermediate_size=-1, preln=False, fp16=False,
+                              layer_path=("bert", "encoder", "layer"),
+                              huggingface=False, local_rank=-1):
+    """Convert every HF encoder layer's params under ``layer_path`` and return
+    (DeepSpeedTransformerLayer factory, converted per-layer params list).
+
+    ``model_params``: the HF model's param tree (``{"params": {...}}`` or bare).
+    """
+    tree = model_params.get("params", model_params)
+    layers = _get(tree, *layer_path)
+    layer_keys = sorted(layers.keys(), key=lambda s: int(s) if str(s).isdigit() else s)
+    converted = [convert_hf_layer_params(layers[k]) for k in layer_keys]
+
+    ds_config = DeepSpeedTransformerConfig(
+        batch_size=micro_batch_size,
+        max_seq_length=max_seq_length,
+        hidden_size=hidden_size,
+        intermediate_size=intermediate_size if intermediate_size > 0 else 4 * hidden_size,
+        heads=heads,
+        attn_dropout_ratio=0.0,
+        hidden_dropout_ratio=0.0,
+        num_hidden_layers=len(converted),
+        initializer_range=0.02,
+        seed=seed,
+        fp16=fp16,
+        pre_layer_norm=preln,
+        huggingface=huggingface,
+        local_rank=local_rank,
+    )
+    return DeepSpeedTransformerLayer(ds_config), converted
+
+
+def revert_transformer_layer(ds_layers_params, hidden_size):
+    """Inverse: list of DS layer params -> dict of HF layer params."""
+    return {
+        str(i): revert_hf_layer_params(p, hidden_size)
+        for i, p in enumerate(ds_layers_params)
+    }
+
+
+def replace_module(params, match_fn, transform_fn, path=()):
+    """Generic recursive param-subtree replacement (reference replace_module:
+    160): wherever ``match_fn(path, subtree)`` is True, substitute
+    ``transform_fn(subtree)``."""
+    if match_fn(path, params):
+        return transform_fn(params)
+    if isinstance(params, dict):
+        return {k: replace_module(v, match_fn, transform_fn, path + (k,)) for k, v in params.items()}
+    return params

@@ -1,0 +1,64 @@
+"""Compressed sparse row tensor for sparse (embedding) gradients.
+
+Capability parity with the reference ``deepspeed/runtime/csr_tensor.py:11``:
+a minimal CSR representation used to shrink embedding-gradient communication
+(engine converts ``nn.Embedding`` grads and allgathers indices/values,
+reference engine.py:1186-1242). On TPU the same capability appears as
+gather/scatter pairs XLA can fuse; this class carries the format, conversion,
+and the sparse-allreduce building block.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class CSRTensor:
+    """Rows with any nonzero entry are stored densely; empty rows are dropped
+    (the reference's semantics for embedding grads: 'sparse' means few rows
+    touched, not elementwise sparsity)."""
+
+    def __init__(self, indices=None, values=None, dense_size=None):
+        self.indices = indices       # [nnz_rows] int32
+        self.values = values         # [nnz_rows, row_dim]
+        self.dense_size = dense_size  # (num_rows, row_dim)
+
+    @staticmethod
+    def from_dense(dense):
+        """Keep rows with any nonzero element."""
+        row_nonzero = jnp.any(dense != 0, axis=tuple(range(1, dense.ndim)))
+        idx = jnp.nonzero(row_nonzero)[0].astype(jnp.int32)
+        return CSRTensor(indices=idx, values=dense[idx], dense_size=dense.shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].set(self.values)
+
+    def sparse_size(self):
+        nnz = int(self.indices.shape[0]) * int(np.prod(self.values.shape[1:]))
+        dense = int(np.prod(self.dense_size))
+        return nnz, dense
+
+    def add(self, other):
+        """Sum two CSR tensors over the same dense size (scatter-add)."""
+        assert self.dense_size == other.dense_size
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        out = out.at[self.indices].add(self.values)
+        out = out.at[other.indices].add(other.values)
+        return CSRTensor.from_dense(out)
+
+    def __str__(self):
+        return f"CSRTensor(indices={self.indices}, values shape {None if self.values is None else self.values.shape}, dense {self.dense_size})"
+
+    __repr__ = __str__
+
+
+def sparse_allreduce(csr, axis_name):
+    """Allreduce of a CSR tensor inside shard_map: allgather indices+values
+    across the axis and scatter-add (reference engine.sparse_allreduce_bucket,
+    :1199-1239)."""
+    all_idx = jax.lax.all_gather(csr.indices, axis_name, tiled=True)
+    all_val = jax.lax.all_gather(csr.values, axis_name, tiled=True)
+    out = jnp.zeros(csr.dense_size, csr.values.dtype)
+    return out.at[all_idx].add(all_val)

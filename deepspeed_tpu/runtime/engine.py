@@ -142,6 +142,13 @@ class DeepSpeedEngine:
         self._acc_grads = None
         self._step_rng = jax.random.PRNGKey(self._config._param_dict.get("seed", 42))
 
+        # flops profiler (reference engine.py:790-813)
+        self.flops_profiler = None
+        if self._config.flops_profiler_config.enabled:
+            from deepspeed_tpu.profiling.flops_profiler.profiler import FlopsProfiler
+
+            self.flops_profiler = FlopsProfiler()
+
         if self.global_rank == 0:
             self._config.print("DeepSpeedEngine configuration")
 
@@ -578,6 +585,14 @@ class DeepSpeedEngine:
         batch = tuple(self._shard_batch(x) for x in inputs)
         needs_rng = self._module_needs_rng()
 
+        profiling = (
+            self.flops_profiler is not None
+            and self.global_steps == self._config.flops_profiler_config.profile_step
+            and self.training
+        )
+        if profiling:
+            self.flops_profiler.start_profile()
+
         if self.training:
             fwd_bwd = self._get_fwd_bwd(needs_rng)
             theta = jnp.asarray(
@@ -590,6 +605,23 @@ class DeepSpeedEngine:
         else:
             fwd = self._get_fwd_only(needs_rng)
             result = fwd(self.params, *batch)
+
+        if profiling:
+            jax.block_until_ready(result)
+            self.flops_profiler.stop_profile()
+            fwd_bwd = self._get_fwd_bwd(needs_rng)
+            theta_p = jnp.asarray(1.0, jnp.float32)
+            self.flops_profiler.set_flops(self.flops_profiler.analyze(
+                fwd_bwd, self.params, self.scaler_state.cur_scale, self._next_rng(), theta_p, *batch
+            ))
+            self.flops_profiler.set_params(self.params)
+            self.flops_profiler.print_model_profile(
+                profile_step=self.global_steps,
+                module_depth=self._config.flops_profiler_config.module_depth,
+                top_modules=self._config.flops_profiler_config.top_modules,
+                detailed=self._config.flops_profiler_config.detailed,
+            )
+            self.flops_profiler.end_profile()
 
         if self.progressive_layer_drop:
             self.progressive_layer_drop.update_state(self.global_steps)

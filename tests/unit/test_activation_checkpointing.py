@@ -1,0 +1,95 @@
+"""Activation checkpointing tests (reference test_activation_checkpointing.py
+pattern: checkpointed results/grads equal non-checkpointed ones, RNG replay
+included)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+
+@pytest.fixture(autouse=True)
+def fresh_config():
+    checkpointing._CONFIG = None
+    checkpointing._PARTITION_ACTIVATIONS = False
+    checkpointing._CPU_CHECKPOINT = False
+    checkpointing._PROFILE_TIME = False
+    yield
+
+
+def test_checkpoint_matches_plain():
+    w = jnp.asarray(np.random.RandomState(0).randn(16, 16).astype(np.float32))
+    x = jnp.asarray(np.random.RandomState(1).randn(4, 16).astype(np.float32))
+
+    def block(x, w):
+        return jnp.tanh(x @ w) @ w.T
+
+    def loss_plain(w):
+        return jnp.sum(block(x, w) ** 2)
+
+    def loss_ckpt(w):
+        return jnp.sum(checkpointing.checkpoint(block, x, w) ** 2)
+
+    np.testing.assert_allclose(float(loss_plain(w)), float(loss_ckpt(w)), rtol=1e-6)
+    g_plain = jax.grad(loss_plain)(w)
+    g_ckpt = jax.grad(loss_ckpt)(w)
+    np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_ckpt), rtol=1e-5)
+
+
+def test_checkpoint_rng_replay():
+    """Dropout inside a checkpointed block must reproduce the same mask in the
+    recompute (reference RNG-replay semantics, checkpointing.py:552-555)."""
+    key = jax.random.PRNGKey(0)
+    x = jnp.ones((8, 32))
+
+    def block(x, key):
+        mask = jax.random.bernoulli(key, 0.5, x.shape)
+        return jnp.where(mask, x, 0.0)
+
+    def loss(x):
+        return jnp.sum(checkpointing.checkpoint(block, x, key) ** 2)
+
+    # value and grad agree with non-checkpointed computation
+    ref = jnp.sum(block(x, key) ** 2)
+    np.testing.assert_allclose(float(loss(x)), float(ref), rtol=1e-6)
+    g = jax.grad(loss)(x)
+    g_ref = jax.grad(lambda x: jnp.sum(block(x, key) ** 2))(x)
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(g_ref))
+
+
+def test_configure_from_dict():
+    checkpointing.configure(None, deepspeed_config={
+        "activation_checkpointing": {
+            "partition_activations": True,
+            "number_checkpoints": 4,
+            "contiguous_memory_optimization": True,
+            "profile": False,
+        }
+    })
+    assert checkpointing.is_configured()
+    assert checkpointing._PARTITION_ACTIVATIONS
+    assert checkpointing._NUM_LAYERS == 4
+
+
+def test_contiguous_requires_partition():
+    with pytest.raises(Exception):
+        checkpointing.configure(None, partition_activations=False,
+                                contiguous_checkpointing=True, num_checkpoints=2)
+
+
+def test_rng_tracker():
+    tracker = checkpointing.get_cuda_rng_tracker()
+    tracker.reset()
+    tracker.add("test", 123)
+    k1 = tracker.fork("test")
+    k2 = tracker.fork("test")
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    with pytest.raises(Exception):
+        tracker.add("test", 456)
+
+    checkpointing.model_parallel_cuda_manual_seed(7)
+    k = checkpointing.get_cuda_rng_tracker().fork()
+    assert k is not None

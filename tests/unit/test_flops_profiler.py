@@ -1,0 +1,79 @@
+"""FLOPS profiler tests (reference test_flops_profiler.py: measured flops
+within tolerance of the analytic count)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+import deepspeed_tpu
+from deepspeed_tpu.profiling.flops_profiler.profiler import (
+    FlopsProfiler,
+    flops_to_string,
+    get_model_profile,
+    params_to_string,
+)
+
+
+def test_matmul_flops_measured():
+    M, K, N = 256, 512, 128
+
+    def fn(a, b):
+        return a @ b
+
+    a = jnp.ones((M, K), jnp.float32)
+    b = jnp.ones((K, N), jnp.float32)
+    prof = FlopsProfiler()
+    flops = prof.analyze(fn, a, b)
+    expected = 2 * M * K * N
+    assert 0.5 * expected <= flops <= 2.0 * expected, f"{flops} vs {expected}"
+
+
+def test_model_profile_dense():
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(128)(x)
+            x = nn.relu(x)
+            return nn.Dense(10)(x)
+
+    m = MLP()
+    x = jnp.ones((32, 64))
+    params = m.init(jax.random.PRNGKey(0), x)
+    flops, macs, n_params = get_model_profile(m, args=(params, x), print_profile=False, as_string=False)
+    expected_macs = 32 * (64 * 128 + 128 * 10)
+    assert 0.5 * expected_macs <= macs <= 3 * expected_macs
+    assert n_params == 64 * 128 + 128 + 128 * 10 + 10
+
+
+def test_engine_profiler_hook(capsys):
+    """Engine prints the profile at the configured step."""
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, y):
+            pred = nn.Dense(1)(x)
+            return jnp.mean((pred - y) ** 2)
+
+    m = Tiny()
+    n_dev = len(jax.devices())
+    x = jnp.ones((2 * n_dev, 8))
+    y = jnp.zeros((2 * n_dev, 1))
+    params = m.init(jax.random.PRNGKey(0), x, y)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=m, model_parameters=params, config_params={
+        "train_batch_size": 2 * n_dev,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "flops_profiler": {"enabled": True, "profile_step": 1},
+    })
+    assert engine.flops_profiler is not None
+    for _ in range(3):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+
+
+def test_formatting():
+    assert flops_to_string(2e12) == "2.00 TFLOPS"
+    assert params_to_string(336e6).endswith("M")

@@ -1,0 +1,108 @@
+"""Module injection numerics: HF-layout params converted into
+DeepSpeedTransformerLayer must reproduce the HF BERT layer computation
+(reference pattern: test_cuda_forward's layer-vs-vendored-BertEncoder check,
+applied to the injection path)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.module_inject.replace_module import (
+    convert_hf_layer_params,
+    replace_module,
+    revert_hf_layer_params,
+)
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)
+
+H, HEADS, FF, S, B = 64, 4, 128, 32, 2
+
+
+def hf_bert_layer_apply(p, x):
+    """Post-LN BERT layer in HF param layout, plain jnp (the ground truth)."""
+    a = p["attention"]
+
+    def dense(px, t):
+        return t @ px["kernel"] + px["bias"]
+
+    q = dense(a["self"]["query"], x).reshape(B, S, HEADS, H // HEADS).transpose(0, 2, 1, 3)
+    k = dense(a["self"]["key"], x).reshape(B, S, HEADS, H // HEADS).transpose(0, 2, 1, 3)
+    v = dense(a["self"]["value"], x).reshape(B, S, HEADS, H // HEADS).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(H // HEADS)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhst,bhtd->bhsd", probs, v).transpose(0, 2, 1, 3).reshape(B, S, H)
+    attn_out = dense(a["output"]["dense"], ctx)
+
+    def ln(pln, t):
+        mu = t.mean(-1, keepdims=True)
+        var = ((t - mu) ** 2).mean(-1, keepdims=True)
+        return (t - mu) / jnp.sqrt(var + 1e-6) * pln["scale"] + pln["bias"]
+
+    x1 = ln(a["output"]["LayerNorm"], x + attn_out)
+    h = dense(p["intermediate"]["dense"], x1)
+    h = jax.nn.gelu(h, approximate=False)
+    h = dense(p["output"]["dense"], h)
+    return ln(p["output"]["LayerNorm"], x1 + h)
+
+
+def make_hf_params(seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda *shape: jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.05)
+    d = lambda i, o: {"kernel": mk(i, o), "bias": mk(o)}
+    lnp = lambda: {"scale": jnp.ones((H,)), "bias": jnp.zeros((H,))}
+    return {
+        "attention": {
+            "self": {"query": d(H, H), "key": d(H, H), "value": d(H, H)},
+            "output": {"dense": d(H, H), "LayerNorm": lnp()},
+        },
+        "intermediate": {"dense": d(H, FF)},
+        "output": {"dense": d(FF, H), "LayerNorm": lnp()},
+    }
+
+
+def ds_layer():
+    cfg = DeepSpeedTransformerConfig(
+        hidden_size=H, intermediate_size=FF, heads=HEADS,
+        attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+        num_hidden_layers=1, initializer_range=0.02,
+        pre_layer_norm=False, training=False,
+    )
+    return DeepSpeedTransformerLayer(cfg)
+
+
+def test_convert_matches_hf_computation():
+    hf = make_hf_params()
+    x = jnp.asarray(np.random.RandomState(1).randn(B, S, H).astype(np.float32))
+    ref = hf_bert_layer_apply(hf, x)
+    ds_params = convert_hf_layer_params(hf)
+    out = ds_layer().apply(ds_params, x, None, deterministic=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4)
+
+
+def test_revert_roundtrip():
+    hf = make_hf_params(seed=2)
+    ds_params = convert_hf_layer_params(hf)
+    back = revert_hf_layer_params(ds_params, H)
+    for path in [("attention", "self", "query", "kernel"),
+                 ("attention", "output", "dense", "bias"),
+                 ("intermediate", "dense", "kernel"),
+                 ("output", "LayerNorm", "scale")]:
+        a, b = hf, back
+        for k in path:
+            a, b = a[k], b[k]
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_replace_module_generic():
+    tree = {"a": {"target": {"x": 1}}, "b": {"other": {"x": 2}}}
+    out = replace_module(
+        tree,
+        match_fn=lambda path, sub: path and path[-1] == "target",
+        transform_fn=lambda sub: {"x": 99},
+    )
+    assert out["a"]["target"]["x"] == 99
+    assert out["b"]["other"]["x"] == 2
