@@ -1,0 +1,89 @@
+"""Tensor (model) parallelism: Megatron-style sharding rules over the
+``model`` mesh axis.
+
+The reference only *cooperates* with an external Megatron mpu (SURVEY §2.2:
+TP is "interface only" — engine.py:514-525, topology model axis). Here TP is
+first-class the TPU way: parameters carry ``NamedSharding``s over the
+``model`` axis and XLA/GSPMD inserts the (all-reduce/all-gather) collectives
+the Megatron forward would issue by hand:
+
+- column-parallel matmuls (qkv, ff1, embedding output) shard their OUTPUT
+  feature dim,
+- row-parallel matmuls (attention output, ff2) shard their INPUT feature dim
+  (XLA emits the psum over ``model`` after the partial matmul),
+- embeddings shard the vocab dim.
+
+Rules are (regex over the param path, dim-spec) pairs; the dim-spec names
+which dimension takes the ``model`` axis, counted from the TRAILING dims so
+scanned layer stacks ([L, ...]-shaped params) match the same rules.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel.mesh import MODEL_AXIS
+from deepspeed_tpu.utils.logging import logger
+
+# (path regex, dim from the END that carries the model axis)
+# Column-parallel: shard last dim (outputs). Row-parallel: shard 2nd-to-last
+# (inputs). Biases of column-parallel layers shard their only dim.
+MEGATRON_RULES = [
+    (r"(qkv|query|key|value|ff1|intermediate|wi|fc1|c_fc)/(kernel|w)$", 1),
+    (r"(qkv|query|key|value|ff1|intermediate|wi|fc1|c_fc)/(bias|b)$", 1),
+    (r"(attn_out|attention_out|proj|wo|fc2|ff2|c_proj|output_dense)/(kernel|w)$", 2),
+    (r"(word_embeddings|wte|embedding|embed)/(embedding|kernel)$", 2),
+]
+
+
+def _path_str(path):
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def spec_for(path, leaf, rules=MEGATRON_RULES):
+    """PartitionSpec for one param: the matched rule's dim-from-end gets the
+    model axis; everything else is replicated."""
+    s = _path_str(path)
+    for pattern, dim_from_end in rules:
+        if re.search(pattern, s):
+            ndim = leaf.ndim
+            if dim_from_end > ndim:
+                continue
+            spec = [None] * ndim
+            spec[ndim - dim_from_end] = MODEL_AXIS
+            return PartitionSpec(*spec)
+    return PartitionSpec()
+
+
+def shard_params(params, mesh, rules=MEGATRON_RULES, log=False):
+    """Apply TP shardings to a param pytree (replicated along data/pipe)."""
+
+    def put(path, leaf):
+        spec = spec_for(path, leaf, rules)
+        if log and spec != PartitionSpec():
+            logger.info(f"TP shard {_path_str(path)} {leaf.shape} -> {spec}")
+        # Dims not divisible by the axis size stay replicated.
+        for i, ax in enumerate(spec):
+            if ax is not None and leaf.shape[i] % mesh.shape[MODEL_AXIS] != 0:
+                spec = PartitionSpec()
+                break
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(put, params)
+
+
+def param_specs(params, rules=MEGATRON_RULES):
+    """The PartitionSpec pytree (for pjit in_shardings / checkpoint layouts)."""
+    return jax.tree_util.tree_map_with_path(lambda p, l: spec_for(p, l, rules), params)

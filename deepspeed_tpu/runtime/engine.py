@@ -98,7 +98,17 @@ class DeepSpeedEngine:
         assert config is not None, "DeepSpeed requires --deepspeed_config to specify configuration file"
 
         # --- mesh ---------------------------------------------------------
-        mp_size = mpu.get_model_parallel_world_size() if mpu is not None else 1
+        if mpu is not None:
+            mp_size = mpu.get_model_parallel_world_size()
+        else:
+            cfg_dict = config if isinstance(config, dict) else None
+            if cfg_dict is None and isinstance(config, str) and os.path.isfile(config):
+                import json
+
+                with open(config) as f:
+                    cfg_dict = json.load(f)
+            tp_cfg = (cfg_dict or {}).get("tensor_parallel", {})
+            mp_size = int(tp_cfg.get("size", 1) or 1)
         self.mesh = create_mesh(model_parallel_size=mp_size, pipe_parallel_size=1)
         self.dp_world_size = dp_world_size(self.mesh)
         self.mp_world_size = mp_world_size(self.mesh)
@@ -300,11 +310,23 @@ class DeepSpeedEngine:
             "pass the result of module.init(...)"
         )
 
-        # fp32 master copy, replicated across the mesh.
-        replicated = NamedSharding(self.mesh, PartitionSpec())
-        self.params = jax.device_put(
-            jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), model_parameters), replicated
-        )
+        # fp32 master copy. mp=1: replicated. mp>1: Megatron-style TP
+        # shardings along the model axis (parallel/tp.py) — XLA inserts the
+        # tensor-parallel collectives in forward/backward.
+        fp32 = jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), model_parameters)
+        if self.mp_world_size > 1:
+            from deepspeed_tpu.parallel.tp import shard_params
+
+            if self.zero_optimization():
+                logger.warning(
+                    "ZeRO + tensor parallelism: ZeRO's flat master currently "
+                    "re-replicates params across the model axis on update; "
+                    "running TP with zero stage 0 semantics."
+                )
+            self.params = shard_params(fp32, self.mesh)
+        else:
+            replicated = NamedSharding(self.mesh, PartitionSpec())
+            self.params = jax.device_put(fp32, replicated)
 
         if self.fp16_enabled():
             self.compute_dtype = jnp.float16
