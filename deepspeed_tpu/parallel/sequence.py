@@ -22,7 +22,6 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 try:  # jax >= 0.8 moved shard_map out of experimental
-    from jax import shard_map as _shard_map_mod  # noqa: F401
     from jax import shard_map
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map

@@ -31,9 +31,9 @@ from deepspeed_tpu.utils.logging import logger
 # Column-parallel: shard last dim (outputs). Row-parallel: shard 2nd-to-last
 # (inputs). Biases of column-parallel layers shard their only dim.
 MEGATRON_RULES = [
-    (r"(qkv|query|key|value|ff1|intermediate|wi|fc1|c_fc)/(kernel|w)$", 1),
-    (r"(qkv|query|key|value|ff1|intermediate|wi|fc1|c_fc)/(bias|b)$", 1),
-    (r"(attn_out|attention_out|proj|wo|fc2|ff2|c_proj|output_dense)/(kernel|w)$", 2),
+    (r"(qkv|query|key|value|[qkv]_proj|up_proj|gate_proj|in_proj|ff1|intermediate|wi|fc1|c_fc)/(kernel|w)$", 1),
+    (r"(qkv|query|key|value|[qkv]_proj|up_proj|gate_proj|in_proj|ff1|intermediate|wi|fc1|c_fc)/(bias|b)$", 1),
+    (r"(attn_out|attention_out|out_proj|o_proj|down_proj|wo|fc2|ff2|c_proj|output_dense)/(kernel|w)$", 2),
     (r"(word_embeddings|wte|embedding|embed)/(embedding|kernel)$", 2),
 ]
 
@@ -52,38 +52,42 @@ def _path_str(path):
     return "/".join(parts)
 
 
-def spec_for(path, leaf, rules=MEGATRON_RULES):
+def spec_for(path, leaf, rules=MEGATRON_RULES, model_axis_size=None):
     """PartitionSpec for one param: the matched rule's dim-from-end gets the
-    model axis; everything else is replicated."""
+    model axis; everything else replicated. Dims not divisible by
+    ``model_axis_size`` stay replicated (so specs always match what
+    ``shard_params`` actually lays out)."""
     s = _path_str(path)
     for pattern, dim_from_end in rules:
         if re.search(pattern, s):
             ndim = leaf.ndim
             if dim_from_end > ndim:
                 continue
+            dim = ndim - dim_from_end
+            if model_axis_size is not None and leaf.shape[dim] % model_axis_size != 0:
+                return PartitionSpec()
             spec = [None] * ndim
-            spec[ndim - dim_from_end] = MODEL_AXIS
+            spec[dim] = MODEL_AXIS
             return PartitionSpec(*spec)
     return PartitionSpec()
 
 
 def shard_params(params, mesh, rules=MEGATRON_RULES, log=False):
     """Apply TP shardings to a param pytree (replicated along data/pipe)."""
+    axis_size = mesh.shape[MODEL_AXIS]
 
     def put(path, leaf):
-        spec = spec_for(path, leaf, rules)
+        spec = spec_for(path, leaf, rules, model_axis_size=axis_size)
         if log and spec != PartitionSpec():
             logger.info(f"TP shard {_path_str(path)} {leaf.shape} -> {spec}")
-        # Dims not divisible by the axis size stay replicated.
-        for i, ax in enumerate(spec):
-            if ax is not None and leaf.shape[i] % mesh.shape[MODEL_AXIS] != 0:
-                spec = PartitionSpec()
-                break
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(put, params)
 
 
-def param_specs(params, rules=MEGATRON_RULES):
-    """The PartitionSpec pytree (for pjit in_shardings / checkpoint layouts)."""
-    return jax.tree_util.tree_map_with_path(lambda p, l: spec_for(p, l, rules), params)
+def param_specs(params, rules=MEGATRON_RULES, model_axis_size=None):
+    """The PartitionSpec pytree (for pjit in_shardings / checkpoint layouts).
+    Pass ``model_axis_size`` to get exactly the layout ``shard_params`` uses."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, l: spec_for(p, l, rules, model_axis_size=model_axis_size), params
+    )

@@ -318,11 +318,14 @@ class DeepSpeedEngine:
             from deepspeed_tpu.parallel.tp import shard_params
 
             if self.zero_optimization():
+                # ZeRO's flat master would re-replicate TP-sharded params on
+                # every update; force stage 0 so TP shardings actually hold.
                 logger.warning(
-                    "ZeRO + tensor parallelism: ZeRO's flat master currently "
-                    "re-replicates params across the model axis on update; "
-                    "running TP with zero stage 0 semantics."
+                    "ZeRO + tensor parallelism is not composed yet: forcing "
+                    "zero stage 0 (optimizer state unsharded) under mp>1."
                 )
+                self._config.zero_enabled = False
+                self._config.zero_optimization_stage = 0
             self.params = shard_params(fp32, self.mesh)
         else:
             replicated = NamedSharding(self.mesh, PartitionSpec())
