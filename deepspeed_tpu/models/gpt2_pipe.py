@@ -1,0 +1,85 @@
+"""GPT-2 expressed as a PipelineModule (layer list) for pipeline parallelism.
+
+Role parity with the reference's Megatron GPT-2 pipeline benchmark subject
+(``tests/model/Megatron_GPT2`` with pipeline configs; BASELINE.json's
+"GPT-2 1.5B under ZeRO-2+pipe"). The embedding and the LM head share weights
+via ``TiedLayerSpec`` — the canonical use of the reference's tied-layer
+machinery (pipe/module.py:71).
+"""
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from deepspeed_tpu.models.bert import cross_entropy
+from deepspeed_tpu.models.gpt2 import GPT2Config, causal_mask
+from deepspeed_tpu.ops.transformer.transformer import DeepSpeedTransformerLayer
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+
+
+class GPT2EmbeddingPipe(nn.Module):
+    """First pipeline layer: token + position embeddings. Also the tied-weight
+    owner for the LM head."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        init = nn.initializers.normal(stddev=cfg.initializer_range)
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, embedding_init=init, name="wte")
+        wpe = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size, embedding_init=init, name="wpe")
+        S = input_ids.shape[1]
+        h = wte(input_ids) + wpe(jnp.arange(S)[None, :])
+        return h
+
+
+class GPT2BlockPipe(nn.Module):
+    """One decoder layer; the causal mask is rebuilt from the static seq len."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, h):
+        cfg = self.config
+        mask = causal_mask(h.shape[1], h.dtype)
+        return DeepSpeedTransformerLayer(cfg.layer_config())(h, mask)
+
+    @property
+    def param_count(self):
+        return 12 * self.config.hidden_size ** 2
+
+
+class GPT2FinalNormPipe(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, h):
+        return nn.LayerNorm(name="ln_f")(h)
+
+
+def _lm_head_forward(layer, layer_params, h):
+    """Tied head: logits via the embedding matrix transpose (weight tying)."""
+    wte = layer_params["params"]["wte"]["embedding"]
+    return h @ wte.T.astype(h.dtype)
+
+
+def gpt2_loss_fn(logits, labels):
+    """Next-token LM loss (labels are the input ids)."""
+    return cross_entropy(logits[:, :-1], labels[:, 1:], ignore_index=-1)
+
+
+def build_gpt2_pipeline(config, num_stages, partition_method="parameters", **pipe_kwargs):
+    """GPT-2 as a layer list: [tied embed, blocks..., ln_f, tied head]."""
+    layers = [TiedLayerSpec("embed", GPT2EmbeddingPipe, config)]
+    layers += [LayerSpec(GPT2BlockPipe, config) for _ in range(config.num_hidden_layers)]
+    layers += [
+        LayerSpec(GPT2FinalNormPipe, config),
+        TiedLayerSpec("embed", GPT2EmbeddingPipe, config, forward_fn=_lm_head_forward),
+    ]
+    return PipelineModule(
+        layers, num_stages=num_stages, loss_fn=gpt2_loss_fn,
+        partition_method=partition_method, **pipe_kwargs,
+    )

@@ -1,0 +1,66 @@
+"""FP16_Optimizer wrapper tests (reference test_fp16.py patterns: overflow
+skip, dynamic scale backoff, master-weight precision)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+from deepspeed_tpu.runtime.fp16.fused_optimizer import FP16_Optimizer, FP16_UnfusedOptimizer
+
+
+def test_fp16_optimizer_steps_and_skips():
+    opt = FP16_Optimizer(FusedAdam(lr=1e-2), dynamic_loss_scale=True,
+                         dynamic_loss_args={"init_scale": 2 ** 8})
+    params = {"w": jnp.ones((8,), jnp.float16)}
+    state = opt.init(params)
+    assert float(state.scaler.cur_scale) == 2 ** 8
+
+    # normal step: grads are pre-scaled by cur_scale (backward parity)
+    g = {"w": jnp.full((8,), 0.5 * 2 ** 8, jnp.float16)}
+    new_params, state, overflow = jax.jit(opt.step)(g, state, params)
+    assert not bool(overflow)
+    assert float(new_params["w"][0]) < 1.0
+
+    # overflowed grads: params unchanged, scale halves
+    g_inf = {"w": jnp.full((8,), np.inf, jnp.float16)}
+    p2, state2, overflow = jax.jit(opt.step)(g_inf, state, new_params)
+    assert bool(overflow)
+    np.testing.assert_array_equal(np.asarray(p2["w"]), np.asarray(new_params["w"]))
+    assert float(state2.scaler.cur_scale) == 2 ** 7
+
+
+def test_fp16_master_precision():
+    """Tiny updates must accumulate in the fp32 master even when fp16 rounding
+    would drop them."""
+    opt = FP16_Optimizer(FusedAdam(lr=1e-4, betas=(0.0, 0.0), bias_correction=False,
+                                   eps=1.0), static_loss_scale=1.0)
+    params = {"w": jnp.ones((4,), jnp.float16)}
+    state = opt.init(params)
+    g = {"w": jnp.full((4,), 1e-3, jnp.float16)}
+    for _ in range(10):
+        params, state, _ = opt.step(g, state, params)
+    master = float(state.master["w"][0])
+    assert master < 1.0, "master should accumulate sub-fp16 updates"
+
+
+def test_unfused_variant_exists():
+    opt = FP16_UnfusedOptimizer(FusedAdam(lr=1e-2))
+    params = {"w": jnp.ones((4,), jnp.float16)}
+    state = opt.init(params)
+    g = {"w": jnp.full((4,), 0.1, jnp.float16)}
+    p, s, o = opt.step(g, state, params)
+    assert not bool(o)
+
+
+def test_state_dict_roundtrip():
+    opt = FP16_Optimizer(FusedAdam(lr=1e-2), dynamic_loss_scale=True)
+    params = {"w": jnp.ones((4,), jnp.float16)}
+    state = opt.init(params)
+    g = {"w": jnp.full((4,), 0.5, jnp.float16)}
+    params, state, _ = opt.step(g, state, params)
+    blob = opt.state_dict(state)
+    restored = opt.load_state_dict(state, blob)
+    for a, b in zip(jax.tree_util.tree_leaves(state), jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

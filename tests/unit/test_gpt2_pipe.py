@@ -1,0 +1,65 @@
+"""GPT-2 pipeline: tied embeddings + convergence across pp layouts."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import GPT2Config
+from deepspeed_tpu.models.gpt2_pipe import build_gpt2_pipeline
+
+
+def tiny_cfg():
+    return GPT2Config(
+        vocab_size=256, hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+        max_position_embeddings=32, hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+    )
+
+
+def data(n, batch, seq, vocab, seed=0):
+    # Skewed distribution (ids in [0,16)) so the LM loss has room to drop
+    # below the uniform-entropy floor ln(vocab).
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        ids = rng.randint(0, 16, (batch, seq)).astype(np.int32)
+        out.append((ids, ids))
+    return out
+
+
+def run(num_stages, steps=3):
+    cfg = tiny_cfg()
+    module = build_gpt2_pipeline(cfg, num_stages=num_stages, partition_method="uniform")
+    dp = len(jax.devices()) // num_stages
+    engine, _, _, _ = deepspeed_tpu.initialize(model=module, config_params={
+        "train_batch_size": 8 * 2 * dp,
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    })
+    d = data(steps * 2, 8 * dp, 16, cfg.vocab_size)
+    it = iter(d)
+    return engine, [engine.train_batch(it) for _ in range(steps)]
+
+
+def test_gpt2_pipe_trains_and_ties():
+    engine, losses = run(num_stages=2)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], f"loss should drop: {losses}"
+    # embedding and head params remain bit-identical (tied)
+    entries = engine._tied["embed"]
+    (s0, l0, _), (s1, l1, _) = entries[0], entries[-1]
+    p0 = jax.device_get(engine._stage_params[s0][l0])
+    p1 = jax.device_get(engine._stage_params[s1][l1])
+    for a, b in zip(jax.tree_util.tree_leaves(p0), jax.tree_util.tree_leaves(p1)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_gpt2_pipe_layout_equivalence():
+    # Different stage splits change XLA fusion boundaries (different fp32
+    # rounding) and Adam amplifies early deltas; a real gradient bug shows up
+    # as O(1) divergence, not fractions of a percent.
+    _, l2 = run(num_stages=2)
+    _, l4 = run(num_stages=4)
+    np.testing.assert_allclose(l2, l4, rtol=5e-3)

@@ -1,0 +1,31 @@
+"""Progressive Layer Drop schedule tests (reference test_pld.py pattern)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+
+@pytest.mark.parametrize("theta", [0.5, 0.9])
+def test_pld_schedule(theta):
+    gamma = 0.001
+    pld = ProgressiveLayerDrop(theta=theta, gamma=gamma)
+    assert pld.get_theta() == 1.0  # starts keeping everything
+    prev = 1.0
+    for step in [0, 10, 100, 1000, 10000]:
+        pld.update_state(step)
+        expected = (1.0 - theta) * np.exp(-gamma * step) + theta
+        np.testing.assert_allclose(pld.get_theta(), expected, rtol=1e-6)
+        assert pld.get_theta() <= prev + 1e-9
+        prev = pld.get_theta()
+    # converges to theta-bar
+    pld.update_state(10 ** 7)
+    np.testing.assert_allclose(pld.get_theta(), theta, rtol=1e-5)
+
+
+def test_pld_state_dict():
+    pld = ProgressiveLayerDrop(theta=0.6, gamma=0.01)
+    pld.update_state(100)
+    state = pld.get_state()
+    assert state["progressive_layer_drop"] is True
+    assert 0.6 <= state["pld_theta"] <= 1.0
