@@ -1,0 +1,98 @@
+// Host-side runtime ops beyond the optimizer kernel.
+//
+// Capability parity targets:
+// - flatten/unflatten: the reference exposes torch's flatten_dense_tensors as
+//   a fast C++ op (csrc/utils/flatten_unflatten.cpp) used by ZeRO and the
+//   engine; here an OpenMP-parallel gather/scatter over raw buffers serves
+//   the host-offload paths.
+// - layout -> LUT segmentation for block-sparse attention: the reference does
+//   this in OpenMP C++ (csrc/sparse_attention/utils.cpp) to feed its Triton
+//   kernels; the same preprocessing feeds the Pallas kernel's
+//   PrefetchScalarGridSpec here.
+// - fused host LAMB step: reference csrc/lamb (fused_lamb_cuda_kernel.cu
+//   trust-ratio math) as the offload-side variant.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Copy `count` source buffers (sizes[i] floats at srcs[i]) into one flat
+// buffer. Parallel over buffers; memcpy per buffer.
+void ds_flatten(const float** srcs, const int64_t* sizes, int64_t count, float* dst) {
+    // prefix offsets
+#pragma omp parallel for schedule(dynamic)
+    for (int64_t i = 0; i < count; ++i) {
+        int64_t off = 0;
+        for (int64_t j = 0; j < i; ++j) off += sizes[j];
+        std::memcpy(dst + off, srcs[i], (size_t)sizes[i] * sizeof(float));
+    }
+}
+
+// Inverse: scatter the flat buffer back into `count` destination buffers.
+void ds_unflatten(const float* src, const int64_t* sizes, int64_t count, float** dsts) {
+#pragma omp parallel for schedule(dynamic)
+    for (int64_t i = 0; i < count; ++i) {
+        int64_t off = 0;
+        for (int64_t j = 0; j < i; ++j) off += sizes[j];
+        std::memcpy(dsts[i], src + off, (size_t)sizes[i] * sizeof(float));
+    }
+}
+
+// Block-sparse layout [H, Qb, Kb] (int64 0/1, C-contiguous) -> per-row LUT.
+// lut: [H, Qb, maxn] int32 (caller-allocated, maxn = max row population,
+// zero-initialized); counts: [H, Qb] int32.
+void ds_layout_to_lut(const int64_t* layout, int64_t H, int64_t Qb, int64_t Kb,
+                      int64_t maxn, int32_t* lut, int32_t* counts) {
+#pragma omp parallel for collapse(2) schedule(static)
+    for (int64_t h = 0; h < H; ++h) {
+        for (int64_t q = 0; q < Qb; ++q) {
+            const int64_t* row = layout + (h * Qb + q) * Kb;
+            int32_t* out = lut + (h * Qb + q) * maxn;
+            int32_t c = 0;
+            for (int64_t k = 0; k < Kb; ++k) {
+                if (row[k] != 0 && c < maxn) out[c++] = (int32_t)k;
+            }
+            counts[h * Qb + q] = c;
+        }
+    }
+}
+
+// Host LAMB step over one flat tensor (one "layer" = one trust-ratio group),
+// matching the reference's per-tensor trust ratio with coefficient clamping
+// (csrc/lamb/fused_lamb_cuda_kernel.cu).
+void ds_lamb_step(float* param, const float* grad, float* exp_avg, float* exp_avg_sq,
+                  int64_t n, float lr, float beta1, float beta2, float eps,
+                  float weight_decay, float max_coeff, float min_coeff, int step) {
+    const float one_m_b1 = 1.0f - beta1;
+    const float one_m_b2 = 1.0f - beta2;
+
+    double w_norm_sq = 0.0, u_norm_sq = 0.0;
+#pragma omp parallel for reduction(+ : w_norm_sq, u_norm_sq) schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grad[i];
+        float m = beta1 * exp_avg[i] + one_m_b1 * g;
+        float v = beta2 * exp_avg_sq[i] + one_m_b2 * g * g;
+        exp_avg[i] = m;
+        exp_avg_sq[i] = v;
+        float u = m / (sqrtf(v) + eps) + weight_decay * param[i];
+        w_norm_sq += (double)param[i] * param[i];
+        u_norm_sq += (double)u * u;
+    }
+    float w_norm = (float)sqrt(w_norm_sq);
+    float u_norm = (float)sqrt(u_norm_sq);
+    float trust = 1.0f;
+    if (w_norm > 0.0f && u_norm > 0.0f) {
+        trust = w_norm / u_norm;
+        if (trust > max_coeff) trust = max_coeff;
+        if (trust < min_coeff) trust = min_coeff;
+    }
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float u = exp_avg[i] / (sqrtf(exp_avg_sq[i]) + eps) + weight_decay * param[i];
+        param[i] -= lr * trust * u;
+    }
+}
+
+}  // extern "C"

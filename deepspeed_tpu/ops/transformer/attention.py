@@ -50,18 +50,13 @@ def layout_to_lut(layout):
     """[H, Qb, Kb] 0/1 layout -> (lut [H, Qb, maxnnz] int32, counts [H, Qb]).
 
     Rows are padded to the max row population; the kernel loops ``counts``
-    blocks so padding is never touched.
+    blocks so padding is never touched. Delegates to the native OpenMP
+    segmenter (csrc/host_ops.cpp, parity with the reference's
+    csrc/sparse_attention/utils.cpp) when the library is built.
     """
-    layout = np.asarray(layout)
-    H, Qb, Kb = layout.shape
-    counts = layout.sum(-1).astype(np.int32)
-    maxn = max(int(counts.max()), 1)
-    lut = np.zeros((H, Qb, maxn), np.int32)
-    for h in range(H):
-        for qi in range(Qb):
-            idx = np.nonzero(layout[h, qi])[0]
-            lut[h, qi, : len(idx)] = idx
-    return lut, counts
+    from deepspeed_tpu.ops.host_ops import layout_to_lut_host
+
+    return layout_to_lut_host(np.asarray(layout))
 
 
 # ---------------------------------------------------------------------------

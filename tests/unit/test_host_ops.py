@@ -1,0 +1,56 @@
+"""Native host op tests: C++ paths must match the numpy fallbacks."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import host_ops
+
+
+def test_lib_available():
+    assert host_ops.available(), "libdstpu_cpu.so should be built (make -C csrc)"
+
+
+def test_flatten_unflatten_roundtrip():
+    rng = np.random.RandomState(0)
+    arrays = [rng.randn(*s).astype(np.float32) for s in [(4, 4), (7,), (2, 3, 5)]]
+    flat = host_ops.flatten_host(arrays)
+    assert flat.shape == (4 * 4 + 7 + 2 * 3 * 5,)
+    np.testing.assert_array_equal(flat[:16], arrays[0].ravel())
+    back = host_ops.unflatten_host(flat, [a.shape for a in arrays])
+    for a, b in zip(arrays, back):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_layout_to_lut_native_matches_numpy():
+    rng = np.random.RandomState(1)
+    layout = (rng.rand(3, 8, 8) < 0.4).astype(np.int64)
+    lut_n, counts_n = host_ops.layout_to_lut_host(layout)
+    # numpy fallback
+    lib = host_ops._LIB
+    host_ops._LIB = False
+    try:
+        lut_p, counts_p = host_ops.layout_to_lut_host(layout)
+    finally:
+        host_ops._LIB = lib
+    np.testing.assert_array_equal(counts_n, counts_p)
+    np.testing.assert_array_equal(lut_n, lut_p)
+
+
+def test_lamb_native_matches_numpy():
+    rng = np.random.RandomState(2)
+    n = 1024
+    p0 = rng.randn(n).astype(np.float32)
+    g = rng.randn(n).astype(np.float32)
+
+    p_a, m_a, v_a = p0.copy(), np.zeros(n, np.float32), np.zeros(n, np.float32)
+    host_ops.lamb_step_host(p_a, g, m_a, v_a, lr=0.01, weight_decay=0.01)
+
+    lib = host_ops._LIB
+    host_ops._LIB = False
+    try:
+        p_b, m_b, v_b = p0.copy(), np.zeros(n, np.float32), np.zeros(n, np.float32)
+        host_ops.lamb_step_host(p_b, g, m_b, v_b, lr=0.01, weight_decay=0.01)
+    finally:
+        host_ops._LIB = lib
+    np.testing.assert_allclose(p_a, p_b, atol=1e-5)
+    np.testing.assert_allclose(m_a, m_b, atol=1e-6)
