@@ -11,6 +11,20 @@ from deepspeed_tpu.version import __version__
 
 version = __version__
 
+# Public surface parity with the reference deepspeed/__init__.py:1-30:
+# transformer kernel layer + config, pipeline module machinery, activation
+# checkpointing, and the sparse-attention suite are importable from the top.
+from deepspeed_tpu.ops.transformer.transformer import (  # noqa: E402
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)
+from deepspeed_tpu.runtime.pipe.module import (  # noqa: E402
+    LayerSpec,
+    PipelineModule,
+    TiedLayerSpec,
+)
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing  # noqa: E402
+
 
 def initialize(args=None, model=None, optimizer=None, model_parameters=None,
                training_data=None, lr_scheduler=None, mpu=None, dist_init_required=None,

@@ -1,0 +1,91 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+Complement to ring attention (``parallel/sequence.py``): instead of rotating
+k/v chunks, an ``all_to_all`` re-shards the activations from sequence-sharded
+to HEAD-sharded just for the attention core, then back. Comm volume is
+O(S*D/W) per device per direction (two all-to-alls), independent of W hops —
+the better choice when heads >= ring size and the per-hop latency of the ring
+would dominate.
+
+Layout dance (inside shard_map over ``axis_name``; local shapes):
+  in:  q,k,v [B, H, S/W, D]   (sequence sharded)
+  a2a: -> [B, H/W, S, D]      (heads sharded, full sequence local)
+  attention (any kernel — here the fused/flash path on full local sequence)
+  a2a: out -> [B, H, S/W, D]  (back to sequence sharded)
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from deepspeed_tpu.ops.transformer.attention import _attention_reference
+
+
+def _seq_to_heads(x, axis_name, W):
+    """[B, H, Sc, D] -> [B, H/W, S, D]: split heads, all_to_all, join seq."""
+    B, H, Sc, D = x.shape
+    assert H % W == 0, f"heads {H} must divide axis size {W}"
+    x = x.reshape(B, W, H // W, Sc, D)
+    # split_axis=1 (head groups) becomes the device axis; the device axis
+    # reappears at concat_axis=2 as the sequence-chunk index:
+    # [B, W, Hw, Sc, D] -> [B, Hw, W, Sc, D] -> [B, Hw, S, D]
+    x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=False)
+    return x.reshape(B, H // W, W * Sc, D)
+
+
+def _heads_to_seq(x, axis_name, W):
+    """[B, H/W, S, D] -> [B, H, S/W, D]: inverse all-to-all."""
+    B, Hw, S, D = x.shape
+    Sc = S // W
+    x = x.reshape(B, Hw, W, Sc, D)
+    x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=False)
+    return x.reshape(B, Hw * W, Sc, D)
+
+
+def ulysses_attention_local(q, k, v, bias, axis_name, causal=False):
+    """Runs INSIDE shard_map: q,k,v local [B, H, S/W, D]; bias local [B, S/W]."""
+    W = jax.lax.psum(1, axis_name)
+    qh = _seq_to_heads(q, axis_name, W)
+    kh = _seq_to_heads(k, axis_name, W)
+    vh = _seq_to_heads(v, axis_name, W)
+    full_bias = jax.lax.all_gather(bias, axis_name, axis=1, tiled=True)  # [B, S]
+    out = _attention_reference(qh, kh, vh, full_bias, None, causal=causal)
+    return _heads_to_seq(out, axis_name, W)
+
+
+def ulysses_attention(q, k, v, mask=None, mesh=None, axis_name="data", causal=False):
+    """Driver: [B,H,S,D] inputs sequence-sharded along ``axis_name``."""
+    B, H, S, D = q.shape
+    if mesh is None:
+        import deepspeed_tpu.parallel.mesh as mesh_lib
+
+        mesh = mesh_lib.create_mesh()
+    W = mesh.shape[axis_name]
+    assert S % W == 0 and H % W == 0, (
+        f"seq {S} and heads {H} must divide the axis size {W}"
+    )
+    if mask is None:
+        bias = jnp.zeros((B, S), jnp.float32)
+    elif mask.ndim == 4:
+        bias = mask[:, 0, 0, :].astype(jnp.float32)
+    else:
+        bias = mask.astype(jnp.float32)
+
+    seq = PartitionSpec(None, None, axis_name, None)
+    bseq = PartitionSpec(None, axis_name)
+    fn = shard_map(
+        functools.partial(ulysses_attention_local, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(seq, seq, seq, bseq),
+        out_specs=seq,
+    )
+    return fn(q, k, v, bias)

@@ -1,0 +1,34 @@
+"""CPU Adam perf microbench (reference tests/perf/adam_test.py: one step over
+~1 GB of fp32 params). Run directly: python tests/perf/adam_test.py [numel]."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+import time
+
+import numpy as np
+
+from deepspeed_tpu.ops import host_ops
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+
+
+def main(numel=64 * 1024 * 1024):
+    param = np.zeros(numel, np.float32)
+    grad = np.random.RandomState(0).randn(numel).astype(np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-3)
+    state = opt.init_host(param)
+    # warmup + timed steps
+    opt.step_host(param, grad, lr=1e-3)
+    t0 = time.perf_counter()
+    steps = 5
+    for _ in range(steps):
+        opt.step_host(param, grad, lr=1e-3)
+    dt = (time.perf_counter() - t0) / steps
+    gbps = numel * 4 * 4 / dt / 1e9  # read p,m,v,g
+    print(f"cpu_adam: {numel/1e6:.0f}M params, {dt*1e3:.1f} ms/step, ~{gbps:.1f} GB/s "
+          f"(native={host_ops.available()})")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 64 * 1024 * 1024)

@@ -105,3 +105,31 @@ def test_ring_attention_grads():
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_dense(causal):
+    from deepspeed_tpu.parallel.ulysses import ulysses_attention
+
+    B, H, S, D = 2, 8, 64, 16
+    rng = np.random.RandomState(3)
+    mk = lambda: jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.5
+    q, k, v = mk(), mk(), mk()
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    out = ulysses_attention(q, k, v, mesh=mesh, axis_name="data", causal=causal)
+    ref = _attention_reference(q, k, v, jnp.zeros((B, S), jnp.float32), None, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_attention_masked():
+    from deepspeed_tpu.parallel.ulysses import ulysses_attention
+
+    B, H, S, D = 2, 8, 64, 16
+    rng = np.random.RandomState(4)
+    mk = lambda: jnp.asarray(rng.randn(B, H, S, D).astype(np.float32)) * 0.5
+    q, k, v = mk(), mk(), mk()
+    bias = jnp.asarray(np.where(rng.rand(B, S) < 0.25, -1e9, 0.0).astype(np.float32))
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    out = ulysses_attention(q, k, v, mask=bias, mesh=mesh, axis_name="data")
+    ref = _attention_reference(q, k, v, bias, None, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
