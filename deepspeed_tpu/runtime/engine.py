@@ -317,15 +317,6 @@ class DeepSpeedEngine:
         if self.mp_world_size > 1:
             from deepspeed_tpu.parallel.tp import shard_params
 
-            if self.zero_optimization():
-                # ZeRO's flat master would re-replicate TP-sharded params on
-                # every update; force stage 0 so TP shardings actually hold.
-                logger.warning(
-                    "ZeRO + tensor parallelism is not composed yet: forcing "
-                    "zero stage 0 (optimizer state unsharded) under mp>1."
-                )
-                self._config.zero_enabled = False
-                self._config.zero_optimization_stage = 0
             self.params = shard_params(fp32, self.mesh)
         else:
             replicated = NamedSharding(self.mesh, PartitionSpec())
@@ -403,6 +394,16 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.zero.sharded_optimizer import ZeroShardedOptimizer
 
         stage = self.zero_optimization_stage()
+        if self.mp_world_size > 1:
+            # Flat-vector ZeRO would destroy TP shardings; the pytree variant
+            # composes (data-axis state sharding on top of model-axis specs).
+            from deepspeed_tpu.runtime.zero.pytree_optimizer import ZeroPytreeOptimizer
+
+            log_dist(f"Creating ZeRO(pytree) stage {stage} optimizer (mp={self.mp_world_size})", ranks=[0])
+            return ZeroPytreeOptimizer(
+                basic_optimizer, stage=stage, mesh=self.mesh,
+                clip_grad=self.gradient_clipping(),
+            )
         log_dist(f"Creating ZeRO stage {stage} optimizer", ranks=[0])
         return ZeroShardedOptimizer(
             basic_optimizer,
