@@ -245,8 +245,28 @@ class PipelineEngine:
                 for i in range(lo, hi)
             ]
             self._stage_params.append(stage)
-        self._stage_opt_state = [self.basic_optimizer.init(sp) for sp in self._stage_params]
+        self._make_stage_optimizers()
+        self._stage_opt_state = [
+            self._stage_opt[s].init(self._stage_params[s]) for s in range(self.num_stages)
+        ]
         self._zero_acc_grads()
+
+    def _make_stage_optimizers(self):
+        """Per-stage optimizer: plain, or ZeRO-1/2 sharded over the stage's
+        data axis (the reference supports ZeRO-1 under PP; the pytree variant
+        composes with any in-stage shardings)."""
+        if self._config.zero_enabled:
+            from deepspeed_tpu.runtime.zero.pytree_optimizer import ZeroPytreeOptimizer
+
+            self._stage_opt = [
+                ZeroPytreeOptimizer(
+                    self.basic_optimizer, stage=self._config.zero_optimization_stage,
+                    mesh=self.stage_meshes[s], clip_grad=0.0,
+                )
+                for s in range(self.num_stages)
+            ]
+        else:
+            self._stage_opt = [self.basic_optimizer] * self.num_stages
 
     def _zero_acc_grads(self):
         self._acc_grads = [
@@ -361,7 +381,7 @@ class PipelineEngine:
         direction vs the pp=1 layout)."""
         key = ("step", s)
         if key not in self._jit:
-            opt = self.basic_optimizer
+            opt = self._stage_opt[s]
 
             def step(stage_params, opt_state, acc, lr, factor):
                 grads = jax.tree_util.tree_map(lambda g: g * factor, acc)
@@ -725,10 +745,24 @@ class PipelineEngine:
                 out[idx] = self._stage_params[s][off]
         return out
 
+    @staticmethod
+    def _is_layer_list(val, n_local):
+        """A per-layer field is a plain list/tuple of length n_local — but NOT
+        a NamedTuple (which is a tuple subclass with _fields)."""
+        return (
+            isinstance(val, (list, tuple))
+            and not hasattr(val, "_fields")
+            and len(val) == n_local
+        )
+
     def _split_opt_state_per_layer(self):
         """Split each stage's optimizer state into per-layer pieces. Works for
         any NamedTuple state whose per-param fields mirror the stage's
-        per-layer params list (FusedAdam/FusedLamb/SGD all do)."""
+        per-layer params list (FusedAdam/FusedLamb/SGD all do). ZeRO-in-pipe
+        states are nested; they are persisted stage-keyed instead (see
+        save_checkpoint)."""
+        if self._config.zero_enabled:
+            return None, None
         n_layers = self.module._num_layers
         opt_layers = [dict() for _ in range(n_layers)]
         opt_global = {}
@@ -739,7 +773,7 @@ class PipelineEngine:
             lo, hi = self.module.stage_layer_range(s)
             n_local = hi - lo
             for name, val in state._asdict().items():
-                if isinstance(val, (list, tuple)) and len(val) == n_local:
+                if self._is_layer_list(val, n_local):
                     for off in range(n_local):
                         opt_layers[lo + off][name] = jax.device_get(val[off])
                 elif s == 0:
@@ -760,7 +794,7 @@ class PipelineEngine:
             n_local = hi - lo
             fields = {}
             for name, val in template._asdict().items():
-                if isinstance(val, (list, tuple)) and len(val) == n_local:
+                if self._is_layer_list(val, n_local):
                     fields[name] = [
                         jax.tree_util.tree_map(jnp.asarray, opt_layers[lo + off][name])
                         for off in range(n_local)
@@ -805,9 +839,12 @@ class PipelineEngine:
                 )
                 for i in range(lo, hi)
             ])
-        self._stage_opt_state = [self.basic_optimizer.init(sp) for sp in self._stage_params]
+        self._make_stage_optimizers()
+        self._stage_opt_state = [
+            self._stage_opt[s].init(self._stage_params[s]) for s in range(self.num_stages)
+        ]
         opt_file = os.path.join(path, "optim_states.pt")
-        if os.path.exists(opt_file):
+        if os.path.exists(opt_file) and not self._config.zero_enabled:
             with open(opt_file, "rb") as f:
                 if not self._restore_opt_state_per_layer(pickle.load(f)):
                     logger.warning("could not restore optimizer state; reinitialized")
