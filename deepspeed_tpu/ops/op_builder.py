@@ -49,12 +49,38 @@ class OpBuilder:
 
 
 class CPUAdamBuilder(OpBuilder):
+    """Host library: offload Adam/LAMB, flatten/unflatten, LUT segmenter."""
+
     NAME = "cpu"
-    SOURCES = ["cpu_adam.cpp"]
+    SOURCES = ["cpu_adam.cpp", "host_ops.cpp"]
+
+
+class PallasOp:
+    """Registry entry for a Pallas (device) kernel — 'installed' means the
+    Pallas TPU lowering path is importable; nothing to compile ahead of time
+    (XLA JIT-compiles at first trace, reference op_builder's JIT semantics)."""
+
+    def __init__(self, name):
+        self.NAME = name
+
+    def is_compatible(self):
+        try:
+            from jax.experimental import pallas  # noqa: F401
+            from jax.experimental.pallas import tpu  # noqa: F401
+
+            return True
+        except ImportError:
+            return False
+
+    def installed(self):
+        return self.is_compatible()
 
 
 ALL_OPS = {
     "cpu_adam": CPUAdamBuilder,
+    "utils": CPUAdamBuilder,            # flatten/unflatten live in the host lib
+    "transformer": PallasOp,            # fused attention (dense layouts)
+    "sparse_attn": PallasOp,            # fused attention (block-sparse layouts)
 }
 
 
@@ -62,8 +88,11 @@ def op_report():
     """Install/compatibility matrix (reference env_report.py op_report)."""
     lines = ["op name " + "." * 20 + " installed .. compatible", "-" * 60]
     for name, builder_cls in ALL_OPS.items():
-        b = builder_cls()
-        installed = os.path.exists(b.lib_path())
+        b = builder_cls() if builder_cls is not PallasOp else PallasOp(name)
+        if isinstance(b, PallasOp):
+            installed = b.installed()
+        else:
+            installed = os.path.exists(b.lib_path())
         compatible = b.is_compatible()
         lines.append(f"{name:<28} {'[YES]' if installed else '[NO] '} ...... {'[OKAY]' if compatible else '[NO]'}")
     return "\n".join(lines)

@@ -737,7 +737,13 @@ class DeepSpeedEngine:
         self.params, self.opt_state, self.scaler_state, overflow, gnorm, self._acc_grads = step_fn(
             self.params, self.opt_state, self._acc_grads, self.scaler_state, jnp.asarray(lr if lr is not None else self._optimizer_base_lr(), jnp.float32)
         )
-        overflow = bool(jax.device_get(overflow))
+        if self.fp16_enabled():
+            # fp16 needs the overflow verdict on host (skip bookkeeping + lr
+            # hold). bf16/fp32 never overflow-skip — avoid the per-step device
+            # sync so XLA queues steps back-to-back.
+            overflow = bool(jax.device_get(overflow))
+        else:
+            overflow = False
         self._last_overflow = overflow
         if overflow:
             self.skipped_steps += 1
