@@ -63,7 +63,7 @@ def layout_to_lut(layout):
 # Pallas kernel
 # ---------------------------------------------------------------------------
 
-def _attn_kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
+def _attn_kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                  *, num_heads, block_q, block_k, maxn, scale, causal):
     """One (batch*head, q-block-row) cell: stream LUT-named k/v blocks with
     online softmax. carry = (m, l, acc) runs in registers/VMEM values."""
@@ -107,6 +107,10 @@ def _attn_kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
 
     out = jnp.where(l > 0.0, acc / jnp.where(l > 0.0, l, 1.0), 0.0)
     o_ref[0] = out.astype(o_ref.dtype)
+    # log-sum-exp residual for the flash backward; +inf-like for empty rows so
+    # exp(s - lse) == 0 there.
+    lse = jnp.where(l[:, 0] > 0.0, m[:, 0] + jnp.log(jnp.where(l[:, 0] > 0, l[:, 0], 1.0)), 1e30)
+    lse_ref[0] = lse
 
 
 def _attention_pallas(q, k, v, bias, lut, counts, *, block_q, block_k, causal, interpret=False):
@@ -128,20 +132,181 @@ def _attention_pallas(q, k, v, bias, lut, counts, *, block_q, block_k, causal, i
             pl.BlockSpec((1, S, D), lambda bh, qi, *_: (bh, 0, 0)),
             pl.BlockSpec((1, 1, S), lambda bh, qi, *_: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, *_: (bh, qi, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, *_: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, *_: (bh, qi)),
+        ),
     )
     kernel = functools.partial(
         _attn_kernel, num_heads=H, block_q=block_q, block_k=block_k,
         maxn=maxn, scale=scale, causal=causal,
     )
     bias_r = jnp.broadcast_to(bias[:, None, :], (B, H, S)).reshape(BH, 1, S)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+        ),
         interpret=interpret,
     )(jnp.asarray(counts), jnp.asarray(lut), qr, kr, vr, bias_r)
-    return out.reshape(B, H, S, D)
+    return out.reshape(B, H, S, D), lse
+
+
+def _attn_bwd_dq_kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref,
+                        do_ref, lse_ref, delta_ref, dq_ref,
+                        *, num_heads, block_q, block_k, scale, causal):
+    """dq for one (bh, q-block-row): dq = scale * sum_j ds_j @ k_j with
+    ds = p * (dO @ v^T - delta) and p = exp(s - lse)."""
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    h = jax.lax.rem(bh, num_heads)
+
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    D = q.shape[-1]
+    count = counts_ref[h, qi]
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(n, dq):
+        kj = lut_ref[h, qi, n]
+        k_blk = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s + bias_ref[0, 0, pl.ds(kj * block_k, block_k)].astype(jnp.float32)[None, :]
+        if causal:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, count, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _attn_bwd_dkv_kernel(qcounts_ref, qlut_ref, q_ref, k_ref, v_ref, bias_ref,
+                         do_ref, lse_ref, delta_ref, dk_ref, dv_ref, db_ref,
+                         *, num_heads, block_q, block_k, scale, causal):
+    """dk/dv/dbias for one (bh, k-block-column), looping the transposed LUT's
+    q blocks: dv = sum p^T dO; dk = sum ds^T (scale*q); dbias = sum_rows ds."""
+    bh = pl.program_id(0)
+    kj = pl.program_id(1)
+    h = jax.lax.rem(bh, num_heads)
+
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    bias_j = bias_ref[0, 0].astype(jnp.float32)
+    D = k_blk.shape[-1]
+    count = qcounts_ref[h, kj]
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(n, carry):
+        dk, dv, db = carry
+        qi = qlut_ref[h, kj, n]
+        q_i = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * scale
+        do_i = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse_i = lse_ref[0, pl.ds(qi * block_q, block_q)]
+        delta_i = delta_ref[0, pl.ds(qi * block_q, block_q)]
+        s = jax.lax.dot_general(q_i, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        s = s + bias_j[None, :]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        p = jnp.exp(s - lse_i[:, None])
+        dv = dv + jax.lax.dot_general(p, do_i, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do_i, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_i[:, None])
+        dk = dk + jax.lax.dot_general(ds, q_i, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        db = db + jnp.sum(ds, axis=0)
+        return dk, dv, db
+
+    zero = jnp.zeros((block_k, D), jnp.float32)
+    dk, dv, db = jax.lax.fori_loop(0, count, body, (zero, zero, jnp.zeros((block_k,), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+    db_ref[0, 0] = db
+
+
+def _attention_pallas_bwd(q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts,
+                          *, block_q, block_k, causal, interpret=False):
+    """Flash backward: returns (dq, dk, dv, dbias[B,S])."""
+    B, H, S, D = q.shape
+    BH = B * H
+    rs = lambda t: t.reshape(BH, S, D)
+    qr, kr, vr, dor, outr = rs(q), rs(k), rs(v), rs(g), rs(out)
+    scale = 1.0 / float(np.sqrt(D))
+    bias_r = jnp.broadcast_to(bias[:, None, :], (B, H, S)).reshape(BH, 1, S)
+    delta = jnp.sum(dor.astype(jnp.float32) * outr.astype(jnp.float32), axis=-1)  # [BH,S]
+
+    # dq: grid over q block rows
+    dq_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(BH, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, *_: (bh, qi, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi, *_: (bh, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda bh, qi, *_: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, qi, *_: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, *_: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, *_: (bh, qi)),
+            pl.BlockSpec((1, block_q), lambda bh, qi, *_: (bh, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, *_: (bh, qi, 0)),
+    )
+    dq = pl.pallas_call(
+        functools.partial(_attn_bwd_dq_kernel, num_heads=H, block_q=block_q,
+                          block_k=block_k, scale=scale, causal=causal),
+        grid_spec=dq_spec,
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(counts), jnp.asarray(lut), qr, kr, vr, bias_r, dor, lse, delta)
+
+    # dk/dv/dbias: grid over k block columns with the TRANSPOSED LUT
+    dkv_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(BH, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, S, D), lambda bh, kj, *_: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kj, *_: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kj, *_: (bh, kj, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bh, kj, *_: (bh, 0, kj)),
+            pl.BlockSpec((1, S, D), lambda bh, kj, *_: (bh, 0, 0)),
+            pl.BlockSpec((1, S), lambda bh, kj, *_: (bh, 0)),
+            pl.BlockSpec((1, S), lambda bh, kj, *_: (bh, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, D), lambda bh, kj, *_: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, kj, *_: (bh, kj, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda bh, kj, *_: (bh, 0, kj)),
+        ),
+    )
+    dk, dv, db = pl.pallas_call(
+        functools.partial(_attn_bwd_dkv_kernel, num_heads=H, block_q=block_q,
+                          block_k=block_k, scale=scale, causal=causal),
+        grid_spec=dkv_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+            jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
+        ),
+        interpret=interpret,
+    )(jnp.asarray(qcounts), jnp.asarray(qlut), qr, kr, vr, bias_r, dor, lse, delta)
+
+    unrs = lambda t: t.reshape(B, H, S, D)
+    dbias = db.reshape(B, H, S).sum(axis=1).astype(bias.dtype)
+    return unrs(dq), unrs(dk), unrs(dv), dbias
 
 
 # ---------------------------------------------------------------------------
@@ -179,6 +344,17 @@ def _expand_layout_mask(layout, S, block):
 # public entry
 # ---------------------------------------------------------------------------
 
+def _luts_for(layout, H, S, block):
+    """(row LUT, counts, transposed LUT, transposed counts)."""
+    nb = S // block
+    if layout is None:
+        lut, counts = _dense_lut(H, nb, nb)
+        return lut, counts, lut, counts
+    lut, counts = layout_to_lut(layout)
+    qlut, qcounts = layout_to_lut(np.asarray(layout).transpose(0, 2, 1))
+    return lut, counts, qlut, qcounts
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def _attention(q, k, v, bias, layout_key, block, causal, force_ref):
     layout = _LAYOUTS.get(layout_key) if layout_key is not None else None
@@ -187,13 +363,11 @@ def _attention(q, k, v, bias, layout_key, block, causal, force_ref):
             q, k, v, bias, _expand_layout_mask(layout, q.shape[2], block), causal=causal
         )
     B, H, S, D = q.shape
-    if layout is None:
-        lut, counts = _dense_lut(H, S // block, S // block)
-    else:
-        lut, counts = layout_to_lut(layout)
-    return _attention_pallas(
+    lut, counts, _, _ = _luts_for(layout, H, S, block)
+    out, _ = _attention_pallas(
         q, k, v, bias, lut, counts, block_q=block, block_k=block, causal=causal
     )
+    return out
 
 
 def _on_tpu():
@@ -201,14 +375,33 @@ def _on_tpu():
 
 
 def _attention_fwd(q, k, v, bias, layout_key, block, causal, force_ref):
-    out = _attention(q, k, v, bias, layout_key, block, causal, force_ref)
-    return out, (q, k, v, bias)
+    layout = _LAYOUTS.get(layout_key) if layout_key is not None else None
+    if force_ref or not _on_tpu():
+        out = _attention_reference(
+            q, k, v, bias, _expand_layout_mask(layout, q.shape[2], block), causal=causal
+        )
+        return out, (q, k, v, bias, None, None)
+    B, H, S, D = q.shape
+    lut, counts, _, _ = _luts_for(layout, H, S, block)
+    out, lse = _attention_pallas(
+        q, k, v, bias, lut, counts, block_q=block, block_k=block, causal=causal
+    )
+    return out, (q, k, v, bias, out, lse)
 
 
 def _attention_bwd(layout_key, block, causal, force_ref, res, g):
-    """Rematerialized backward in XLA (layout-masked dense math)."""
-    q, k, v, bias = res
+    """Flash backward kernels on the Pallas path (O(S*D) memory); dense
+    rematerialized VJP on the reference path."""
+    q, k, v, bias, out, lse = res
     layout = _LAYOUTS.get(layout_key) if layout_key is not None else None
+
+    if lse is not None:
+        B, H, S, D = q.shape
+        lut, counts, qlut, qcounts = _luts_for(layout, H, S, block)
+        return _attention_pallas_bwd(
+            q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts,
+            block_q=block, block_k=block, causal=causal,
+        )
 
     def f(q, k, v, bias):
         return _attention_reference(

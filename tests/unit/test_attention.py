@@ -33,7 +33,7 @@ def test_dense_kernel_matches_reference():
     B, H, S, D = q.shape
     bias = jnp.zeros((B, S), jnp.float32)
     lut, counts = _dense_lut(H, S // 128, S // 128)
-    out_k = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
+    out_k, _ = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
                               causal=False, interpret=True)
     out_r = _attention_reference(q, k, v, bias, None, causal=False)
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r), atol=2e-5, rtol=2e-5)
@@ -46,7 +46,7 @@ def test_masked_kernel_matches_reference():
     pad = rng.rand(B, S) < 0.2
     bias = jnp.asarray(np.where(pad, -10000.0, 0.0).astype(np.float32))
     lut, counts = _dense_lut(H, S // 128, S // 128)
-    out_k = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
+    out_k, _ = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
                               causal=False, interpret=True)
     out_r = _attention_reference(q, k, v, bias, None, causal=False)
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r), atol=2e-5, rtol=2e-5)
@@ -57,7 +57,7 @@ def test_causal_kernel_matches_reference():
     B, H, S, D = q.shape
     bias = jnp.zeros((B, S), jnp.float32)
     lut, counts = _dense_lut(H, S // 128, S // 128)
-    out_k = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
+    out_k, _ = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
                               causal=True, interpret=True)
     out_r = _attention_reference(q, k, v, bias, None, causal=True)
     np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r), atol=2e-5, rtol=2e-5)
@@ -72,7 +72,7 @@ def test_sparse_layout_kernel_matches_masked_reference():
     layout[:, :, 0] = 1  # keep every row alive
     bias = jnp.zeros((B, S), jnp.float32)
     lut, counts = layout_to_lut(layout)
-    out_k = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
+    out_k, _ = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
                               causal=False, interpret=True)
     out_r = _attention_reference(q, k, v, bias, _expand_layout_mask(layout, S, 128),
                                  causal=False)
@@ -87,7 +87,7 @@ def test_empty_rows_give_zero():
     layout[0, 1, :] = 0  # head 0, q-block 1 attends to nothing
     bias = jnp.zeros((B, S), jnp.float32)
     lut, counts = layout_to_lut(layout)
-    out_k = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
+    out_k, _ = _attention_pallas(q, k, v, bias, lut, counts, block_q=128, block_k=128,
                               causal=False, interpret=True)
     np.testing.assert_array_equal(np.asarray(out_k[:, 0, 128:256, :]), 0.0)
 
@@ -111,3 +111,57 @@ def test_flash_attention_grads():
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-5)
+
+
+def _bwd_check(layout=None, causal=False, bias=None, seed=10):
+    """Flash backward kernels (interpret mode) vs dense-masked VJP."""
+    from deepspeed_tpu.ops.transformer.attention import (
+        _attention_pallas_bwd,
+        _luts_for,
+    )
+
+    q, k, v = rand_qkv(B=2, H=2, S=256, D=32, seed=seed)
+    B, H, S, D = q.shape
+    if bias is None:
+        bias = jnp.zeros((B, S), jnp.float32)
+    lut, counts, qlut, qcounts = _luts_for(layout, H, S, 128)
+    out, lse = _attention_pallas(q, k, v, bias, lut, counts, block_q=128,
+                                 block_k=128, causal=causal, interpret=True)
+    g = jnp.asarray(np.random.RandomState(seed + 1).randn(*out.shape).astype(np.float32))
+    dq, dk, dv, dbias = _attention_pallas_bwd(
+        q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts,
+        block_q=128, block_k=128, causal=causal, interpret=True,
+    )
+
+    mask = _expand_layout_mask(layout, S, 128)
+
+    def f(q, k, v, bias):
+        return _attention_reference(q, k, v, bias, mask, causal=causal)
+
+    _, vjp = jax.vjp(f, q, k, v, bias)
+    rq, rk, rv, rb = vjp(g)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(rq), atol=3e-4, rtol=3e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(rk), atol=3e-4, rtol=3e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(rv), atol=3e-4, rtol=3e-4)
+    np.testing.assert_allclose(np.asarray(dbias), np.asarray(rb), atol=3e-3, rtol=3e-3)
+
+
+def test_flash_bwd_dense():
+    _bwd_check()
+
+
+def test_flash_bwd_causal():
+    _bwd_check(causal=True, seed=11)
+
+
+def test_flash_bwd_masked():
+    rng = np.random.RandomState(12)
+    bias = jnp.asarray(np.where(rng.rand(2, 256) < 0.2, -10000.0, 0.0).astype(np.float32))
+    _bwd_check(bias=bias, seed=12)
+
+
+def test_flash_bwd_sparse_layout():
+    rng = np.random.RandomState(13)
+    layout = (rng.rand(2, 2, 2) < 0.6).astype(np.int64)
+    layout[:, :, 0] = 1
+    _bwd_check(layout=layout, seed=13)
