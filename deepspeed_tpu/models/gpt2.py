@@ -59,6 +59,7 @@ class GPT2Config:
             initializer_range=self.initializer_range,
             pre_layer_norm=True,
             training=training,
+            causal=True,
         )
 
 
@@ -97,7 +98,9 @@ class GPT2Model(nn.Module):
         h = word(input_ids) + pos(jnp.arange(S)[None, :])
         h = nn.Dropout(rate=cfg.hidden_dropout_prob)(h, deterministic=deterministic)
 
-        mask = causal_mask(S, h.dtype)
+        # Causality is a layer-config flag (applied in-kernel on the fused
+        # path); no materialized S x S mask.
+        mask = None
         body = _ScannedDecoderLayer
         if cfg.checkpoint_activations:
             body = nn.remat(body, prevent_cse=False)

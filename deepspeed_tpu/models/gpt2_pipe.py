@@ -44,8 +44,8 @@ class GPT2BlockPipe(nn.Module):
     @nn.compact
     def __call__(self, h):
         cfg = self.config
-        mask = causal_mask(h.shape[1], h.dtype)
-        return DeepSpeedTransformerLayer(cfg.layer_config())(h, mask)
+        # cfg.layer_config() sets causal=True: masking happens in-kernel.
+        return DeepSpeedTransformerLayer(cfg.layer_config())(h, None)
 
     @property
     def param_count(self):

@@ -55,6 +55,7 @@ class DeepSpeedTransformerConfig:
     stochastic_mode: bool = False
     huggingface: bool = False
     training: bool = True
+    causal: bool = False  # autoregressive masking applied in-kernel (GPT-style)
 
     @classmethod
     def from_dict(cls, json_object):
@@ -72,7 +73,23 @@ class DeepSpeedTransformerConfig:
             return cls.from_dict(json.loads(reader.read()))
 
 
-def _attention_core(q, k, v, mask, dropout_ratio, deterministic, dropout_rng, use_pallas=True):
+def _is_causal_mask(mask):
+    """Static check: is this additive [.,.,S,S] mask exactly lower-triangular
+    (0 on/below diag, large-negative above)? Only answerable for concrete
+    arrays; traced masks -> False (jnp fallback)."""
+    import numpy as np
+
+    try:
+        m = np.asarray(mask)
+    except Exception:
+        return False
+    S = m.shape[-1]
+    tril = np.tril(np.ones((S, S), bool))
+    return bool(np.all((m[..., :, :] >= -1e-6) == tril))
+
+
+def _attention_core(q, k, v, mask, dropout_ratio, deterministic, dropout_rng,
+                    use_pallas=True, causal=False):
     """Scaled masked attention softmax + PV.
 
     The reference implements this as fused CUDA softmax/dropout kernels
@@ -80,21 +97,30 @@ def _attention_core(q, k, v, mask, dropout_ratio, deterministic, dropout_rng, us
     Pallas flash-attention kernel when available; otherwise an XLA-fused jnp
     path (still one fused softmax on TPU).
 
-    Shapes: q,k,v = [B, H, S, D]; mask = [B, 1, 1, S] additive.
+    Shapes: q,k,v = [B, H, S, D]; mask = [B, 1, 1, S] additive key bias;
+    ``causal`` applies autoregressive masking (in-kernel on the fused path).
     """
-    if use_pallas:
-        try:
-            from deepspeed_tpu.ops.transformer.attention import flash_attention
+    if use_pallas and (deterministic or dropout_ratio == 0.0):
+        from deepspeed_tpu.ops.transformer.attention import flash_attention
 
-            if deterministic or dropout_ratio == 0.0:
-                return flash_attention(q, k, v, mask)
-        except Exception:
-            pass
+        # The fused kernel takes a KEY bias ([B,1,1,S] / [B,S]) plus an
+        # in-kernel causal flag. A full [.,.,S,S] mask must either be
+        # recognized as causal (concrete arrays only) or fall through to the
+        # general jnp path — collapsing it to a key bias would be wrong.
+        if mask is None or (mask.ndim == 4 and mask.shape[-2] == 1):
+            return flash_attention(q, k, v, mask, causal=causal)
+        if not causal and mask.ndim == 4 and mask.shape[-2] == mask.shape[-1]:
+            if _is_causal_mask(mask):
+                return flash_attention(q, k, v, None, causal=True)
 
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     scores = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale
     if mask is not None:
         scores = scores + mask
+    if causal:
+        S = q.shape[2]
+        cm = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(cm[None, None], scores, jnp.asarray(-1e30, scores.dtype))
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     if not deterministic and dropout_ratio > 0.0:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_ratio, probs.shape)
@@ -131,7 +157,8 @@ class DeepSpeedTransformerLayer(nn.Module):
             reshape = lambda t: t.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
             q, k, v = reshape(q), reshape(k), reshape(v)
             rng = self.make_rng("dropout") if (not deterministic and cfg.attn_dropout_ratio > 0) else None
-            ctx = _attention_core(q, k, v, attention_mask, cfg.attn_dropout_ratio, deterministic, rng)
+            ctx = _attention_core(q, k, v, attention_mask, cfg.attn_dropout_ratio,
+                                  deterministic, rng, causal=cfg.causal)
             ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, H)
             return dense(H, "attn_out")(ctx)
 

@@ -86,3 +86,29 @@ def test_bert_trains():
         losses.append(float(jax.device_get(loss)))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], f"bert loss should drop: {losses}"
+
+
+def test_gpt2_is_actually_causal():
+    """Future tokens must not influence earlier positions (regression: a full
+    S x S mask collapsed to a key bias silently broke causality on the fused
+    path)."""
+    cfg = tiny_gpt2()
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    model = GPT2LMHeadModel(cfg)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        jnp.asarray(ids),
+    )
+    logits_a = model.apply(params, jnp.asarray(ids), deterministic=True)
+    ids_b = ids.copy()
+    ids_b[:, -1] = (ids_b[:, -1] + 7) % cfg.vocab_size  # perturb LAST token
+    logits_b = model.apply(params, jnp.asarray(ids_b), deterministic=True)
+    # all positions before the last must be identical
+    np.testing.assert_allclose(
+        np.asarray(logits_a[:, :-1]), np.asarray(logits_b[:, :-1]), atol=1e-5,
+        err_msg="future token leaked into past positions: causality broken",
+    )
+    assert not np.allclose(np.asarray(logits_a[:, -1]), np.asarray(logits_b[:, -1]))
