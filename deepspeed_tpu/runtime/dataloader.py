@@ -112,6 +112,10 @@ class RepeatingLoader:
         try:
             batch = next(self.data_iter)
         except StopIteration:
+            # New epoch: advance the sampler so the shuffle order changes.
+            sampler = getattr(self.loader, "data_sampler", None)
+            if sampler is not None and hasattr(sampler, "set_epoch"):
+                sampler.set_epoch(getattr(sampler, "epoch", 0) + 1)
             self.data_iter = iter(self.loader)
             batch = next(self.data_iter)
         return batch
