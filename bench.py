@@ -1,65 +1,108 @@
-"""Benchmark: BERT-large pretraining throughput (samples/sec/chip) @ seq128.
+"""Benchmark: BERT-large pretraining throughput + MFU @ seq128.
 
-The reference's headline number is 272 samples/sec (64 Tflops) on 1x V100 for
-BERT-large seq128 pretraining under its fused kernels + ZeRO
-(reference docs/_posts/2020-05-28-fastest-bert-training.md:38-39; BASELINE.md).
-This harness trains the same model shape through the deepspeed_tpu engine on
-whatever chip `jax.devices()[0]` is and prints ONE JSON line:
+The reference's headline number is 272 samples/sec (64 Tflops, >50% of V100
+peak) on 1x V100 for BERT-large seq128 pretraining under its fused kernels +
+ZeRO (reference docs/_posts/2020-05-28-fastest-bert-training.md:15-16,38-39;
+BASELINE.md). This harness trains the same model shape through the
+deepspeed_tpu engine and prints ONE JSON line:
 
-    {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N, ...}
+
+Resilience contract (the TPU tunnel in this environment can hang for hours,
+and ``jax.devices()`` HANGS rather than erroring): the parent process never
+imports jax. It probes the TPU backend in a bounded-time subprocess (one
+retry), then runs the measured benchmark itself in a subprocess with a hard
+timeout — falling back to the CPU backend, and finally to a structured JSON
+error line. Something parseable is ALWAYS printed.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+BASELINE_SAMPLES_PER_SEC = 272.0  # V100 reference, BERT-large seq128
+BASELINE_TFLOPS = 64.0
 
-BASELINE_SAMPLES_PER_SEC = 272.0  # V100 reference, seq128
+# Dense bf16 peak per chip, by device_kind substring (lowercased match).
+_PEAK_TFLOPS = [
+    ("v6", 918.0),        # Trillium
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),   # v5e reports "TPU v5 lite"
+    ("v5e", 197.0),
+    ("v5", 459.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
 
 
-def main():
-    batch_size = int(os.environ.get("BENCH_BATCH", "64"))
-    seq_len = int(os.environ.get("BENCH_SEQ", "128"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+def _peak_tflops(device_kind):
+    kind = (device_kind or "").lower()
+    for sub, peak in _PEAK_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+# ---------------------------------------------------------------------------
+# child: the actual measurement (runs under whatever backend the env forces)
+# ---------------------------------------------------------------------------
+
+def child_main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
     import deepspeed_tpu
     from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
 
-    platform = jax.devices()[0].platform
+    dev = jax.devices()[0]
+    platform = dev.platform
+    on_tpu = platform == "tpu"
+
+    micro_batch = int(os.environ.get("BENCH_BATCH", "64" if on_tpu else "2"))
+    seq_len = int(os.environ.get("BENCH_SEQ", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "2"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3" if on_tpu else "1"))
+
     cfg = BertConfig.bert_large()
     model = BertForPreTraining(cfg)
 
+    n_dev = len(jax.devices())
+    # The engine shards the given batch across the data axis as the GLOBAL
+    # batch, so feed micro_batch * n_dev rows and count exactly that many
+    # samples per step (round-1 advisor finding: counting batch*n_dev while
+    # feeding batch rows inflated multi-device throughput by n_dev).
+    global_batch = micro_batch * n_dev
+
     rng = np.random.RandomState(0)
-    input_ids = rng.randint(0, cfg.vocab_size, (batch_size, seq_len)).astype(np.int32)
-    token_type_ids = np.zeros((batch_size, seq_len), np.int32)
-    attention_mask = np.ones((batch_size, seq_len), np.int32)
+    input_ids = rng.randint(0, cfg.vocab_size, (global_batch, seq_len)).astype(np.int32)
+    token_type_ids = np.zeros((global_batch, seq_len), np.int32)
+    attention_mask = np.ones((global_batch, seq_len), np.int32)
     masked_lm_labels = np.where(
-        rng.rand(batch_size, seq_len) < 0.15,
-        rng.randint(0, cfg.vocab_size, (batch_size, seq_len)),
+        rng.rand(global_batch, seq_len) < 0.15,
+        rng.randint(0, cfg.vocab_size, (global_batch, seq_len)),
         -1,
     ).astype(np.int32)
-    next_sentence_label = rng.randint(0, 2, (batch_size,)).astype(np.int32)
+    next_sentence_label = rng.randint(0, 2, (global_batch,)).astype(np.int32)
     batch = (input_ids, token_type_ids, attention_mask, masked_lm_labels, next_sentence_label)
 
     params = model.init(
         {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
         *[jnp.asarray(x) for x in batch],
     )
+    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
 
-    n_dev = len(jax.devices())
     ds_config = {
-        "train_batch_size": batch_size * n_dev,
-        "train_micro_batch_size_per_gpu": batch_size,
+        "train_batch_size": global_batch,
+        "train_micro_batch_size_per_gpu": micro_batch,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         # bf16 is the TPU-native precision story (fp16 loss scaling exists for
         # parity but is unnecessary overhead on the MXU).
-        "bfloat16": {"enabled": True},
+        "bf16": {"enabled": True},
         "zero_optimization": {"stage": 2 if n_dev > 1 else 0},
     }
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -85,15 +128,131 @@ def main():
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
-    samples_per_sec = batch_size * n_dev * steps / dt
+    samples_per_sec = global_batch * steps / dt
     per_chip = samples_per_sec / n_dev
+    step_ms = dt / steps * 1000.0
+
+    # Model FLOPs (analytic, the standard MFU accounting): a training step
+    # costs ~6*N FLOPs/token for the matmuls plus 12*L*H*S FLOPs/token for
+    # attention score/value products (fwd + bwd).
+    tokens = global_batch * seq_len
+    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+    model_flops_per_step = flops_per_token * tokens
+    achieved_tflops = model_flops_per_step / (dt / steps) / n_dev / 1e12
+
+    peak = _peak_tflops(dev.device_kind) if on_tpu else None
+    mfu = round(achieved_tflops / peak, 4) if peak else None
+
     print(json.dumps({
         "metric": f"bert-large pretrain samples/sec/chip @ seq{seq_len} ({platform})",
         "value": round(per_chip, 2),
         "unit": "samples/sec",
         "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC, 3),
+        "tflops_per_chip": round(achieved_tflops, 2),
+        "vs_baseline_tflops": round(achieved_tflops / BASELINE_TFLOPS, 3),
+        "mfu": mfu,
+        "device_kind": dev.device_kind,
+        "n_devices": n_dev,
+        "global_batch": global_batch,
+        "step_ms": round(step_ms, 2),
+        "params": n_params,
     }))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestration (stdlib only — never imports jax)
+# ---------------------------------------------------------------------------
+
+def _probe_tpu(timeout):
+    """Bounded-time TPU backend probe in a throwaway subprocess."""
+    code = (
+        "import jax\n"
+        "d = jax.devices()\n"
+        "assert d and d[0].platform == 'tpu', d\n"
+        "print('TPU_OK', d[0].device_kind)\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+        )
+        if r.returncode == 0 and "TPU_OK" in r.stdout:
+            return True, r.stdout.strip().split("TPU_OK", 1)[1].strip()
+        return False, (r.stderr or r.stdout).strip()[-400:]
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout}s (tunnel hung)"
+    except Exception as e:  # noqa: BLE001
+        return False, repr(e)
+
+
+def _run_child(env_extra, timeout):
+    """Run the measured benchmark in a subprocess; return (json_dict|None, err)."""
+    env = dict(os.environ)
+    env.update(env_extra)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"bench child timed out after {timeout}s"
+    except Exception as e:  # noqa: BLE001
+        return None, repr(e)
+    for line in reversed(r.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, f"rc={r.returncode}: {(r.stderr or r.stdout).strip()[-400:]}"
+
+
+def main():
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+    child_timeout = int(os.environ.get("BENCH_TIMEOUT", "1500"))
+
+    errors = []
+    tpu_ok = False
+    for attempt in range(2):  # one retry: the tunnel is known-flaky
+        tpu_ok, info = _probe_tpu(probe_timeout)
+        if tpu_ok:
+            break
+        errors.append(f"tpu probe {attempt + 1}: {info}")
+        time.sleep(5)
+
+    if tpu_ok:
+        result, err = _run_child({}, child_timeout)
+        if result is not None:
+            print(json.dumps(result))
+            return 0
+        errors.append(f"tpu bench: {err}")
+
+    # CPU fallback: still produces a real measured number (tiny shapes).
+    result, err = _run_child(
+        {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
+        child_timeout,
+    )
+    if result is not None:
+        result["tpu_error"] = "; ".join(errors) if errors else None
+        print(json.dumps(result))
+        return 0
+    errors.append(f"cpu bench: {err}")
+
+    print(json.dumps({
+        "metric": "bert-large pretrain samples/sec/chip @ seq128 (unavailable)",
+        "value": 0.0,
+        "unit": "samples/sec",
+        "vs_baseline": 0.0,
+        "error": "; ".join(errors),
+    }))
+    return 0
 
 
 if __name__ == "__main__":
+    if "--child" in sys.argv:
+        sys.exit(child_main())
     sys.exit(main())

@@ -15,28 +15,38 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 extern "C" {
 
 // Copy `count` source buffers (sizes[i] floats at srcs[i]) into one flat
-// buffer. Parallel over buffers; memcpy per buffer.
+// buffer. Offsets are a serial prefix-sum (ZeRO-offload param lists reach
+// thousands of tensors; recomputing the prefix inside the loop would make
+// this O(count^2)); the copies are parallel over buffers.
 void ds_flatten(const float** srcs, const int64_t* sizes, int64_t count, float* dst) {
-    // prefix offsets
+    std::vector<int64_t> offs((size_t)count);
+    int64_t acc = 0;
+    for (int64_t i = 0; i < count; ++i) {
+        offs[(size_t)i] = acc;
+        acc += sizes[i];
+    }
 #pragma omp parallel for schedule(dynamic)
     for (int64_t i = 0; i < count; ++i) {
-        int64_t off = 0;
-        for (int64_t j = 0; j < i; ++j) off += sizes[j];
-        std::memcpy(dst + off, srcs[i], (size_t)sizes[i] * sizeof(float));
+        std::memcpy(dst + offs[(size_t)i], srcs[i], (size_t)sizes[i] * sizeof(float));
     }
 }
 
 // Inverse: scatter the flat buffer back into `count` destination buffers.
 void ds_unflatten(const float* src, const int64_t* sizes, int64_t count, float** dsts) {
+    std::vector<int64_t> offs((size_t)count);
+    int64_t acc = 0;
+    for (int64_t i = 0; i < count; ++i) {
+        offs[(size_t)i] = acc;
+        acc += sizes[i];
+    }
 #pragma omp parallel for schedule(dynamic)
     for (int64_t i = 0; i < count; ++i) {
-        int64_t off = 0;
-        for (int64_t j = 0; j < i; ++j) off += sizes[j];
-        std::memcpy(dsts[i], src + off, (size_t)sizes[i] * sizeof(float));
+        std::memcpy(dsts[i], src + offs[(size_t)i], (size_t)sizes[i] * sizeof(float));
     }
 }
 

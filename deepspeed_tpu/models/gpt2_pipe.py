@@ -42,10 +42,10 @@ class GPT2BlockPipe(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, h):
+    def __call__(self, h, deterministic=None):
         cfg = self.config
         # cfg.layer_config() sets causal=True: masking happens in-kernel.
-        return DeepSpeedTransformerLayer(cfg.layer_config())(h, None)
+        return DeepSpeedTransformerLayer(cfg.layer_config())(h, None, deterministic=deterministic)
 
     @property
     def param_count(self):

@@ -1,0 +1,3 @@
+from deepspeed_tpu.monitor.tensorboard import SummaryWriter, TensorBoardMonitor
+
+__all__ = ["SummaryWriter", "TensorBoardMonitor"]

@@ -1,0 +1,181 @@
+"""TensorBoard scalar streams (monitoring subsystem).
+
+Capability parity with the reference engine's rank-0 TensorBoard writes
+(``deepspeed/runtime/engine.py:149-150, 866-876, 1010-1025``: train loss, lr,
+loss scale, and timer scalars under ``Train/Samples/...``, keyed by the global
+sample count), honoring the ``tensorboard`` config section
+(``runtime/constants.py``: enabled / output_path / job_name).
+
+TPU-first redesign: the reference instantiates
+``torch.utils.tensorboard.SummaryWriter``. Importing the tensorboard package
+costs seconds and drags in TensorFlow machinery, so this module writes the
+event-file format directly with the stdlib — TFRecord framing (length +
+masked CRC32c) around hand-encoded ``Event`` protobufs. Files are readable by
+any standard TensorBoard. A second difference: writes are BUFFERED — scalars
+may be recorded as device arrays and are only host-synced at ``flush()``, so
+monitoring never forces a per-step device sync into the training loop.
+"""
+
+import os
+import socket
+import struct
+import time
+
+
+# -- CRC32c (Castagnoli, reflected poly 0x82F63B78) -------------------------
+
+def _make_crc_table():
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC_TABLE = _make_crc_table()
+
+
+def _crc32c(data):
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data):
+    crc = _crc32c(data)
+    return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
+
+
+# -- minimal protobuf encoding ----------------------------------------------
+
+def _varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _key(field, wire):
+    return _varint((field << 3) | wire)
+
+
+def _pb_double(field, value):
+    return _key(field, 1) + struct.pack("<d", value)
+
+
+def _pb_float(field, value):
+    return _key(field, 5) + struct.pack("<f", value)
+
+
+def _pb_int64(field, value):
+    return _key(field, 0) + _varint(value & 0xFFFFFFFFFFFFFFFF)
+
+
+def _pb_bytes(field, data):
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    return _key(field, 2) + _varint(len(data)) + data
+
+
+def _event_file_version(wall_time):
+    # Event { wall_time=1 (double), file_version=3 (string) }
+    return _pb_double(1, wall_time) + _pb_bytes(3, "brain.Event:2")
+
+
+def _event_scalar(wall_time, step, tag, value):
+    # Summary.Value { tag=1, simple_value=2 (float) }
+    val = _pb_bytes(1, tag) + _pb_float(2, float(value))
+    # Summary { repeated value=1 }
+    summary = _pb_bytes(1, val)
+    # Event { wall_time=1, step=2, summary=5 }
+    return _pb_double(1, wall_time) + _pb_int64(2, int(step)) + _pb_bytes(5, summary)
+
+
+def _tfrecord(payload):
+    header = struct.pack("<Q", len(payload))
+    return (
+        header
+        + struct.pack("<I", _masked_crc(header))
+        + payload
+        + struct.pack("<I", _masked_crc(payload))
+    )
+
+
+class SummaryWriter:
+    """Append-only scalar event-file writer (torch SummaryWriter API subset)."""
+
+    _seq = 0
+
+    def __init__(self, log_dir):
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        # pid + per-process counter: two writers in the same second must not
+        # truncate each other's file (torch SummaryWriter embeds pid likewise).
+        SummaryWriter._seq += 1
+        fname = (
+            f"events.out.tfevents.{int(time.time())}.{socket.gethostname()}"
+            f".{os.getpid()}.{SummaryWriter._seq}"
+        )
+        self._path = os.path.join(log_dir, fname)
+        self._f = open(self._path, "wb")
+        self._f.write(_tfrecord(_event_file_version(time.time())))
+        self._f.flush()
+
+    def add_scalar(self, tag, scalar_value, global_step=0, walltime=None):
+        wall = time.time() if walltime is None else walltime
+        self._f.write(_tfrecord(_event_scalar(wall, global_step, tag, float(scalar_value))))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class TensorBoardMonitor:
+    """Buffered scalar recorder used by the engines.
+
+    ``record()`` accepts Python floats OR jax scalar arrays and defers the
+    host transfer; ``flush()`` converts and writes. The engines flush every
+    ``steps_per_print`` steps, so monitoring adds zero per-step syncs while the
+    event stream still carries every step's value.
+    """
+
+    def __init__(self, output_path, job_name, rank=0):
+        base = output_path or os.path.join("runs", "deepspeed_tpu")
+        self.enabled = rank == 0
+        self.writer = SummaryWriter(os.path.join(base, job_name)) if self.enabled else None
+        self._pending = []
+
+    def record(self, tag, value, step):
+        if self.enabled:
+            self._pending.append((tag, value, int(step), time.time()))
+
+    def flush(self):
+        if not self.enabled or not self._pending:
+            return
+        for tag, value, step, wall in self._pending:
+            self.writer.add_scalar(tag, float(value), step, walltime=wall)
+        self._pending.clear()
+        self.writer.flush()
+
+    def close(self):
+        if self.enabled:
+            self.flush()
+            self.writer.close()

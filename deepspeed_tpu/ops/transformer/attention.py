@@ -333,6 +333,25 @@ def _attention_reference(q, k, v, bias, layout_mask, *, causal):
     return out.astype(q.dtype)
 
 
+def attention_reference(q, k, v, mask=None, causal=False):
+    """Dense attention accepting an arbitrary ADDITIVE mask broadcastable to
+    [B,H,S,S] (the reference transformer's mask shape) — the documented
+    fallback for masks ``flash_attention`` cannot express in-kernel."""
+    B, H, S, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    s = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if mask is not None:
+        m = jnp.asarray(mask, jnp.float32)
+        if m.ndim == 2:
+            m = m[:, None, None, :]
+        s = s + m
+    if causal:
+        cm = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(cm[None, None], s, -1e30)
+    probs = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhst,bhtd->bhsd", probs, v.astype(jnp.float32)).astype(q.dtype)
+
+
 def _expand_layout_mask(layout, S, block):
     if layout is None:
         return None
@@ -439,6 +458,16 @@ def flash_attention(q, k, v, mask=None, layout=None, block=DEFAULT_BLOCK,
     if mask is None:
         bias = jnp.zeros((B, S), q.dtype)
     elif mask.ndim == 4:
+        # Only a broadcastable key bias [B,1,1,S] collapses losslessly; a full
+        # [B,1,S,S]/[B,H,S,S] additive mask (the reference's shape) must NOT be
+        # silently sliced to its first query row.
+        if mask.shape[-2] != 1 or mask.shape[1] != 1:
+            raise ValueError(
+                f"flash_attention only supports key-bias masks [B,1,1,S] or [B,S]; "
+                f"got {mask.shape}. For causal masking pass causal=True; for an "
+                f"arbitrary S x S additive mask use the dense reference path "
+                f"(ops.transformer.attention.attention_reference)."
+            )
         bias = mask[:, 0, 0, :]
     else:
         bias = mask

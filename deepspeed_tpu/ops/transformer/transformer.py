@@ -107,7 +107,7 @@ def _attention_core(q, k, v, mask, dropout_ratio, deterministic, dropout_rng,
         # in-kernel causal flag. A full [.,.,S,S] mask must either be
         # recognized as causal (concrete arrays only) or fall through to the
         # general jnp path — collapsing it to a key bias would be wrong.
-        if mask is None or (mask.ndim == 4 and mask.shape[-2] == 1):
+        if mask is None or (mask.ndim == 4 and mask.shape[-2] == 1 and mask.shape[1] == 1):
             return flash_attention(q, k, v, mask, causal=causal)
         if not causal and mask.ndim == 4 and mask.shape[-2] == mask.shape[-1]:
             if _is_causal_mask(mask):

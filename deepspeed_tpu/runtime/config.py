@@ -43,8 +43,10 @@ def get_fp16_enabled(param_dict):
 
 
 def get_bfloat16_enabled(param_dict):
-    if BFLOAT16 in param_dict:
-        return get_scalar_param(param_dict[BFLOAT16], BFLOAT16_ENABLED, BFLOAT16_ENABLED_DEFAULT)
+    # "bf16" is the canonical section name; "bfloat16" is accepted as an alias.
+    for key in (BFLOAT16, BFLOAT16_ALIAS):
+        if key in param_dict:
+            return get_scalar_param(param_dict[key], BFLOAT16_ENABLED, BFLOAT16_ENABLED_DEFAULT)
     return False
 
 
@@ -298,6 +300,19 @@ def get_tensorboard_job_name(param_dict):
     return TENSORBOARD_JOB_NAME_DEFAULT
 
 
+def get_checkpoint_tag_validation_mode(param_dict):
+    """checkpoint: {tag_validation: Ignore|Warn|Fail} (reference
+    runtime/config.py:483-495)."""
+    checkpoint_params = param_dict.get(CHECKPOINT, {})
+    mode = get_scalar_param(
+        checkpoint_params, CHECKPOINT_TAG_VALIDATION, CHECKPOINT_TAG_VALIDATION_DEFAULT
+    )
+    mode = str(mode).upper()
+    if mode not in CHECKPOINT_TAG_VALIDATION_MODES:
+        raise ValueError(f"Checkpoint config contains invalid tag_validation value: {mode}")
+    return mode
+
+
 def get_progressive_layer_drop(param_dict):
     pld_dict = param_dict.get(PROGRESSIVE_LAYER_DROP, {})
     enabled = get_scalar_param(pld_dict, PLD_ENABLED, PLD_ENABLED_DEFAULT)
@@ -441,6 +456,10 @@ class DeepSpeedConfig:
 
         self.sparse_attention = get_sparse_attention(param_dict)
         self.pipeline = get_pipeline_config(param_dict)
+
+        mode = get_checkpoint_tag_validation_mode(param_dict)
+        self.checkpoint_tag_validation_enabled = mode != CHECKPOINT_TAG_VALIDATION_IGNORE
+        self.checkpoint_tag_validation_fail = mode == CHECKPOINT_TAG_VALIDATION_FAIL
 
         (
             self.pld_enabled,

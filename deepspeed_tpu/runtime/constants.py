@@ -78,6 +78,7 @@ FP16_MIN_LOSS_SCALE = "min_loss_scale"
 FP16_MIN_LOSS_SCALE_DEFAULT = 1
 
 BFLOAT16 = "bf16"
+BFLOAT16_ALIAS = "bfloat16"
 BFLOAT16_ENABLED = "enabled"
 BFLOAT16_ENABLED_DEFAULT = False
 
@@ -136,6 +137,22 @@ TENSORBOARD_OUTPUT_PATH = "output_path"
 TENSORBOARD_OUTPUT_PATH_DEFAULT = ""
 TENSORBOARD_JOB_NAME = "job_name"
 TENSORBOARD_JOB_NAME_DEFAULT = "DeepSpeedTPUJobName"
+
+#############################################
+# Checkpoint (reference runtime/constants.py:319-326: validation of the tag's
+# cross-rank consistency when saving)
+#############################################
+CHECKPOINT = "checkpoint"
+CHECKPOINT_TAG_VALIDATION = "tag_validation"
+CHECKPOINT_TAG_VALIDATION_IGNORE = "IGNORE"
+CHECKPOINT_TAG_VALIDATION_WARN = "WARN"
+CHECKPOINT_TAG_VALIDATION_FAIL = "FAIL"
+CHECKPOINT_TAG_VALIDATION_DEFAULT = CHECKPOINT_TAG_VALIDATION_WARN
+CHECKPOINT_TAG_VALIDATION_MODES = [
+    CHECKPOINT_TAG_VALIDATION_IGNORE,
+    CHECKPOINT_TAG_VALIDATION_WARN,
+    CHECKPOINT_TAG_VALIDATION_FAIL,
+]
 
 #############################################
 # Sparse attention

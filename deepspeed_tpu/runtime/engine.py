@@ -68,6 +68,43 @@ def split_half_float_double_csr(tensors):
     return [("all", tensors)]
 
 
+def _path_str(path):
+    """Stable string form of a jax key path."""
+    parts = []
+    for p in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(p, attr):
+                parts.append(str(getattr(p, attr)))
+                break
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _find_sparse_grad_paths(params):
+    """Embedding-like leaves: 2-D tables whose path mentions 'embed' (the
+    reference keys off nn.Embedding module type, engine.py:179-185; flax param
+    trees carry the module name in the path instead)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    paths, names = set(), []
+    for path, leaf in flat:
+        joined = _path_str(path)
+        if getattr(leaf, "ndim", 0) == 2 and "embed" in joined.lower():
+            paths.add(joined)
+            names.append(joined)
+    return paths, names
+
+
+def _grads_to_csr(grads, sparse_paths):
+    """Replace the registered leaves with CSRTensors (touched rows only)."""
+    from deepspeed_tpu.runtime.csr_tensor import CSRTensor
+
+    def conv(path, g):
+        return CSRTensor.from_dense(g) if _path_str(path) in sparse_paths else g
+
+    return jax.tree_util.tree_map_with_path(conv, grads)
+
+
 class DeepSpeedEngine:
     """Wraps a user model for distributed mixed-precision training on TPU."""
 
@@ -158,6 +195,21 @@ class DeepSpeedEngine:
             from deepspeed_tpu.profiling.flops_profiler.profiler import FlopsProfiler
 
             self.flops_profiler = FlopsProfiler()
+
+        # monitoring: rank-0 TensorBoard scalar streams (reference
+        # engine.py:149-150,1010-1025); writes are buffered so the training
+        # loop never host-syncs for monitoring.
+        self.monitor = None
+        self._last_loss = None
+        self._loss_sum = None
+        if self._config.tensorboard_enabled:
+            from deepspeed_tpu.monitor import TensorBoardMonitor
+
+            self.monitor = TensorBoardMonitor(
+                self._config.tensorboard_output_path,
+                self._config.tensorboard_job_name,
+                rank=self.global_rank,
+            )
 
         if self.global_rank == 0:
             self._config.print("DeepSpeedEngine configuration")
@@ -329,6 +381,28 @@ class DeepSpeedEngine:
         else:
             self.compute_dtype = jnp.float32
 
+        # sparse (embedding) gradients: identify embedding-like leaves once
+        # (reference registers nn.Embedding modules, engine.py:179-185). Under
+        # XLA the in-jit grad reduction is dense either way; the CSR format
+        # pays on the ZeRO-Offload D2H grad transfer (_take_model_step_host).
+        self.csr_tensor_module_names = []
+        self._sparse_grad_paths = set()
+        if self.sparse_gradients_enabled():
+            self._sparse_grad_paths, self.csr_tensor_module_names = _find_sparse_grad_paths(self.params)
+            if not self._sparse_grad_paths:
+                logger.warning(
+                    "sparse_gradients is enabled but no embedding-like parameters "
+                    "were found; the setting has no effect."
+                )
+            elif not self.zero_cpu_offload():
+                log_dist(
+                    "sparse_gradients: gradient reduction runs inside the XLA "
+                    "program (dense over ICI); CSR compression applies to the "
+                    f"host-offload transfer of {len(self.csr_tensor_module_names)} "
+                    "embedding gradients when zero cpu_offload is enabled.",
+                    ranks=[0],
+                )
+
     def _configure_optimizer(self, client_optimizer, model_parameters):
         if client_optimizer is not None:
             basic_optimizer = client_optimizer
@@ -394,6 +468,10 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.zero.sharded_optimizer import ZeroShardedOptimizer
 
         stage = self.zero_optimization_stage()
+        # fp32 compute: params are the fp32 master already — a stored sharded
+        # master would double-store them (the stage-1/2 memory win must hold
+        # for fp32 configs too).
+        keep_master = self.compute_dtype != jnp.float32
         if self.mp_world_size > 1:
             # Flat-vector ZeRO would destroy TP shardings; the pytree variant
             # composes (data-axis state sharding on top of model-axis specs).
@@ -403,6 +481,7 @@ class DeepSpeedEngine:
             return ZeroPytreeOptimizer(
                 basic_optimizer, stage=stage, mesh=self.mesh,
                 clip_grad=self.gradient_clipping(),
+                keep_master=keep_master,
             )
         log_dist(f"Creating ZeRO stage {stage} optimizer", ranks=[0])
         return ZeroShardedOptimizer(
@@ -415,6 +494,7 @@ class DeepSpeedEngine:
             allgather_bucket_size=self.zero_allgather_bucket_size(),
             elastic_checkpoint=self.zero_elastic_checkpoint(),
             clip_grad=self.gradient_clipping(),
+            keep_master=keep_master,
         )
 
     def _configure_lr_scheduler(self, client_lr_scheduler):
@@ -499,6 +579,155 @@ class DeepSpeedEngine:
             self._jit_cache[key] = jax.jit(fwd_bwd)
         return self._jit_cache[key]
 
+    def _onebit_path(self):
+        """True when the engine step must run the 1-bit compressed collective:
+        OnebitAdam configured, real data parallelism, no ZeRO/TP wrapping
+        (reference: OnebitAdam disables the engine allreduce and runs its own
+        compressed comm, onebit_adam.py:230-372)."""
+        return (
+            (self.optimizer_name() or "").lower() == ONEBIT_ADAM_OPTIMIZER
+            and not self.zero_optimization()
+            and self.dp_world_size > 1
+            and self.mp_world_size == 1
+            and self.client_optimizer is None
+        )
+
+    def _get_fwd_bwd_onebit(self, needs_rng, batch_ndims):
+        """Per-worker fwd+bwd inside shard_map: grads come back with a leading
+        worker axis (sharded along ``data``) and are NOT averaged — the dense
+        allreduce XLA would insert is exactly what 1-bit Adam replaces with
+        its compressed collective at step time."""
+        key = ("fwd_bwd_onebit", needs_rng, batch_ndims)
+        if key not in self._jit_cache:
+            from jax.experimental.shard_map import shard_map
+
+            compute_dtype = self.compute_dtype
+            apply_fn = self.apply_fn
+            pld = self.progressive_layer_drop is not None
+            mesh = self.mesh
+            P = PartitionSpec
+
+            def local_fwd_bwd(params, scale, rng, theta, *batch):
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
+
+                def loss_fn(p):
+                    p_c = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p)
+                    kwargs = {}
+                    if needs_rng:
+                        kwargs["rngs"] = {"dropout": rng}
+                    if pld:
+                        kwargs["progressive_layer_drop"] = True
+                        kwargs["pld_theta"] = theta
+                    out = apply_fn(p_c, *batch, **kwargs)
+                    loss = out[0] if isinstance(out, tuple) else out
+                    return loss.astype(jnp.float32) * scale
+
+                scaled_loss, grads = jax.value_and_grad(loss_fn)(params)
+                loss = jax.lax.pmean(scaled_loss / scale, DATA_AXIS)
+                grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+                return loss, grads
+
+            batch_specs = tuple(P(DATA_AXIS) for _ in range(batch_ndims))
+            fn = shard_map(
+                local_fwd_bwd, mesh=mesh,
+                in_specs=(P(), P(), P(), P()) + batch_specs,
+                out_specs=(P(), P(DATA_AXIS)),
+                check_rep=False,
+            )
+            self._jit_cache[key] = jax.jit(fn)
+        return self._jit_cache[key]
+
+    def _get_onebit_step_fn(self):
+        """Jitted shard_map step: each worker compresses its LOCAL accumulated
+        grads; the only cross-worker traffic is the two-phase sign exchange
+        (~1/32 of a dense fp32 allreduce) plus scalars."""
+        if "onebit_step" in self._jit_cache:
+            return self._jit_cache["onebit_step"]
+
+        from jax.experimental.shard_map import shard_map
+
+        from deepspeed_tpu.ops.utils_op import flatten_dense_tensors, tree_spec, unflatten_dense_tensors
+        from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdamState
+
+        opt = self.basic_optimizer
+        fp16 = self.fp16_enabled()
+        dynamic = self.dynamic_loss_scale()
+        scaler_kwargs = self._scaler_kwargs or {}
+        clip = self.gradient_clipping()
+        mesh = self.mesh
+        W = self.dp_world_size
+        treedef, shapes, dtypes, sizes = tree_spec(self.params)
+        numel = sum(sizes)
+        n_pad = opt.padded_numel(numel, W)
+        P = PartitionSpec
+
+        def inner(params, step, exp_avg, exp_avg_sq, worker_error, server_error,
+                  acc_grads, scale, lr):
+            local_g = jax.tree_util.tree_map(lambda g: jnp.squeeze(g, 0), acc_grads)
+            flat_g = flatten_dense_tensors(local_g, jnp.float32)
+            if n_pad != numel:
+                flat_g = jnp.concatenate([flat_g, jnp.zeros((n_pad - numel,), jnp.float32)])
+            overflow = (
+                jax.lax.pmax(jnp.logical_not(jnp.all(jnp.isfinite(flat_g))).astype(jnp.float32), DATA_AXIS) > 0
+                if fp16 else jnp.asarray(False)
+            )
+            flat_g = flat_g / scale
+            # Gradient clipping with a SCALAR collective only (a dense-norm
+            # allreduce would defeat the compressed comm): clip every worker's
+            # local grads by the mean-over-workers norm so the coefficient is
+            # identical everywhere and the update stays consistent.
+            gnorm = jnp.sqrt(jax.lax.pmean(jnp.sum(jnp.square(flat_g)), DATA_AXIS))
+            if clip > 0:
+                coeff = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                flat_g = flat_g * coeff
+            flat_p = flatten_dense_tensors(params, jnp.float32)
+            if n_pad != numel:
+                flat_p = jnp.concatenate([flat_p, jnp.zeros((n_pad - numel,), jnp.float32)])
+            state = OnebitAdamState(
+                step=step, exp_avg=exp_avg, exp_avg_sq=exp_avg_sq,
+                worker_error=jnp.squeeze(worker_error, 0),
+                server_error=jnp.squeeze(server_error, 0),
+            )
+
+            def do(_):
+                return opt.update_flat(flat_g, state, flat_p, DATA_AXIS, lr=lr)
+
+            def skip(_):
+                return flat_p, state
+
+            new_flat, new_state = jax.lax.cond(overflow, skip, do, None)
+            new_params = unflatten_dense_tensors(new_flat[:numel], treedef, shapes, dtypes)
+            return (
+                new_params, new_state.step, new_state.exp_avg, new_state.exp_avg_sq,
+                new_state.worker_error[None], new_state.server_error[None], overflow, gnorm,
+            )
+
+        sharded_step = shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+            out_specs=(P(), P(), P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(), P()),
+            check_rep=False,
+        )
+
+        def step_fn(params, opt_state, acc_grads, scaler_state, lr):
+            scale = scaler_state.cur_scale
+            new_params, step, m, v, we, se, overflow, gnorm = sharded_step(
+                params, opt_state.step, opt_state.exp_avg, opt_state.exp_avg_sq,
+                opt_state.worker_error, opt_state.server_error, acc_grads, scale, lr,
+            )
+            new_state = OnebitAdamState(
+                step=step, exp_avg=m, exp_avg_sq=v, worker_error=we, server_error=se
+            )
+            if dynamic:
+                new_scaler = update_scaler(scaler_state, overflow, **scaler_kwargs)
+            else:
+                new_scaler = scaler_state._replace(cur_iter=scaler_state.cur_iter + 1)
+            zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc_grads)
+            return new_params, new_state, new_scaler, overflow, gnorm, zeroed
+
+        self._jit_cache["onebit_step"] = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        return self._jit_cache["onebit_step"]
+
     def _get_fwd_only(self, needs_rng):
         """Inference path: dropout disabled (deterministic=True when the module
         accepts it; no dropout rng otherwise)."""
@@ -580,6 +809,9 @@ class DeepSpeedEngine:
 
     def _ensure_opt_state(self):
         if self.opt_state is None:
+            if self._onebit_path():
+                self.opt_state = self.basic_optimizer.init_engine_state(self.params, self.mesh)
+                return
             self.opt_state = self.optimizer.init(self.params)
             if self.zero_optimization() and self.compute_dtype != jnp.float32:
                 # The fp32 master now lives (sharded) inside the ZeRO state;
@@ -620,13 +852,18 @@ class DeepSpeedEngine:
             self.flops_profiler.start_profile()
 
         if self.training:
-            fwd_bwd = self._get_fwd_bwd(needs_rng)
             theta = jnp.asarray(
                 self.progressive_layer_drop.get_theta() if self.progressive_layer_drop else 1.0,
                 jnp.float32,
             )
-            loss, out, grads = fwd_bwd(self.params, self.scaler_state.cur_scale, self._next_rng(), theta, *batch)
+            if self._onebit_path():
+                fwd_bwd = self._get_fwd_bwd_onebit(needs_rng, len(batch))
+                loss, grads = fwd_bwd(self.params, self.scaler_state.cur_scale, self._next_rng(), theta, *batch)
+            else:
+                fwd_bwd = self._get_fwd_bwd(needs_rng)
+                loss, out, grads = fwd_bwd(self.params, self.scaler_state.cur_scale, self._next_rng(), theta, *batch)
             self._cached_grads = grads
+            self._last_loss = loss
             result = loss
         else:
             fwd = self._get_fwd_only(needs_rng)
@@ -687,6 +924,13 @@ class DeepSpeedEngine:
         factor = 1.0 / gas if self.postscale_gradients() else 1.0 / (gas * self.gradient_predivide_factor())
         self._acc_grads = self._get_accumulate()(self._acc_grads, self._cached_grads, factor)
         self._cached_grads = None
+        # Monitoring sees the MEAN microbatch loss of the boundary step, not
+        # the last microbatch's (device-side add; no host sync).
+        if self.monitor is not None and self._last_loss is not None:
+            self._loss_sum = (
+                self._last_loss if self.micro_steps % gas == 0
+                else self._loss_sum + self._last_loss
+            )
         self.micro_steps += 1
 
         if self.wall_clock_breakdown():
@@ -713,11 +957,14 @@ class DeepSpeedEngine:
         if self.is_gradient_accumulation_boundary() and self.micro_steps > 0 and self._acc_grads is not None:
             self._take_model_step()
             report_progress = self.global_steps % self.steps_per_print() == 0
+            self._monitor_step()
 
         self.tput_timer.stop(report_progress)
 
         if report_progress:
             self._report_progress(self.global_steps)
+            if self.monitor is not None:
+                self.monitor.flush()
 
         if self.wall_clock_breakdown():
             self.timers("step").stop(sync=False)
@@ -733,7 +980,7 @@ class DeepSpeedEngine:
         if self.zero_optimization() and self.zero_cpu_offload():
             self._take_model_step_host(lr)
             return
-        step_fn = self._get_step_fn()
+        step_fn = self._get_onebit_step_fn() if self._onebit_path() else self._get_step_fn()
         self.params, self.opt_state, self.scaler_state, overflow, gnorm, self._acc_grads = step_fn(
             self.params, self.opt_state, self._acc_grads, self.scaler_state, jnp.asarray(lr if lr is not None else self._optimizer_base_lr(), jnp.float32)
         )
@@ -770,6 +1017,10 @@ class DeepSpeedEngine:
                 grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
             if self.gradient_clipping() > 0:
                 grads, _ = clip_grad_norm_(grads, self.gradient_clipping())
+            if self._sparse_grad_paths:
+                # CSR-compress embedding grads so only touched rows cross D2H
+                # (reference sparse allgather, engine.py:1186-1242).
+                grads = _grads_to_csr(grads, self._sparse_grad_paths)
             self.params, self.opt_state = self.optimizer.update_host(
                 grads, self.opt_state, self.params,
                 lr=lr if lr is not None else self._optimizer_base_lr(),
@@ -786,6 +1037,37 @@ class DeepSpeedEngine:
         self._acc_grads = jax.tree_util.tree_map(jnp.zeros_like, self._acc_grads)
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
+
+    def _monitor_step(self):
+        """Record the per-step scalar streams (reference engine.py:1010-1025:
+        Train/Samples/{train_loss,lr,loss_scale} keyed by global_samples, plus
+        timer scalars under wall_clock_breakdown). Values may be device arrays;
+        the monitor host-syncs only at flush."""
+        if self.monitor is None:
+            return
+        samples = self.global_samples
+        if self._loss_sum is not None:
+            self.monitor.record(
+                "Train/Samples/train_loss",
+                self._loss_sum / self.gradient_accumulation_steps(), samples,
+            )
+        self.monitor.record("Train/Samples/lr", self.get_lr()[0], samples)
+        if self.fp16_enabled():
+            self.monitor.record("Train/Samples/loss_scale", self.scaler_state.cur_scale, samples)
+        if self.wall_clock_breakdown():
+            # Timer.elapsed_ ACCUMULATES until timers.log() resets it every
+            # steps_per_print; record per-step deltas (skip timers still
+            # running — step_microstep hasn't stopped yet at this point).
+            if not hasattr(self, "_timer_prev"):
+                self._timer_prev = {}
+            for name in ("forward_microstep", "backward_microstep"):
+                t = self.timers.timers.get(name)
+                if t is None or t.started_:
+                    continue
+                prev = self._timer_prev.get(name, 0.0)
+                delta = t.elapsed_ - prev if t.elapsed_ >= prev else t.elapsed_
+                self._timer_prev[name] = t.elapsed_
+                self.monitor.record(f"Train/Samples/{name}", delta * 1000.0, samples)
 
     def _optimizer_base_lr(self):
         return getattr(self.basic_optimizer, "lr", 1e-3)
@@ -856,10 +1138,37 @@ class DeepSpeedEngine:
         self._ensure_opt_state()
         return jax.device_get(self.opt_state)
 
+    def _checkpoint_tag_validation(self, tag):
+        """Verify the tag is identical on every process (reference
+        engine.py:1444-1459: allreduced sha1 of the tag; rank-unique tags break
+        restores at a different world size). Host-level allgather of the digest
+        over the jax.distributed control plane."""
+        if not self._config.checkpoint_tag_validation_enabled or dist.get_world_size() == 1:
+            return
+        import hashlib
+
+        from jax.experimental import multihost_utils
+
+        digest = np.frombuffer(hashlib.sha1(str(tag).encode()).digest(), np.uint8)
+        gathered = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray(digest, jnp.int32))
+        ).reshape(-1, digest.size)
+        valid = bool((gathered == gathered[0]).all())
+        msg = (
+            f"[rank={self.global_rank}] The checkpoint tag '{tag}' is not consistent across "
+            "all ranks. Including rank-unique information in the tag can break restores "
+            "at a different world size."
+        )
+        if self._config.checkpoint_tag_validation_fail:
+            assert valid, msg
+        elif not valid:
+            logger.warning(msg)
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
         if tag is None:
             tag = f"global_step{self.global_steps}"
         client_state = client_state or {}
+        self._checkpoint_tag_validation(tag)
 
         os.makedirs(os.path.join(save_dir, str(tag)), exist_ok=True)
         if self.global_rank == 0:
@@ -868,6 +1177,7 @@ class DeepSpeedEngine:
                 optimizer=None if self.zero_optimization() else self.optimizer_state_dict(),
                 lr_scheduler=self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
                 scaler=jax.device_get(self.scaler_state),
+                csr_tensor_module_names=self.csr_tensor_module_names,
                 skipped_steps=self.skipped_steps,
                 global_steps=self.global_steps,
                 global_samples=self.global_samples,
@@ -885,6 +1195,8 @@ class DeepSpeedEngine:
         if save_latest and self.global_rank == 0:
             with open(os.path.join(save_dir, "latest"), "w") as fd:
                 fd.write(str(tag))
+        if self.monitor is not None:
+            self.monitor.flush()
         return True
 
     def _save_zero_checkpoint(self, save_path, tag):
@@ -941,8 +1253,8 @@ class DeepSpeedEngine:
         self.loaded_checkpoint_dp_world_size = checkpoint.get("dp_world_size", None)
 
         deepspeed_states = [
-            "module", "optimizer", "lr_scheduler", "scaler", "skipped_steps",
-            "global_steps", "global_samples", "dp_world_size", "mp_world_size",
+            "module", "optimizer", "lr_scheduler", "scaler", "csr_tensor_module_names",
+            "skipped_steps", "global_steps", "global_samples", "dp_world_size", "mp_world_size",
         ]
         client_state = {k: v for k, v in checkpoint.items() if k not in deepspeed_states}
         log_dist(f"Loaded checkpoint {ckpt_name} at global step {self.global_steps}", ranks=[0])

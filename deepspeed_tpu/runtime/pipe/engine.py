@@ -158,6 +158,17 @@ class PipelineEngine:
         self.pipe_buffers = {}
         self.agg_train_loss = None
 
+        # monitoring: rank-0 TensorBoard scalars (reference engine.py:1010-1025)
+        self.monitor = None
+        if self._config.tensorboard_enabled:
+            from deepspeed_tpu.monitor import TensorBoardMonitor
+
+            self.monitor = TensorBoardMonitor(
+                self._config.tensorboard_output_path,
+                self._config.tensorboard_job_name,
+                rank=dist.get_rank(),
+            )
+
         log_dist(
             f"PipelineEngine: stages={self.num_stages} dp={self.dp_world_size} "
             f"micro_batches={self.micro_batches}\n{model.describe_partitions()}",
@@ -262,6 +273,7 @@ class PipelineEngine:
                 ZeroPytreeOptimizer(
                     self.basic_optimizer, stage=self._config.zero_optimization_stage,
                     mesh=self.stage_meshes[s], clip_grad=0.0,
+                    keep_master=(self.compute_dtype != jnp.float32),
                 )
                 for s in range(self.num_stages)
             ]
@@ -276,10 +288,10 @@ class PipelineEngine:
     # ------------------------------------------------------------------
     # jitted per-stage programs
     # ------------------------------------------------------------------
-    def _stage_fwd_fn(self, s):
-        key = ("fwd", s)
+    def _stage_fwd_fn(self, s, deterministic=False):
+        key = ("fwd", s, deterministic)
         if key not in self._jit:
-            stage_fn = self.module.stage_forward(s)
+            stage_fn = self.module.stage_forward(s, deterministic=deterministic or None)
             dtype = self.compute_dtype
 
             def fwd(stage_params, x, rng):
@@ -289,11 +301,11 @@ class PipelineEngine:
             self._jit[key] = jax.jit(fwd)
         return self._jit[key]
 
-    def _stage_loss_fn(self, s):
+    def _stage_loss_fn(self, s, deterministic=False):
         """Last-stage forward incl. loss (loss reporting path)."""
-        key = ("loss", s)
+        key = ("loss", s, deterministic)
         if key not in self._jit:
-            stage_fn = self.module.stage_forward(s)
+            stage_fn = self.module.stage_forward(s, deterministic=deterministic or None)
             loss_fn = self.module.loss_fn
             dtype = self.compute_dtype
 
@@ -427,15 +439,25 @@ class PipelineEngine:
         self.agg_train_loss = float(np.mean([float(jax.device_get(l)) for l in self._losses]))
         self.global_steps += 1
         self.global_samples += self.micro_batch_size * self.micro_batches * self.dp_world_size
+        if self.monitor is not None:
+            self.monitor.record("Train/Samples/train_loss", self.agg_train_loss, self.global_samples)
+            self.monitor.record("Train/Samples/lr", self.get_lr()[0], self.global_samples)
+            if self._fp16:
+                self.monitor.record("Train/Samples/loss_scale", self.scaler_state.cur_scale, self.global_samples)
         self.tput_timer.stop(self.global_steps % self._config.steps_per_print == 0)
         if self.global_steps % self._config.steps_per_print == 0:
             log_dist(
                 f"step={self.global_steps}, loss={self.agg_train_loss:.4f}, lr={self.get_lr()}",
                 ranks=[0],
             )
+            if self.monitor is not None:
+                self.monitor.flush()
         return self.agg_train_loss
 
     def eval_batch(self, data_iter):
+        """Evaluate micro_batches batches in EVAL mode: every stage program is
+        built with deterministic=True so dropout is off (the reference's
+        eval_batch switches the module to eval mode, pipe/engine.py:438)."""
         micro = [self._split_batch(next(data_iter)) for _ in range(self.micro_batches)]
         self._ensure_params(micro[0][0])
         losses = []
@@ -444,12 +466,12 @@ class PipelineEngine:
             act = self._to_stage(x, 0)
             for s in range(self.num_stages):
                 if s == self.num_stages - 1:
-                    loss = self._stage_loss_fn(s)(
+                    loss = self._stage_loss_fn(s, deterministic=True)(
                         self._stage_params[s], act, self._to_stage(label, s), rng
                     )
                     losses.append(loss)
                 else:
-                    out = self._stage_fwd_fn(s)(self._stage_params[s], act, rng)
+                    out = self._stage_fwd_fn(s, deterministic=True)(self._stage_params[s], act, rng)
                     act = self._to_stage(out, s + 1)
         return float(np.mean([float(jax.device_get(l)) for l in losses]))
 
@@ -755,53 +777,117 @@ class PipelineEngine:
             and len(val) == n_local
         )
 
+    @staticmethod
+    def _is_zero_state(state):
+        from deepspeed_tpu.runtime.zero.pytree_optimizer import ZeroPytreeState
+
+        return isinstance(state, ZeroPytreeState)
+
     def _split_opt_state_per_layer(self):
         """Split each stage's optimizer state into per-layer pieces. Works for
         any NamedTuple state whose per-param fields mirror the stage's
-        per-layer params list (FusedAdam/FusedLamb/SGD all do). ZeRO-in-pipe
-        states are nested; they are persisted stage-keyed instead (see
-        save_checkpoint)."""
-        if self._config.zero_enabled:
-            return None, None
+        per-layer params list (FusedAdam/FusedLamb/SGD all do), and for
+        ZeRO-in-pipe (``ZeroPytreeState``): the fp32 master and each inner
+        per-param field are per-layer lists, so they regroup per layer the same
+        way — making the saved state elastic across stage counts. Shardings are
+        NOT persisted; they are re-derived from the target meshes on load."""
         n_layers = self.module._num_layers
         opt_layers = [dict() for _ in range(n_layers)]
         opt_global = {}
-        for s in range(self.num_stages):
-            state = self._stage_opt_state[s]
+
+        def split_fields(state, lo, n_local, prefix=""):
             if not hasattr(state, "_asdict"):
-                return None, None  # unknown state shape: skip optimizer persistence
-            lo, hi = self.module.stage_layer_range(s)
-            n_local = hi - lo
+                return False
             for name, val in state._asdict().items():
                 if self._is_layer_list(val, n_local):
                     for off in range(n_local):
-                        opt_layers[lo + off][name] = jax.device_get(val[off])
-                elif s == 0:
-                    opt_global[name] = jax.device_get(val)
+                        opt_layers[lo + off][prefix + name] = jax.device_get(val[off])
+                elif lo == 0:
+                    opt_global[prefix + name] = jax.device_get(val)
+            return True
+
+        for s in range(self.num_stages):
+            state = self._stage_opt_state[s]
+            lo, hi = self.module.stage_layer_range(s)
+            n_local = hi - lo
+            if self._is_zero_state(state):
+                opt_global["zero"] = True
+                if state.master is None:
+                    # fp32 compute: master is re-derived from the layer params.
+                    opt_global["zero_master_from_params"] = True
+                elif not self._is_layer_list(state.master, n_local):
+                    return None, None
+                else:
+                    for off in range(n_local):
+                        opt_layers[lo + off]["zero_master"] = jax.device_get(state.master[off])
+                if not split_fields(state.inner_state, lo, n_local, prefix="inner_"):
+                    return None, None
+            elif not split_fields(state, lo, n_local):
+                return None, None  # unknown state shape: skip optimizer persistence
         return opt_global, opt_layers
+
+    @staticmethod
+    def _put_like(template, data):
+        """Rebuild ``data`` with template leaf dtypes; leaves whose template
+        carries a mesh sharding (ZeRO master/inner shards) are re-committed to
+        it, the rest stay uncommitted so the next jitted step places them."""
+        t_leaves, treedef = jax.tree_util.tree_flatten(template)
+        d_leaves = jax.tree_util.tree_leaves(data)
+        if len(t_leaves) != len(d_leaves):
+            raise ValueError("optimizer state structure mismatch on load")
+        put = []
+        for t, d in zip(t_leaves, d_leaves):
+            arr = jnp.asarray(np.asarray(d), t.dtype)
+            if isinstance(getattr(t, "sharding", None), NamedSharding):
+                arr = jax.device_put(arr, t.sharding)
+            put.append(arr)
+        return jax.tree_util.tree_unflatten(treedef, put)
 
     def _restore_opt_state_per_layer(self, blob):
         """Inverse of ``_split_opt_state_per_layer`` for the CURRENT staging."""
         if not blob or blob.get("global") is None:
             return False
         opt_global, opt_layers = blob["global"], blob["layers"]
-        new_states = []
-        for s in range(self.num_stages):
-            template = self._stage_opt_state[s]
-            if not hasattr(template, "_asdict"):
-                return False
-            lo, hi = self.module.stage_layer_range(s)
-            n_local = hi - lo
+        is_zero_blob = bool(opt_global.get("zero"))
+        if is_zero_blob != self._config.zero_enabled:
+            return False  # zero-ness changed between save and load
+
+        def join_fields(template, lo, n_local, prefix=""):
             fields = {}
             for name, val in template._asdict().items():
                 if self._is_layer_list(val, n_local):
                     fields[name] = [
-                        jax.tree_util.tree_map(jnp.asarray, opt_layers[lo + off][name])
+                        self._put_like(val[off], opt_layers[lo + off][prefix + name])
                         for off in range(n_local)
                     ]
                 else:
-                    fields[name] = jax.tree_util.tree_map(jnp.asarray, opt_global[name])
-            new_states.append(type(template)(**fields))
+                    fields[name] = self._put_like(val, opt_global[prefix + name])
+            return type(template)(**fields)
+
+        try:
+            new_states = []
+            for s in range(self.num_stages):
+                template = self._stage_opt_state[s]
+                lo, hi = self.module.stage_layer_range(s)
+                n_local = hi - lo
+                if self._is_zero_state(template):
+                    if template.master is None:
+                        if not opt_global.get("zero_master_from_params"):
+                            return False
+                        master = None
+                    else:
+                        master = [
+                            self._put_like(template.master[off], opt_layers[lo + off]["zero_master"])
+                            for off in range(n_local)
+                        ]
+                    inner = join_fields(template.inner_state, lo, n_local, prefix="inner_")
+                    new_states.append(type(template)(master=master, inner_state=inner))
+                elif hasattr(template, "_asdict"):
+                    new_states.append(join_fields(template, lo, n_local))
+                else:
+                    return False
+        except (KeyError, ValueError):
+            return False
         self._stage_opt_state = new_states
         return True
 
@@ -844,7 +930,7 @@ class PipelineEngine:
             self._stage_opt[s].init(self._stage_params[s]) for s in range(self.num_stages)
         ]
         opt_file = os.path.join(path, "optim_states.pt")
-        if os.path.exists(opt_file) and not self._config.zero_enabled:
+        if os.path.exists(opt_file):
             with open(opt_file, "rb") as f:
                 if not self._restore_opt_state_per_layer(pickle.load(f)):
                     logger.warning("could not restore optimizer state; reinitialized")

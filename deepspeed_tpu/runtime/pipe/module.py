@@ -208,7 +208,22 @@ class PipelineModule:
         return params
 
     # -- forward -----------------------------------------------------------
-    def _apply_layer(self, idx, layer_params, x, rngs=None):
+    def _layer_accepts_deterministic(self, idx):
+        import inspect
+
+        if not hasattr(self, "_accepts_det"):
+            self._accepts_det = {}
+        if idx not in self._accepts_det:
+            layer = self._built[idx]
+            target = getattr(layer, "__call__", layer)
+            try:
+                ok = "deterministic" in inspect.signature(target).parameters
+            except (TypeError, ValueError):
+                ok = False
+            self._accepts_det[idx] = ok
+        return self._accepts_det[idx]
+
+    def _apply_layer(self, idx, layer_params, x, rngs=None, deterministic=None):
         layer = self._built[idx]
         spec = self._layer_specs[idx]
         inputs = x if isinstance(x, tuple) else (x,)
@@ -216,17 +231,24 @@ class PipelineModule:
             return spec.forward_fn(layer, layer_params, *inputs)
         if _is_flax_module(layer):
             kwargs = {"rngs": rngs} if rngs else {}
+            if deterministic is not None and self._layer_accepts_deterministic(idx):
+                kwargs["deterministic"] = deterministic
             return layer.apply(layer_params, *inputs, **kwargs)
         return layer(*inputs)
 
-    def stage_forward(self, stage_id):
+    def stage_forward(self, stage_id, deterministic=None):
         """fn(stage_params, x, rngs) running this stage's layers sequentially;
-        ``stage_params`` is the per-layer params list for layers[start:end]."""
+        ``stage_params`` is the per-layer params list for layers[start:end].
+        ``deterministic=True`` builds the eval-mode program (dropout off for
+        every layer that exposes the flag — the reference's eval_batch runs the
+        module in eval mode)."""
         start, end = self.stage_layer_range(stage_id)
 
         def fn(stage_params, x, rngs=None):
             for off, idx in enumerate(range(start, end)):
-                x = self._apply_layer(idx, stage_params[off], x, rngs=rngs)
+                x = self._apply_layer(
+                    idx, stage_params[off], x, rngs=rngs, deterministic=deterministic
+                )
             return x
 
         return fn

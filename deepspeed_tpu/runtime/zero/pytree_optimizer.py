@@ -47,13 +47,17 @@ def _master_spec(leaf_shape, tp_spec, dp):
 class ZeroPytreeOptimizer:
     """ZeRO-1/2 over a param pytree; composes with TP param shardings."""
 
-    def __init__(self, inner, stage=2, mesh=None, clip_grad=0.0, **unused):
+    def __init__(self, inner, stage=2, mesh=None, clip_grad=0.0, keep_master=True, **unused):
         assert mesh is not None
         self.inner = inner
         self.stage = stage
         self.mesh = mesh
         self.dp = dp_world_size(mesh)
         self.clip_grad = clip_grad
+        # keep_master=False (fp32 compute): params are already fp32 — storing a
+        # second sharded fp32 master would double-store them; the step derives
+        # the local master shard from params instead.
+        self.keep_master = keep_master
         self.lr = getattr(inner, "lr", 1e-3)
         self.name = getattr(inner, "name", "zero_pytree")
         self._tp_specs = None
@@ -73,15 +77,26 @@ class ZeroPytreeOptimizer:
 
     def init(self, params):
         self._collect_specs(params)
-        master = jax.tree_util.tree_map(
-            # jnp.copy: a master leaf whose spec equals the param's would
-            # otherwise alias the param buffer, and the engine's jitted step
-            # donates both (double-donation crash).
-            lambda p, spec: jax.device_put(
-                jnp.copy(jnp.asarray(p, jnp.float32)), NamedSharding(self.mesh, spec)
-            ),
-            params, self._master_specs,
-        )
+        if self.keep_master:
+            master = jax.tree_util.tree_map(
+                # jnp.copy: a master leaf whose spec equals the param's would
+                # otherwise alias the param buffer, and the engine's jitted step
+                # donates both (double-donation crash).
+                lambda p, spec: jax.device_put(
+                    jnp.copy(jnp.asarray(p, jnp.float32)), NamedSharding(self.mesh, spec)
+                ),
+                params, self._master_specs,
+            )
+        else:
+            # Not stored (fp32 compute): no copy — reshard the params view so
+            # only shard-sized buffers materialize; the inner init just needs
+            # shapes/shardings for its zeros.
+            master = jax.tree_util.tree_map(
+                lambda p, spec: jax.device_put(
+                    jnp.asarray(p, jnp.float32), NamedSharding(self.mesh, spec)
+                ),
+                params, self._master_specs,
+            )
         inner_state = self.inner.init(master)
         n_shard = sum(x.size for x in jax.tree_util.tree_leaves(master)) // self.dp
         log_dist(
@@ -89,6 +104,8 @@ class ZeroPytreeOptimizer:
             f"master per dp shard (dp={self.dp})",
             ranks=[0],
         )
+        if not self.keep_master:
+            return ZeroPytreeState(master=None, inner_state=inner_state)
         return ZeroPytreeState(master=master, inner_state=inner_state)
 
     def update(self, grads, opt_state, params, lr=None):
@@ -102,8 +119,15 @@ class ZeroPytreeOptimizer:
             return g
 
         g32 = jax.tree_util.tree_map(to_master, grads, self._master_specs)
-        new_master, new_inner = self.inner.update(g32, opt_state.inner_state,
-                                                  opt_state.master, lr=lr)
+        if self.keep_master:
+            master = opt_state.master
+        else:
+            # fp32 compute: derive the sharded master view from params.
+            master = jax.tree_util.tree_map(
+                lambda p, spec: constrain(p.astype(jnp.float32), NamedSharding(self.mesh, spec)),
+                params, self._master_specs,
+            )
+        new_master, new_inner = self.inner.update(g32, opt_state.inner_state, master, lr=lr)
         new_master = jax.tree_util.tree_map(
             lambda m, spec: constrain(m, NamedSharding(self.mesh, spec)),
             new_master, self._master_specs,
@@ -113,6 +137,8 @@ class ZeroPytreeOptimizer:
             lambda m, p, spec: constrain(m, NamedSharding(self.mesh, spec)).astype(p.dtype),
             new_master, params, self._tp_specs,
         )
+        if not self.keep_master:
+            new_master = None
         return new_params, ZeroPytreeState(master=new_master, inner_state=new_inner)
 
     # -- elastic checkpointing ---------------------------------------------

@@ -53,7 +53,7 @@ class ZeroShardedOptimizer:
     def __init__(self, inner, stage=1, mesh=None, cpu_offload=False, reduce_scatter=True,
                  reduce_bucket_size=500000000, allgather_bucket_size=500000000,
                  elastic_checkpoint=True, clip_grad=0.0, postscale_gradients=True,
-                 gradient_predivide_factor=1.0):
+                 gradient_predivide_factor=1.0, keep_master=True):
         assert mesh is not None, "ZeroShardedOptimizer requires a mesh"
         self.inner = inner
         self.stage = stage
@@ -65,8 +65,13 @@ class ZeroShardedOptimizer:
         self.allgather_bucket_size = allgather_bucket_size
         self.elastic_checkpoint = elastic_checkpoint
         self.clip_grad = clip_grad
+        # keep_master=False (fp32 compute): the replicated params ARE fp32, so
+        # a persistent sharded master would double-store them — the step
+        # re-derives the local master slice from params instead.
+        self.keep_master = keep_master
         self._spec = None  # (treedef, shapes, dtypes, sizes)
         self._numel = None
+        self._padded = None
         self.lr = getattr(inner, "lr", 1e-3)
         self.name = getattr(inner, "name", "zero")
 
@@ -79,6 +84,7 @@ class ZeroShardedOptimizer:
         flat = flatten_dense_tensors(params, jnp.float32)
         self._numel = int(flat.shape[0])
         flat, _ = pad_to_multiple(flat, self.dp)
+        self._padded = int(flat.shape[0])
         if self.cpu_offload:
             # ZeRO-Offload: master AND optimizer state live on host only — no
             # device-side copies (that HBM is exactly what offload frees).
@@ -88,6 +94,8 @@ class ZeroShardedOptimizer:
             return ZeroState(flat_master=jnp.zeros((0,), jnp.float32), inner_state=None)
         flat = jax.device_put(flat, self._shard_sharding())
         inner_state = self.inner.init(flat)
+        if not self.keep_master:
+            return ZeroState(flat_master=jnp.zeros((0,), jnp.float32), inner_state=inner_state)
         return ZeroState(flat_master=flat, inner_state=inner_state)
 
     # -- device path (jit-traceable) --------------------------------------
@@ -103,7 +111,15 @@ class ZeroShardedOptimizer:
             # Stage 2: gradient partitioning — only the owner shard persists.
             flat_grads = jax.lax.with_sharding_constraint(flat_grads, self._shard_sharding())
 
-        new_master, new_inner = self.inner.update(flat_grads, opt_state.inner_state, opt_state.flat_master, lr=lr)
+        if self.keep_master:
+            master = opt_state.flat_master
+        else:
+            # fp32 compute: derive the local master slice from the (fp32)
+            # params — XLA materializes only this rank's shard transiently.
+            master = flatten_dense_tensors(params, jnp.float32)
+            master, _ = pad_to_multiple(master, self.dp)
+            master = jax.lax.with_sharding_constraint(master, self._shard_sharding())
+        new_master, new_inner = self.inner.update(flat_grads, opt_state.inner_state, master, lr=lr)
         new_master = jax.lax.with_sharding_constraint(new_master, self._shard_sharding())
 
         # Rebuild replicated params in their original dtypes: XLA inserts the
@@ -116,15 +132,31 @@ class ZeroShardedOptimizer:
         # mixed precision — the fp32 master stays only in the shard).
         out_dtypes = [l.dtype for l in jax.tree_util.tree_leaves(params)]
         new_params = unflatten_dense_tensors(full, treedef, shapes, out_dtypes)
+        if not self.keep_master:
+            new_master = jnp.zeros((0,), jnp.float32)
         return new_params, ZeroState(flat_master=new_master, inner_state=new_inner)
 
     # -- host path (ZeRO-Offload) -----------------------------------------
     def update_host(self, grads, opt_state, params, lr=None):
-        """Host-side step: D2H grads, C++/numpy Adam on host master, H2D params."""
+        """Host-side step: D2H grads, C++/numpy Adam on host master, H2D params.
+
+        Grad leaves may be ``CSRTensor``s (sparse embedding gradients,
+        reference engine.py:1186-1242): only the touched rows cross the
+        device→host boundary; the dense layout is rebuilt host-side."""
+        from deepspeed_tpu.runtime.csr_tensor import CSRTensor
+
         treedef, shapes, dtypes, _ = self._spec
-        flat_grads = np.asarray(
-            jax.device_get(flatten_dense_tensors(grads, jnp.float32)), np.float32
-        )
+        parts = []
+        for leaf in jax.tree_util.tree_leaves(grads):
+            if isinstance(leaf, CSRTensor):
+                dense = np.zeros(leaf.dense_size, np.float32)
+                idx = np.asarray(jax.device_get(leaf.indices))
+                if idx.size:
+                    dense[idx] = np.asarray(jax.device_get(leaf.values), np.float32)
+                parts.append(dense.reshape(-1))
+            else:
+                parts.append(np.asarray(jax.device_get(leaf), np.float32).reshape(-1))
+        flat_grads = np.concatenate(parts) if parts else np.zeros(0, np.float32)
         if flat_grads.shape[0] < self._host_master.shape[0]:
             flat_grads = np.concatenate(
                 [flat_grads, np.zeros(self._host_master.shape[0] - flat_grads.shape[0], np.float32)]
@@ -141,9 +173,10 @@ class ZeroShardedOptimizer:
         different dp degree can re-partition (reference 'lean' states)."""
         if self.cpu_offload:
             return self._host_shard_state_dicts()
-        flat = np.asarray(jax.device_get(opt_state.flat_master), np.float32)
+        has_master = self.keep_master
+        flat = np.asarray(jax.device_get(opt_state.flat_master), np.float32) if has_master else None
         inner_leaves, inner_treedef = jax.tree_util.tree_flatten(jax.device_get(opt_state.inner_state))
-        shard_size = flat.shape[0] // self.dp
+        shard_size = self._padded // self.dp
         shards = []
         for r in range(self.dp):
             lo, hi = r * shard_size, (r + 1) * shard_size
@@ -152,9 +185,11 @@ class ZeroShardedOptimizer:
                 "rank": r,
                 "dp_world_size": self.dp,
                 "numel": self._numel,
-                "flat_master": flat[lo:hi_logical],
+                # fp32 compute: master == params; the module checkpoint carries it.
+                "master_from_params": not has_master,
+                "flat_master": flat[lo:hi_logical] if has_master else None,
                 "inner": [
-                    np.asarray(l[lo:hi_logical]) if getattr(l, "ndim", 0) == 1 and l.shape[0] == flat.shape[0] else np.asarray(l)
+                    np.asarray(l[lo:hi_logical]) if getattr(l, "ndim", 0) == 1 and l.shape[0] == self._padded else np.asarray(l)
                     for l in inner_leaves
                 ],
             }
@@ -209,14 +244,13 @@ class ZeroShardedOptimizer:
         assert numel == self._numel, (
             f"checkpoint numel {numel} != model numel {self._numel}"
         )
-        full_master = np.concatenate([s["flat_master"] for s in shards])[:numel]
 
         inner_leaves_t, inner_treedef = jax.tree_util.tree_flatten(opt_state.inner_state)
         n_inner = len(shards[0]["inner"])
         merged_inner = []
         for i in range(n_inner):
             tmpl = inner_leaves_t[i]
-            if getattr(tmpl, "ndim", 0) == 1 and tmpl.shape[0] == opt_state.flat_master.shape[0]:
+            if getattr(tmpl, "ndim", 0) == 1 and tmpl.shape[0] == self._padded:
                 merged = np.concatenate([s["inner"][i] for s in shards])[:numel]
                 pad = tmpl.shape[0] - numel
                 if pad > 0:
@@ -226,7 +260,25 @@ class ZeroShardedOptimizer:
                 merged_inner.append(jnp.asarray(shards[0]["inner"][i], tmpl.dtype))
         new_inner = jax.tree_util.tree_unflatten(inner_treedef, merged_inner)
 
-        pad = opt_state.flat_master.shape[0] - numel
+        if shards[0].get("master_from_params"):
+            if self.keep_master:
+                # Saved under fp32 compute (no stored master), loading under
+                # fp16/bf16 which requires one. Failing here is better than an
+                # empty master crashing mid-step far from the load site.
+                raise ValueError(
+                    "This ZeRO checkpoint was saved with fp32 compute (the fp32 "
+                    "params serve as the master; none is stored). Loading it into "
+                    "a mixed-precision run needs a stored master — resume with "
+                    "fp32 compute, or re-save the checkpoint from a mixed-"
+                    "precision run."
+                )
+            return ZeroState(flat_master=jnp.zeros((0,), jnp.float32), inner_state=new_inner)
+        if not self.keep_master:
+            # Mixed-precision checkpoint into an fp32 run: the stored master is
+            # simply ignored (params from the module checkpoint are the master).
+            return ZeroState(flat_master=jnp.zeros((0,), jnp.float32), inner_state=new_inner)
+        full_master = np.concatenate([s["flat_master"] for s in shards])[:numel]
+        pad = self._padded - numel
         if pad > 0:
             full_master = np.concatenate([full_master, np.zeros(pad, np.float32)])
         new_master = jax.device_put(jnp.asarray(full_master, jnp.float32), self._shard_sharding())

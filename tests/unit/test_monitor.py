@@ -1,0 +1,138 @@
+"""Monitoring: TensorBoard event files written by the engine (reference
+deepspeed/runtime/engine.py:1010-1025) and the stdlib event-file writer."""
+
+import glob
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.monitor.tensorboard import (
+    SummaryWriter,
+    TensorBoardMonitor,
+    _crc32c,
+    _masked_crc,
+    _tfrecord,
+)
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors for CRC32c.
+    assert _crc32c(b"") == 0x00000000
+    assert _crc32c(b"123456789") == 0xE3069283
+    assert _crc32c(bytes(32)) == 0x8A9136AA
+
+
+def test_tfrecord_framing_roundtrip():
+    payload = b"hello deepspeed"
+    rec = _tfrecord(payload)
+    (length,) = struct.unpack("<Q", rec[:8])
+    assert length == len(payload)
+    (len_crc,) = struct.unpack("<I", rec[8:12])
+    assert len_crc == _masked_crc(rec[:8])
+    assert rec[12:12 + length] == payload
+    (data_crc,) = struct.unpack("<I", rec[12 + length:])
+    assert data_crc == _masked_crc(payload)
+
+
+def _read_scalars(log_dir):
+    """Parse scalar events back with tensorboard's own reader if available,
+    else a minimal TFRecord walk."""
+    try:
+        from tensorboard.backend.event_processing.event_accumulator import EventAccumulator
+
+        acc = EventAccumulator(log_dir)
+        acc.Reload()
+        out = {}
+        for tag in acc.Tags()["scalars"]:
+            out[tag] = [(e.step, e.value) for e in acc.Scalars(tag)]
+        return out
+    except Exception:
+        return None
+
+
+def test_summary_writer_readable_by_tensorboard(tmpdir):
+    log_dir = str(tmpdir.join("tb"))
+    w = SummaryWriter(log_dir)
+    for step in range(5):
+        w.add_scalar("Train/Samples/train_loss", 2.0 - 0.1 * step, step)
+    w.add_scalar("Train/Samples/lr", 1e-4, 4)
+    w.close()
+
+    files = glob.glob(os.path.join(log_dir, "events.out.tfevents.*"))
+    assert len(files) == 1
+    scalars = _read_scalars(log_dir)
+    if scalars is None:
+        pytest.skip("tensorboard reader unavailable")
+    assert "Train/Samples/train_loss" in scalars
+    losses = scalars["Train/Samples/train_loss"]
+    assert [s for s, _ in losses] == list(range(5))
+    assert losses[0][1] == pytest.approx(2.0, abs=1e-6)
+    assert scalars["Train/Samples/lr"][0][1] == pytest.approx(1e-4, rel=1e-3)
+
+
+def test_monitor_buffers_until_flush(tmpdir):
+    mon = TensorBoardMonitor(str(tmpdir.join("out")), "job", rank=0)
+    import jax.numpy as jnp
+
+    mon.record("x", jnp.asarray(1.5), 0)  # device scalar: no sync until flush
+    mon.record("x", 2.5, 1)
+    path = mon.writer._path
+    size_before = os.path.getsize(path)
+    mon.flush()
+    assert os.path.getsize(path) > size_before
+    mon.close()
+
+
+def test_monitor_rank_nonzero_writes_nothing(tmpdir):
+    mon = TensorBoardMonitor(str(tmpdir.join("out")), "job", rank=1)
+    mon.record("x", 1.0, 0)
+    mon.flush()
+    mon.close()
+    assert not os.path.exists(os.path.join(str(tmpdir.join("out")), "job"))
+
+
+def test_engine_writes_tensorboard_scalars(tmpdir):
+    """Engine-level: training with tensorboard enabled produces an event file
+    with per-step loss/lr (reference engine.py:1010-1025)."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+
+    out = str(tmpdir.join("tb_engine"))
+
+    def model(params, x, y):
+        pred = x @ params["w"]
+        return jnp.mean((pred - y) ** 2)
+
+    params = {"w": jnp.ones((4, 2))}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "steps_per_print": 2,
+            "tensorboard": {"enabled": True, "output_path": out, "job_name": "unit"},
+        },
+    )
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randn(8, 2).astype(np.float32)
+    for _ in range(4):
+        loss = engine(jnp.asarray(x), jnp.asarray(y))
+        engine.backward(loss)
+        engine.step()
+    engine.monitor.flush()
+
+    scalars = _read_scalars(os.path.join(out, "unit"))
+    if scalars is None:
+        pytest.skip("tensorboard reader unavailable")
+    assert "Train/Samples/train_loss" in scalars
+    assert len(scalars["Train/Samples/train_loss"]) == 4
+    assert "Train/Samples/lr" in scalars
+    # keyed by global sample count (8 per step), matching the reference
+    assert [s for s, _ in scalars["Train/Samples/train_loss"]] == [8, 16, 24, 32]
