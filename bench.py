@@ -112,10 +112,9 @@ def child_main():
     dev_batch = tuple(jnp.asarray(x) for x in batch)
 
     def one_step():
-        loss = engine(*dev_batch)
-        engine.backward(loss)
-        engine.step()
-        return loss
+        # Fused scanned step: one dispatch, donated buffers, loss stays on
+        # device so consecutive steps queue without host syncs.
+        return engine.train_step([dev_batch])
 
     for _ in range(warmup):
         loss = one_step()

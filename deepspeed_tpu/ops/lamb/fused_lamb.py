@@ -76,10 +76,11 @@ class FusedLamb:
             )
             return (p32 - lr * trust * update).astype(p.dtype), m_new, v_new
 
-        flat = jax.tree_util.tree_map(upd, grads, state.exp_avg, state.exp_avg_sq, params)
-        new_params = jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=lambda x: isinstance(x, tuple))
-        new_m = jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=lambda x: isinstance(x, tuple))
-        new_v = jax.tree_util.tree_map(lambda t: t[2], flat, is_leaf=lambda x: isinstance(x, tuple))
+        from deepspeed_tpu.ops.utils_op import tree_map_multi
+
+        new_params, new_m, new_v = tree_map_multi(
+            upd, 3, grads, state.exp_avg, state.exp_avg_sq, params
+        )
         return new_params, LambState(step=step, exp_avg=new_m, exp_avg_sq=new_v)
 
     @property

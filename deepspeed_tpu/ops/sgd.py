@@ -35,9 +35,9 @@ class SGD:
             step_dir = g + self.momentum * buf_new if self.nesterov else buf_new
             return (p32 - lr * step_dir).astype(p.dtype), buf_new
 
-        out = jax.tree_util.tree_map(upd, grads, state.momentum_buf, params)
-        new_params = jax.tree_util.tree_map(lambda t: t[0], out, is_leaf=lambda x: isinstance(x, tuple))
-        new_buf = jax.tree_util.tree_map(lambda t: t[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        from deepspeed_tpu.ops.utils_op import tree_map_multi
+
+        new_params, new_buf = tree_map_multi(upd, 2, grads, state.momentum_buf, params)
         return new_params, SGDState(step=state.step + 1, momentum_buf=new_buf)
 
     @property

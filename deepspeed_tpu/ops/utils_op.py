@@ -41,6 +41,21 @@ def unflatten_dense_tensors(flat, treedef, shapes, dtypes):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def tree_map_multi(fn, n_out, tree, *rest):
+    """Map ``fn`` (returning an ``n_out``-tuple) over aligned pytrees and
+    un-zip the results into ``n_out`` pytrees. Unlike
+    ``tree_map(..., is_leaf=lambda x: isinstance(x, tuple))`` picking, this is
+    robust to tuples appearing INSIDE the input pytrees (e.g. the compiled
+    pipeline's ``(stacked_params, aux_params)``)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    rest_leaves = [jax.tree_util.tree_leaves(r) for r in rest]
+    outs = [fn(l, *(rl[i] for rl in rest_leaves)) for i, l in enumerate(leaves)]
+    return tuple(
+        jax.tree_util.tree_unflatten(treedef, [o[k] for o in outs])
+        for k in range(n_out)
+    )
+
+
 def pad_to_multiple(flat, multiple):
     """Zero-pad a flat array so its length divides ``multiple``; returns (padded, orig_len)."""
     n = flat.shape[0]

@@ -553,30 +553,36 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # jitted programs
     # ------------------------------------------------------------------
+    def _fwd_bwd_core(self, needs_rng):
+        """Traceable (loss, grads) of one microbatch. The model outputs are NOT
+        returned: only the loss is consumed, and returning e.g. BERT-large
+        logits would pin ~B*S*V per step in HBM after the program ends."""
+        compute_dtype = self.compute_dtype
+        apply_fn = self.apply_fn
+        pld = self.progressive_layer_drop is not None
+
+        def fwd_bwd(params, scale, rng, theta, *batch):
+            def loss_fn(p):
+                p_c = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p)
+                kwargs = {}
+                if needs_rng:
+                    kwargs["rngs"] = {"dropout": rng}
+                if pld:
+                    kwargs["progressive_layer_drop"] = True
+                    kwargs["pld_theta"] = theta
+                out = apply_fn(p_c, *batch, **kwargs)
+                loss = out[0] if isinstance(out, tuple) else out
+                return loss.astype(jnp.float32) * scale
+
+            scaled_loss, grads = jax.value_and_grad(loss_fn)(params)
+            return scaled_loss / scale, grads
+
+        return fwd_bwd
+
     def _get_fwd_bwd(self, needs_rng):
         key = ("fwd_bwd", needs_rng)
         if key not in self._jit_cache:
-            compute_dtype = self.compute_dtype
-            apply_fn = self.apply_fn
-            pld = self.progressive_layer_drop is not None
-
-            def fwd_bwd(params, scale, rng, theta, *batch):
-                def loss_fn(p):
-                    p_c = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p)
-                    kwargs = {}
-                    if needs_rng:
-                        kwargs["rngs"] = {"dropout": rng}
-                    if pld:
-                        kwargs["progressive_layer_drop"] = True
-                        kwargs["pld_theta"] = theta
-                    out = apply_fn(p_c, *batch, **kwargs)
-                    loss = out[0] if isinstance(out, tuple) else out
-                    return (loss.astype(jnp.float32) * scale, out)
-
-                (scaled_loss, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-                return scaled_loss / scale, out, grads
-
-            self._jit_cache[key] = jax.jit(fwd_bwd)
+            self._jit_cache[key] = jax.jit(self._fwd_bwd_core(needs_rng))
         return self._jit_cache[key]
 
     def _onebit_path(self):
@@ -765,18 +771,17 @@ class DeepSpeedEngine:
             self._jit_cache["acc"] = jax.jit(acc)
         return self._jit_cache["acc"]
 
-    def _get_step_fn(self):
-        """Jitted optimizer step with on-device overflow skip (lax.cond)."""
-        if "step" in self._jit_cache:
-            return self._jit_cache["step"]
-
+    def _update_core(self):
+        """Traceable update: unscale -> clip -> optimizer -> scaler, with the
+        overflow skip as lax.cond on device. Shared by the 3-call step and the
+        fused scanned train step."""
         optimizer = self.optimizer
         clip = self.gradient_clipping()
         fp16 = self.fp16_enabled()
         dynamic = self.dynamic_loss_scale()
         scaler_kwargs = self._scaler_kwargs or {}
 
-        def step_fn(params, opt_state, acc_grads, scaler_state, lr):
+        def update(params, opt_state, acc_grads, scaler_state, lr):
             scale = scaler_state.cur_scale
             overflow = has_overflow(acc_grads) if fp16 else jnp.asarray(False)
 
@@ -801,11 +806,67 @@ class DeepSpeedEngine:
                 new_scaler = update_scaler(scaler_state, overflow, **scaler_kwargs)
             else:
                 new_scaler = scaler_state._replace(cur_iter=scaler_state.cur_iter + 1)
-            zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc_grads)
+            return new_params, new_opt_state, new_scaler, overflow, gnorm
+
+        return update
+
+    def _get_step_fn(self):
+        """Jitted optimizer step with on-device overflow skip (lax.cond)."""
+        if "step" in self._jit_cache:
+            return self._jit_cache["step"]
+
+        update = self._update_core()
+        gas1 = self._no_accumulation_needed()
+
+        def step_fn(params, opt_state, acc_grads, scaler_state, lr):
+            new_params, new_opt_state, new_scaler, overflow, gnorm = update(
+                params, opt_state, acc_grads, scaler_state, lr
+            )
+            # gas == 1: backward rebinds acc from the next forward's grads, so
+            # don't pay a zero-fill per step.
+            zeroed = None if gas1 else jax.tree_util.tree_map(jnp.zeros_like, acc_grads)
             return new_params, new_opt_state, new_scaler, overflow, gnorm, zeroed
 
         self._jit_cache["step"] = jax.jit(step_fn, donate_argnums=(0, 1, 2))
         return self._jit_cache["step"]
+
+    def _get_train_step(self, needs_rng, batch_ndims):
+        """ONE jitted program for a whole optimizer step: lax.scan over the gas
+        microbatches (stacked on a leading axis) accumulating grads, then the
+        shared update — with params/opt_state/scaler donated so the update is
+        in-place in HBM. This is the hot path ``train_batch`` and ``bench.py``
+        use; the 3-call API remains for reference parity.
+
+        Replaces the reference's eager micro-loop + hook-driven allreduce
+        (engine.py:783-987) with compiler-scheduled grad accumulation."""
+        key = ("train_step", needs_rng, batch_ndims)
+        if key not in self._jit_cache:
+            fwd_bwd = self._fwd_bwd_core(needs_rng)
+            update = self._update_core()
+            gas = self.gradient_accumulation_steps()
+
+            def train_step(params, opt_state, scaler_state, rng, theta, lr, *stacked):
+                scale = scaler_state.cur_scale
+
+                def body(acc, mb):
+                    i, batch = mb
+                    loss, grads = fwd_bwd(params, scale, jax.random.fold_in(rng, i), theta, *batch)
+                    acc = jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(jnp.float32) * (1.0 / gas), acc, grads
+                    )
+                    return acc, loss
+
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params
+                )
+                acc, losses = jax.lax.scan(body, zeros, (jnp.arange(gas), stacked))
+                new_params, new_opt_state, new_scaler, overflow, gnorm = update(
+                    params, opt_state, acc, scaler_state, lr
+                )
+                return new_params, new_opt_state, new_scaler, jnp.mean(losses), overflow, gnorm
+
+            self._jit_cache[key] = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        return self._jit_cache[key]
 
     def _ensure_opt_state(self):
         if self.opt_state is None:
@@ -858,10 +919,9 @@ class DeepSpeedEngine:
             )
             if self._onebit_path():
                 fwd_bwd = self._get_fwd_bwd_onebit(needs_rng, len(batch))
-                loss, grads = fwd_bwd(self.params, self.scaler_state.cur_scale, self._next_rng(), theta, *batch)
             else:
                 fwd_bwd = self._get_fwd_bwd(needs_rng)
-                loss, out, grads = fwd_bwd(self.params, self.scaler_state.cur_scale, self._next_rng(), theta, *batch)
+            loss, grads = fwd_bwd(self.params, self.scaler_state.cur_scale, self._next_rng(), theta, *batch)
             self._cached_grads = grads
             self._last_loss = loss
             result = loss
@@ -917,12 +977,17 @@ class DeepSpeedEngine:
             self.timers("backward").start(sync=False)
 
         gas = self.gradient_accumulation_steps()
-        if self._acc_grads is None:
-            self._acc_grads = jax.tree_util.tree_map(
-                lambda g: jnp.zeros_like(g, dtype=jnp.float32), self._cached_grads
-            )
-        factor = 1.0 / gas if self.postscale_gradients() else 1.0 / (gas * self.gradient_predivide_factor())
-        self._acc_grads = self._get_accumulate()(self._acc_grads, self._cached_grads, factor)
+        if self._no_accumulation_needed():
+            # gas == 1: the microbatch grads ARE the step grads — skip the
+            # zero-init + add dispatch and the extra grads-sized buffer.
+            self._acc_grads = self._cached_grads
+        else:
+            if self._acc_grads is None:
+                self._acc_grads = jax.tree_util.tree_map(
+                    lambda g: jnp.zeros_like(g, dtype=jnp.float32), self._cached_grads
+                )
+            factor = 1.0 / gas if self.postscale_gradients() else 1.0 / (gas * self.gradient_predivide_factor())
+            self._acc_grads = self._get_accumulate()(self._acc_grads, self._cached_grads, factor)
         self._cached_grads = None
         # Monitoring sees the MEAN microbatch loss of the boundary step, not
         # the last microbatch's (device-side add; no host sync).
@@ -937,6 +1002,13 @@ class DeepSpeedEngine:
             self.timers("backward").stop(sync=False)
             self.timers("backward_microstep").stop()
         return loss
+
+    def _no_accumulation_needed(self):
+        return (
+            self.gradient_accumulation_steps() == 1
+            and self.postscale_gradients()
+            and self.gradient_predivide_factor() == 1.0
+        )
 
     def is_gradient_accumulation_boundary(self):
         return self.micro_steps % self.gradient_accumulation_steps() == 0
@@ -984,27 +1056,9 @@ class DeepSpeedEngine:
         self.params, self.opt_state, self.scaler_state, overflow, gnorm, self._acc_grads = step_fn(
             self.params, self.opt_state, self._acc_grads, self.scaler_state, jnp.asarray(lr if lr is not None else self._optimizer_base_lr(), jnp.float32)
         )
-        if self.fp16_enabled():
-            # fp16 needs the overflow verdict on host (skip bookkeeping + lr
-            # hold). bf16/fp32 never overflow-skip — avoid the per-step device
-            # sync so XLA queues steps back-to-back.
-            overflow = bool(jax.device_get(overflow))
-        else:
-            overflow = False
-        self._last_overflow = overflow
-        if overflow:
-            self.skipped_steps += 1
-            if self.dynamic_loss_scale() and self.global_rank == 0:
-                logger.info(
-                    "[deepspeed_tpu] OVERFLOW! Skipping step. Attempted loss scale: "
-                    f"{float(jax.device_get(self.scaler_state.cur_scale) * 2)}, reducing to "
-                    f"{float(jax.device_get(self.scaler_state.cur_scale))}"
-                )
-        else:
-            if self.lr_scheduler is not None:
-                self.lr_scheduler.step()
-        self.global_steps += 1
-        self.global_samples += self.train_batch_size()
+        # bf16/fp32 never overflow-skip — _finish_step_bookkeeping syncs the
+        # overflow verdict only under fp16, so XLA queues steps back-to-back.
+        self._finish_step_bookkeeping(overflow)
 
     def _take_model_step_host(self, lr):
         """ZeRO-Offload step: overflow/clip on host, C++/numpy Adam over the
@@ -1096,21 +1150,113 @@ class DeepSpeedEngine:
             ranks=[0],
         )
 
+    def _can_fuse_train_step(self):
+        return (
+            self.training
+            and not self._onebit_path()
+            and not (self.zero_optimization() and self.zero_cpu_offload())
+            and self.flops_profiler is None
+        )
+
+    def train_step(self, microbatches):
+        """ONE dispatch for a full optimizer step: ``microbatches`` is a list
+        of ``gradient_accumulation_steps`` batch tuples; grads accumulate in a
+        scanned loop and the update runs with donated buffers. Returns the
+        mean loss as a DEVICE scalar — no host sync, so back-to-back calls
+        queue on the device."""
+        assert self._can_fuse_train_step(), (
+            "fused train_step unavailable for this config (1-bit Adam, "
+            "ZeRO-Offload and profiling use forward/backward/step)"
+        )
+        gas = self.gradient_accumulation_steps()
+        micro = [
+            tuple(jnp.asarray(x) for x in (mb if isinstance(mb, (tuple, list)) else (mb,)))
+            for mb in microbatches
+        ]
+        assert len(micro) == gas, f"need {gas} microbatches, got {len(micro)}"
+        stacked = tuple(
+            self._shard_stacked(jnp.stack([m[k] for m in micro]))
+            for k in range(len(micro[0]))
+        )
+        self._ensure_opt_state()
+        fused = self._get_train_step(self._module_needs_rng(), len(stacked))
+        theta = jnp.asarray(
+            self.progressive_layer_drop.get_theta() if self.progressive_layer_drop else 1.0,
+            jnp.float32,
+        )
+        lr = self.get_lr()[0] if self.lr_scheduler is not None else self._optimizer_base_lr()
+        self.params, self.opt_state, self.scaler_state, loss, overflow, gnorm = fused(
+            self.params, self.opt_state, self.scaler_state, self._next_rng(), theta,
+            jnp.asarray(lr, jnp.float32), *stacked,
+        )
+        self._last_loss = loss
+        self._loss_sum = loss * gas
+        self.micro_steps += gas
+        self._finish_step_bookkeeping(overflow)
+        self.tput_timer.stop(self.global_steps % self.steps_per_print() == 0)
+        self._monitor_step()
+        if self.progressive_layer_drop:
+            self.progressive_layer_drop.update_state(self.global_steps)
+        if self.global_steps % self.steps_per_print() == 0:
+            self._report_progress(self.global_steps)
+            if self.monitor is not None:
+                self.monitor.flush()
+        return loss
+
+    def _shard_stacked(self, x):
+        """[gas, global_batch, ...]: batch dim (axis 1) sharded along data."""
+        if x.ndim <= 1:
+            return x
+        try:
+            spec = PartitionSpec(None, DATA_AXIS, *([None] * (x.ndim - 2)))
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
+        except Exception:
+            return x
+
+    def _finish_step_bookkeeping(self, overflow):
+        """Post-update host bookkeeping shared by the fused and 3-call paths:
+        overflow verdict (host sync only under fp16), skip counting, lr
+        scheduler hold-on-overflow (reference engine.py:951-987)."""
+        if self.fp16_enabled():
+            overflow = bool(jax.device_get(overflow))
+        else:
+            overflow = False
+        self._last_overflow = overflow
+        if overflow:
+            self.skipped_steps += 1
+            if self.dynamic_loss_scale() and self.global_rank == 0:
+                logger.info(
+                    "[deepspeed_tpu] OVERFLOW! Skipping step. Attempted loss scale: "
+                    f"{float(jax.device_get(self.scaler_state.cur_scale) * 2)}, reducing to "
+                    f"{float(jax.device_get(self.scaler_state.cur_scale))}"
+                )
+        else:
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+
     def train_batch(self, data_iter=None):
-        """Fused convenience: run gas micro-steps + optimizer step, return mean loss."""
+        """Convenience: run gas micro-steps + optimizer step, return mean loss.
+        Uses the fused scanned program when the config allows; falls back to
+        the 3-call micro loop (1-bit / offload / profiling)."""
         if data_iter is None:
             assert self.training_dataloader is not None
             data_iter = iter(self.training_dataloader)
-        total = 0.0
-        for _ in range(self.gradient_accumulation_steps()):
+        gas = self.gradient_accumulation_steps()
+        if self._can_fuse_train_step():
+            micro = [next(data_iter) for _ in range(gas)]
+            return float(jax.device_get(self.train_step(micro)))
+        losses = []
+        for _ in range(gas):
             batch = next(data_iter)
             if not isinstance(batch, (tuple, list)):
                 batch = (batch,)
             loss = self.forward(*batch)
             self.backward(loss)
-            total += float(jax.device_get(loss))
+            losses.append(loss)  # device values: sync ONCE after the loop
             self.step()
-        return total / self.gradient_accumulation_steps()
+        return float(np.mean([float(jax.device_get(l)) for l in losses]))
 
     # ------------------------------------------------------------------
     # checkpointing (parity: engine.py:1271-1561)

@@ -162,12 +162,13 @@ class OnebitAdam:
                 upd_val = upd_val + self.weight_decay * p32
             return (p32 - lr * upd_val).astype(p.dtype), m_new, v_new
 
-        out = jax.tree_util.tree_map(upd, grads, state.exp_avg, state.exp_avg_sq, params)
-        pick = lambda i: jax.tree_util.tree_map(
-            lambda t: t[i], out, is_leaf=lambda x: isinstance(x, tuple)
+        from deepspeed_tpu.ops.utils_op import tree_map_multi
+
+        new_p, new_m, new_v = tree_map_multi(
+            upd, 3, grads, state.exp_avg, state.exp_avg_sq, params
         )
-        return pick(0), OnebitAdamState(
-            step=step, exp_avg=pick(1), exp_avg_sq=pick(2),
+        return new_p, OnebitAdamState(
+            step=step, exp_avg=new_m, exp_avg_sq=new_v,
             worker_error=state.worker_error, server_error=state.server_error,
         )
 

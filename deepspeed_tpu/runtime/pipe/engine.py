@@ -158,6 +158,13 @@ class PipelineEngine:
         self.pipe_buffers = {}
         self.agg_train_loss = None
 
+        # Compiled SPMD executor (pipe/compiled.py): opt-in via config
+        # ``pipeline: {"executor": "compiled"}``; requires homogeneous stages.
+        # The interpreter remains the general-case default.
+        self._executor = str(self._config.pipeline.get("executor", "interpreted")).lower()
+        self._compiled = None  # lazy: (step_fn, stacked_params, aux, opt_state, mesh)
+        self._compiled_warned = False
+
         # monitoring: rank-0 TensorBoard scalars (reference engine.py:1010-1025)
         self.monitor = None
         if self._config.tensorboard_enabled:
@@ -423,6 +430,107 @@ class PipelineEngine:
     # ------------------------------------------------------------------
     # public API (train_batch/eval_batch are the only entry points)
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # compiled SPMD executor path (scan + ppermute; pipe/compiled.py)
+    # ------------------------------------------------------------------
+    def _compiled_eligible(self):
+        """Homogeneous stages, no ties/ZeRO/fp16 (v1 scope)."""
+        if self._executor != "compiled":
+            return False
+        reasons = []
+        if self.module.tied_specs:
+            reasons.append("tied layers")
+        if self._config.zero_enabled:
+            reasons.append("ZeRO")
+        if self._fp16:
+            reasons.append("fp16 loss scaling")
+        sig0 = None
+        for s in range(self.num_stages):
+            lo, hi = self.module.stage_layer_range(s)
+            sig = tuple(type(self.module._built[i]).__name__ for i in range(lo, hi))
+            tdef = jax.tree_util.tree_structure(self._stage_params[s])
+            shapes = tuple(
+                l.shape for l in jax.tree_util.tree_leaves(self._stage_params[s])
+            )
+            if sig0 is None:
+                sig0 = (sig, tdef, shapes)
+            elif (sig, tdef, shapes) != sig0:
+                reasons.append(f"stage {s} differs from stage 0 (heterogeneous)")
+                break
+        if reasons and not self._compiled_warned:
+            logger.warning(
+                "pipeline executor 'compiled' unavailable (%s); falling back to "
+                "the interpreter", ", ".join(reasons)
+            )
+            self._compiled_warned = True
+        return not reasons
+
+    def _ensure_compiled(self):
+        if self._compiled is not None:
+            return
+        from deepspeed_tpu.runtime.pipe import compiled as C
+
+        mesh = C.pipeline_mesh(self.num_stages)
+        stacked = C.stack_stage_params(self._stage_params, mesh)
+        stage_fn = self.module.stage_forward(0)
+        dtype = self.compute_dtype
+
+        def block_fn(stage_params, x, rng):
+            p = jax.tree_util.tree_map(lambda a: a.astype(dtype), stage_params)
+            return stage_fn(p, x, rngs={"dropout": rng})
+
+        loss_fn = self.module.loss_fn
+
+        def aux_loss(aux, y, label):
+            return loss_fn(y, label)
+
+        step = C.build_pipeline_train_step(
+            block_fn, aux_loss, self.basic_optimizer,
+            mesh, self.micro_batches, clip_grad=self._config.gradient_clipping,
+        )
+        opt_state = self.basic_optimizer.init((stacked, {}))
+        self._compiled = {"step": step, "stacked": stacked, "aux": {},
+                          "opt_state": opt_state, "mesh": mesh}
+
+    def _train_batch_compiled(self, micro):
+        self._ensure_compiled()
+        c = self._compiled
+        x0 = jnp.stack([m[0] for m in micro])
+        labels = jnp.stack([m[1] for m in micro])
+        rng = jax.random.fold_in(self._base_rng, self.global_steps)
+        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        c["stacked"], c["aux"], c["opt_state"], loss = c["step"](
+            c["stacked"], c["aux"], c["opt_state"], x0, labels, rng, lr
+        )
+        self._stage_params_stale = True
+        return loss
+
+    def _sync_from_compiled(self):
+        """Materialize per-stage params/opt state from the stacked compiled
+        state (for eval/checkpointing through the interpreter structures)."""
+        if self._compiled is None or not getattr(self, "_stage_params_stale", False):
+            return
+        from deepspeed_tpu.runtime.pipe import compiled as C
+
+        per_stage = C.unstack_stage_params(self._compiled["stacked"])
+        for s in range(self.num_stages):
+            repl = NamedSharding(self.stage_meshes[s], PartitionSpec())
+            self._stage_params[s] = jax.device_put(per_stage[s], repl)
+        # Optimizer state mirrors the (stacked_tree, aux) param container:
+        # per-param fields are that 2-tuple; slice stage s out of part 0.
+        state = self._compiled["opt_state"]
+        if hasattr(state, "_asdict") and self._stage_opt_state is not None:
+            def stage_field(val, s):
+                if isinstance(val, tuple) and len(val) == 2:
+                    return jax.tree_util.tree_map(lambda l: l[s], val[0])
+                return val
+
+            self._stage_opt_state = [
+                type(state)(**{n: stage_field(v, s) for n, v in state._asdict().items()})
+                for s in range(self.num_stages)
+            ]
+        self._stage_params_stale = False
+
     def train_batch(self, data_iter=None):
         if data_iter is None:
             assert self.training_dataloader is not None, "no training data"
@@ -431,6 +539,31 @@ class PipelineEngine:
         self.tput_timer.start()
         micro = [self._split_batch(next(data_iter)) for _ in range(self.micro_batches)]
         self._ensure_params(micro[0][0])
+
+        if (
+            self._executor == "compiled"
+            and isinstance(micro[0][0], jnp.ndarray)
+            and isinstance(micro[0][1], jnp.ndarray)
+            and self._compiled_eligible()
+        ):
+            loss = self._train_batch_compiled(micro)
+            self.agg_train_loss = float(jax.device_get(loss))
+            self.global_steps += 1
+            self.global_samples += self.micro_batch_size * self.micro_batches * self.dp_world_size
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+            if self.monitor is not None:
+                self.monitor.record("Train/Samples/train_loss", self.agg_train_loss, self.global_samples)
+                self.monitor.record("Train/Samples/lr", self.get_lr()[0], self.global_samples)
+            self.tput_timer.stop(self.global_steps % self._config.steps_per_print == 0)
+            if self.global_steps % self._config.steps_per_print == 0:
+                log_dist(
+                    f"step={self.global_steps}, loss={self.agg_train_loss:.4f}, lr={self.get_lr()}",
+                    ranks=[0],
+                )
+                if self.monitor is not None:
+                    self.monitor.flush()
+            return self.agg_train_loss
 
         self._losses = []
         sched = _MergedSchedule(pipe_schedule.TrainSchedule, self.micro_batches, self.num_stages)
@@ -460,6 +593,7 @@ class PipelineEngine:
         eval_batch switches the module to eval mode, pipe/engine.py:438)."""
         micro = [self._split_batch(next(data_iter)) for _ in range(self.micro_batches)]
         self._ensure_params(micro[0][0])
+        self._sync_from_compiled()
         losses = []
         rng = self._base_rng
         for x, label in micro:
@@ -732,6 +866,7 @@ class PipelineEngine:
         path = os.path.join(save_dir, str(tag))
         os.makedirs(path, exist_ok=True)
         assert self._stage_params is not None, "nothing to save: run a batch first"
+        self._sync_from_compiled()
         layer_params = self._gather_layer_params()
         for idx, p in enumerate(layer_params):
             if p is None:
@@ -935,6 +1070,10 @@ class PipelineEngine:
                 if not self._restore_opt_state_per_layer(pickle.load(f)):
                     logger.warning("could not restore optimizer state; reinitialized")
         self._zero_acc_grads()
+        # Loaded per-stage params are now authoritative: a previously built
+        # compiled (stacked) state would shadow them on the next sync.
+        self._compiled = None
+        self._stage_params_stale = False
         self.global_steps = meta["global_steps"]
         self.global_samples = meta["global_samples"]
         if self.lr_scheduler is not None and meta.get("lr_scheduler"):
