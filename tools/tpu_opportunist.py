@@ -1,0 +1,184 @@
+"""Opportunistic TPU bench harness (VERDICT r2 item 1).
+
+The axon TPU tunnel in this environment is flaky: ``jax.devices()`` can HANG
+for hours rather than erroring. This watcher runs in the background for the
+whole round:
+
+  1. probes the TPU backend in a bounded-time subprocess, with backoff;
+  2. the moment the tunnel answers, runs (a) a Mosaic compile smoke test of
+     the Pallas attention kernels (``interpret=False``, tiny shapes, fwd AND
+     bwd) and (b) the full BERT-large bench (``bench.py --child``);
+  3. persists results IMMEDIATELY: ``TPU_SMOKE.json``, ``BENCH_r03.json``,
+     and every attempt timestamp to ``TPU_ATTEMPTS.log``.
+
+Exits 0 once both smoke and bench have succeeded; runs until killed
+otherwise. Never imports jax in the parent process.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_ATTEMPTS.log")
+SMOKE_OUT = os.path.join(REPO, "TPU_SMOKE.json")
+BENCH_OUT = os.path.join(REPO, "BENCH_r03.json")
+
+PROBE_TIMEOUT = int(os.environ.get("TPU_PROBE_TIMEOUT", "90"))
+SMOKE_TIMEOUT = int(os.environ.get("TPU_SMOKE_TIMEOUT", "900"))
+BENCH_TIMEOUT = int(os.environ.get("TPU_BENCH_TIMEOUT", "2400"))
+SLEEP_MIN = int(os.environ.get("TPU_RETRY_MIN", "60"))
+SLEEP_MAX = int(os.environ.get("TPU_RETRY_MAX", "300"))
+
+
+def log(msg):
+    line = f"{time.strftime('%Y-%m-%dT%H:%M:%S')} {msg}"
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+    print(line, flush=True)
+
+
+def probe():
+    code = (
+        "import jax\n"
+        "d = jax.devices()\n"
+        "assert d and d[0].platform == 'tpu', d\n"
+        "print('TPU_OK', d[0].device_kind)\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT, cwd=REPO,
+        )
+        if r.returncode == 0 and "TPU_OK" in r.stdout:
+            return True, r.stdout.strip().split("TPU_OK", 1)[1].strip()
+        return False, (r.stderr or r.stdout).strip()[-300:]
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {PROBE_TIMEOUT}s"
+    except Exception as e:  # noqa: BLE001
+        return False, repr(e)
+
+
+SMOKE_CODE = r"""
+import json, time
+import jax, jax.numpy as jnp
+import numpy as np
+
+dev = jax.devices()[0]
+assert dev.platform == "tpu", dev
+out = {"device_kind": dev.device_kind, "interpret": False}
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import DenseSparsityConfig
+from deepspeed_tpu.ops.transformer.attention import sparse_flash_attention
+
+B, H, S, D = 1, 4, 256, 64
+rng = np.random.RandomState(0)
+q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+k = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+v = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+cfg = DenseSparsityConfig(num_heads=H, block=128)
+
+t0 = time.time()
+o = sparse_flash_attention(q, k, v, sparsity_config=cfg, interpret=False)
+jax.block_until_ready(o)
+out["fwd_compile_s"] = round(time.time() - t0, 1)
+
+def loss(q, k, v):
+    return jnp.sum(sparse_flash_attention(q, k, v, sparsity_config=cfg, interpret=False) ** 2)
+
+t0 = time.time()
+g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+jax.block_until_ready(g)
+out["bwd_compile_s"] = round(time.time() - t0, 1)
+
+# numerics vs dense reference on-device
+ref = jax.nn.softmax(q @ jnp.swapaxes(k, -1, -2) / np.sqrt(D), axis=-1) @ v
+err = float(jnp.max(jnp.abs(o - ref)))
+out["fwd_max_err_vs_dense"] = err
+out["ok"] = bool(err < 2e-2)
+print("SMOKE_JSON " + json.dumps(out))
+"""
+
+
+def run_smoke():
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", SMOKE_CODE],
+            capture_output=True, text=True, timeout=SMOKE_TIMEOUT, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"smoke timed out after {SMOKE_TIMEOUT}s"
+    for line in reversed(r.stdout.strip().splitlines()):
+        if line.startswith("SMOKE_JSON "):
+            return json.loads(line[len("SMOKE_JSON "):]), None
+    return None, f"rc={r.returncode}: {(r.stderr or r.stdout).strip()[-800:]}"
+
+
+def run_bench():
+    env = dict(os.environ)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--child"],
+            capture_output=True, text=True, timeout=BENCH_TIMEOUT, env=env, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"bench timed out after {BENCH_TIMEOUT}s"
+    for line in reversed(r.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    return None, f"rc={r.returncode}: {(r.stderr or r.stdout).strip()[-800:]}"
+
+
+def main():
+    smoke_done = os.path.exists(SMOKE_OUT)
+    bench_done = False
+    if os.path.exists(BENCH_OUT):
+        try:
+            with open(BENCH_OUT) as f:
+                bench_done = "tpu" in json.load(f).get("device_kind", "").lower()
+        except Exception:  # noqa: BLE001
+            pass
+    sleep = SLEEP_MIN
+    attempt = 0
+    while not (smoke_done and bench_done):
+        attempt += 1
+        ok, info = probe()
+        if not ok:
+            log(f"attempt {attempt}: tunnel down ({info}); retry in {sleep}s")
+            time.sleep(sleep)
+            sleep = min(sleep * 2, SLEEP_MAX)
+            continue
+        log(f"attempt {attempt}: TUNNEL UP ({info})")
+        sleep = SLEEP_MIN
+        if not smoke_done:
+            res, err = run_smoke()
+            if res is not None:
+                with open(SMOKE_OUT, "w") as f:
+                    json.dump(res, f, indent=1)
+                log(f"smoke: {json.dumps(res)}")
+                smoke_done = True
+            else:
+                log(f"smoke FAILED: {err}")
+        if not bench_done:
+            res, err = run_bench()
+            if res is not None and "tpu" in str(res.get("device_kind", "")).lower():
+                with open(BENCH_OUT, "w") as f:
+                    f.write(json.dumps(res) + "\n")
+                log(f"bench: {json.dumps(res)}")
+                bench_done = True
+            else:
+                log(f"bench FAILED: {err or res}")
+        if not (smoke_done and bench_done):
+            time.sleep(SLEEP_MIN)
+    log("all done: smoke + bench recorded on TPU")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
