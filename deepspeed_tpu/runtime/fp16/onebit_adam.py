@@ -211,10 +211,22 @@ class OnebitAdam:
             server_error=jnp.zeros((n // world_size,), jnp.float32),
         )
 
-    def update_flat(self, local_grad, state, flat_params, axis_name, lr=None):
+    def update_flat(self, local_grad, state, flat_params, axis_name, lr=None,
+                    clip=0.0):
         """Full 1-bit pipeline over a FLAT fp32 param vector, inside shard_map:
         warmup -> dense psum Adam; frozen -> local momentum + compressed
-        allreduce of the momentum (reference step:230-372)."""
+        allreduce of the momentum (reference step:230-372).
+
+        Returns (new_params, new_state, gnorm). Gradient clipping (``clip``)
+        applies only in the warmup phase, to the exact norm of the
+        worker-AVERAGED gradient (an RMS of per-worker local norms would be
+        ~sqrt(W) inflated for decorrelated grads). In the compression phase
+        no clipping is applied — clipping sign-compressed momentum would
+        corrupt the error-feedback loop, and the reference likewise accepts
+        max_grad_norm but never applies it (onebit_adam.py:61) — and the
+        reported gnorm is the exact norm of the averaged momentum (replicated
+        after phase 2), for monitoring only.
+        """
         lr = self.lr if lr is None else lr
         beta1, beta2 = self.betas
         step = state.step + 1
@@ -222,18 +234,22 @@ class OnebitAdam:
 
         def warmup(_):
             g = jax.lax.pmean(local_grad, axis_name)
+            gnorm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            if clip > 0:
+                g = g * jnp.minimum(1.0, clip / (gnorm + 1e-6))
             m = beta1 * state.exp_avg + (1 - beta1) * g
             v = beta2 * state.exp_avg_sq + (1 - beta2) * jnp.square(g)
-            return m, v, state.worker_error, state.server_error
+            return m, v, state.worker_error, state.server_error, gnorm
 
         def compressed(_):
             m_local = beta1 * state.exp_avg + (1 - beta1) * local_grad
             m_avg, we, se = compressed_allreduce(
                 m_local, state.worker_error, state.server_error, axis_name
             )
-            return m_avg, state.exp_avg_sq, we, se
+            mnorm = jnp.sqrt(jnp.sum(jnp.square(m_avg)))
+            return m_avg, state.exp_avg_sq, we, se, mnorm
 
-        m_new, v_new, we, se = jax.lax.cond(frozen, compressed, warmup, None)
+        m_new, v_new, we, se, gnorm = jax.lax.cond(frozen, compressed, warmup, None)
 
         if self.bias_correction:
             bc1 = 1 - beta1 ** step.astype(jnp.float32)
@@ -246,4 +262,4 @@ class OnebitAdam:
         new_params = flat_params - lr * update
         return new_params, OnebitAdamState(
             step=step, exp_avg=m_new, exp_avg_sq=v_new, worker_error=we, server_error=se
-        )
+        ), gnorm

@@ -489,8 +489,61 @@ class PipelineEngine:
             mesh, self.micro_batches, clip_grad=self._config.gradient_clipping,
         )
         opt_state = self.basic_optimizer.init((stacked, {}))
+        # Resume correctness: if per-stage optimizer state exists (a loaded
+        # checkpoint, or prior interpreter steps), carry it into the stacked
+        # representation — an unconditional init() here silently reset Adam
+        # moments on the compiled path after load_checkpoint (round-2 advisor
+        # finding d).
+        restacked = self._restack_opt_state(opt_state)
+        if restacked is not None:
+            opt_state = restacked
         self._compiled = {"step": step, "stacked": stacked, "aux": {},
                           "opt_state": opt_state, "mesh": mesh}
+
+    def _restack_opt_state(self, template):
+        """Inverse of ``_sync_from_compiled``'s slicing: stack homogeneous
+        per-stage optimizer states into the compiled executor's stacked state.
+        Per-param fields (the (stacked_tree, aux) 2-tuples in ``template``)
+        stack along a leading stage axis; scalar fields (step counts) take the
+        stage-0 value. Returns None when no per-stage state exists or the
+        shapes don't line up (fresh init is then correct)."""
+        states = self._stage_opt_state
+        if not states or not hasattr(template, "_asdict"):
+            return None
+        if any(type(s) is not type(states[0]) or not hasattr(s, "_asdict") for s in states):
+            return None
+        # A state that has never advanced carries no information worth moving.
+        step0 = getattr(states[0], "step", None)
+        if step0 is not None and int(jax.device_get(jnp.asarray(step0))) == 0:
+            return None
+        try:
+            fields = {}
+            for name, tval in template._asdict().items():
+                svals = [getattr(s, name) for s in states]
+                if isinstance(tval, tuple) and len(tval) == 2:
+                    # per-stage states are committed to disjoint stage
+                    # sub-meshes; stack through the host (same hop as
+                    # C.stack_stage_params) before re-committing below
+                    stacked_f = jax.tree_util.tree_map(
+                        lambda *ls: np.stack([np.asarray(jax.device_get(l)) for l in ls]),
+                        *svals,
+                    )
+                    stacked_f = jax.tree_util.tree_map(
+                        lambda t, a: (
+                            jax.device_put(jnp.asarray(a, t.dtype), t.sharding)
+                            if isinstance(getattr(t, "sharding", None), NamedSharding)
+                            else jnp.asarray(a, t.dtype)
+                        ),
+                        tval[0], stacked_f,
+                    )
+                    fields[name] = (stacked_f, tval[1])
+                elif hasattr(tval, "dtype"):
+                    fields[name] = jnp.asarray(svals[0], tval.dtype)
+                else:
+                    fields[name] = svals[0]
+            return type(template)(**fields)
+        except (TypeError, ValueError):
+            return None
 
     def _train_batch_compiled(self, micro):
         self._ensure_compiled()

@@ -122,21 +122,28 @@ class ThroughputTimer:
     def _init_timer(self):
         self.initialized = True
 
-    def start(self):
+    def start(self, sync=True):
+        """``sync=False`` records a host-side timestamp without draining the
+        device queue — used by the fused engine path, which must not host-sync
+        per step. Accuracy comes from the caller syncing at report boundaries
+        (stop(sync=True) there absorbs the whole window's device time, so the
+        windowed average stays honest)."""
         self._init_timer()
         self.started = True
         if self.global_step_count >= self.start_step:
-            _device_sync()
+            if sync:
+                _device_sync()
             self.start_time = time.time()
 
-    def stop(self, report_speed=True):
+    def stop(self, report_speed=True, sync=True):
         if not self.started:
             return
         self.started = False
         self.micro_step_count += 1
         self.global_step_count += 1
         if self.start_time > 0:
-            _device_sync()
+            if sync:
+                _device_sync()
             self.end_time = time.time()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration

@@ -209,7 +209,7 @@ def test_onebit_adam_distributed_converges():
         g = (params - jnp.asarray(target)) + noise[0]
         st = type(state)(step=step, exp_avg=m[0], exp_avg_sq=v[0],
                          worker_error=we[0], server_error=se[0])
-        new_p, new_st = opt.update_flat(g, st, params, "data")
+        new_p, new_st, _gnorm = opt.update_flat(g, st, params, "data")
         return (new_p, new_st.exp_avg[None], new_st.exp_avg_sq[None],
                 new_st.worker_error[None], new_st.server_error[None], new_st.step)
 

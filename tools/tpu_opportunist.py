@@ -24,11 +24,16 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "TPU_ATTEMPTS.log")
 SMOKE_OUT = os.path.join(REPO, "TPU_SMOKE.json")
-BENCH_OUT = os.path.join(REPO, "BENCH_r03.json")
+# bench.py caches every successful real-TPU measurement here and falls back
+# to it when the tunnel is down at round end; the watcher's job is to make
+# sure that cache gets populated the moment the tunnel answers.
+BENCH_OUT = os.path.join(REPO, "TPU_BENCH.json")
 
 PROBE_TIMEOUT = int(os.environ.get("TPU_PROBE_TIMEOUT", "90"))
 SMOKE_TIMEOUT = int(os.environ.get("TPU_SMOKE_TIMEOUT", "900"))
-BENCH_TIMEOUT = int(os.environ.get("TPU_BENCH_TIMEOUT", "2400"))
+# run_bench wraps bench.py's full orchestration: probe retries plus up to a
+# 5-rung OOM ladder of children at BENCH_TIMEOUT(=1500s) each — budget for it.
+BENCH_TIMEOUT = int(os.environ.get("TPU_BENCH_TIMEOUT", "7200"))
 SLEEP_MIN = int(os.environ.get("TPU_RETRY_MIN", "60"))
 SLEEP_MAX = int(os.environ.get("TPU_RETRY_MAX", "300"))
 
@@ -70,34 +75,61 @@ dev = jax.devices()[0]
 assert dev.platform == "tpu", dev
 out = {"device_kind": dev.device_kind, "interpret": False}
 
-from deepspeed_tpu.ops.sparse_attention.sparsity_config import DenseSparsityConfig
-from deepspeed_tpu.ops.transformer.attention import sparse_flash_attention
+# flash_attention dispatches to the Mosaic-compiled Pallas kernels whenever
+# the default backend is TPU (attention.py:_on_tpu) — no interpret kwarg
+# needed; interpret=True is a test-only internal path.
+from deepspeed_tpu.ops.transformer.attention import (
+    flash_attention, attention_reference,
+)
 
-B, H, S, D = 1, 4, 256, 64
+B, H, S, D = 1, 4, 512, 64
 rng = np.random.RandomState(0)
 q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
 k = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
 v = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
-cfg = DenseSparsityConfig(num_heads=H, block=128)
 
+# 1) dense fwd: Mosaic compile of the fwd kernel
 t0 = time.time()
-o = sparse_flash_attention(q, k, v, sparsity_config=cfg, interpret=False)
+o = flash_attention(q, k, v)
 jax.block_until_ready(o)
 out["fwd_compile_s"] = round(time.time() - t0, 1)
 
+# 2) dense bwd: Mosaic compile of the flash dq + dkv kernels
 def loss(q, k, v):
-    return jnp.sum(sparse_flash_attention(q, k, v, sparsity_config=cfg, interpret=False) ** 2)
-
+    return jnp.sum(flash_attention(q, k, v) ** 2)
 t0 = time.time()
 g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
 jax.block_until_ready(g)
 out["bwd_compile_s"] = round(time.time() - t0, 1)
 
-# numerics vs dense reference on-device
-ref = jax.nn.softmax(q @ jnp.swapaxes(k, -1, -2) / np.sqrt(D), axis=-1) @ v
+# numerics vs the dense jnp reference, on-device
+ref = attention_reference(q, k, v)
 err = float(jnp.max(jnp.abs(o - ref)))
 out["fwd_max_err_vs_dense"] = err
-out["ok"] = bool(err < 2e-2)
+gref = jax.grad(lambda a, b, c: jnp.sum(attention_reference(a, b, c) ** 2),
+                argnums=(0, 1, 2))(q, k, v)
+gerr = max(float(jnp.max(jnp.abs(x - y))) for x, y in zip(g, gref))
+out["bwd_max_err_vs_dense"] = gerr
+
+# 3) block-sparse causal fwd+bwd: exercises the scalar-prefetch LUT path,
+# the Mosaic-risk part of the kernels (banded layout, 4x4 blocks of 128)
+nb = S // 128
+layout = np.zeros((H, nb, nb), np.int64)
+for i in range(nb):
+    for j in range(max(0, i - 1), i + 1):
+        layout[:, i, j] = 1
+t0 = time.time()
+os_ = flash_attention(q, k, v, layout=layout, causal=True)
+gs = jax.grad(lambda a, b, c: jnp.sum(
+    flash_attention(a, b, c, layout=layout, causal=True) ** 2),
+    argnums=(0, 1, 2))(q, k, v)
+jax.block_until_ready((os_, gs))
+out["sparse_causal_compile_s"] = round(time.time() - t0, 1)
+refs = flash_attention(q, k, v, layout=layout, causal=True, force_reference=True)
+serr = float(jnp.max(jnp.abs(os_ - refs)))
+out["sparse_causal_max_err"] = serr
+
+out["ok"] = bool(err < 2e-2 and gerr < 2e-1 and serr < 2e-2)
 print("SMOKE_JSON " + json.dumps(out))
 """
 
@@ -117,10 +149,12 @@ def run_smoke():
 
 
 def run_bench():
+    """Run bench.py's full orchestration (probe + OOM ladder); on success it
+    writes the cached TPU measurement to TPU_BENCH.json itself."""
     env = dict(os.environ)
     try:
         r = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py"), "--child"],
+            [sys.executable, os.path.join(REPO, "bench.py")],
             capture_output=True, text=True, timeout=BENCH_TIMEOUT, env=env, cwd=REPO,
         )
     except subprocess.TimeoutExpired:
@@ -167,9 +201,9 @@ def main():
                 log(f"smoke FAILED: {err}")
         if not bench_done:
             res, err = run_bench()
-            if res is not None and "tpu" in str(res.get("device_kind", "")).lower():
-                with open(BENCH_OUT, "w") as f:
-                    f.write(json.dumps(res) + "\n")
+            fresh = (res is not None and not res.get("cached")
+                     and "tpu" in str(res.get("device_kind", "")).lower())
+            if fresh:
                 log(f"bench: {json.dumps(res)}")
                 bench_done = True
             else:
