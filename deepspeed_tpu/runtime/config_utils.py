@@ -4,10 +4,26 @@ Capability parity with the reference's ``deepspeed/runtime/config_utils.py``.
 """
 
 import json
+import os
 
 
 def get_scalar_param(param_dict, param_name, param_default_value):
     return param_dict.get(param_name, param_default_value)
+
+
+def resolve_tp_size(config, mpu=None):
+    """Tensor-parallel (``model``) axis size, resolved identically by the
+    DeepSpeedEngine and the PipelineEngine: an mpu reporting > 1 wins,
+    otherwise the ds_config's ``tensor_parallel.size`` (dict or JSON path)."""
+    if mpu is not None:
+        mp = int(mpu.get_model_parallel_world_size() or 1)
+        if mp > 1:
+            return mp
+    cfg_dict = config if isinstance(config, dict) else None
+    if cfg_dict is None and isinstance(config, str) and os.path.isfile(config):
+        with open(config) as f:
+            cfg_dict = json.load(f)
+    return int(((cfg_dict or {}).get("tensor_parallel", {}) or {}).get("size", 1) or 1)
 
 
 def get_list_param(param_dict, param_name, param_default_value):

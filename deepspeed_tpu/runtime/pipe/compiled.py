@@ -143,6 +143,91 @@ def build_pipeline_loss(block_fn, loss_fn, mesh, num_micro, remat=True):
     return fn
 
 
+def build_pipeline_loss_hetero(first_fn, block_fn, last_loss_fn, mesh, num_micro,
+                               remat=True):
+    """Heterogeneous-stage pipelined loss (generalizes ``build_pipeline_loss``
+    to embedding/head stages and tied weights — reference tied-layer grads,
+    pipe/module.py:405-474, pipe/engine.py:208).
+
+    fn(stacked_params, aux_params, x0, labels, rng) -> mean loss, where:
+
+    - ``first_fn(aux_params, inp, rng) -> hidden``: stage 0's extra leading
+      layers (e.g. token+position embedding). ``inp`` is the raw microbatch
+      input from ``x0`` ([M, mb, ...], any dtype — ids are fine); its output
+      must have the carried activation shape.
+    - ``block_fn(stage_params, hidden, rng) -> hidden``: the uniform per-stage
+      block stack; params stacked over ``pipe`` exactly as in the homogeneous
+      executor.
+    - ``last_loss_fn(aux_params, hidden, label) -> scalar``: the last stage's
+      extra trailing layers folded into the loss (final norm + LM head + CE).
+    - ``aux_params`` are REPLICATED over the mesh. A parameter used by BOTH
+      ``first_fn`` and ``last_loss_fn`` (weight tying) automatically receives
+      the SUM of both stages' gradients: the transpose of the shard_map
+      broadcast is a psum over the mesh — the collective the reference issues
+      by hand for tied layers.
+
+    The head computation runs under ``lax.cond`` so only the last stage pays
+    for the vocab-sized projection each tick.
+    """
+    S = mesh.shape[PIPE_AXIS]
+    M = num_micro
+    T = M + S - 1
+    block = jax.checkpoint(block_fn) if remat else block_fn
+    P = PartitionSpec
+
+    def pipelined(stacked_params, aux_params, x0, labels, rng):
+        params = jax.tree_util.tree_map(lambda a: jnp.squeeze(a, 0), stacked_params)
+        sid = jax.lax.axis_index(PIPE_AXIS)
+
+        # hidden shape probe (static): stage 0's first_fn output
+        hidden_shape = jax.eval_shape(
+            lambda a, i: first_fn(a, i, rng), aux_params, jax.tree_util.tree_map(
+                lambda l: jnp.take(l, 0, axis=0), x0)
+        )
+
+        def body(carry, t):
+            x_recv, loss_acc = carry
+            mi = jnp.minimum(t, M - 1)
+            inp = jnp.take(x0, mi, axis=0)
+            x_in = jax.lax.cond(
+                sid == 0,
+                lambda: first_fn(aux_params, inp,
+                                 jax.random.fold_in(rng, t * (S + 2) + S + 1)),
+                lambda: x_recv,
+            )
+            y = block(params, x_in, jax.random.fold_in(rng, t * (S + 2) + sid))
+            li = jnp.clip(t - (S - 1), 0, M - 1)
+            valid = jnp.logical_and(sid == S - 1, t >= S - 1)
+            l = jax.lax.cond(
+                valid,
+                lambda: last_loss_fn(aux_params, y,
+                                     jnp.take(labels, li, axis=0)).astype(jnp.float32),
+                lambda: jnp.float32(0.0),
+            )
+            loss_acc = loss_acc + l
+            y_send = jax.lax.ppermute(
+                y, PIPE_AXIS, [(i, (i + 1) % S) for i in range(S)]
+            )
+            return (y_send, loss_acc), None
+
+        zero_act = jnp.zeros(hidden_shape.shape, hidden_shape.dtype)
+        (_, loss_acc), _ = jax.lax.scan(body, (zero_act, jnp.float32(0.0)), jnp.arange(T))
+        total = jax.lax.psum(loss_acc, PIPE_AXIS) / M
+        return jax.lax.pmean(total, DATA_AXIS)
+
+    data_sharded = lambda ndim: P(None, DATA_AXIS, *([None] * max(0, ndim - 2)))
+
+    def fn(stacked_params, aux_params, x0, labels, rng):
+        return shard_map(
+            pipelined, mesh=mesh,
+            in_specs=(P(PIPE_AXIS), P(), data_sharded(x0.ndim), data_sharded(labels.ndim), P()),
+            out_specs=P(),
+            check_rep=False,
+        )(stacked_params, aux_params, x0, labels, rng)
+
+    return fn
+
+
 def build_pipeline_train_step(block_fn, loss_fn, optimizer, mesh, num_micro,
                               clip_grad=0.0, remat=True):
     """Fused pipelined train step: loss + backward pipeline + per-stage update
@@ -157,7 +242,23 @@ def build_pipeline_train_step(block_fn, loss_fn, optimizer, mesh, num_micro,
         build_pipeline_loss(block_fn, loss_fn, mesh, num_micro, remat=remat),
         argnums=(0, 1),
     )
+    return _train_step_from_loss_grad(loss_grad, optimizer, clip_grad)
 
+
+def build_pipeline_train_step_hetero(first_fn, block_fn, last_loss_fn, optimizer,
+                                     mesh, num_micro, clip_grad=0.0, remat=True):
+    """Fused pipelined train step over the heterogeneous executor; same
+    (stacked, aux, opt_state, x0, labels, rng, lr) signature as the
+    homogeneous variant so the engine can use either interchangeably."""
+    loss_grad = jax.value_and_grad(
+        build_pipeline_loss_hetero(first_fn, block_fn, last_loss_fn, mesh,
+                                   num_micro, remat=remat),
+        argnums=(0, 1),
+    )
+    return _train_step_from_loss_grad(loss_grad, optimizer, clip_grad)
+
+
+def _train_step_from_loss_grad(loss_grad, optimizer, clip_grad):
     def train_step(stacked_params, aux_params, opt_state, x0, labels, rng, lr):
         loss, (gp, ga) = loss_grad(stacked_params, aux_params, x0, labels, rng)
         grads = (gp, ga)

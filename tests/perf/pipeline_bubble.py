@@ -63,18 +63,51 @@ def measure(num_micro, mb=8, iters=10):
     return (time.perf_counter() - t0) / iters
 
 
+def measure_interpreter(num_micro, mb=8, iters=3):
+    """Same workload through the PipelineEngine instruction interpreter (the
+    per-instruction dispatch path) for the compiled-vs-interpreted comparison
+    (VERDICT r3 item 5)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+    module = PipelineModule(
+        [LayerSpec(Block) for _ in range(STAGES)], num_stages=STAGES,
+        loss_fn=lambda y, l: jnp.mean((y - l) ** 2), partition_method="uniform",
+    )
+    dp = len(jax.devices()) // STAGES
+    engine, _, _, _ = deepspeed_tpu.initialize(model=module, config_params={
+        "train_batch_size": mb * num_micro * dp,
+        "train_micro_batch_size_per_gpu": mb,
+        "gradient_accumulation_steps": num_micro,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "pipeline": {"executor": "interpreted"},
+    })
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(mb * dp, HID).astype(np.float32),
+             rng.randn(mb * dp, HID).astype(np.float32))
+            for _ in range(num_micro * (iters + 1))]
+    it = iter(data)
+    engine.train_batch(it)  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        engine.train_batch(it)
+    return (time.perf_counter() - t0) / iters
+
+
 def main():
     print(f"S={STAGES} stages, block=dense {HID}x{HID * 4} MLP, fwd+bwd")
-    print(f"{'M':>4} {'t_step ms':>10} {'t/micro ms':>11} {'analytic bubble':>16} {'ideal t/micro':>14}")
+    print(f"{'M':>4} {'compiled ms':>12} {'interp ms':>10} {'speedup':>8} "
+          f"{'analytic bubble':>16} {'ideal t/micro':>14}")
     base = None
     for M in (1, 2, 4, 8, 16):
         t = measure(M)
+        ti = measure_interpreter(M)
         if base is None:
             # t(M=1) = S ticks; per-tick cost:
             t_tick = t / STAGES
             base = t_tick
         ideal = base * (M + STAGES - 1) / M
-        print(f"{M:>4} {t * 1e3:>10.2f} {t / M * 1e3:>11.2f} "
+        print(f"{M:>4} {t * 1e3:>12.2f} {ti * 1e3:>10.2f} {ti / t:>8.1f}x "
               f"{analytic_bubble_fraction(STAGES, M):>16.3f} {ideal * 1e3:>14.2f}")
 
 

@@ -93,3 +93,73 @@ def test_rng_tracker():
     checkpointing.model_parallel_cuda_manual_seed(7)
     k = checkpointing.get_cuda_rng_tracker().fork()
     assert k is not None
+
+
+# -- engine-level config wiring (VERDICT r3 item 3) --------------------------
+
+def _engine_cfg(**over):
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def test_engine_applies_remat_fallback():
+    """A model with no per-layer switch gets its whole apply wrapped in
+    jax.checkpoint when the config section enables it; numerics unchanged."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from tests.unit.simple_model import create_simple_model
+
+    model, params = create_simple_model(hidden_dim=16, seed=3)
+    e_remat, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params=_engine_cfg(activation_checkpointing={"enabled": True}),
+    )
+    assert e_remat._remat_apply_fn
+
+    # the traced program really contains the remat
+    fwd_bwd = e_remat._fwd_bwd_core(needs_rng=False)
+    x = jnp.ones((8, 16)); y = jnp.ones((8, 16))
+    jaxpr = jax.make_jaxpr(fwd_bwd)(
+        e_remat.params, jnp.asarray(1.0), jax.random.PRNGKey(0),
+        jnp.asarray(1.0), x, y,
+    )
+    assert "remat" in str(jaxpr), "no remat primitive in the traced step"
+
+    model2, params2 = create_simple_model(hidden_dim=16, seed=3)
+    e_plain, _, _, _ = deepspeed_tpu.initialize(
+        model=model2, model_parameters=params2, config_params=_engine_cfg(),
+    )
+    assert not e_plain._remat_apply_fn
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(8, 16).astype(np.float32), rng.randn(8, 16).astype(np.float32))
+            for _ in range(3)]
+    la = [float(jax.device_get(e_remat.train_step([mb]))) for mb in data]
+    lb = [float(jax.device_get(e_plain.train_step([mb]))) for mb in data]
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
+
+
+def test_engine_flips_model_config_switch():
+    """A model exposing config.checkpoint_activations gets per-layer remat
+    flipped on by the engine (the bench path)."""
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining, init_bert
+
+    cfg = BertConfig.bert_base(num_hidden_layers=2, hidden_size=128,
+                               num_attention_heads=2, intermediate_size=256,
+                               vocab_size=256)
+    assert not cfg.checkpoint_activations
+    model, params = init_bert(cfg, batch_size=2, seq_len=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params=_engine_cfg(activation_checkpointing={"enabled": True}),
+    )
+    assert cfg.checkpoint_activations, "engine did not flip the model switch"
+    assert not engine._remat_apply_fn

@@ -165,3 +165,44 @@ def test_flash_bwd_sparse_layout():
     layout = (rng.rand(2, 2, 2) < 0.6).astype(np.int64)
     layout[:, :, 0] = 1
     _bwd_check(layout=layout, seed=13)
+
+
+def test_grad_binds_flash_backward_kernels(monkeypatch):
+    """jax.grad through flash_attention must hit the Pallas backward kernels on
+    the TPU path (VERDICT r3 item 9): patch the backend check to the TPU branch
+    (kernels in interpret mode so this runs on CPU) and assert the bwd kernel
+    entry point is actually invoked, with grads matching the dense reference."""
+    import functools
+
+    from deepspeed_tpu.ops.transformer import attention as A
+
+    monkeypatch.setattr(A, "_on_tpu", lambda: True)
+    monkeypatch.setattr(
+        A, "_attention_pallas", functools.partial(A._attention_pallas, interpret=True)
+    )
+    calls = {"bwd": 0}
+    real_bwd = A._attention_pallas_bwd
+
+    def spy_bwd(*args, **kwargs):
+        calls["bwd"] += 1
+        kwargs["interpret"] = True
+        return real_bwd(*args, **kwargs)
+
+    monkeypatch.setattr(A, "_attention_pallas_bwd", spy_bwd)
+
+    q, k, v = rand_qkv(B=1, H=2, S=256, D=64, seed=9)
+
+    def loss(q, k, v):
+        return jnp.sum(A.flash_attention(q, k, v) ** 2)
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    assert calls["bwd"] == 1, "flash backward kernels were not invoked"
+
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(
+            A.flash_attention(q, k, v, force_reference=True) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4)

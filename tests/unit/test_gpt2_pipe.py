@@ -63,3 +63,46 @@ def test_gpt2_pipe_layout_equivalence():
     _, l2 = run(num_stages=2)
     _, l4 = run(num_stages=4)
     np.testing.assert_allclose(l2, l4, rtol=5e-3)
+
+
+def test_gpt2_pipe_compiled_default_and_matches_interpreter():
+    """gpt2_pipe defaults to the heterogeneous compiled executor (VERDICT r3
+    item 5) and its losses match the interpreter's step for step."""
+    cfg = tiny_cfg()
+    dp = len(jax.devices()) // 2
+
+    def build(executor):
+        module = build_gpt2_pipeline(cfg, num_stages=2, partition_method="uniform")
+        cfg_d = {
+            "train_batch_size": 8 * 2 * dp,
+            "train_micro_batch_size_per_gpu": 8,
+            "gradient_accumulation_steps": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        }
+        if executor:
+            cfg_d["pipeline"] = {"executor": executor}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=module, config_params=cfg_d)
+        return engine
+
+    steps = 3
+    e_auto = build(None)
+    d = data(steps * 2, 8 * dp, 16, cfg.vocab_size)
+    l_auto = [e_auto.train_batch(iter_chunk) for iter_chunk in [iter(d)] * steps]
+    assert e_auto._compiled is not None and e_auto._compiled["mode"] == "hetero", (
+        "gpt2_pipe did not default to the hetero compiled executor"
+    )
+
+    e_int = build("interpreted")
+    it = iter(data(steps * 2, 8 * dp, 16, cfg.vocab_size))
+    l_int = [e_int.train_batch(it) for _ in range(steps)]
+    assert e_int._compiled is None
+    np.testing.assert_allclose(l_auto, l_int, rtol=5e-3)
+
+    # tied embed/head stay identical through the compiled path after sync
+    e_auto._sync_from_compiled()
+    entries = e_auto._tied["embed"]
+    (s0, l0, _), (s1, l1, _) = entries[0], entries[-1]
+    p0 = jax.device_get(e_auto._stage_params[s0][l0])
+    p1 = jax.device_get(e_auto._stage_params[s1][l1])
+    for a, b in zip(jax.tree_util.tree_leaves(p0), jax.tree_util.tree_leaves(p1)):
+        np.testing.assert_array_equal(a, b)

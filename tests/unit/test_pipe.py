@@ -241,3 +241,49 @@ def test_pipe_zero1_matches_plain():
     # optimizer state is the sharded pytree variant
     from deepspeed_tpu.runtime.zero.pytree_optimizer import ZeroPytreeState
     assert isinstance(engine._stage_opt_state[0], ZeroPytreeState)
+
+
+# -- 3D parallelism: TP inside pipeline stages (VERDICT r3 item 4) -----------
+
+class TPBlock(nn.Module):
+    """Residual MLP whose param names match parallel/tp.py MEGATRON_RULES:
+    ff1 column-parallel, ff2 row-parallel."""
+
+    @nn.compact
+    def __call__(self, x):
+        h = jax.nn.relu(nn.Dense(4 * HIDDEN, name="ff1")(x))
+        return x + nn.Dense(HIDDEN, name="ff2")(h)
+
+
+def test_pipe_3d_tp_matches_dp():
+    """pp2 x dp4 and pp2 x dp2 x tp2 are the same computation under different
+    shardings — losses must match (reference composes PP x DP x TP via
+    PipeModelDataParallelTopology, topology.py:246-250)."""
+
+    def run(tp):
+        module = PipelineModule([LayerSpec(TPBlock) for _ in range(4)],
+                                num_stages=2, loss_fn=mse_loss,
+                                base_seed=11, partition_method="uniform")
+        dp = 4 // tp
+        cfg = ds_config(mb=8 // dp, gas=2, dp=dp)
+        if tp > 1:
+            cfg["tensor_parallel"] = {"size": tp}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=module, config_params=cfg)
+        data = make_data(8, 8, seed=4)
+        it = iter(data)
+        losses = [engine.train_batch(it) for _ in range(4)]
+        return engine, losses
+
+    e_dp, l_dp = run(1)
+    e_tp, l_tp = run(2)
+    np.testing.assert_allclose(l_dp, l_tp, rtol=2e-4)
+    assert l_dp[-1] < l_dp[0], "loss should decrease"
+
+    assert e_tp.mp_world_size == 2
+    from deepspeed_tpu.parallel.tp import MODEL_AXIS
+    tp_leaves = [
+        leaf for tree in e_tp._stage_params[0]
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if MODEL_AXIS in (leaf.sharding.spec or ())
+    ]
+    assert tp_leaves, "no stage param actually carries the model axis"
