@@ -1,7 +1,10 @@
 from deepspeed_tpu.module_inject.replace_module import (
+    HFBertLayerPolicy,
     convert_hf_layer_params,
+    inject_policies,
     replace_module,
     replace_transformer_layer,
     revert_hf_layer_params,
+    revert_policies,
     revert_transformer_layer,
 )

@@ -131,3 +131,92 @@ def replace_module(params, match_fn, transform_fn, path=()):
     if isinstance(params, dict):
         return {k: replace_module(v, match_fn, transform_fn, path + (k,)) for k, v in params.items()}
     return params
+
+
+# ---------------------------------------------------------------------------
+# policy-driven recursive injection (reference _replace_module:175 +
+# replace_policy.py HFBertLayerPolicy): shape-matched subtrees are swapped
+# ANYWHERE in an arbitrary model tree, no layer_path needed.
+# ---------------------------------------------------------------------------
+
+class HFBertLayerPolicy:
+    """Detects HF FlaxBertLayer-shaped param subtrees and converts them
+    to/from DeepSpeedTransformerLayer layout (the flax analogue of the
+    reference's class-matched replace policy — params have no classes, so the
+    SHAPE of the subtree is the policy's match criterion)."""
+
+    @staticmethod
+    def matches(path, subtree):
+        # EXACT key sets, not supersets: a decoder layer carrying e.g. an
+        # extra 'crossattention' subtree must NOT match — the fixed DS layout
+        # has nowhere to keep the extras and the round trip would silently
+        # drop them.
+        if not isinstance(subtree, dict) or set(subtree) != {"attention", "intermediate", "output"}:
+            return False
+        attn = subtree["attention"]
+        if not isinstance(attn, dict) or set(attn) != {"self", "output"}:
+            return False
+        self_attn, a_out = attn["self"], attn["output"]
+        return (
+            isinstance(self_attn, dict)
+            and set(self_attn) == {"query", "key", "value"}
+            and isinstance(a_out, dict)
+            and set(a_out) == {"dense", "LayerNorm"}
+            and isinstance(subtree["intermediate"], dict)
+            and set(subtree["intermediate"]) == {"dense"}
+            and isinstance(subtree["output"], dict)
+            and set(subtree["output"]) == {"dense", "LayerNorm"}
+        )
+
+    convert = staticmethod(convert_hf_layer_params)
+
+    @staticmethod
+    def matches_ds(subtree):
+        """Detects the converted DeepSpeedTransformerLayer layout (for the
+        reverse walk)."""
+        if not isinstance(subtree, dict):
+            return False
+        p = subtree.get("params")
+        return isinstance(p, dict) and {"qkv", "attn_out", "ln_attn", "ff1", "ff2"} <= set(p)
+
+    @staticmethod
+    def revert(subtree, hidden_size):
+        return revert_hf_layer_params(subtree, hidden_size)
+
+
+def inject_policies(params, policies=(HFBertLayerPolicy,)):
+    """Recursively swap every policy-matched subtree anywhere in ``params``
+    for DeepSpeedTransformerLayer-layout params.
+
+    Returns (new_params, replaced_paths) — replaced_paths lists the tree
+    paths that were swapped, in traversal order, so callers can build the
+    matching module structure (and ``revert_policies`` can invert exactly)."""
+    replaced = []
+
+    def walk(tree, path):
+        for pol in policies:
+            if pol.matches(path, tree):
+                replaced.append(path)
+                return pol.convert(tree)
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        return tree
+
+    return walk(params, ()), replaced
+
+
+def revert_policies(params, hidden_size, policies=(HFBertLayerPolicy,)):
+    """Inverse of ``inject_policies``: recursively restore every
+    DS-layout subtree to the policy's original (HF) layout."""
+    reverted = []
+
+    def walk(tree, path):
+        for pol in policies:
+            if pol.matches_ds(tree):
+                reverted.append(path)
+                return pol.revert(tree, hidden_size)
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        return tree
+
+    return walk(params, ()), reverted

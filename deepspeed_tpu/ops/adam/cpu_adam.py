@@ -77,39 +77,52 @@ class DeepSpeedCPUAdam(FusedAdam):
         self._host_state = HostAdamState(flat_master.shape[0])
         return self._host_state
 
-    def step_host(self, master, grads, lr=None):
-        """In-place Adam step over the host fp32 master (numpy arrays)."""
+    def step_host(self, master, grads, lr=None, lo=0, hi=None, advance_step=True):
+        """In-place Adam step over the host fp32 master (numpy arrays).
+
+        ``lo``/``hi`` restrict the step to a contiguous slice of the flat
+        vector so ZeRO-Offload can pipeline D2H / compute / H2D at leaf
+        granularity; ``grads`` may be the full vector or exactly the slice.
+        ``advance_step=False`` keeps the shared Adam step counter (bias
+        correction) fixed for the 2nd..Nth slice of one logical step.
+        """
         st = self._host_state
         assert st is not None, "call init_host first"
-        st.step += 1
+        if advance_step:
+            st.step += 1
+        hi = master.shape[0] if hi is None else hi
+        n = hi - lo
+        g = grads if grads.shape[0] == n else grads[lo:hi]
+        m = master[lo:hi]
+        ea = st.exp_avg[lo:hi]
+        es = st.exp_avg_sq[lo:hi]
         lr = float(self.lr if lr is None else lr)
         lib = _load_lib()
         beta1, beta2 = self.betas
         if lib is not None:
             fp = ctypes.POINTER(ctypes.c_float)
             lib.ds_adam_step(
-                master.ctypes.data_as(fp), grads.ctypes.data_as(fp),
-                st.exp_avg.ctypes.data_as(fp), st.exp_avg_sq.ctypes.data_as(fp),
-                ctypes.c_int64(master.shape[0]), ctypes.c_float(lr),
+                m.ctypes.data_as(fp), np.ascontiguousarray(g).ctypes.data_as(fp),
+                ea.ctypes.data_as(fp), es.ctypes.data_as(fp),
+                ctypes.c_int64(n), ctypes.c_float(lr),
                 ctypes.c_float(beta1), ctypes.c_float(beta2), ctypes.c_float(self.eps),
                 ctypes.c_float(self.weight_decay), ctypes.c_int(1 if self.adam_w_mode else 0),
                 ctypes.c_int(st.step), ctypes.c_int(1 if self.bias_correction else 0),
             )
         else:
-            g = grads
             if self.weight_decay and not self.adam_w_mode:
-                g = g + self.weight_decay * master
-            np.multiply(st.exp_avg, beta1, out=st.exp_avg)
-            st.exp_avg += (1 - beta1) * g
-            np.multiply(st.exp_avg_sq, beta2, out=st.exp_avg_sq)
-            st.exp_avg_sq += (1 - beta2) * np.square(g)
+                g = g + self.weight_decay * m
+            np.multiply(ea, beta1, out=ea)
+            ea += (1 - beta1) * g
+            np.multiply(es, beta2, out=es)
+            es += (1 - beta2) * np.square(g)
             if self.bias_correction:
                 bc1 = 1 - beta1**st.step
                 bc2 = 1 - beta2**st.step
-                update = (st.exp_avg / bc1) / (np.sqrt(st.exp_avg_sq / bc2) + self.eps)
+                update = (ea / bc1) / (np.sqrt(es / bc2) + self.eps)
             else:
-                update = st.exp_avg / (np.sqrt(st.exp_avg_sq) + self.eps)
+                update = ea / (np.sqrt(es) + self.eps)
             if self.weight_decay and self.adam_w_mode:
-                update = update + self.weight_decay * master
-            master -= lr * update
+                update = update + self.weight_decay * m
+            m -= lr * update
         return master

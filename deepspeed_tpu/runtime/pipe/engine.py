@@ -176,6 +176,12 @@ class PipelineEngine:
         #     to the interpreter if neither fits).
         #   "interpreted": always interpret.
         self._executor = str(self._config.pipeline.get("executor", "auto")).lower()
+        if self._executor not in ("auto", "compiled", "interpreted"):
+            logger.warning(
+                "unknown pipeline.executor %r — valid: auto|compiled|interpreted; "
+                "using the interpreter", self._executor,
+            )
+            self._executor = "interpreted"
         self._compiled = None  # lazy: (step_fn, stacked_params, aux, opt_state, mesh)
         self._compiled_warned = False
         self._hetero_cache = "unset"

@@ -42,6 +42,10 @@ from deepspeed_tpu.parallel.mesh import DATA_AXIS, dp_world_size
 from deepspeed_tpu.utils.logging import log_dist
 
 
+# reference default (stage2.py); the warn loop below keys off this constant
+DEFAULT_BUCKET_SIZE = 500000000
+
+
 class ZeroState(NamedTuple):
     flat_master: jnp.ndarray  # fp32, padded, sharded along data axis
     inner_state: object  # inner optimizer state over the flat vector (sharded)
@@ -51,7 +55,8 @@ class ZeroShardedOptimizer:
     """Optimizer wrapper implementing ZeRO-1/2 semantics on a mesh."""
 
     def __init__(self, inner, stage=1, mesh=None, cpu_offload=False, reduce_scatter=True,
-                 reduce_bucket_size=500000000, allgather_bucket_size=500000000,
+                 reduce_bucket_size=DEFAULT_BUCKET_SIZE,
+                 allgather_bucket_size=DEFAULT_BUCKET_SIZE,
                  elastic_checkpoint=True, clip_grad=0.0, postscale_gradients=True,
                  gradient_predivide_factor=1.0, keep_master=True):
         assert mesh is not None, "ZeroShardedOptimizer requires a mesh"
@@ -61,8 +66,28 @@ class ZeroShardedOptimizer:
         self.dp = dp_world_size(mesh)
         self.cpu_offload = cpu_offload
         self.reduce_scatter = reduce_scatter
+        # Bucket-size knobs are accepted for config parity but are NO-OPS on
+        # TPU, by design rather than omission: the reference buckets grads to
+        # bound transient memory because its reduce/all-gather are eager
+        # NCCL calls issued from backward hooks (stage2.py:904-940,1444-1477).
+        # Here the whole step is ONE XLA program — the reduce-scatter and
+        # all-gather are compiler-scheduled ops whose buffers the scheduler
+        # already bounds (XLA splits oversized collectives internally), and
+        # hand-chunking them would impose an interleaved master layout for no
+        # measured gain. Each ignored non-default knob logs once, loudly.
         self.reduce_bucket_size = reduce_bucket_size
         self.allgather_bucket_size = allgather_bucket_size
+        for knob, val in (
+            ("reduce_bucket_size", reduce_bucket_size),
+            ("allgather_bucket_size", allgather_bucket_size),
+        ):
+            if val != DEFAULT_BUCKET_SIZE:
+                log_dist(
+                    f"ZeRO: '{knob}'={val} is accepted for parity but IGNORED "
+                    "on TPU — collectives are compiler-scheduled inside one "
+                    "XLA program (see ZeroShardedOptimizer docstring)",
+                    ranks=[0],
+                )
         self.elastic_checkpoint = elastic_checkpoint
         self.clip_grad = clip_grad
         # keep_master=False (fp32 compute): the replicated params ARE fp32, so
@@ -138,7 +163,16 @@ class ZeroShardedOptimizer:
 
     # -- host path (ZeRO-Offload) -----------------------------------------
     def update_host(self, grads, opt_state, params, lr=None):
-        """Host-side step: D2H grads, C++/numpy Adam on host master, H2D params.
+        """Host-side step with a pipelined D2H / compute / H2D boundary
+        (reference overlaps via pinned double buffers, csrc/adam/cpu_adam.cpp):
+
+        1. async D2H is kicked off for EVERY dense grad leaf up front
+           (``copy_to_host_async``) — transfers run while earlier leaves
+           compute;
+        2. leaves step the host master slice-by-slice (C++ Adam on the leaf's
+           [lo, hi) range; one shared Adam step counter per logical step);
+        3. each leaf's updated params start their async H2D (``device_put``)
+           immediately, overlapping the remaining leaves' host compute.
 
         Grad leaves may be ``CSRTensor``s (sparse embedding gradients,
         reference engine.py:1186-1242): only the touched rows cross the
@@ -146,25 +180,43 @@ class ZeroShardedOptimizer:
         from deepspeed_tpu.runtime.csr_tensor import CSRTensor
 
         treedef, shapes, dtypes, _ = self._spec
-        parts = []
-        for leaf in jax.tree_util.tree_leaves(grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+
+        # (1) start all D2H transfers before any host compute
+        for leaf in leaves:
+            if hasattr(leaf, "copy_to_host_async"):
+                try:
+                    leaf.copy_to_host_async()
+                except Exception:  # noqa: BLE001 — backend without async copy
+                    pass
+
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        lr_f = lr
+        master = self._host_master
+        new_leaves = []
+        offset = 0
+        for i, (leaf, shape, dtype) in enumerate(zip(leaves, shapes, dtypes)):
+            n = int(np.prod(shape)) if shape else 1
             if isinstance(leaf, CSRTensor):
-                dense = np.zeros(leaf.dense_size, np.float32)
+                g = np.zeros(leaf.dense_size, np.float32)
                 idx = np.asarray(jax.device_get(leaf.indices))
                 if idx.size:
-                    dense[idx] = np.asarray(jax.device_get(leaf.values), np.float32)
-                parts.append(dense.reshape(-1))
+                    g[idx] = np.asarray(jax.device_get(leaf.values), np.float32)
+                g = g.reshape(-1)
             else:
-                parts.append(np.asarray(jax.device_get(leaf), np.float32).reshape(-1))
-        flat_grads = np.concatenate(parts) if parts else np.zeros(0, np.float32)
-        if flat_grads.shape[0] < self._host_master.shape[0]:
-            flat_grads = np.concatenate(
-                [flat_grads, np.zeros(self._host_master.shape[0] - flat_grads.shape[0], np.float32)]
+                g = np.asarray(jax.device_get(leaf), np.float32).reshape(-1)
+            # (2) C++/numpy Adam on this leaf's master range
+            self.inner.step_host(
+                master, g, lr=lr_f, lo=offset, hi=offset + n, advance_step=(i == 0)
             )
-        self.inner.step_host(self._host_master, flat_grads, lr=lr)
-        full = jnp.asarray(self._host_master[: self._numel])
-        full = jax.device_put(full, NamedSharding(self.mesh, PartitionSpec()))
-        new_params = unflatten_dense_tensors(full, treedef, shapes, dtypes)
+            # (3) async H2D of the updated leaf while later leaves compute
+            # (numpy straight into device_put: one transfer, async; routing
+            # through jnp.asarray would commit a second, synchronous copy)
+            upd = master[offset:offset + n].reshape(shape).astype(dtype, copy=False)
+            new_leaves.append(jax.device_put(upd, repl))
+            offset += n
+        # padding tail (if any) never holds real params; leave it untouched
+        new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
         return new_params, opt_state
 
     # -- elastic checkpointing --------------------------------------------
