@@ -75,7 +75,9 @@ def child_main():
     # section below — the ENGINE flips BertConfig.checkpoint_activations
     # (per-layer scanned remat), exercising the config wiring end-to-end.
     remat = os.environ.get("BENCH_REMAT", "1") == "1"
-    cfg = BertConfig.bert_large()
+    cfg = BertConfig.bert_large(
+        checkpoint_policy=os.environ.get("BENCH_REMAT_POLICY", "dots")
+    )
     model = BertForPreTraining(cfg)
 
     n_dev = len(jax.devices())
@@ -173,6 +175,7 @@ def child_main():
         "params": n_params,
         "micro_batch": micro_batch,
         "remat": cfg.checkpoint_activations,
+        "remat_policy": cfg.checkpoint_policy,
         "final_loss": round(final_loss, 3),
     }))
     return 0

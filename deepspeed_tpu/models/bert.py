@@ -35,6 +35,21 @@ class BertConfig:
     initializer_range: float = 0.02
     pre_layer_norm: bool = True
     checkpoint_activations: bool = False
+    # remat policy when checkpoint_activations is on:
+    #   "nothing" — save nothing, recompute the whole layer in backward
+    #     (max memory savings, ~1 extra forward of FLOPs);
+    #   "dots"    — save matmul outputs, recompute only elementwise ops
+    #     (jax.checkpoint_policies.dots_with_no_batch_dims_saveable — the
+    #     standard transformer trade: most of the memory win at a fraction
+    #     of the recompute cost).
+    checkpoint_policy: str = "nothing"
+
+    def __post_init__(self):
+        if self.checkpoint_policy not in ("nothing", "dots"):
+            raise ValueError(
+                f"checkpoint_policy must be 'nothing' or 'dots', got "
+                f"{self.checkpoint_policy!r}"
+            )
 
     @staticmethod
     def bert_large(**kw):
@@ -104,7 +119,10 @@ class BertEncoder(nn.Module):
         if cfg.checkpoint_activations:
             # Activation checkpointing: recompute each layer in backward
             # (reference runtime/activation_checkpointing/checkpointing.py).
-            body = nn.remat(body, prevent_cse=False, static_argnums=())
+            policy = None
+            if cfg.checkpoint_policy == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            body = nn.remat(body, prevent_cse=False, static_argnums=(), policy=policy)
         ScanStack = nn.scan(
             body,
             variable_axes={"params": 0},
@@ -112,7 +130,13 @@ class BertEncoder(nn.Module):
             length=cfg.num_hidden_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        (h, _), _ = ScanStack(cfg.layer_config(), deterministic)((hidden_states, attention_mask), None)
+        # Explicit stable name: nn.remat would otherwise change the generated
+        # param key ("ScanCheckpoint_ScannedLayer_0" vs "_ScannedLayer_0"),
+        # breaking param trees initialized before the engine flips
+        # checkpoint_activations per the ds_config.
+        (h, _), _ = ScanStack(cfg.layer_config(), deterministic, name="layers")(
+            (hidden_states, attention_mask), None
+        )
         return h
 
 

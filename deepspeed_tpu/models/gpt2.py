@@ -111,7 +111,9 @@ class GPT2Model(nn.Module):
             length=cfg.num_hidden_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
-        (h, _), _ = ScanStack(cfg.layer_config(), deterministic)((h, mask), None)
+        # Explicit stable name: keeps the param key identical whether or not
+        # nn.remat wraps the body (see models/bert.py BertEncoder).
+        (h, _), _ = ScanStack(cfg.layer_config(), deterministic, name="layers")((h, mask), None)
         h = nn.LayerNorm(name="ln_f")(h)
         logits = h @ word.embedding.T.astype(h.dtype)
         return logits

@@ -173,11 +173,14 @@ class HFBertLayerPolicy:
     @staticmethod
     def matches_ds(subtree):
         """Detects the converted DeepSpeedTransformerLayer layout (for the
-        reverse walk)."""
-        if not isinstance(subtree, dict):
+        reverse walk). Exact key set, symmetric with ``matches`` — a superset
+        match would silently drop extra keys on revert."""
+        if not isinstance(subtree, dict) or set(subtree) != {"params"}:
             return False
-        p = subtree.get("params")
-        return isinstance(p, dict) and {"qkv", "attn_out", "ln_attn", "ff1", "ff2"} <= set(p)
+        p = subtree["params"]
+        return isinstance(p, dict) and set(p) == {
+            "qkv", "attn_out", "ln_attn", "ln_ffn", "ff1", "ff2"
+        }
 
     @staticmethod
     def revert(subtree, hidden_size):

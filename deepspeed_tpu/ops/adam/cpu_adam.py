@@ -92,6 +92,10 @@ class DeepSpeedCPUAdam(FusedAdam):
             st.step += 1
         hi = master.shape[0] if hi is None else hi
         n = hi - lo
+        assert grads.shape[0] in (n, master.shape[0]), (
+            f"grads must be the [lo,hi) slice ({n}) or the full vector "
+            f"({master.shape[0]}), got {grads.shape[0]}"
+        )
         g = grads if grads.shape[0] == n else grads[lo:hi]
         m = master[lo:hi]
         ea = st.exp_avg[lo:hi]

@@ -211,8 +211,14 @@ class ZeroShardedOptimizer:
             )
             # (3) async H2D of the updated leaf while later leaves compute
             # (numpy straight into device_put: one transfer, async; routing
-            # through jnp.asarray would commit a second, synchronous copy)
-            upd = master[offset:offset + n].reshape(shape).astype(dtype, copy=False)
+            # through jnp.asarray would commit a second, synchronous copy).
+            # The copy=True is load-bearing: on the CPU backend device_put can
+            # adopt an aligned numpy buffer zero-copy, and a VIEW into
+            # self._host_master would silently mutate these params on the
+            # NEXT in-place step_host.
+            upd = np.array(
+                master[offset:offset + n].reshape(shape), dtype=dtype, copy=True
+            )
             new_leaves.append(jax.device_put(upd, repl))
             offset += n
         # padding tail (if any) never holds real params; leave it untouched

@@ -112,3 +112,52 @@ def test_gpt2_is_actually_causal():
         err_msg="future token leaked into past positions: causality broken",
     )
     assert not np.allclose(np.asarray(logits_a[:, -1]), np.asarray(logits_b[:, -1]))
+
+
+def test_bert_remat_policies_equal_loss():
+    """checkpoint_activations with either remat policy ("nothing" and "dots")
+    computes the same loss and grads as the non-remat encoder — remat changes
+    memory/recompute, never numerics."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
+
+    def mk_cfg(**kw):
+        return BertConfig.bert_base(
+            num_hidden_layers=2, hidden_size=64, num_attention_heads=2,
+            intermediate_size=128, vocab_size=128,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0, **kw
+        )
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 128, (2, 16)).astype(np.int32))
+    mask = jnp.ones((2, 16), jnp.int32)
+    labels = jnp.asarray(np.where(rng.rand(2, 16) < 0.3,
+                                  rng.randint(0, 128, (2, 16)), -1).astype(np.int32))
+    nsl = jnp.zeros((2,), jnp.int32)
+    # ONE param set shared across configs (nn.remat changes the init rng
+    # folding, so per-config init would draw different params)
+    params = BertForPreTraining(mk_cfg()).init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        ids, ids * 0, mask, labels, nsl,
+    )
+
+    def run(**kw):
+        model = BertForPreTraining(mk_cfg(**kw))
+
+        def loss_fn(p):
+            return model.apply(p, ids, ids * 0, mask, labels, nsl,
+                               deterministic=True)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return float(loss), grads
+
+    l0, g0 = run()
+    l1, g1 = run(checkpoint_activations=True, checkpoint_policy="nothing")
+    l2, g2 = run(checkpoint_activations=True, checkpoint_policy="dots")
+    np.testing.assert_allclose(l1, l0, rtol=1e-6)
+    np.testing.assert_allclose(l2, l0, rtol=1e-6)
+    for g in (g1, g2):
+        for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
