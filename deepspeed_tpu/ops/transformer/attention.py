@@ -65,10 +65,27 @@ def layout_to_lut(layout):
 # Pallas kernel
 # ---------------------------------------------------------------------------
 
-def _attn_kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                 *, num_heads, block_q, block_k, maxn, scale, causal):
+def _dropout_keep(seed_ref, bh, qi, kj, block_q, block_k, rate):
+    """[BQ, BK] keep/(1-rate) scale mask from the TPU PRNG, deterministically
+    re-derivable from (seed, bh, qi, kj) — the forward and BOTH backward
+    kernels regenerate the identical mask instead of storing O(S^2) bits
+    (the flash-dropout trick; reference stores the mask from its fused
+    dropout kernels, csrc/transformer/dropout_kernels.cu)."""
+    pltpu.prng_seed(seed_ref[0], bh, qi, kj)
+    bits = pltpu.prng_random_bits((block_q, block_k)).astype(jnp.uint32)
+    threshold = jnp.uint32(min(int(rate * 2**32), 2**32 - 1))
+    return jnp.where(bits >= threshold, 1.0 / (1.0 - rate), 0.0)
+
+
+def _attn_kernel(seed_ref, counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref,
+                 o_ref, lse_ref,
+                 *, num_heads, block_q, block_k, maxn, scale, causal, dropout_rate):
     """One (batch*head, q-block-row) cell: stream LUT-named k/v blocks with
-    online softmax. carry = (m, l, acc) runs in registers/VMEM values."""
+    online softmax. carry = (m, l, acc) runs in registers/VMEM values.
+
+    Dropout (rate > 0) applies to the softmax PROBS: the normalizer l
+    accumulates the UNDROPPED p while acc accumulates (mask * p / keep) @ v,
+    so out = dropout(softmax(s)) @ v exactly."""
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     h = jax.lax.rem(bh, num_heads)
@@ -97,8 +114,11 @@ def _attn_kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        p_acc = p
+        if dropout_rate > 0.0:
+            p_acc = p * _dropout_keep(seed_ref, bh, qi, kj, block_q, block_k, dropout_rate)
         acc_new = acc * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p_acc, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         return m_new, l_new, acc_new
 
@@ -117,8 +137,10 @@ def _attn_kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_
     lse_ref[0, 0] = lse
 
 
-def _attention_pallas(q, k, v, bias, lut, counts, *, block_q, block_k, causal, interpret=False):
-    """q,k,v: [B, H, S, D]; bias additive [B, S] (key bias, e.g. padding)."""
+def _attention_pallas(q, k, v, bias, lut, counts, *, block_q, block_k, causal,
+                      interpret=False, dropout_rate=0.0, seed=None):
+    """q,k,v: [B, H, S, D]; bias additive [B, S] (key bias, e.g. padding).
+    ``seed``: [1] int32 array feeding the in-kernel dropout PRNG."""
     B, H, S, D = q.shape
     BH = B * H
     qr = q.reshape(BH, S, D)
@@ -128,7 +150,7 @@ def _attention_pallas(q, k, v, bias, lut, counts, *, block_q, block_k, causal, i
     scale = 1.0 / float(np.sqrt(D))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(BH, S // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi, *_: (bh, qi, 0)),
@@ -143,9 +165,10 @@ def _attention_pallas(q, k, v, bias, lut, counts, *, block_q, block_k, causal, i
     )
     kernel = functools.partial(
         _attn_kernel, num_heads=H, block_q=block_q, block_k=block_k,
-        maxn=maxn, scale=scale, causal=causal,
+        maxn=maxn, scale=scale, causal=causal, dropout_rate=dropout_rate,
     )
     bias_r = jnp.broadcast_to(bias[:, None, :], (B, H, S)).reshape(BH, 1, S)
+    seed_arr = jnp.zeros((1,), jnp.int32) if seed is None else jnp.asarray(seed, jnp.int32).reshape(1)
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -154,15 +177,16 @@ def _attention_pallas(q, k, v, bias, lut, counts, *, block_q, block_k, causal, i
             jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
         ),
         interpret=interpret,
-    )(jnp.asarray(counts), jnp.asarray(lut), qr, kr, vr, bias_r)
+    )(seed_arr, jnp.asarray(counts), jnp.asarray(lut), qr, kr, vr, bias_r)
     return out.reshape(B, H, S, D), lse.reshape(BH, S)
 
 
-def _attn_bwd_dq_kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref,
+def _attn_bwd_dq_kernel(seed_ref, counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref,
                         do_ref, lse_ref, delta_ref, dq_ref,
-                        *, num_heads, block_q, block_k, scale, causal):
+                        *, num_heads, block_q, block_k, scale, causal, dropout_rate):
     """dq for one (bh, q-block-row): dq = scale * sum_j ds_j @ k_j with
-    ds = p * (dO @ v^T - delta) and p = exp(s - lse)."""
+    ds = p * (mask * dO @ v^T - delta) and p = exp(s - lse). The dropout mask
+    regenerates from (seed, bh, qi, kj) — identical to the forward's."""
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     h = jax.lax.rem(bh, num_heads)
@@ -188,6 +212,8 @@ def _attn_bwd_dq_kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref,
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            dp = dp * _dropout_keep(seed_ref, bh, qi, kj, block_q, block_k, dropout_rate)
         ds = p * (dp - delta[:, None])
         return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
@@ -196,11 +222,14 @@ def _attn_bwd_dq_kernel(counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _attn_bwd_dkv_kernel(qcounts_ref, qlut_ref, q_ref, k_ref, v_ref, bias_ref,
+def _attn_bwd_dkv_kernel(seed_ref, qcounts_ref, qlut_ref, q_ref, k_ref, v_ref, bias_ref,
                          do_ref, lse_ref, delta_ref, dk_ref, dv_ref, db_ref,
-                         *, num_heads, block_q, block_k, scale, causal):
+                         *, num_heads, block_q, block_k, scale, causal, dropout_rate):
     """dk/dv/dbias for one (bh, k-block-column), looping the transposed LUT's
-    q blocks: dv = sum p^T dO; dk = sum ds^T (scale*q); dbias = sum_rows ds."""
+    q blocks: dv = sum (mask*p)^T dO; dk = sum ds^T (scale*q); dbias =
+    sum_rows ds. The dropout mask regenerates with the same (seed, bh, qi,
+    kj) ordering as the forward, regardless of this kernel's transposed
+    iteration order."""
     bh = pl.program_id(0)
     kj = pl.program_id(1)
     h = jax.lax.rem(bh, num_heads)
@@ -226,10 +255,15 @@ def _attn_bwd_dkv_kernel(qcounts_ref, qlut_ref, q_ref, k_ref, v_ref, bias_ref,
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             s = jnp.where(q_pos >= k_pos, s, -1e30)
         p = jnp.exp(s - lse_i[:, None])
-        dv = dv + jax.lax.dot_general(p, do_i, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do_i, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        p_drop = p
+        if dropout_rate > 0.0:
+            keep = _dropout_keep(seed_ref, bh, qi, kj, block_q, block_k, dropout_rate)
+            p_drop = p * keep
+            dp = dp * keep
+        dv = dv + jax.lax.dot_general(p_drop, do_i, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
         ds = p * (dp - delta_i[:, None])
         dk = dk + jax.lax.dot_general(ds, q_i, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -244,7 +278,8 @@ def _attn_bwd_dkv_kernel(qcounts_ref, qlut_ref, q_ref, k_ref, v_ref, bias_ref,
 
 
 def _attention_pallas_bwd(q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts,
-                          *, block_q, block_k, causal, interpret=False):
+                          *, block_q, block_k, causal, interpret=False,
+                          dropout_rate=0.0, seed=None):
     """Flash backward: returns (dq, dk, dv, dbias[B,S])."""
     B, H, S, D = q.shape
     BH = B * H
@@ -258,9 +293,11 @@ def _attention_pallas_bwd(q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts
     delta_r = delta.reshape(BH, 1, S)
     lse_r = lse.reshape(BH, 1, S)
 
+    seed_arr = jnp.zeros((1,), jnp.int32) if seed is None else jnp.asarray(seed, jnp.int32).reshape(1)
+
     # dq: grid over q block rows
     dq_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(BH, S // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi, *_: (bh, qi, 0)),
@@ -275,15 +312,16 @@ def _attention_pallas_bwd(q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts
     )
     dq = pl.pallas_call(
         functools.partial(_attn_bwd_dq_kernel, num_heads=H, block_q=block_q,
-                          block_k=block_k, scale=scale, causal=causal),
+                          block_k=block_k, scale=scale, causal=causal,
+                          dropout_rate=dropout_rate),
         grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         interpret=interpret,
-    )(jnp.asarray(counts), jnp.asarray(lut), qr, kr, vr, bias_r, dor, lse_r, delta_r)
+    )(seed_arr, jnp.asarray(counts), jnp.asarray(lut), qr, kr, vr, bias_r, dor, lse_r, delta_r)
 
     # dk/dv/dbias: grid over k block columns with the TRANSPOSED LUT
     dkv_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(BH, S // block_k),
         in_specs=[
             pl.BlockSpec((1, S, D), lambda bh, kj, *_: (bh, 0, 0)),
@@ -302,7 +340,8 @@ def _attention_pallas_bwd(q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts
     )
     dk, dv, db = pl.pallas_call(
         functools.partial(_attn_bwd_dkv_kernel, num_heads=H, block_q=block_q,
-                          block_k=block_k, scale=scale, causal=causal),
+                          block_k=block_k, scale=scale, causal=causal,
+                          dropout_rate=dropout_rate),
         grid_spec=dkv_spec,
         out_shape=(
             jax.ShapeDtypeStruct((BH, S, D), k.dtype),
@@ -310,7 +349,7 @@ def _attention_pallas_bwd(q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts
             jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
         ),
         interpret=interpret,
-    )(jnp.asarray(qcounts), jnp.asarray(qlut), qr, kr, vr, bias_r, dor, lse_r, delta_r)
+    )(seed_arr, jnp.asarray(qcounts), jnp.asarray(qlut), qr, kr, vr, bias_r, dor, lse_r, delta_r)
 
     unrs = lambda t: t.reshape(B, H, S, D)
     dbias = db.reshape(B, H, S).sum(axis=1).astype(bias.dtype)
@@ -321,7 +360,8 @@ def _attention_pallas_bwd(q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts
 # jnp reference path (non-TPU backends + the recompute backward)
 # ---------------------------------------------------------------------------
 
-def _attention_reference(q, k, v, bias, layout_mask, *, causal):
+def _attention_reference(q, k, v, bias, layout_mask, *, causal,
+                         dropout_rate=0.0, seed=None):
     B, H, S, D = q.shape
     scale = 1.0 / np.sqrt(D)
     s = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
@@ -337,6 +377,13 @@ def _attention_reference(q, k, v, bias, layout_mask, *, causal):
     l = jnp.sum(p, axis=-1, keepdims=True)
     alive = m > -1e29
     probs = jnp.where(alive, p / jnp.where(l > 0, l, 1.0), 0.0)
+    if dropout_rate > 0.0 and seed is not None:
+        # Seed-deterministic prob dropout (same semantics as the Pallas
+        # kernels' in-kernel PRNG; the bit streams differ between backends,
+        # which is fine — dropout is stochastic regularization).
+        key = jax.random.PRNGKey(jnp.asarray(seed).reshape(())[()].astype(jnp.uint32))
+        keep = jax.random.bernoulli(key, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
     out = jnp.einsum("bhst,bhtd->bhsd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
 
@@ -382,17 +429,19 @@ def _luts_for(layout, H, S, block):
     return lut, counts, qlut, qcounts
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _attention(q, k, v, bias, layout_key, block, causal, force_ref):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _attention(q, k, v, bias, seed, layout_key, block, causal, force_ref, dropout_rate):
     layout = _LAYOUTS.get(layout_key) if layout_key is not None else None
     if force_ref or not _on_tpu():
         return _attention_reference(
-            q, k, v, bias, _expand_layout_mask(layout, q.shape[2], block), causal=causal
+            q, k, v, bias, _expand_layout_mask(layout, q.shape[2], block),
+            causal=causal, dropout_rate=dropout_rate, seed=seed,
         )
     B, H, S, D = q.shape
     lut, counts, _, _ = _luts_for(layout, H, S, block)
     out, _ = _attention_pallas(
-        q, k, v, bias, lut, counts, block_q=block, block_k=block, causal=causal
+        q, k, v, bias, lut, counts, block_q=block, block_k=block, causal=causal,
+        dropout_rate=dropout_rate, seed=seed,
     )
     return out
 
@@ -401,42 +450,52 @@ def _on_tpu():
     return jax.default_backend() == "tpu"
 
 
-def _attention_fwd(q, k, v, bias, layout_key, block, causal, force_ref):
+def _attention_fwd(q, k, v, bias, seed, layout_key, block, causal, force_ref, dropout_rate):
     layout = _LAYOUTS.get(layout_key) if layout_key is not None else None
     if force_ref or not _on_tpu():
         out = _attention_reference(
-            q, k, v, bias, _expand_layout_mask(layout, q.shape[2], block), causal=causal
+            q, k, v, bias, _expand_layout_mask(layout, q.shape[2], block),
+            causal=causal, dropout_rate=dropout_rate, seed=seed,
         )
-        return out, (q, k, v, bias, None, None)
+        return out, (q, k, v, bias, seed, None, None)
     B, H, S, D = q.shape
     lut, counts, _, _ = _luts_for(layout, H, S, block)
     out, lse = _attention_pallas(
-        q, k, v, bias, lut, counts, block_q=block, block_k=block, causal=causal
+        q, k, v, bias, lut, counts, block_q=block, block_k=block, causal=causal,
+        dropout_rate=dropout_rate, seed=seed,
     )
-    return out, (q, k, v, bias, out, lse)
+    return out, (q, k, v, bias, seed, out, lse)
 
 
-def _attention_bwd(layout_key, block, causal, force_ref, res, g):
-    """Flash backward kernels on the Pallas path (O(S*D) memory); dense
-    rematerialized VJP on the reference path."""
-    q, k, v, bias, out, lse = res
+def _attention_bwd(layout_key, block, causal, force_ref, dropout_rate, res, g):
+    """Flash backward kernels on the Pallas path (O(S*D) memory, dropout mask
+    regenerated in-kernel from the saved seed); dense rematerialized VJP on
+    the reference path (same seed reproduces the same mask)."""
+    q, k, v, bias, seed, out, lse = res
     layout = _LAYOUTS.get(layout_key) if layout_key is not None else None
+    seed_ct = (
+        None if seed is None
+        else np.zeros(np.shape(seed), jax.dtypes.float0)
+    )
 
     if lse is not None:
         B, H, S, D = q.shape
         lut, counts, qlut, qcounts = _luts_for(layout, H, S, block)
-        return _attention_pallas_bwd(
+        dq, dk, dv, dbias = _attention_pallas_bwd(
             q, k, v, bias, out, lse, g, lut, counts, qlut, qcounts,
             block_q=block, block_k=block, causal=causal,
+            dropout_rate=dropout_rate, seed=seed,
         )
+        return dq, dk, dv, dbias, seed_ct
 
     def f(q, k, v, bias):
         return _attention_reference(
-            q, k, v, bias, _expand_layout_mask(layout, q.shape[2], block), causal=causal
+            q, k, v, bias, _expand_layout_mask(layout, q.shape[2], block),
+            causal=causal, dropout_rate=dropout_rate, seed=seed,
         )
 
     _, vjp = jax.vjp(f, q, k, v, bias)
-    return vjp(g)
+    return vjp(g) + (seed_ct,)
 
 
 _attention.defvjp(_attention_fwd, _attention_bwd)
@@ -455,11 +514,31 @@ def _register_layout(layout):
 
 
 def flash_attention(q, k, v, mask=None, layout=None, block=DEFAULT_BLOCK,
-                    causal=False, force_reference=False):
+                    causal=False, force_reference=False,
+                    dropout_rate=0.0, dropout_rng=None):
     """Fused attention. q,k,v: [B,H,S,D]; ``mask``: additive [B,1,1,S] (or
     [B,S]) key bias; ``layout``: optional [H, S/block, S/block] 0/1 block
-    sparsity; ``causal`` adds the autoregressive mask in-kernel."""
+    sparsity; ``causal`` adds the autoregressive mask in-kernel.
+
+    ``dropout_rate`` > 0 (with a ``dropout_rng`` PRNG key) applies dropout to
+    the softmax probs IN-KERNEL: the mask is regenerated from a seed in the
+    backward kernels instead of being stored, so memory stays O(S*D) — the
+    fused-softmax-dropout capability of the reference's transformer kernels
+    (csrc/transformer/{softmax,dropout}_kernels.cu). The TPU kernel and the
+    reference path draw from different PRNGs (same distribution)."""
     B, H, S, D = q.shape
+    if dropout_rate > 0.0:
+        if not (0.0 < dropout_rate < 1.0):
+            raise ValueError(
+                f"dropout_rate must be in [0, 1), got {dropout_rate} "
+                "(a fraction, not a percentage)"
+            )
+        if dropout_rng is None:
+            raise ValueError("dropout_rate > 0 requires dropout_rng")
+        seed = jax.random.randint(dropout_rng, (1,), 0, 2**31 - 1, dtype=jnp.int32)
+    else:
+        seed = None
+        dropout_rate = 0.0
     if S % block != 0:
         # Unaligned sequence: fall back to the dense reference path.
         force_reference = True
@@ -480,4 +559,5 @@ def flash_attention(q, k, v, mask=None, layout=None, block=DEFAULT_BLOCK,
     else:
         bias = mask
     key = _register_layout(layout)
-    return _attention(q, k, v, bias, key, block, causal, force_reference)
+    return _attention(q, k, v, bias, seed, key, block, causal, force_reference,
+                      float(dropout_rate))

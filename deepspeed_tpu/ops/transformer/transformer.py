@@ -100,18 +100,25 @@ def _attention_core(q, k, v, mask, dropout_ratio, deterministic, dropout_rng,
     Shapes: q,k,v = [B, H, S, D]; mask = [B, 1, 1, S] additive key bias;
     ``causal`` applies autoregressive masking (in-kernel on the fused path).
     """
-    if use_pallas and (deterministic or dropout_ratio == 0.0):
+    if use_pallas:
         from deepspeed_tpu.ops.transformer.attention import flash_attention
 
-        # The fused kernel takes a KEY bias ([B,1,1,S] / [B,S]) plus an
-        # in-kernel causal flag. A full [.,.,S,S] mask must either be
-        # recognized as causal (concrete arrays only) or fall through to the
-        # general jnp path — collapsing it to a key bias would be wrong.
-        if mask is None or (mask.ndim == 4 and mask.shape[-2] == 1 and mask.shape[1] == 1):
-            return flash_attention(q, k, v, mask, causal=causal)
-        if not causal and mask.ndim == 4 and mask.shape[-2] == mask.shape[-1]:
-            if _is_causal_mask(mask):
-                return flash_attention(q, k, v, None, causal=True)
+        rate = 0.0 if deterministic else float(dropout_ratio)
+        if rate == 0.0 or dropout_rng is not None:
+            # Attention-prob dropout runs IN-KERNEL (mask regenerated from a
+            # seed in backward — the reference's fused softmax-dropout
+            # capability), so training with attn dropout stays on the fused
+            # path instead of falling back to the jnp einsum chain.
+            kw = dict(dropout_rate=rate, dropout_rng=dropout_rng if rate > 0 else None)
+            # The fused kernel takes a KEY bias ([B,1,1,S] / [B,S]) plus an
+            # in-kernel causal flag. A full [.,.,S,S] mask must either be
+            # recognized as causal (concrete arrays only) or fall through to
+            # the general jnp path — collapsing it to a key bias would be wrong.
+            if mask is None or (mask.ndim == 4 and mask.shape[-2] == 1 and mask.shape[1] == 1):
+                return flash_attention(q, k, v, mask, causal=causal, **kw)
+            if not causal and mask.ndim == 4 and mask.shape[-2] == mask.shape[-1]:
+                if _is_causal_mask(mask):
+                    return flash_attention(q, k, v, None, causal=True, **kw)
 
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     scores = jnp.einsum("bhsd,bhtd->bhst", q, k) * scale

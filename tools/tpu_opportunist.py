@@ -129,7 +129,39 @@ refs = flash_attention(q, k, v, layout=layout, causal=True, force_reference=True
 serr = float(jnp.max(jnp.abs(os_ - refs)))
 out["sparse_causal_max_err"] = serr
 
-out["ok"] = bool(err < 2e-2 and gerr < 2e-1 and serr < 2e-2)
+# 4) in-kernel dropout: Mosaic compile of fwd+bwd with the TPU PRNG,
+# determinism, keep-rate, and the bwd-mask == fwd-mask invariant via the
+# identity-V trick (V = I makes the output the dropped prob matrix itself,
+# and dL/dV for L = sum(out) must equal its row sums).
+rate = 0.3
+rngd = jax.random.PRNGKey(5)
+t0 = time.time()
+od1 = flash_attention(q, k, v, dropout_rate=rate, dropout_rng=rngd)
+od2 = flash_attention(q, k, v, dropout_rate=rate, dropout_rng=rngd)
+gd = jax.grad(lambda a, b, c: jnp.sum(
+    flash_attention(a, b, c, dropout_rate=rate, dropout_rng=rngd) ** 2),
+    argnums=(0, 1, 2))(q, k, v)
+jax.block_until_ready((od1, od2, gd))
+out["dropout_compile_s"] = round(time.time() - t0, 1)
+out["dropout_deterministic"] = bool(float(jnp.max(jnp.abs(od1 - od2))) == 0.0)
+
+Si = 128
+qi = jnp.asarray(rng.randn(1, 2, Si, Si), jnp.float32) * 0.1
+eye = jnp.broadcast_to(jnp.eye(Si, dtype=jnp.float32), (1, 2, Si, Si))
+pd = flash_attention(qi, qi, eye, dropout_rate=rate, dropout_rng=rngd)  # P'
+zero_frac = float(jnp.mean((pd == 0.0).astype(jnp.float32)))
+out["dropout_zero_frac"] = round(zero_frac, 3)  # ~= rate
+dv = jax.grad(lambda v_: jnp.sum(
+    flash_attention(qi, qi, v_, dropout_rate=rate, dropout_rng=rngd)))(eye)
+mask_err = float(jnp.max(jnp.abs(dv[..., 0] - pd.sum(axis=2))))
+out["dropout_bwd_mask_err"] = mask_err  # 0 iff bwd regenerates fwd's mask
+
+out["ok"] = bool(
+    err < 2e-2 and gerr < 2e-1 and serr < 2e-2
+    and out["dropout_deterministic"]
+    and abs(zero_frac - rate) < 0.05
+    and mask_err < 1e-4
+)
 print("SMOKE_JSON " + json.dumps(out))
 """
 
@@ -183,6 +215,7 @@ def main():
         # the existing TPU_BENCH.json stays as the fallback until the new
         # measurement lands.
         bench_done = False
+        smoke_done = False
     sleep = SLEEP_MIN
     attempt = 0
     while not (smoke_done and bench_done):
