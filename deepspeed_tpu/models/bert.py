@@ -45,11 +45,11 @@ class BertConfig:
     checkpoint_policy: str = "nothing"
 
     def __post_init__(self):
-        if self.checkpoint_policy not in ("nothing", "dots"):
-            raise ValueError(
-                f"checkpoint_policy must be 'nothing' or 'dots', got "
-                f"{self.checkpoint_policy!r}"
-            )
+        from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+            resolve_remat_policy,
+        )
+
+        resolve_remat_policy(self.checkpoint_policy)  # validates
 
     @staticmethod
     def bert_large(**kw):
@@ -119,10 +119,12 @@ class BertEncoder(nn.Module):
         if cfg.checkpoint_activations:
             # Activation checkpointing: recompute each layer in backward
             # (reference runtime/activation_checkpointing/checkpointing.py).
-            policy = None
-            if cfg.checkpoint_policy == "dots":
-                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            body = nn.remat(body, prevent_cse=False, static_argnums=(), policy=policy)
+            from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+                resolve_remat_policy,
+            )
+
+            body = nn.remat(body, prevent_cse=False, static_argnums=(),
+                            policy=resolve_remat_policy(cfg.checkpoint_policy))
         ScanStack = nn.scan(
             body,
             variable_axes={"params": 0},

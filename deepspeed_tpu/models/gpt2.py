@@ -32,6 +32,16 @@ class GPT2Config:
     attention_probs_dropout_prob: float = 0.1
     initializer_range: float = 0.02
     checkpoint_activations: bool = False
+    # "nothing" (full recompute) or "dots" (save matmul outputs; recompute
+    # only elementwise) — see models/bert.py BertConfig.checkpoint_policy.
+    checkpoint_policy: str = "nothing"
+
+    def __post_init__(self):
+        from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+            resolve_remat_policy,
+        )
+
+        resolve_remat_policy(self.checkpoint_policy)  # validates
 
     @staticmethod
     def gpt2_xl(**kw):
@@ -103,7 +113,12 @@ class GPT2Model(nn.Module):
         mask = None
         body = _ScannedDecoderLayer
         if cfg.checkpoint_activations:
-            body = nn.remat(body, prevent_cse=False)
+            from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+                resolve_remat_policy,
+            )
+
+            body = nn.remat(body, prevent_cse=False,
+                            policy=resolve_remat_policy(cfg.checkpoint_policy))
         ScanStack = nn.scan(
             body,
             variable_axes={"params": 0},

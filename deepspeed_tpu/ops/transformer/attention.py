@@ -527,12 +527,12 @@ def flash_attention(q, k, v, mask=None, layout=None, block=DEFAULT_BLOCK,
     (csrc/transformer/{softmax,dropout}_kernels.cu). The TPU kernel and the
     reference path draw from different PRNGs (same distribution)."""
     B, H, S, D = q.shape
+    if not (0.0 <= dropout_rate < 1.0):
+        raise ValueError(
+            f"dropout_rate must be in [0, 1), got {dropout_rate} "
+            "(a fraction, not a percentage)"
+        )
     if dropout_rate > 0.0:
-        if not (0.0 < dropout_rate < 1.0):
-            raise ValueError(
-                f"dropout_rate must be in [0, 1), got {dropout_rate} "
-                "(a fraction, not a percentage)"
-            )
         if dropout_rng is None:
             raise ValueError("dropout_rate > 0 requires dropout_rng")
         seed = jax.random.randint(dropout_rng, (1,), 0, 2**31 - 1, dtype=jnp.int32)

@@ -146,6 +146,23 @@ def checkpoint_wrapper(fn):
     return jax.checkpoint(fn, policy=_remat_policy(), prevent_cse=False)
 
 
+# Named remat policies shared by the model configs (BertConfig/GPT2Config
+# checkpoint_policy): ONE vocabulary and mapping, so models can't drift.
+REMAT_POLICIES = ("nothing", "dots")
+
+
+def resolve_remat_policy(name):
+    """checkpoint_policy name -> jax.checkpoint policy (None = save nothing).
+    'dots' saves matmul outputs so backward recomputes only elementwise ops."""
+    if name not in REMAT_POLICIES:
+        raise ValueError(
+            f"checkpoint_policy must be one of {REMAT_POLICIES}, got {name!r}"
+        )
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return None
+
+
 def partition_activations_in_checkpoint(partition_activation):
     global _PARTITION_ACTIVATIONS
     _PARTITION_ACTIVATIONS = partition_activation

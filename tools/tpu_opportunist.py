@@ -24,6 +24,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "TPU_ATTEMPTS.log")
 SMOKE_OUT = os.path.join(REPO, "TPU_SMOKE.json")
+SEQ512_OUT = os.path.join(REPO, "TPU_BENCH_SEQ512.json")
 # bench.py caches every successful real-TPU measurement here and falls back
 # to it when the tunnel is down at round end; the watcher's job is to make
 # sure that cache gets populated the moment the tunnel answers.
@@ -180,10 +181,11 @@ def run_smoke():
     return None, f"rc={r.returncode}: {(r.stderr or r.stdout).strip()[-800:]}"
 
 
-def run_bench():
+def run_bench(env_extra=None):
     """Run bench.py's full orchestration (probe + OOM ladder); on success it
     writes the cached TPU measurement to TPU_BENCH.json itself."""
     env = dict(os.environ)
+    env.update(env_extra or {})
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
@@ -201,24 +203,28 @@ def run_bench():
     return None, f"rc={r.returncode}: {(r.stderr or r.stdout).strip()[-800:]}"
 
 
+def _bench_file_ok(path):
+    try:
+        with open(path) as f:
+            return "tpu" in json.load(f).get("device_kind", "").lower()
+    except Exception:  # noqa: BLE001
+        return False
+
+
 def main():
     smoke_done = os.path.exists(SMOKE_OUT)
-    bench_done = False
-    if os.path.exists(BENCH_OUT):
-        try:
-            with open(BENCH_OUT) as f:
-                bench_done = "tpu" in json.load(f).get("device_kind", "").lower()
-        except Exception:  # noqa: BLE001
-            pass
+    bench_done = _bench_file_ok(BENCH_OUT)
+    seq512_done = _bench_file_ok(SEQ512_OUT)
     if os.environ.get("TPU_REFRESH") == "1":
         # re-measure even though artifacts exist (e.g. after a perf change);
         # the existing TPU_BENCH.json stays as the fallback until the new
         # measurement lands.
         bench_done = False
         smoke_done = False
+        seq512_done = False
     sleep = SLEEP_MIN
     attempt = 0
-    while not (smoke_done and bench_done):
+    while not (smoke_done and bench_done and seq512_done):
         attempt += 1
         ok, info = probe()
         if not ok:
@@ -246,9 +252,27 @@ def main():
                 bench_done = True
             else:
                 log(f"bench FAILED: {err or res}")
-        if not (smoke_done and bench_done):
+        if bench_done and not seq512_done:
+            # secondary headline: seq512 (reference: 53 Tflops / 52
+            # samples/sec on V100, fastest-bert post :38-39). mb ladder
+            # starts at 16 — seq512 activations are 4x seq128's. First-class
+            # artifact: retried every cycle until it lands.
+            res2, err2 = run_bench({
+                "BENCH_SEQ": "512", "BENCH_BATCH": "16",
+                # don't clobber the primary seq128 cache / skip CPU fallback
+                "BENCH_NO_CACHE": "1",
+            })
+            if (res2 is not None and not res2.get("cached")
+                    and "tpu" in str(res2.get("device_kind", "")).lower()):
+                with open(SEQ512_OUT, "w") as f:
+                    f.write(json.dumps(res2) + "\n")
+                log(f"bench seq512: {json.dumps(res2)}")
+                seq512_done = True
+            else:
+                log(f"bench seq512 FAILED: {err2 or res2}")
+        if not (smoke_done and bench_done and seq512_done):
             time.sleep(SLEEP_MIN)
-    log("all done: smoke + bench recorded on TPU")
+    log("all done: smoke + bench (seq128 + seq512) recorded on TPU")
     return 0
 
 
