@@ -19,6 +19,9 @@ from deepspeed_tpu.ops.transformer.transformer import (
     DeepSpeedTransformerConfig,
     DeepSpeedTransformerLayer,
 )
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+    resolve_remat_policy,
+)
 
 
 @dataclass
@@ -45,10 +48,6 @@ class BertConfig:
     checkpoint_policy: str = "nothing"
 
     def __post_init__(self):
-        from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
-            resolve_remat_policy,
-        )
-
         resolve_remat_policy(self.checkpoint_policy)  # validates
 
     @staticmethod
@@ -119,10 +118,6 @@ class BertEncoder(nn.Module):
         if cfg.checkpoint_activations:
             # Activation checkpointing: recompute each layer in backward
             # (reference runtime/activation_checkpointing/checkpointing.py).
-            from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
-                resolve_remat_policy,
-            )
-
             body = nn.remat(body, prevent_cse=False, static_argnums=(),
                             policy=resolve_remat_policy(cfg.checkpoint_policy))
         ScanStack = nn.scan(

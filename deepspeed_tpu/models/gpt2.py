@@ -15,6 +15,9 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from deepspeed_tpu.models.bert import cross_entropy
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+    resolve_remat_policy,
+)
 from deepspeed_tpu.ops.transformer.transformer import (
     DeepSpeedTransformerConfig,
     DeepSpeedTransformerLayer,
@@ -37,10 +40,6 @@ class GPT2Config:
     checkpoint_policy: str = "nothing"
 
     def __post_init__(self):
-        from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
-            resolve_remat_policy,
-        )
-
         resolve_remat_policy(self.checkpoint_policy)  # validates
 
     @staticmethod
@@ -113,10 +112,6 @@ class GPT2Model(nn.Module):
         mask = None
         body = _ScannedDecoderLayer
         if cfg.checkpoint_activations:
-            from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
-                resolve_remat_policy,
-            )
-
             body = nn.remat(body, prevent_cse=False,
                             policy=resolve_remat_policy(cfg.checkpoint_policy))
         ScanStack = nn.scan(
