@@ -274,7 +274,10 @@ def _cached_tpu_result():
     try:
         with open(_TPU_CACHE) as f:
             cached = json.loads(f.read().strip())
-        if "tpu" in str(cached.get("device_kind", "")).lower():
+        # belt-and-braces: a cache file written by older code (or by hand)
+        # could hold a non-seq128 record; never serve it as the headline
+        if ("tpu" in str(cached.get("device_kind", "")).lower()
+                and "seq128" in str(cached.get("metric", ""))):
             return cached
     except (OSError, ValueError):
         pass
@@ -303,11 +306,13 @@ def main():
             result, err, oom = _run_child({"BENCH_BATCH": str(mb)}, child_timeout)
             if result is not None:
                 # Guard the cache: a silent in-child CPU fallback must not
-                # clobber a previously recorded genuine TPU measurement, and
-                # the cache holds ONLY the primary seq128 headline — keyed on
-                # the measured config, not a caller-supplied opt-out env.
+                # clobber a previously recorded genuine TPU measurement; the
+                # cache holds ONLY the primary seq128 headline (keyed on the
+                # measured config); and BENCH_NO_CACHE=1 opts experimental
+                # runs (A/B switches, tiny-step probes) out of writing it.
                 if ("tpu" in str(result.get("device_kind", "")).lower()
-                        and os.environ.get("BENCH_SEQ", "128") == "128"):
+                        and os.environ.get("BENCH_SEQ", "128") == "128"
+                        and os.environ.get("BENCH_NO_CACHE") != "1"):
                     _record_tpu_result(result)
                 print(json.dumps(result))
                 return 0

@@ -27,7 +27,7 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from deepspeed_tpu.ops.transformer.attention import _attention_reference
+from deepspeed_tpu.ops.transformer.attention import flash_attention
 
 
 def _seq_to_heads(x, axis_name, W):
@@ -58,7 +58,10 @@ def ulysses_attention_local(q, k, v, bias, axis_name, causal=False):
     kh = _seq_to_heads(k, axis_name, W)
     vh = _seq_to_heads(v, axis_name, W)
     full_bias = jax.lax.all_gather(bias, axis_name, axis=1, tiled=True)  # [B, S]
-    out = _attention_reference(qh, kh, vh, full_bias, None, causal=causal)
+    # Fused/flash local attention: on TPU this is the Pallas kernel over the
+    # full local sequence (O(S*D) memory — the point of head-sharding), with
+    # the dense reference fallback on other backends / unaligned S.
+    out = flash_attention(qh, kh, vh, full_bias, causal=causal)
     return _heads_to_seq(out, axis_name, W)
 
 
@@ -82,10 +85,14 @@ def ulysses_attention(q, k, v, mask=None, mesh=None, axis_name="data", causal=Fa
 
     seq = PartitionSpec(None, None, axis_name, None)
     bseq = PartitionSpec(None, axis_name)
-    fn = shard_map(
-        functools.partial(ulysses_attention_local, axis_name=axis_name, causal=causal),
-        mesh=mesh,
-        in_specs=(seq, seq, seq, bseq),
-        out_specs=seq,
+    kwargs = dict(
+        mesh=mesh, in_specs=(seq, seq, seq, bseq), out_specs=seq,
     )
+    local = functools.partial(ulysses_attention_local, axis_name=axis_name, causal=causal)
+    try:
+        # new-style shard_map: vma checking must be off for pallas_call
+        # (the flash kernel's ShapeDtypeStructs carry no vma annotations)
+        fn = shard_map(local, check_vma=False, **kwargs)
+    except TypeError:  # pragma: no cover — older jax
+        fn = shard_map(local, check_rep=False, **kwargs)
     return fn(q, k, v, bias)
