@@ -90,7 +90,12 @@ def _attn_kernel(seed_ref, counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref,
     qi = pl.program_id(1)
     h = jax.lax.rem(bh, num_heads)
 
-    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    # MXU dtype discipline: matmul OPERANDS stay in the input dtype (bf16
+    # inputs hit the native bf16 MXU path — fp32 matmuls are several times
+    # slower on TPU) while every accumulation/softmax runs in fp32 via
+    # preferred_element_type. Scale applies to the fp32 scores, not to q.
+    q = q_ref[0]                                      # [BQ, D], input dtype
+    in_dtype = q.dtype
     D = q.shape[-1]
     count = counts_ref[h, qi]
 
@@ -99,11 +104,11 @@ def _attn_kernel(seed_ref, counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref,
     def body(n, carry):
         m, l, acc = carry
         kj = lut_ref[h, qi, n]
-        k_blk = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kj * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kj * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )                                              # [BQ, BK]
+        ) * scale                                      # [BQ, BK] fp32
         s = s + bias_ref[0, 0, pl.ds(kj * block_k, block_k)].astype(jnp.float32)[None, :]
         if causal:
             k_pos = kj * block_k + jax.lax.broadcasted_iota(
@@ -118,7 +123,8 @@ def _attn_kernel(seed_ref, counts_ref, lut_ref, q_ref, k_ref, v_ref, bias_ref,
         if dropout_rate > 0.0:
             p_acc = p * _dropout_keep(seed_ref, bh, qi, kj, block_q, block_k, dropout_rate)
         acc_new = acc * corr + jax.lax.dot_general(
-            p_acc, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p_acc.astype(in_dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc_new
 
@@ -191,8 +197,9 @@ def _attn_bwd_dq_kernel(seed_ref, counts_ref, lut_ref, q_ref, k_ref, v_ref, bias
     qi = pl.program_id(1)
     h = jax.lax.rem(bh, num_heads)
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]                      # input dtype; scale applied to scores
+    do = do_ref[0]
+    in_dtype = q.dtype
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
     D = q.shape[-1]
@@ -201,10 +208,10 @@ def _attn_bwd_dq_kernel(seed_ref, counts_ref, lut_ref, q_ref, k_ref, v_ref, bias
 
     def body(n, dq):
         kj = lut_ref[h, qi, n]
-        k_blk = k_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kj * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kj * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         s = s + bias_ref[0, 0, pl.ds(kj * block_k, block_k)].astype(jnp.float32)[None, :]
         if causal:
             k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -215,7 +222,7 @@ def _attn_bwd_dq_kernel(seed_ref, counts_ref, lut_ref, q_ref, k_ref, v_ref, bias
         if dropout_rate > 0.0:
             dp = dp * _dropout_keep(seed_ref, bh, qi, kj, block_q, block_k, dropout_rate)
         ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+        return dq + jax.lax.dot_general(ds.astype(in_dtype), k_blk, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, count, body, jnp.zeros((block_q, D), jnp.float32))
@@ -234,8 +241,9 @@ def _attn_bwd_dkv_kernel(seed_ref, qcounts_ref, qlut_ref, q_ref, k_ref, v_ref, b
     kj = pl.program_id(1)
     h = jax.lax.rem(bh, num_heads)
 
-    k_blk = k_ref[0].astype(jnp.float32)
-    v_blk = v_ref[0].astype(jnp.float32)
+    k_blk = k_ref[0]                  # input dtype; scale folded at write-out
+    v_blk = v_ref[0]
+    in_dtype = k_blk.dtype
     bias_j = bias_ref[0, 0].astype(jnp.float32)
     D = k_blk.shape[-1]
     count = qcounts_ref[h, kj]
@@ -244,12 +252,12 @@ def _attn_bwd_dkv_kernel(seed_ref, qcounts_ref, qlut_ref, q_ref, k_ref, v_ref, b
     def body(n, carry):
         dk, dv, db = carry
         qi = qlut_ref[h, kj, n]
-        q_i = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * scale
-        do_i = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        q_i = q_ref[0, pl.ds(qi * block_q, block_q), :]
+        do_i = do_ref[0, pl.ds(qi * block_q, block_q), :]
         lse_i = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
         delta_i = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
         s = jax.lax.dot_general(q_i, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
         s = s + bias_j[None, :]
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -262,17 +270,17 @@ def _attn_bwd_dkv_kernel(seed_ref, qcounts_ref, qlut_ref, q_ref, k_ref, v_ref, b
             keep = _dropout_keep(seed_ref, bh, qi, kj, block_q, block_k, dropout_rate)
             p_drop = p * keep
             dp = dp * keep
-        dv = dv + jax.lax.dot_general(p_drop, do_i, (((0,), (0,)), ((), ())),
+        dv = dv + jax.lax.dot_general(p_drop.astype(in_dtype), do_i, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         ds = p * (dp - delta_i[:, None])
-        dk = dk + jax.lax.dot_general(ds, q_i, (((0,), (0,)), ((), ())),
+        dk = dk + jax.lax.dot_general(ds.astype(in_dtype), q_i, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         db = db + jnp.sum(ds, axis=0)
         return dk, dv, db
 
     zero = jnp.zeros((block_k, D), jnp.float32)
     dk, dv, db = jax.lax.fori_loop(0, count, body, (zero, zero, jnp.zeros((block_k,), jnp.float32)))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
     db_ref[0, 0] = db
 

@@ -281,3 +281,31 @@ def test_transformer_layer_training_uses_fused_path_with_dropout(monkeypatch):
     out = T._attention_core(q, k, v, None, 0.1, False, jax.random.PRNGKey(0))
     assert calls["n"] == 1
     assert out.shape == q.shape
+
+
+def test_bf16_kernel_matches_reference():
+    """bf16 inputs keep matmul operands in bf16 (native MXU path) with fp32
+    softmax/accumulation — numerics must track the fp32 reference within bf16
+    tolerance, fwd and bwd."""
+    q, k, v = rand_qkv(B=1, H=2, S=256, D=64, seed=21)
+    qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+    B, H, S, D = q.shape
+    bias = jnp.zeros((B, S), jnp.float32)
+    lut, counts = _dense_lut(H, S // 128, S // 128)
+    out_k, lse = _attention_pallas(qb, kb, vb, bias, lut, counts, block_q=128,
+                                   block_k=128, causal=False, interpret=True)
+    out_r = _attention_reference(q, k, v, bias, None, causal=False)
+    np.testing.assert_allclose(np.asarray(out_k, np.float32), np.asarray(out_r),
+                               atol=2e-2, rtol=2e-2)
+
+    from deepspeed_tpu.ops.transformer.attention import _attention_pallas_bwd, _luts_for
+    lut, counts, qlut, qcounts = _luts_for(None, H, S, 128)
+    g = jnp.ones_like(qb)
+    dq, dk, dv, db = _attention_pallas_bwd(
+        qb, kb, vb, bias, out_k, lse, g, lut, counts, qlut, qcounts,
+        block_q=128, block_k=128, causal=False, interpret=True)
+    g_ref = jax.grad(lambda q, k, v: jnp.sum(
+        _attention_reference(q, k, v, bias, None, causal=False)), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip((dq, dk, dv), g_ref):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b),
+                                   atol=5e-2, rtol=5e-2)
