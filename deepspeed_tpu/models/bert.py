@@ -205,6 +205,48 @@ class BertForPreTraining(nn.Module):
         return mlm_loss + nsp_loss
 
 
+class BertForQuestionAnswering(nn.Module):
+    """Extractive-QA (SQuAD) head: start/end span logits over the sequence.
+
+    Parity with the reference's BingBertSquad fine-tune subject
+    (``tests/unit/modeling.py`` BertForQuestionAnswering; driven by
+    ``tests/model/BingBertSquad`` and the 1-bit Adam blog's fine-tune runs):
+    a Dense(2) over the encoder output split into start/end logits; training
+    loss is the mean of the two position cross-entropies with out-of-span
+    positions clamped to the sequence length (reference clamps to
+    ``ignored_index`` and ignores it in the loss).
+    """
+
+    config: BertConfig
+    needs_rng = True
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, attention_mask,
+                 start_positions=None, end_positions=None, deterministic=False):
+        cfg = self.config
+        h, _, _ = BertModel(cfg, name="bert")(
+            input_ids, token_type_ids, attention_mask, deterministic
+        )
+        logits = nn.Dense(2, name="qa_outputs")(h)  # [B, S, 2]
+        start_logits = logits[..., 0]
+        end_logits = logits[..., 1]
+
+        if start_positions is None:
+            return start_logits, end_logits
+
+        S = start_logits.shape[1]
+        # positions outside [0, S) (answer truncated away) are ignored
+        start_positions = jnp.where(
+            (start_positions >= 0) & (start_positions < S), start_positions, -1
+        )
+        end_positions = jnp.where(
+            (end_positions >= 0) & (end_positions < S), end_positions, -1
+        )
+        start_loss = cross_entropy(start_logits, start_positions, ignore_index=-1)
+        end_loss = cross_entropy(end_logits, end_positions, ignore_index=-1)
+        return (start_loss + end_loss) / 2.0
+
+
 def init_bert(config, batch_size=2, seq_len=128, seed=0, dtype=jnp.float32):
     model = BertForPreTraining(config)
     ids = jnp.zeros((batch_size, seq_len), jnp.int32)

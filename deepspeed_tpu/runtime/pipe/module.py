@@ -107,6 +107,9 @@ class PipelineModule:
         # partitioner may need parameter counts, and stage slicing is cheap.
         self._built = [self._build_layer(i) for i in range(self._num_layers)]
 
+        # must exist before the 'parameters' balancer runs _count_layer_params
+        self._params = None  # per-layer param pytrees (None entries = stateless)
+
         # stage -> [start, end) layer range
         self.parts = self._partition_layers(self._partition_method)
 
@@ -115,8 +118,6 @@ class PipelineModule:
         for i, spec in enumerate(self._layer_specs):
             if isinstance(spec, TiedLayerSpec):
                 self.tied_specs.setdefault(spec.key, []).append(i)
-
-        self._params = None  # per-layer param pytrees (None entries = stateless)
 
     # -- construction ------------------------------------------------------
     def _build_layer(self, idx):

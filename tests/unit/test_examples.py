@@ -1,0 +1,56 @@
+"""Every examples/ script must run end-to-end (tiny shapes, CPU mesh).
+
+The reference drives its example models from tests/model/* against the
+external DeepSpeedExamples checkout; here the examples are in-repo and each
+asserts its own loss decreased, so executing main() IS the convergence smoke.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "..", "examples")
+
+
+def _run(name, argv):
+    spec = importlib.util.spec_from_file_location(
+        f"examples_{name}", os.path.join(EXAMPLES, f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(argv) == 0
+
+
+def test_cifar_cnn():
+    _run("cifar_cnn", ["--steps", "8", "--batch", "4"])
+
+
+def test_cifar_cnn_offload():
+    _run("cifar_cnn",
+         ["--steps", "10", "--batch", "4", "--lr", "3e-3", "--offload"])
+
+
+def test_bert_pretrain():
+    _run("bert_pretrain", ["--steps", "5", "--batch", "1", "--seq", "32"])
+
+
+def test_gpt2_pipeline():
+    _run("gpt2_pipeline", ["--steps", "4", "--batch", "2", "--seq", "16"])
+
+
+def test_sparse_attention_bert():
+    _run("sparse_attention_bert", ["--steps", "6", "--batch", "2", "--seq", "64"])
+
+
+@pytest.mark.parametrize("layout", ["bigbird"])
+def test_sparse_attention_layouts(layout):
+    _run("sparse_attention_bert",
+         ["--steps", "4", "--batch", "1", "--seq", "64", "--layout", layout])
+
+
+def test_onebit_adam_squad():
+    # freeze_step 6 of 10 -> 4 steps on the compressed path (the lr/freeze
+    # combination is stability-validated; see the example's freeze_step note)
+    _run("onebit_adam_squad",
+         ["--steps", "10", "--batch", "1", "--seq", "32", "--freeze-step", "6"])

@@ -17,6 +17,7 @@ otherwise. Never imports jax in the parent process.
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -239,8 +240,11 @@ def run_ab():
         return None, "attention A/B timed out"
     out = r.stdout.strip()
     # "(tpu)" in the device line guards against a mid-run tunnel drop making
-    # the child silently fall back to CPU and recording that as on-chip data.
-    if r.returncode == 0 and "pallas" in out and "(tpu)" in out:
+    # the child silently fall back to CPU; the row regex requires at least one
+    # actual pallas measurement (the header alone contains "pallas ms", so a
+    # substring check would pass on an empty table).
+    has_row = re.search(r"^\s*\d+\s+\d+\s+\d+\s+\d+\.\d+", out, re.MULTILINE)
+    if r.returncode == 0 and has_row and "(tpu)" in out:
         return out, None
     return None, f"rc={r.returncode}: {(r.stderr or out).strip()[-600:]}"
 
@@ -282,9 +286,19 @@ def _load_sweep():
         return []
 
 
+MAX_SWEEP_ATTEMPTS = 3
+
+
+def _sweep_settled(entry):
+    """An entry needs no further runs: it has a result, or it failed
+    terminally (config drift = deterministically unsatisfiable, or the
+    attempt budget is spent — each attempt can cost a full bench ladder)."""
+    return bool(entry.get("result")) or entry.get("terminal")
+
+
 def _sweep_complete():
     done = {json.dumps(e["config"], sort_keys=True)
-            for e in _load_sweep() if e.get("result")}
+            for e in _load_sweep() if _sweep_settled(e)}
     return all(json.dumps(c, sort_keys=True) in done for c in SWEEP_CONFIGS)
 
 
@@ -295,24 +309,32 @@ def run_sweep():
     a recorded result (this run or a previous watcher life) are skipped;
     returns True only when every config has landed, so a tunnel drop mid-sweep
     retries the missing ones next cycle instead of silencing them forever."""
-    prev = {json.dumps(e["config"], sort_keys=True): e
-            for e in _load_sweep() if e.get("result")}
+    prev = {json.dumps(e["config"], sort_keys=True): e for e in _load_sweep()}
     results = []
     for cfg in SWEEP_CONFIGS:
         key = json.dumps(cfg, sort_keys=True)
-        if key in prev:
-            results.append(prev[key])
+        old = prev.get(key)
+        if old is not None and _sweep_settled(old):
+            results.append(old)
             continue
+        attempts = (old or {}).get("attempts", 0) + 1
         env = dict(cfg)
         env["BENCH_NO_CACHE"] = "1"
         res, err = run_bench(env)
         fresh = _fresh_tpu(res)
+        terminal = False
         if fresh and not _matches_config(res, cfg):
+            # drift down the OOM ladder is deterministic — re-running would
+            # just re-measure (and re-discard) the same other config
             fresh, err = False, f"config drift (OOM ladder?): measured {res}"
+            terminal = True
         entry = {"config": cfg, "result": res if fresh else None,
-                 "error": None if fresh else (err or str(res))}
+                 "error": None if fresh else (err or str(res)),
+                 "attempts": attempts,
+                 "terminal": terminal or (not fresh and attempts >= MAX_SWEEP_ATTEMPTS)}
         results.append(entry)
-        log(f"sweep {cfg}: {json.dumps(res) if fresh else err}")
+        log(f"sweep {cfg}: {json.dumps(res) if fresh else err}"
+            + (" [terminal]" if entry["terminal"] else ""))
         with open(SWEEP_OUT, "w") as f:
             json.dump(results, f, indent=1)
     # rewrite the FULL list: skip-path entries appended after the last fresh
@@ -329,7 +351,7 @@ def run_sweep():
     if best is not None and best.get("value", 0.0) > current.get("value", 0.0):
         _record_headline(best)
         log(f"sweep winner promoted to headline: {json.dumps(best)}")
-    return all(e.get("result") for e in results)
+    return all(_sweep_settled(e) for e in results)
 
 
 def main():
