@@ -96,43 +96,75 @@ class _ScannedLayer(nn.Module):
     """Scan body: one transformer layer; params stack along the scan axis.
 
     ``deterministic`` is a static field (NOT part of the scan carry — a traced
-    bool there would break the Python-level dropout branch in the layer)."""
+    bool there would break the Python-level dropout branch in the layer).
+    ``pld`` enables progressive layer drop: the scanned xs carry
+    ``(layer_idx, theta)`` and the layer is stochastically bypassed with the
+    PLD paper's depth scaling, keep_prob(l) = 1 - ((l+1)/L)·(1-θ) — deeper
+    layers drop first. The coin draws from a dedicated "pld" RNG stream so
+    the dropout stream (and thus θ=1 numerics) is untouched.
+
+    Kept layers scale their delta by 1/p (inverted-dropout convention), so
+    E[output] equals the full layer and eval (all layers, unscaled) sees the
+    distribution training optimized — the reference's example-model PLD
+    leaves outputs unscaled and accepts that shift. At p==1 the raw layer
+    output is used unmodified, keeping θ=1 bit-identical to PLD off.
+    The bypass is a select, not a branch: under a scanned stack XLA
+    schedules statically, so the skipped layer's FLOPs are still executed
+    (conditional skip inside scan would break flax variable lifting);
+    PLD here buys the accuracy-per-sample effect, not step time."""
 
     layer_cfg: DeepSpeedTransformerConfig
     deterministic: bool = False
+    pld: bool = False
+    num_layers: int = 0
 
     @nn.compact
-    def __call__(self, carry, _):
+    def __call__(self, carry, xs):
         h, mask = carry
-        h = DeepSpeedTransformerLayer(self.layer_cfg)(h, mask, deterministic=self.deterministic)
-        return (h, mask), None
+        new_h = DeepSpeedTransformerLayer(self.layer_cfg)(h, mask, deterministic=self.deterministic)
+        if self.pld:
+            idx, theta = xs
+            p_keep = 1.0 - ((idx + 1.0) / float(self.num_layers)) * (1.0 - theta)
+            keep = jax.random.bernoulli(self.make_rng("pld"), p_keep)
+            inv_p = (1.0 / jnp.maximum(p_keep, 1e-6)).astype(h.dtype)
+            scaled = h + (new_h - h) * inv_p
+            kept_val = jnp.where(p_keep >= 1.0, new_h, scaled)
+            new_h = jnp.where(keep, kept_val, h)
+        return (new_h, mask), None
 
 
 class BertEncoder(nn.Module):
     config: BertConfig
 
     @nn.compact
-    def __call__(self, hidden_states, attention_mask, deterministic):
+    def __call__(self, hidden_states, attention_mask, deterministic, pld_theta=None):
         cfg = self.config
+        L = cfg.num_hidden_layers
         body = _ScannedLayer
         if cfg.checkpoint_activations:
             # Activation checkpointing: recompute each layer in backward
             # (reference runtime/activation_checkpointing/checkpointing.py).
             body = nn.remat(body, prevent_cse=False, static_argnums=(),
                             policy=resolve_remat_policy(cfg.checkpoint_policy))
+        pld = pld_theta is not None and not deterministic
         ScanStack = nn.scan(
             body,
             variable_axes={"params": 0},
-            split_rngs={"params": True, "dropout": True},
-            length=cfg.num_hidden_layers,
+            split_rngs={"params": True, "dropout": True, "pld": True},
+            length=L,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
+        xs = None
+        if pld:
+            theta = jnp.asarray(pld_theta, jnp.float32)
+            xs = (jnp.arange(L, dtype=jnp.float32), jnp.broadcast_to(theta, (L,)))
         # Explicit stable name: nn.remat would otherwise change the generated
         # param key ("ScanCheckpoint_ScannedLayer_0" vs "_ScannedLayer_0"),
         # breaking param trees initialized before the engine flips
         # checkpoint_activations per the ds_config.
-        (h, _), _ = ScanStack(cfg.layer_config(), deterministic, name="layers")(
-            (hidden_states, attention_mask), None
+        (h, _), _ = ScanStack(cfg.layer_config(), deterministic, pld, L,
+                              name="layers")(
+            (hidden_states, attention_mask), xs
         )
         return h
 
@@ -142,7 +174,8 @@ class BertModel(nn.Module):
     needs_rng = True
 
     @nn.compact
-    def __call__(self, input_ids, token_type_ids=None, attention_mask=None, deterministic=False):
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None, deterministic=False,
+                 progressive_layer_drop=False, pld_theta=None):
         cfg = self.config
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
@@ -153,7 +186,10 @@ class BertModel(nn.Module):
 
         h, embed_table = BertEmbeddings(cfg, name="embeddings")(input_ids, token_type_ids, deterministic)
         add_mask = add_mask.astype(h.dtype)
-        h = BertEncoder(cfg, name="encoder")(h, add_mask, deterministic)
+        h = BertEncoder(cfg, name="encoder")(
+            h, add_mask, deterministic,
+            pld_theta=pld_theta if progressive_layer_drop else None,
+        )
         pooled = nn.tanh(nn.Dense(cfg.hidden_size, name="pooler")(h[:, 0]))
         return h, pooled, embed_table
 
@@ -178,10 +214,12 @@ class BertForPreTraining(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids, attention_mask,
-                 masked_lm_labels=None, next_sentence_label=None, deterministic=False):
+                 masked_lm_labels=None, next_sentence_label=None, deterministic=False,
+                 progressive_layer_drop=False, pld_theta=None):
         cfg = self.config
         h, pooled, word_table = BertModel(cfg, name="bert")(
-            input_ids, token_type_ids, attention_mask, deterministic
+            input_ids, token_type_ids, attention_mask, deterministic,
+            progressive_layer_drop=progressive_layer_drop, pld_theta=pld_theta,
         )
 
         # MLM head: transform + tied decoder (weight tying with word embeddings).
@@ -222,10 +260,12 @@ class BertForQuestionAnswering(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids, attention_mask,
-                 start_positions=None, end_positions=None, deterministic=False):
+                 start_positions=None, end_positions=None, deterministic=False,
+                 progressive_layer_drop=False, pld_theta=None):
         cfg = self.config
         h, _, _ = BertModel(cfg, name="bert")(
-            input_ids, token_type_ids, attention_mask, deterministic
+            input_ids, token_type_ids, attention_mask, deterministic,
+            progressive_layer_drop=progressive_layer_drop, pld_theta=pld_theta,
         )
         logits = nn.Dense(2, name="qa_outputs")(h)  # [B, S, 2]
         start_logits = logits[..., 0]

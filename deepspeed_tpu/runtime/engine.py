@@ -96,6 +96,16 @@ def _find_sparse_grad_paths(params):
     return paths, names
 
 
+def _apply_pld_kwargs(kwargs, rng, theta):
+    """Progressive-layer-drop kwargs + the dedicated coin stream. One
+    definition for every loss path: the fold constant and the
+    stream-separation invariant (theta=1 must stay bit-identical to PLD off
+    because the dropout stream is untouched) live only here."""
+    kwargs["progressive_layer_drop"] = True
+    kwargs["pld_theta"] = theta
+    kwargs.setdefault("rngs", {})["pld"] = jax.random.fold_in(rng, 0x1D)
+
+
 def _grads_to_csr(grads, sparse_paths):
     """Replace the registered leaves with CSRTensors (touched rows only)."""
     from deepspeed_tpu.runtime.csr_tensor import CSRTensor
@@ -606,8 +616,7 @@ class DeepSpeedEngine:
                 if needs_rng:
                     kwargs["rngs"] = {"dropout": rng}
                 if pld:
-                    kwargs["progressive_layer_drop"] = True
-                    kwargs["pld_theta"] = theta
+                    _apply_pld_kwargs(kwargs, rng, theta)
 
                 def run(p_c, *b):
                     return apply_fn(p_c, *b, **kwargs)
@@ -669,8 +678,7 @@ class DeepSpeedEngine:
                     if needs_rng:
                         kwargs["rngs"] = {"dropout": rng}
                     if pld:
-                        kwargs["progressive_layer_drop"] = True
-                        kwargs["pld_theta"] = theta
+                        _apply_pld_kwargs(kwargs, rng, theta)
                     out = apply_fn(p_c, *batch, **kwargs)
                     loss = out[0] if isinstance(out, tuple) else out
                     return loss.astype(jnp.float32) * scale
