@@ -53,37 +53,105 @@ def _peak_tflops(device_kind):
 # child: the actual measurement (runs under whatever backend the env forces)
 # ---------------------------------------------------------------------------
 
+def _bench_knobs(on_tpu, default_mb, default_seq, default_steps, default_warmup):
+    """Shared env-knob surface of every bench leg."""
+    return dict(
+        micro_batch=int(os.environ.get("BENCH_BATCH", default_mb if on_tpu else "2")),
+        seq_len=int(os.environ.get("BENCH_SEQ", default_seq)),
+        steps=int(os.environ.get("BENCH_STEPS", default_steps if on_tpu else "2")),
+        warmup=int(os.environ.get("BENCH_WARMUP", default_warmup if on_tpu else "1")),
+        remat=os.environ.get("BENCH_REMAT", "1") == "1",
+        policy=os.environ.get("BENCH_REMAT_POLICY", "dots"),
+    )
+
+
+def _make_engine(model, params, global_batch, micro_batch, n_dev, remat):
+    """One engine config for every leg: bf16 (the TPU-native precision story;
+    fp16 loss scaling exists for parity but is unnecessary overhead on the
+    MXU), ZeRO-2 when data-parallel, config-driven activation remat."""
+    import deepspeed_tpu
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params={
+            "train_batch_size": global_batch,
+            "train_micro_batch_size_per_gpu": micro_batch,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2 if n_dev > 1 else 0},
+            "activation_checkpointing": {"enabled": remat},
+        },
+    )
+    return engine
+
+
+def _timed_chain(engine, batch, warmup, steps):
+    """Measured train_step window. THE timing contract (verified empirically
+    on this image's axon relay): ``block_until_ready`` does NOT wait for
+    remote TPU execution — only a data FETCH does. Each fetch costs ~60ms of
+    relay round-trip, so chain ``steps`` donated-buffer train steps (step
+    i+1's params depend on step i's) and fetch ONE final scalar loss; the
+    fetch transitively waits for the whole chain and the overhead amortizes
+    across the window. Any future timing fix belongs HERE, for all legs."""
+    import jax
+
+    loss = None
+    for _ in range(warmup):
+        loss = engine.train_step([batch])
+    if loss is not None:
+        float(jax.device_get(loss))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_step([batch])
+    final_loss = float(jax.device_get(loss))
+    return time.perf_counter() - t0, final_loss
+
+
+def _perf_fields(dt, steps, cfg, n_params, global_batch, seq_len, n_dev, dev, on_tpu):
+    """Analytic model-FLOPs accounting shared by every leg (the standard MFU
+    convention): a training step costs ~6*N FLOPs/token for the matmuls plus
+    12*L*H*S FLOPs/token for attention score/value products (fwd + bwd)."""
+    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
+    tokens = global_batch * seq_len
+    achieved_tflops = flops_per_token * tokens / (dt / steps) / n_dev / 1e12
+    peak = _peak_tflops(dev.device_kind) if on_tpu else None
+    return {
+        "tflops_per_chip": round(achieved_tflops, 2),
+        "mfu": round(achieved_tflops / peak, 4) if peak else None,
+        "device_kind": dev.device_kind,
+        "n_devices": n_dev,
+        "global_batch": global_batch,
+        "step_ms": round(dt / steps * 1000.0, 2),
+        "params": n_params,
+    }
+
+
 def child_main():
+    if os.environ.get("BENCH_MODEL", "bert") == "gpt2":
+        return gpt2_child_main()
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    import deepspeed_tpu
     from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
 
     dev = jax.devices()[0]
     platform = dev.platform
     on_tpu = platform == "tpu"
-
-    micro_batch = int(os.environ.get("BENCH_BATCH", "64" if on_tpu else "2"))
-    seq_len = int(os.environ.get("BENCH_SEQ", "128"))
-    steps = int(os.environ.get("BENCH_STEPS", "30" if on_tpu else "2"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "3" if on_tpu else "1"))
+    knobs = _bench_knobs(on_tpu, "64", "128", "30", "3")
+    micro_batch, seq_len = knobs["micro_batch"], knobs["seq_len"]
+    n_dev = len(jax.devices())
 
     # Remat the encoder stack by default: without it, 24 layers of saved
     # [B,S,H] intermediates + dropout masks OOM a single chip's HBM at
     # micro-batch 64 (the round-3 failure: a 192MB pred[24,64,128,1024]
     # dropout-mask stack died in AllocateBuffer). BENCH_REMAT=0 opts out.
     # Remat is requested through the ds_config activation_checkpointing
-    # section below — the ENGINE flips BertConfig.checkpoint_activations
+    # section — the ENGINE flips BertConfig.checkpoint_activations
     # (per-layer scanned remat), exercising the config wiring end-to-end.
-    remat = os.environ.get("BENCH_REMAT", "1") == "1"
-    cfg = BertConfig.bert_large(
-        checkpoint_policy=os.environ.get("BENCH_REMAT_POLICY", "dots")
-    )
+    cfg = BertConfig.bert_large(checkpoint_policy=knobs["policy"])
     model = BertForPreTraining(cfg)
 
-    n_dev = len(jax.devices())
     # The engine shards the given batch across the data axis as the GLOBAL
     # batch, so feed micro_batch * n_dev rows and count exactly that many
     # samples per step (round-1 advisor finding: counting batch*n_dev while
@@ -100,68 +168,18 @@ def child_main():
         -1,
     ).astype(np.int32)
     next_sentence_label = rng.randint(0, 2, (global_batch,)).astype(np.int32)
-    batch = (input_ids, token_type_ids, attention_mask, masked_lm_labels, next_sentence_label)
+    batch = tuple(jnp.asarray(x) for x in (
+        input_ids, token_type_ids, attention_mask, masked_lm_labels, next_sentence_label
+    ))
 
     params = model.init(
-        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
-        *[jnp.asarray(x) for x in batch],
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)}, *batch
     )
     n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
 
-    ds_config = {
-        "train_batch_size": global_batch,
-        "train_micro_batch_size_per_gpu": micro_batch,
-        "gradient_accumulation_steps": 1,
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-        # bf16 is the TPU-native precision story (fp16 loss scaling exists for
-        # parity but is unnecessary overhead on the MXU).
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 2 if n_dev > 1 else 0},
-        "activation_checkpointing": {"enabled": remat},
-    }
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        model=model, model_parameters=params, config_params=ds_config
-    )
-
-    dev_batch = tuple(jnp.asarray(x) for x in batch)
-
-    def one_step():
-        # Fused scanned step: one dispatch, donated buffers, loss stays on
-        # device so consecutive steps queue without host syncs.
-        return engine.train_step([dev_batch])
-
-    # Timing contract (verified empirically on this image's axon relay):
-    # ``block_until_ready`` does NOT wait for remote TPU execution — only a
-    # data FETCH does. Each fetch costs ~60ms of relay round-trip, so we chain
-    # ``steps`` donated-buffer train steps (step i+1's params depend on step
-    # i's) and fetch ONE final scalar loss; the fetch transitively waits for
-    # the whole chain and the overhead amortizes across the window.
-    loss = None
-    for _ in range(warmup):
-        loss = one_step()
-    if loss is not None:
-        float(jax.device_get(loss))
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = one_step()
-    final_loss = float(jax.device_get(loss))
-    dt = time.perf_counter() - t0
-
-    samples_per_sec = global_batch * steps / dt
-    per_chip = samples_per_sec / n_dev
-    step_ms = dt / steps * 1000.0
-
-    # Model FLOPs (analytic, the standard MFU accounting): a training step
-    # costs ~6*N FLOPs/token for the matmuls plus 12*L*H*S FLOPs/token for
-    # attention score/value products (fwd + bwd).
-    tokens = global_batch * seq_len
-    flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * seq_len
-    model_flops_per_step = flops_per_token * tokens
-    achieved_tflops = model_flops_per_step / (dt / steps) / n_dev / 1e12
-
-    peak = _peak_tflops(dev.device_kind) if on_tpu else None
-    mfu = round(achieved_tflops / peak, 4) if peak else None
+    engine = _make_engine(model, params, global_batch, micro_batch, n_dev, knobs["remat"])
+    dt, final_loss = _timed_chain(engine, batch, knobs["warmup"], knobs["steps"])
+    per_chip = global_batch * knobs["steps"] / dt / n_dev
 
     # The reference publishes baselines only for seq128 and seq512; any other
     # seq reports vs_baseline as null rather than a cross-config ratio.
@@ -171,19 +189,74 @@ def child_main():
         base_sps, base_tf = BASELINE_SEQ512_SAMPLES_PER_SEC, BASELINE_SEQ512_TFLOPS
     else:
         base_sps = base_tf = None
+    fields = _perf_fields(dt, knobs["steps"], cfg, n_params, global_batch,
+                          seq_len, n_dev, dev, on_tpu)
     print(json.dumps({
         "metric": f"bert-large pretrain samples/sec/chip @ seq{seq_len} ({platform})",
         "value": round(per_chip, 2),
         "unit": "samples/sec",
         "vs_baseline": round(per_chip / base_sps, 3) if base_sps else None,
-        "tflops_per_chip": round(achieved_tflops, 2),
-        "vs_baseline_tflops": round(achieved_tflops / base_tf, 3) if base_tf else None,
-        "mfu": mfu,
-        "device_kind": dev.device_kind,
-        "n_devices": n_dev,
-        "global_batch": global_batch,
-        "step_ms": round(step_ms, 2),
-        "params": n_params,
+        "vs_baseline_tflops": (round(fields["tflops_per_chip"] / base_tf, 3)
+                               if base_tf else None),
+        **fields,
+        "micro_batch": micro_batch,
+        "remat": cfg.checkpoint_activations,
+        "remat_policy": cfg.checkpoint_policy,
+        "final_loss": round(final_loss, 3),
+    }))
+    return 0
+
+
+def gpt2_child_main():
+    """Secondary flagship leg: GPT-2 causal-LM pretraining tokens/sec.
+
+    BASELINE.json's metric names GPT-2 throughput alongside BERT; the
+    reference has no published per-chip number (its GPT-2 runs drive the
+    external Megatron examples), so vs_baseline is null — the value is the
+    measured record itself. BENCH_GPT2_SIZE: small|medium|large|xl
+    (default medium, 355M — the largest whose full Adam state fits one v5e
+    chip next to seq-1024 activations)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    on_tpu = platform == "tpu"
+    size = os.environ.get("BENCH_GPT2_SIZE", "medium")
+    knobs = _bench_knobs(on_tpu, "8", "1024" if on_tpu else "64", "20", "2")
+    micro_batch, seq_len = knobs["micro_batch"], knobs["seq_len"]
+    n_dev = len(jax.devices())
+
+    ctor = {"small": GPT2Config.gpt2_small, "medium": GPT2Config.gpt2_medium,
+            "large": GPT2Config.gpt2_large, "xl": GPT2Config.gpt2_xl}[size]
+    cfg = ctor(checkpoint_policy=knobs["policy"],
+               max_position_embeddings=max(1024, seq_len))
+    model = GPT2LMHeadModel(cfg)
+    global_batch = micro_batch * n_dev
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (global_batch, seq_len)).astype(np.int32))
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)}, ids, ids
+    )
+    n_params = sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+    engine = _make_engine(model, params, global_batch, micro_batch, n_dev, knobs["remat"])
+    dt, final_loss = _timed_chain(engine, (ids, ids), knobs["warmup"], knobs["steps"])
+    per_chip = global_batch * seq_len * knobs["steps"] / dt / n_dev
+
+    fields = _perf_fields(dt, knobs["steps"], cfg, n_params, global_batch,
+                          seq_len, n_dev, dev, on_tpu)
+    print(json.dumps({
+        "metric": f"gpt2-{size} pretrain tokens/sec/chip @ seq{seq_len} ({platform})",
+        "value": round(per_chip, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "samples_per_sec_per_chip": round(per_chip / seq_len, 3),
+        **fields,
         "micro_batch": micro_batch,
         "remat": cfg.checkpoint_activations,
         "remat_policy": cfg.checkpoint_policy,
@@ -300,8 +373,12 @@ def main():
     if tpu_ok:
         # OOM-retry ladder: one allocation failure must not forfeit the
         # round's perf axis — drop the micro-batch a rung and try again.
-        start_mb = int(os.environ.get("BENCH_BATCH", "64"))
-        ladder = [start_mb] + [mb for mb in (64, 32, 16, 8) if mb < start_mb]
+        # The default start matches the child's per-model default (GPT-2 at
+        # seq1024 is 16x BERT-seq128 activations per row); rungs below 8
+        # exist so large models at long seq still find a fitting batch.
+        model_default = "64" if os.environ.get("BENCH_MODEL", "bert") == "bert" else "8"
+        start_mb = int(os.environ.get("BENCH_BATCH", model_default))
+        ladder = [start_mb] + [mb for mb in (64, 32, 16, 8, 4, 2, 1) if mb < start_mb]
         for mb in ladder:
             result, err, oom = _run_child({"BENCH_BATCH": str(mb)}, child_timeout)
             if result is not None:
@@ -311,6 +388,7 @@ def main():
                 # measured config); and BENCH_NO_CACHE=1 opts experimental
                 # runs (A/B switches, tiny-step probes) out of writing it.
                 if ("tpu" in str(result.get("device_kind", "")).lower()
+                        and os.environ.get("BENCH_MODEL", "bert") == "bert"
                         and os.environ.get("BENCH_SEQ", "128") == "128"
                         and os.environ.get("BENCH_NO_CACHE") != "1"):
                     _record_tpu_result(result)
@@ -327,6 +405,7 @@ def main():
     # numbers); BENCH_NO_CACHE additionally opts out entirely.
     cached = None
     if (os.environ.get("BENCH_NO_CACHE") != "1"
+            and os.environ.get("BENCH_MODEL", "bert") == "bert"
             and os.environ.get("BENCH_SEQ", "128") == "128"):
         cached = _cached_tpu_result()
     if cached is not None:

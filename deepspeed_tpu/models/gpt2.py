@@ -53,6 +53,18 @@ class GPT2Config:
         d.update(kw)
         return GPT2Config(**d)
 
+    @staticmethod
+    def gpt2_medium(**kw):
+        d = dict(hidden_size=1024, num_hidden_layers=24, num_attention_heads=16)
+        d.update(kw)
+        return GPT2Config(**d)
+
+    @staticmethod
+    def gpt2_large(**kw):
+        d = dict(hidden_size=1280, num_hidden_layers=36, num_attention_heads=20)
+        d.update(kw)
+        return GPT2Config(**d)
+
     @property
     def intermediate_size(self):
         return 4 * self.hidden_size

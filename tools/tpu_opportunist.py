@@ -26,6 +26,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "TPU_ATTEMPTS.log")
 SMOKE_OUT = os.path.join(REPO, "TPU_SMOKE.json")
 SEQ512_OUT = os.path.join(REPO, "TPU_BENCH_SEQ512.json")
+GPT2_OUT = os.path.join(REPO, "GPT2_BENCH.json")
 # bench.py caches every successful real-TPU measurement here and falls back
 # to it when the tunnel is down at round end; the watcher's job is to make
 # sure that cache gets populated the moment the tunnel answers.
@@ -359,6 +360,7 @@ def main():
     bench_done = _bench_file_ok(BENCH_OUT)
     seq512_done = _bench_file_ok(SEQ512_OUT)
     ab_done = os.path.exists(AB_OUT)
+    gpt2_done = _bench_file_ok(GPT2_OUT)
     sweep_done = _sweep_complete()
     if os.environ.get("TPU_REFRESH") == "1":
         # re-measure even though artifacts exist (e.g. after a perf change);
@@ -371,6 +373,7 @@ def main():
         smoke_done = False
         seq512_done = False
         ab_done = False
+        gpt2_done = False
         sweep_done = False
         try:
             os.remove(SWEEP_OUT)
@@ -379,7 +382,7 @@ def main():
     sleep = SLEEP_MIN
     attempt = 0
     while not (smoke_done and bench_done and seq512_done and ab_done
-               and sweep_done):
+               and gpt2_done and sweep_done):
         attempt += 1
         ok, info = probe()
         if not ok:
@@ -427,6 +430,20 @@ def main():
                 seq512_done = True
             else:
                 log(f"bench seq512 FAILED: {err2 or res2}")
+        if bench_done and not gpt2_done:
+            # GPT-2 flagship leg (BASELINE.json names GPT-2 tokens/sec next
+            # to BERT samples/sec; no published per-chip reference number).
+            res3, err3 = run_bench({
+                "BENCH_MODEL": "gpt2", "BENCH_BATCH": "8",
+                "BENCH_NO_CACHE": "1",
+            })
+            if _fresh_tpu(res3):
+                with open(GPT2_OUT, "w") as f:
+                    f.write(json.dumps(res3) + "\n")
+                log(f"bench gpt2: {json.dumps(res3)}")
+                gpt2_done = True
+            else:
+                log(f"bench gpt2 FAILED: {err3 or res3}")
         if bench_done and not ab_done:
             out, err = run_ab()
             if out is not None:
@@ -439,9 +456,9 @@ def main():
         if bench_done and not sweep_done:
             sweep_done = run_sweep()
         if not (smoke_done and bench_done and seq512_done and ab_done
-                and sweep_done):
+                and gpt2_done and sweep_done):
             time.sleep(SLEEP_MIN)
-    log("all done: smoke + bench (seq128 + seq512) + A/B + sweep recorded on TPU")
+    log("all done: smoke + bench (seq128 + seq512 + gpt2) + A/B + sweep recorded on TPU")
     return 0
 
 
