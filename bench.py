@@ -378,7 +378,10 @@ def main():
         # exist so large models at long seq still find a fitting batch.
         model_default = "64" if os.environ.get("BENCH_MODEL", "bert") == "bert" else "8"
         start_mb = int(os.environ.get("BENCH_BATCH", model_default))
-        ladder = [start_mb] + [mb for mb in (64, 32, 16, 8, 4, 2, 1) if mb < start_mb]
+        # cap at 4 rungs: callers budget their timeout for ladder_len x
+        # BENCH_TIMEOUT children (tools/tpu_opportunist.py TPU_BENCH_TIMEOUT),
+        # and a config that OOMs four halvings deep won't be saved by a fifth
+        ladder = ([start_mb] + [mb for mb in (64, 32, 16, 8, 4, 2, 1) if mb < start_mb])[:4]
         for mb in ladder:
             result, err, oom = _run_child({"BENCH_BATCH": str(mb)}, child_timeout)
             if result is not None:
@@ -428,11 +431,18 @@ def main():
             return 0
         errors.append(f"cpu bench: {err}")
 
-    seq = os.environ.get("BENCH_SEQ", "128")
+    if os.environ.get("BENCH_MODEL", "bert") == "gpt2":
+        label = f"gpt2-{os.environ.get('BENCH_GPT2_SIZE', 'medium')} pretrain tokens/sec/chip"
+        seq = os.environ.get("BENCH_SEQ", "1024")
+        unit = "tokens/sec"
+    else:
+        label = "bert-large pretrain samples/sec/chip"
+        seq = os.environ.get("BENCH_SEQ", "128")
+        unit = "samples/sec"
     print(json.dumps({
-        "metric": f"bert-large pretrain samples/sec/chip @ seq{seq} (unavailable)",
+        "metric": f"{label} @ seq{seq} (unavailable)",
         "value": 0.0,
-        "unit": "samples/sec",
+        "unit": unit,
         "vs_baseline": 0.0,
         "error": "; ".join(errors),
     }))

@@ -5,6 +5,7 @@ from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
     VariableSparsityConfig,
     BigBirdSparsityConfig,
     BSLongformerSparsityConfig,
+    sparsity_config_from_dict,
 )
 from deepspeed_tpu.ops.sparse_attention.matmul import MatMul, Softmax
 from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import SparseSelfAttention

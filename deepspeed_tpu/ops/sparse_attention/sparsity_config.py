@@ -332,3 +332,28 @@ class BSLongformerSparsityConfig(SparsityConfig):
             layout = self._set_sliding(layout, h, num_blocks)
             layout = self._set_global(layout, h, num_blocks)
         return self.check_and_propagate_first_head_layout(layout)
+
+
+def sparsity_config_from_dict(d, num_heads):
+    """Build a SparsityConfig from a parsed ds_config ``sparse_attention``
+    section (``runtime/config.py:get_sparse_attention``). The reference
+    parses the JSON but leaves users to construct the object by hand in
+    their model code; this closes that gap — the parsed dict's keys are
+    exactly the constructor kwargs.
+
+        cfg = engine.sparse_attention_sparsity_config(num_heads=16)
+    """
+    classes = {
+        "dense": DenseSparsityConfig,
+        "fixed": FixedSparsityConfig,
+        "variable": VariableSparsityConfig,
+        "bigbird": BigBirdSparsityConfig,
+        "bslongformer": BSLongformerSparsityConfig,
+    }
+    d = dict(d)
+    mode = d.pop("mode")
+    try:
+        cls = classes[mode]
+    except KeyError:
+        raise NotImplementedError(f"sparsity mode {mode!r} not implemented") from None
+    return cls(num_heads=num_heads, **d)

@@ -363,6 +363,21 @@ class DeepSpeedEngine:
     def scheduler_params(self):
         return self._config.scheduler_params
 
+    def sparse_attention_config(self):
+        """Parsed ds_config ``sparse_attention`` section (mode-keyed dict) or
+        None — name parity with the reference config surface."""
+        return self._config.sparse_attention
+
+    def sparse_attention_sparsity_config(self, num_heads):
+        """The configured sparsity as a ready ``SparsityConfig`` object for
+        ``SparseSelfAttention``/``BertSparseSelfAttention``; None when the
+        config has no sparse_attention section."""
+        if self._config.sparse_attention is None:
+            return None
+        from deepspeed_tpu.ops.sparse_attention import sparsity_config_from_dict
+
+        return sparsity_config_from_dict(self._config.sparse_attention, num_heads)
+
     def pld_enabled(self):
         return self._config.pld_enabled
 

@@ -129,3 +129,49 @@ def test_bert_sparse_self_attention_module():
     out = m.apply(params, x)
     assert out.shape == (2, S, H * D)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_sparsity_config_from_dict_all_modes():
+    """ds_config sparse_attention section -> SparsityConfig object, for every
+    mode, through the engine accessor (config keys == constructor kwargs)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.ops.sparse_attention import sparsity_config_from_dict
+    from tests.unit.simple_model import create_simple_model
+
+    sections = {
+        "dense": ({"mode": "dense", "block": 32}, DenseSparsityConfig),
+        "fixed": ({"mode": "fixed", "block": 16, "num_local_blocks": 2,
+                   "num_global_blocks": 1}, FixedSparsityConfig),
+        "variable": ({"mode": "variable", "block": 16,
+                      "local_window_blocks": [2],
+                      "global_block_indices": [0]}, VariableSparsityConfig),
+        "bigbird": ({"mode": "bigbird", "block": 16, "num_random_blocks": 1,
+                     "num_sliding_window_blocks": 3}, BigBirdSparsityConfig),
+        "bslongformer": ({"mode": "bslongformer", "block": 16,
+                          "num_sliding_window_blocks": 3}, BSLongformerSparsityConfig),
+    }
+    for mode, (section, cls) in sections.items():
+        cfg = sparsity_config_from_dict(section, num_heads=4)
+        assert isinstance(cfg, cls), mode
+        assert cfg.block == section["block"]
+        layout = cfg.make_layout(128)
+        assert layout.shape == (4, 128 // cfg.block, 128 // cfg.block)
+        assert layout.sum() > 0
+
+    # engine surface: config section -> accessor -> object
+    model, params = create_simple_model(hidden_dim=8)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params={
+            "train_batch_size": len(jax.devices()),
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "sparse_attention": {"mode": "bigbird", "block": 16},
+        },
+    )
+    assert engine.sparse_attention_config()["mode"] == "bigbird"
+    sc = engine.sparse_attention_sparsity_config(num_heads=2)
+    assert isinstance(sc, BigBirdSparsityConfig) and sc.num_heads == 2
+
+    with pytest.raises(NotImplementedError):
+        sparsity_config_from_dict({"mode": "nope"}, num_heads=2)
