@@ -351,7 +351,9 @@ def sparsity_config_from_dict(d, num_heads):
         "bslongformer": BSLongformerSparsityConfig,
     }
     d = dict(d)
-    mode = d.pop("mode")
+    # absent mode defaults to "fixed", matching the JSON parser
+    # (runtime/config.py SPARSE_MODE_DEFAULT)
+    mode = d.pop("mode", "fixed")
     try:
         cls = classes[mode]
     except KeyError:

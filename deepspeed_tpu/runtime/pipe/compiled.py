@@ -41,15 +41,31 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 try:  # jax >= 0.8: top-level shard_map with check_vma instead of check_rep
     from jax import shard_map as _new_shard_map
 
-    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False, axis_names=None):
+        # axis_names = the MANUAL axes; any other mesh axis (the TP ``model``
+        # axis) stays automatic and GSPMD handles its collectives inside f
         return _new_shard_map(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_rep
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_rep, axis_names=frozenset(axis_names or ()),
         )
 except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False, axis_names=None):
+        auto = (frozenset(mesh.axis_names) - frozenset(axis_names)
+                if axis_names else frozenset())
+        return _old_shard_map(f, mesh, in_specs=in_specs, out_specs=out_specs,
+                              check_rep=check_rep, auto=auto)
 
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def _manual_axes(mesh):
+    """Manual shard_map axes for this mesh: pipe+data; a ``model`` axis (3D
+    TP) is left automatic so GSPMD inserts the in-stage TP collectives."""
+    return ({PIPE_AXIS, DATA_AXIS} if MODEL_AXIS in mesh.axis_names else None)
 
 
 def analytic_bubble_fraction(num_stages, num_micro):
@@ -57,29 +73,58 @@ def analytic_bubble_fraction(num_stages, num_micro):
     return (num_stages - 1) / (num_micro + num_stages - 1)
 
 
-def pipeline_mesh(num_stages, devices=None):
-    """('pipe', 'data') mesh: pipe outermost (lowest-bandwidth traffic)."""
+def pipeline_mesh(num_stages, devices=None, tp=1):
+    """('pipe', 'data'[, 'model']) mesh: pipe outermost (lowest-bandwidth
+    traffic), model innermost (highest-bandwidth TP collectives ride the
+    tightest ICI ring) — the reference's PipeModelDataParallelTopology axis
+    order (pipe/topology.py:246)."""
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
-    assert n % num_stages == 0, f"{n} devices not divisible by {num_stages} stages"
+    assert n % (num_stages * tp) == 0, (
+        f"{n} devices not divisible by {num_stages} stages x tp {tp}"
+    )
+    if tp > 1:
+        return Mesh(
+            np.asarray(devices).reshape(num_stages, n // (num_stages * tp), tp),
+            (PIPE_AXIS, DATA_AXIS, MODEL_AXIS),
+        )
     return Mesh(np.asarray(devices).reshape(num_stages, n // num_stages),
                 (PIPE_AXIS, DATA_AXIS))
 
 
-def stack_stage_params(per_stage_params, mesh):
+def stack_stage_params(per_stage_params, mesh, specs=None):
     """[stage pytrees] -> one pytree with leading stage axis, sharded over
     ``pipe`` (leaf i of every stage must agree in shape/dtype). Stages may
     arrive committed to different sub-meshes, so stacking stages through the
-    host once at setup; thereafter the stacked copy lives sharded on ``mesh``."""
+    host once at setup; thereafter the stacked copy lives sharded on ``mesh``.
+
+    ``specs``: optional pytree of ``PartitionSpec`` (same structure as the
+    STACKED tree, each spec covering the stacked leaf's dims) adding TP
+    ``model``-axis placement on top of the stage split — position 0 is
+    overridden with ``pipe``."""
     stacked = jax.tree_util.tree_map(
         lambda *leaves: np.stack([np.asarray(jax.device_get(l)) for l in leaves]),
         *per_stage_params,
     )
-    shard = lambda l: jax.device_put(
-        jnp.asarray(l),
-        NamedSharding(mesh, PartitionSpec(PIPE_AXIS, *([None] * (l.ndim - 1)))),
-    )
-    return jax.tree_util.tree_map(shard, stacked)
+
+    if specs is None:
+        return jax.tree_util.tree_map(
+            lambda l: shard_stacked_leaf(mesh, l), stacked)
+    return jax.tree_util.tree_map(
+        lambda l, s: shard_stacked_leaf(mesh, l, s), stacked, specs)
+
+
+def shard_stacked_leaf(mesh, l, spec=None):
+    """Commit one stacked leaf: dim 0 split over ``pipe``; ``spec`` (covering
+    the stacked dims) overlays extra axis placement (TP ``model``) on the
+    remaining dims. Single definition shared by the homogeneous stacker and
+    the engine's heterogeneous arranger."""
+    dims = [PIPE_AXIS] + [None] * (l.ndim - 1)
+    if spec is not None:
+        for d, name in enumerate(spec):
+            if d > 0 and name is not None:
+                dims[d] = name
+    return jax.device_put(jnp.asarray(l), NamedSharding(mesh, PartitionSpec(*dims)))
 
 
 def unstack_stage_params(stacked):
@@ -137,7 +182,7 @@ def build_pipeline_loss(block_fn, loss_fn, mesh, num_micro, remat=True):
             pipelined, mesh=mesh,
             in_specs=(P(PIPE_AXIS), P(), data_sharded(x0.ndim), data_sharded(labels.ndim), P()),
             out_specs=P(),
-            check_rep=False,
+            check_rep=False, axis_names=_manual_axes(mesh),
         )(stacked_params, aux_params, x0, labels, rng)
 
     return fn
@@ -222,7 +267,7 @@ def build_pipeline_loss_hetero(first_fn, block_fn, last_loss_fn, mesh, num_micro
             pipelined, mesh=mesh,
             in_specs=(P(PIPE_AXIS), P(), data_sharded(x0.ndim), data_sharded(labels.ndim), P()),
             out_specs=P(),
-            check_rep=False,
+            check_rep=False, axis_names=_manual_axes(mesh),
         )(stacked_params, aux_params, x0, labels, rng)
 
     return fn

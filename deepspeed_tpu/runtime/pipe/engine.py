@@ -464,14 +464,16 @@ class PipelineEngine:
     # compiled SPMD executor path (scan + ppermute; pipe/compiled.py)
     # ------------------------------------------------------------------
     def _compiled_base_reasons(self):
-        """Config features neither compiled executor supports yet."""
+        """Config features neither compiled executor supports yet. Tensor
+        parallelism is NOT one of them: a 3-axis ('pipe','data','model') mesh
+        runs the same scan+ppermute program with the ``model`` axis left
+        automatic (shard_map axis_names), so GSPMD inserts the in-stage TP
+        collectives inside each stage's block."""
         reasons = []
         if self._config.zero_enabled:
             reasons.append("ZeRO")
         if self._fp16:
             reasons.append("fp16 loss scaling")
-        if self.mp_world_size > 1:
-            reasons.append("tensor parallelism")
         return reasons
 
     def _homogeneous_ok(self):
@@ -604,11 +606,29 @@ class PipelineEngine:
             return
         from deepspeed_tpu.runtime.pipe import compiled as C
 
-        mesh = C.pipeline_mesh(self.num_stages)
+        mesh = C.pipeline_mesh(self.num_stages, tp=self.mp_world_size)
         clip = self._config.gradient_clipping
 
+        def tp_specs(one_tree, lead_dims):
+            """TP PartitionSpecs for a stacked tree: Megatron rules on ONE
+            stage/block tree (rules count dims from the END, so the stacked
+            leading dims just get ``lead_dims`` Nones prepended)."""
+            if self.mp_world_size <= 1:
+                return None
+            from deepspeed_tpu.parallel.tp import spec_for
+
+            return jax.tree_util.tree_map_with_path(
+                lambda p, l: PartitionSpec(
+                    *([None] * lead_dims),
+                    *spec_for(p, l, model_axis_size=self.mp_world_size)
+                ),
+                one_tree,
+            )
+
         if mode == "homog":
-            stacked = C.stack_stage_params(self._stage_params, mesh)
+            stacked = C.stack_stage_params(
+                self._stage_params, mesh, specs=tp_specs(self._stage_params[0], 1)
+            )
             aux = {}
             stage_fn = self.module.stage_forward(0)
             dtype = self.compute_dtype
@@ -627,8 +647,11 @@ class PipelineEngine:
                 self.micro_batches, clip_grad=clip,
             )
         else:
+            per_layer = self._gather_layer_params()
+            plan = self._hetero_plan()
             stacked, aux = self._arrange_hetero(
-                self._gather_layer_params(), mesh
+                per_layer, mesh,
+                specs=tp_specs(per_layer[plan["block_idx"][0]], 2),
             )
             first_fn, block_fn, last_loss_fn = self._hetero_fns()
             step = C.build_pipeline_train_step_hetero(
@@ -695,10 +718,12 @@ class PipelineEngine:
 
         return first_fn, block_fn, last_loss_fn
 
-    def _arrange_hetero(self, per_layer, mesh):
+    def _arrange_hetero(self, per_layer, mesh, specs=None):
         """Per-layer param trees -> (stacked [S,k,...] blocks over ``pipe``,
         replicated aux {'first', 'tail'}). The tied head reuses aux['first']
-        so the tied parameter exists ONCE in the compiled state."""
+        so the tied parameter exists ONCE in the compiled state. ``specs``:
+        optional per-leaf PartitionSpecs over the STACKED [S,k,...] dims
+        adding TP model-axis placement (dim 0 forced to ``pipe``)."""
         from deepspeed_tpu.runtime.pipe.compiled import PIPE_AXIS
 
         plan = self._hetero_plan()
@@ -711,18 +736,40 @@ class PipelineEngine:
             ),
             *blocks,
         )
-        shard = lambda l: jax.device_put(
-            jnp.asarray(l),
-            NamedSharding(mesh, PartitionSpec(PIPE_AXIS, *([None] * (l.ndim - 1)))),
-        )
-        stacked = jax.tree_util.tree_map(shard, stacked)
-        repl = NamedSharding(mesh, PartitionSpec())
-        put_repl = lambda t: jax.device_put(
-            jax.tree_util.tree_map(lambda l: jnp.asarray(host(l)), t), repl
-        )
+
+        from deepspeed_tpu.runtime.pipe.compiled import shard_stacked_leaf
+
+        if specs is None:
+            stacked = jax.tree_util.tree_map(
+                lambda l: shard_stacked_leaf(mesh, l), stacked)
+        else:
+            stacked = jax.tree_util.tree_map(
+                lambda l, s: shard_stacked_leaf(mesh, l, s), stacked, specs)
+
+        # Aux (embedding / final-norm / tied head) params: replicated over the
+        # manual pipe/data axes, but TP-sharded on the auto ``model`` axis —
+        # without this, every device in a model group would hold the FULL
+        # embedding (+2x Adam moments), the memory TP exists to split.
+        tp = self.mp_world_size
+        if tp > 1:
+            from deepspeed_tpu.parallel.tp import spec_for
+
+            def put_aux(t):
+                return jax.tree_util.tree_map_with_path(
+                    lambda p, l: jax.device_put(
+                        jnp.asarray(host(l)),
+                        NamedSharding(mesh, spec_for(p, l, model_axis_size=tp)),
+                    ),
+                    t,
+                )
+        else:
+            repl = NamedSharding(mesh, PartitionSpec())
+            put_aux = lambda t: jax.device_put(
+                jax.tree_util.tree_map(lambda l: jnp.asarray(host(l)), t), repl
+            )
         aux = {
-            "first": put_repl(per_layer[0]),
-            "tail": [put_repl(per_layer[i]) for i in plan["tail_idx"]],
+            "first": put_aux(per_layer[0]),
+            "tail": [put_aux(per_layer[i]) for i in plan["tail_idx"]],
         }
         return stacked, aux
 
