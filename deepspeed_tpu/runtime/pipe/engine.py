@@ -464,14 +464,16 @@ class PipelineEngine:
     # compiled SPMD executor path (scan + ppermute; pipe/compiled.py)
     # ------------------------------------------------------------------
     def _compiled_base_reasons(self):
-        """Config features neither compiled executor supports yet. Tensor
+        """Config features the compiled executors do not support. Tensor
         parallelism is NOT one of them: a 3-axis ('pipe','data','model') mesh
         runs the same scan+ppermute program with the ``model`` axis left
         automatic (shard_map axis_names), so GSPMD inserts the in-stage TP
-        collectives inside each stage's block."""
+        collectives inside each stage's block. ZeRO is not either: the
+        compiled step wraps the optimizer in ``ZeroPytreeOptimizer``, whose
+        master/moment shardings compose pipe (+model) with the ``data`` axis."""
         reasons = []
-        if self._config.zero_enabled:
-            reasons.append("ZeRO")
+        if getattr(self, "_compiled_unavailable", None):
+            reasons.append(self._compiled_unavailable)
         if self._fp16:
             reasons.append("fp16 loss scaling")
         return reasons
@@ -608,21 +610,19 @@ class PipelineEngine:
 
         mesh = C.pipeline_mesh(self.num_stages, tp=self.mp_world_size)
         clip = self._config.gradient_clipping
+        tp_specs = self._tp_stacked_specs
 
-        def tp_specs(one_tree, lead_dims):
-            """TP PartitionSpecs for a stacked tree: Megatron rules on ONE
-            stage/block tree (rules count dims from the END, so the stacked
-            leading dims just get ``lead_dims`` Nones prepended)."""
-            if self.mp_world_size <= 1:
-                return None
-            from deepspeed_tpu.parallel.tp import spec_for
+        # ZeRO in the compiled step: wrap the optimizer so master/moments take
+        # each leaf's existing pipe(+model) sharding PLUS the data axis —
+        # ZeRO-1/2 composed into the single jitted pipeline program.
+        opt = self.basic_optimizer
+        if self._config.zero_enabled:
+            from deepspeed_tpu.runtime.zero.pytree_optimizer import ZeroPytreeOptimizer
 
-            return jax.tree_util.tree_map_with_path(
-                lambda p, l: PartitionSpec(
-                    *([None] * lead_dims),
-                    *spec_for(p, l, model_axis_size=self.mp_world_size)
-                ),
-                one_tree,
+            opt = ZeroPytreeOptimizer(
+                self.basic_optimizer, stage=self._config.zero_optimization_stage,
+                mesh=mesh, clip_grad=0.0,
+                keep_master=(self.compute_dtype != jnp.float32),
             )
 
         if mode == "homog":
@@ -643,7 +643,7 @@ class PipelineEngine:
                 return loss_fn(y, label)
 
             step = C.build_pipeline_train_step(
-                block_fn, aux_loss, self.basic_optimizer, mesh,
+                block_fn, aux_loss, opt, mesh,
                 self.micro_batches, clip_grad=clip,
             )
         else:
@@ -655,11 +655,11 @@ class PipelineEngine:
             )
             first_fn, block_fn, last_loss_fn = self._hetero_fns()
             step = C.build_pipeline_train_step_hetero(
-                first_fn, block_fn, last_loss_fn, self.basic_optimizer, mesh,
+                first_fn, block_fn, last_loss_fn, opt, mesh,
                 self.micro_batches, clip_grad=clip,
             )
 
-        opt_state = self.basic_optimizer.init((stacked, aux))
+        opt_state = opt.init((stacked, aux))
         # Resume correctness: if per-stage optimizer state exists (a loaded
         # checkpoint, or prior interpreter steps), carry it into the stacked
         # representation — an unconditional init() here silently reset Adam
@@ -671,6 +671,17 @@ class PipelineEngine:
         )
         if restacked is not None:
             opt_state = restacked
+        elif self._stage_state_advanced():
+            # Advanced per-stage state that could NOT be carried must not be
+            # silently reset (round-2 advisor finding d) — bow out loudly and
+            # let the interpreter keep running on the existing state.
+            logger.warning(
+                "compiled pipeline executor could not carry the advanced "
+                "per-stage optimizer state; staying on the interpreter"
+            )
+            self._compiled_unavailable = "uncarryable optimizer state"
+            self._compiled = None
+            return
         self._compiled = {"step": step, "stacked": stacked, "aux": aux,
                           "opt_state": opt_state, "mesh": mesh, "mode": mode}
 
@@ -799,39 +810,104 @@ class PipelineEngine:
             return None
         if any(type(s) is not type(states[0]) or not hasattr(s, "_asdict") for s in states):
             return None
-        step0 = getattr(states[0], "step", None)
-        if step0 is not None and int(jax.device_get(jnp.asarray(step0))) == 0:
+        if not self._stage_state_advanced():
             return None
         N = self.module._num_layers
+        plan = self._hetero_plan()
+        block_specs = lambda one_block_tree: self._tp_stacked_specs(one_block_tree, 2)
+
+        def restack_val(tval, svals):
+            if tval is None:
+                return None
+            if (isinstance(tval, tuple) and len(tval) == 2
+                    and not hasattr(tval, "_asdict")):
+                # regroup per-stage per-layer lists -> global per-layer
+                per_layer = [None] * N
+                for s in range(self.num_stages):
+                    lo, hi = self.module.stage_layer_range(s)
+                    for off, idx in enumerate(range(lo, hi)):
+                        per_layer[idx] = svals[s][off]
+                stacked_f, aux_f = self._arrange_hetero(
+                    per_layer, mesh,
+                    specs=block_specs(per_layer[plan["block_idx"][0]]),
+                )
+                # commit to the template's EXACT shardings (ZeRO master specs
+                # add a data axis the arranger doesn't know about)
+                recommit = lambda t, a: (
+                    jax.device_put(a, t.sharding)
+                    if isinstance(getattr(t, "sharding", None), NamedSharding)
+                    else a
+                )
+                stacked_f = jax.tree_util.tree_map(recommit, tval[0], stacked_f)
+                aux_f = jax.tree_util.tree_map(recommit, tval[1], aux_f)
+                return (stacked_f, aux_f)
+            if hasattr(tval, "_asdict"):
+                return type(tval)(**{
+                    n: restack_val(v, [getattr(s, n) for s in svals])
+                    for n, v in tval._asdict().items()
+                })
+            if hasattr(tval, "dtype"):
+                return jnp.asarray(
+                    jax.device_get(jnp.asarray(svals[0])), tval.dtype
+                )
+            return svals[0]
+
         try:
-            fields = {}
-            for name, tval in template._asdict().items():
-                if isinstance(tval, tuple) and len(tval) == 2:
-                    # regroup per-stage per-layer lists -> global per-layer
-                    per_layer = [None] * N
-                    for s in range(self.num_stages):
-                        lo, hi = self.module.stage_layer_range(s)
-                        svals = getattr(states[s], name)
-                        for off, idx in enumerate(range(lo, hi)):
-                            per_layer[idx] = svals[off]
-                    stacked_f, aux_f = self._arrange_hetero(per_layer, mesh)
-                    # match the template's aux structure (plain dict/list)
-                    fields[name] = (stacked_f, aux_f)
-                elif hasattr(tval, "dtype"):
-                    fields[name] = jnp.asarray(
-                        jax.device_get(jnp.asarray(getattr(states[0], name))), tval.dtype
-                    )
-                else:
-                    fields[name] = getattr(states[0], name)
-            return type(template)(**fields)
+            return restack_val(template, states)
         except (TypeError, ValueError, KeyError):
             return None
+
+    def _tp_stacked_specs(self, one_tree, lead_dims):
+        """TP PartitionSpecs for a stacked tree: Megatron rules on ONE
+        stage/block tree (rules count dims from the END, so the stacked
+        leading dims just get ``lead_dims`` Nones prepended). One definition
+        for the fresh-stack and opt-state-restack paths — their shardings
+        must never diverge."""
+        if self.mp_world_size <= 1:
+            return None
+        from deepspeed_tpu.parallel.tp import spec_for
+
+        return jax.tree_util.tree_map_with_path(
+            lambda p, l: PartitionSpec(
+                *([None] * lead_dims),
+                *spec_for(p, l, model_axis_size=self.mp_world_size)
+            ),
+            one_tree,
+        )
+
+    @staticmethod
+    def _state_step(state):
+        """Recursively find a 'step' counter inside a (possibly nested)
+        optimizer-state NamedTuple; None when there is none."""
+        if state is None or not hasattr(state, "_asdict"):
+            return None
+        step = getattr(state, "step", None)
+        if step is not None:
+            return int(jax.device_get(jnp.asarray(step)))
+        for v in state._asdict().values():
+            s = PipelineEngine._state_step(v)
+            if s is not None:
+                return s
+        return None
+
+    def _stage_state_advanced(self):
+        """True when per-stage optimizer state exists and may have taken
+        steps — state that must NOT be silently reset by a fresh compiled
+        init. A state WITHOUT a step counter (client optimizers) counts as
+        advanced: we cannot prove it is fresh, so failing to carry it must
+        bow out rather than zero it."""
+        states = self._stage_opt_state
+        if not states:
+            return False
+        step = self._state_step(states[0])
+        return step is None or step > 0
 
     def _restack_opt_state(self, template):
         """Inverse of ``_sync_from_compiled``'s slicing: stack homogeneous
         per-stage optimizer states into the compiled executor's stacked state.
         Per-param fields (the (stacked_tree, aux) 2-tuples in ``template``)
-        stack along a leading stage axis; scalar fields (step counts) take the
+        stack along a leading stage axis; nested state NamedTuples (ZeRO's
+        ``inner_state``) recurse; scalar fields (step counts) take the
         stage-0 value. Returns None when no per-stage state exists or the
         shapes don't line up (fresh init is then correct)."""
         states = self._stage_opt_state
@@ -840,40 +916,48 @@ class PipelineEngine:
         if any(type(s) is not type(states[0]) or not hasattr(s, "_asdict") for s in states):
             return None
         # A state that has never advanced carries no information worth moving.
-        step0 = getattr(states[0], "step", None)
-        if step0 is not None and int(jax.device_get(jnp.asarray(step0))) == 0:
+        if not self._stage_state_advanced():
             return None
+
+        def restack_val(tval, svals):
+            if tval is None:
+                return None
+            if (isinstance(tval, tuple) and len(tval) == 2
+                    and not hasattr(tval, "_asdict")):
+                # per-stage states are committed to disjoint stage
+                # sub-meshes; stack through the host (same hop as
+                # C.stack_stage_params) before re-committing below
+                stacked_f = jax.tree_util.tree_map(
+                    lambda *ls: np.stack([np.asarray(jax.device_get(l)) for l in ls]),
+                    *svals,
+                )
+                stacked_f = jax.tree_util.tree_map(
+                    lambda t, a: (
+                        jax.device_put(jnp.asarray(a, t.dtype), t.sharding)
+                        if isinstance(getattr(t, "sharding", None), NamedSharding)
+                        else jnp.asarray(a, t.dtype)
+                    ),
+                    tval[0], stacked_f,
+                )
+                return (stacked_f, tval[1])
+            if hasattr(tval, "_asdict"):
+                return type(tval)(**{
+                    n: restack_val(v, [getattr(s, n) for s in svals])
+                    for n, v in tval._asdict().items()
+                })
+            if hasattr(tval, "dtype"):
+                return jnp.asarray(svals[0], tval.dtype)
+            return svals[0]
+
         try:
-            fields = {}
-            for name, tval in template._asdict().items():
-                svals = [getattr(s, name) for s in states]
-                if isinstance(tval, tuple) and len(tval) == 2:
-                    # per-stage states are committed to disjoint stage
-                    # sub-meshes; stack through the host (same hop as
-                    # C.stack_stage_params) before re-committing below
-                    stacked_f = jax.tree_util.tree_map(
-                        lambda *ls: np.stack([np.asarray(jax.device_get(l)) for l in ls]),
-                        *svals,
-                    )
-                    stacked_f = jax.tree_util.tree_map(
-                        lambda t, a: (
-                            jax.device_put(jnp.asarray(a, t.dtype), t.sharding)
-                            if isinstance(getattr(t, "sharding", None), NamedSharding)
-                            else jnp.asarray(a, t.dtype)
-                        ),
-                        tval[0], stacked_f,
-                    )
-                    fields[name] = (stacked_f, tval[1])
-                elif hasattr(tval, "dtype"):
-                    fields[name] = jnp.asarray(svals[0], tval.dtype)
-                else:
-                    fields[name] = svals[0]
-            return type(template)(**fields)
+            return restack_val(template, states)
         except (TypeError, ValueError):
             return None
 
     def _train_batch_compiled(self, micro, mode):
         self._ensure_compiled(mode)
+        if self._compiled is None:
+            return None
         c = self._compiled
         x0 = jnp.stack([m[0] for m in micro])
         labels = jnp.stack([m[1] for m in micro])
@@ -903,13 +987,19 @@ class PipelineEngine:
         state = self._compiled["opt_state"]
         if hasattr(state, "_asdict") and self._stage_opt_state is not None:
             def stage_field(val, s):
-                if isinstance(val, tuple) and len(val) == 2:
+                if val is None:
+                    return None
+                if (isinstance(val, tuple) and len(val) == 2
+                        and not hasattr(val, "_asdict")):
                     return jax.tree_util.tree_map(lambda l: l[s], val[0])
+                if hasattr(val, "_asdict"):
+                    return type(val)(**{
+                        n: stage_field(v, s) for n, v in val._asdict().items()
+                    })
                 return val
 
             self._stage_opt_state = [
-                type(state)(**{n: stage_field(v, s) for n, v in state._asdict().items()})
-                for s in range(self.num_stages)
+                stage_field(state, s) for s in range(self.num_stages)
             ]
         self._stage_params_stale = False
 
@@ -926,15 +1016,21 @@ class PipelineEngine:
         state = c["opt_state"]
         if hasattr(state, "_asdict") and self._stage_opt_state is not None:
             def stage_field(val, s):
-                if isinstance(val, tuple) and len(val) == 2:
+                if val is None:
+                    return None
+                if (isinstance(val, tuple) and len(val) == 2
+                        and not hasattr(val, "_asdict")):
                     layer_field = self._unarrange_hetero(val[0], val[1])
                     lo, hi = self.module.stage_layer_range(s)
                     return [layer_field[i] for i in range(lo, hi)]
+                if hasattr(val, "_asdict"):
+                    return type(val)(**{
+                        n: stage_field(v, s) for n, v in val._asdict().items()
+                    })
                 return val
 
             self._stage_opt_state = [
-                type(state)(**{n: stage_field(v, s) for n, v in state._asdict().items()})
-                for s in range(self.num_stages)
+                stage_field(state, s) for s in range(self.num_stages)
             ]
         self._stage_params_stale = False
 
@@ -954,6 +1050,9 @@ class PipelineEngine:
         )
         if mode is not None:
             loss = self._train_batch_compiled(micro, mode)
+            if loss is None:
+                mode = None  # compiled bowed out (e.g. uncarryable state)
+        if mode is not None:
             self.agg_train_loss = float(jax.device_get(loss))
             self.global_steps += 1
             self.global_samples += self.micro_batch_size * self.micro_batches * self.dp_world_size
@@ -1477,8 +1576,11 @@ class PipelineEngine:
                     logger.warning("could not restore optimizer state; reinitialized")
         self._zero_acc_grads()
         # Loaded per-stage params are now authoritative: a previously built
-        # compiled (stacked) state would shadow them on the next sync.
+        # compiled (stacked) state would shadow them on the next sync. A prior
+        # "uncarryable state" bow-out is also void — the freshly loaded state
+        # deserves a new carry attempt rather than a permanent interpreter.
         self._compiled = None
+        self._compiled_unavailable = None
         self._stage_params_stale = False
         self.global_steps = meta["global_steps"]
         self.global_samples = meta["global_samples"]
