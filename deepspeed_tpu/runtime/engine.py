@@ -44,6 +44,7 @@ from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
 from deepspeed_tpu.runtime.fp16.loss_scaler import (
     DynamicScalerState,
     init_dynamic_scaler_state,
+    advance_scaler,
     update_scaler,
 )
 from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
@@ -790,10 +791,7 @@ class DeepSpeedEngine:
             new_state = OnebitAdamState(
                 step=step, exp_avg=m, exp_avg_sq=v, worker_error=we, server_error=se
             )
-            if dynamic:
-                new_scaler = update_scaler(scaler_state, overflow, **scaler_kwargs)
-            else:
-                new_scaler = scaler_state._replace(cur_iter=scaler_state.cur_iter + 1)
+            new_scaler = advance_scaler(scaler_state, overflow, dynamic, scaler_kwargs)
             zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc_grads)
             return new_params, new_state, new_scaler, overflow, gnorm, zeroed
 
@@ -868,10 +866,7 @@ class DeepSpeedEngine:
             new_params, new_opt_state, gnorm = jax.lax.cond(
                 overflow, skip_step, do_step, (params, opt_state, acc_grads)
             )
-            if dynamic:
-                new_scaler = update_scaler(scaler_state, overflow, **scaler_kwargs)
-            else:
-                new_scaler = scaler_state._replace(cur_iter=scaler_state.cur_iter + 1)
+            new_scaler = advance_scaler(scaler_state, overflow, dynamic, scaler_kwargs)
             return new_params, new_opt_state, new_scaler, overflow, gnorm
 
         return update

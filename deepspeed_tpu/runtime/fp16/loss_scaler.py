@@ -174,3 +174,12 @@ def CreateLossScaler(static_loss_scale=None, dynamic_scaling=False, dynamic_loss
             min_scale=dynamic_loss_args.get(MIN_LOSS_SCALE, 1),
         )
     return LossScaler(scale=static_loss_scale if static_loss_scale else 1.0)
+
+
+def advance_scaler(state: DynamicScalerState, overflow, dynamic, scaler_kwargs=None):
+    """One step of the scaler for a jitted train step: the dynamic state
+    machine, or (static scale) just the iteration counter. Single definition
+    for the engine's fused step, the 1-bit step, and the compiled pipeline."""
+    if dynamic:
+        return update_scaler(state, overflow, **(scaler_kwargs or {}))
+    return state._replace(cur_iter=state.cur_iter + 1)

@@ -274,7 +274,8 @@ def build_pipeline_loss_hetero(first_fn, block_fn, last_loss_fn, mesh, num_micro
 
 
 def build_pipeline_train_step(block_fn, loss_fn, optimizer, mesh, num_micro,
-                              clip_grad=0.0, remat=True):
+                              clip_grad=0.0, remat=True, fp16=False,
+                              dynamic=False, scaler_kwargs=None):
     """Fused pipelined train step: loss + backward pipeline + per-stage update
     in one jitted program with donated params/optimizer state.
 
@@ -282,38 +283,85 @@ def build_pipeline_train_step(block_fn, loss_fn, optimizer, mesh, num_micro,
     (init(params)->state, update(grads, state, params, lr)->(params, state));
     it runs elementwise on the stage-stacked leaves, so optimizer state is
     automatically sharded over ``pipe`` exactly like the params.
+
+    ``fp16``: loss scaling — the scale seeds the VJP cotangent (loss * scale
+    before grad), grads unscale, a nonfinite-grad check drives an on-device
+    ``lax.cond`` overflow skip, and (``dynamic``) the scaler state machine
+    advances — the reference FP16_Optimizer semantics inside the pipeline
+    program.
     """
+    fn = build_pipeline_loss(block_fn, loss_fn, mesh, num_micro, remat=remat)
     loss_grad = jax.value_and_grad(
-        build_pipeline_loss(block_fn, loss_fn, mesh, num_micro, remat=remat),
+        lambda sp, ap, x0, lb, rng, scale: fn(sp, ap, x0, lb, rng) * scale,
         argnums=(0, 1),
     )
-    return _train_step_from_loss_grad(loss_grad, optimizer, clip_grad)
+    return _train_step_from_loss_grad(loss_grad, optimizer, clip_grad,
+                                      fp16=fp16, dynamic=dynamic,
+                                      scaler_kwargs=scaler_kwargs)
 
 
 def build_pipeline_train_step_hetero(first_fn, block_fn, last_loss_fn, optimizer,
-                                     mesh, num_micro, clip_grad=0.0, remat=True):
+                                     mesh, num_micro, clip_grad=0.0, remat=True,
+                                     fp16=False, dynamic=False, scaler_kwargs=None):
     """Fused pipelined train step over the heterogeneous executor; same
-    (stacked, aux, opt_state, x0, labels, rng, lr) signature as the
-    homogeneous variant so the engine can use either interchangeably."""
+    (stacked, aux, opt_state, scaler_state, x0, labels, rng, lr) signature as
+    the homogeneous variant so the engine can use either interchangeably."""
+    fn = build_pipeline_loss_hetero(first_fn, block_fn, last_loss_fn, mesh,
+                                    num_micro, remat=remat)
     loss_grad = jax.value_and_grad(
-        build_pipeline_loss_hetero(first_fn, block_fn, last_loss_fn, mesh,
-                                   num_micro, remat=remat),
+        lambda sp, ap, x0, lb, rng, scale: fn(sp, ap, x0, lb, rng) * scale,
         argnums=(0, 1),
     )
-    return _train_step_from_loss_grad(loss_grad, optimizer, clip_grad)
+    return _train_step_from_loss_grad(loss_grad, optimizer, clip_grad,
+                                      fp16=fp16, dynamic=dynamic,
+                                      scaler_kwargs=scaler_kwargs)
 
 
-def _train_step_from_loss_grad(loss_grad, optimizer, clip_grad):
-    def train_step(stacked_params, aux_params, opt_state, x0, labels, rng, lr):
-        loss, (gp, ga) = loss_grad(stacked_params, aux_params, x0, labels, rng)
-        grads = (gp, ga)
-        if clip_grad > 0:
-            from deepspeed_tpu.runtime.utils import clip_grad_norm_
-
-            grads, _ = clip_grad_norm_(grads, clip_grad)
-        (new_p, new_a), new_state = optimizer.update(
-            grads, opt_state, (stacked_params, aux_params), lr=lr
+def _train_step_from_loss_grad(loss_grad, optimizer, clip_grad, fp16=False,
+                               dynamic=False, scaler_kwargs=None):
+    def train_step(stacked_params, aux_params, opt_state, scaler_state,
+                   x0, labels, rng, lr):
+        scale = scaler_state.cur_scale if fp16 else jnp.float32(1.0)
+        scaled_loss, (gp, ga) = loss_grad(
+            stacked_params, aux_params, x0, labels, rng, scale
         )
-        return new_p, new_a, new_state, loss
+        loss = scaled_loss / scale
+        if fp16:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) / scale, (gp, ga)
+            )
+        else:
+            grads = (gp, ga)
 
-    return jax.jit(train_step, donate_argnums=(0, 1, 2))
+        def do_update(_):
+            g = grads
+            if clip_grad > 0:
+                from deepspeed_tpu.runtime.utils import clip_grad_norm_
+
+                g, _ = clip_grad_norm_(g, clip_grad)
+            (new_p, new_a), new_state = optimizer.update(
+                g, opt_state, (stacked_params, aux_params), lr=lr
+            )
+            return new_p, new_a, new_state
+
+        if fp16:
+            from deepspeed_tpu.runtime.fp16.loss_scaler import advance_scaler
+
+            finite = jnp.asarray(True)
+            for l in jax.tree_util.tree_leaves(grads):
+                finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(l)))
+            overflow = jnp.logical_not(finite)
+            new_p, new_a, new_state = jax.lax.cond(
+                overflow,
+                lambda _: (stacked_params, aux_params, opt_state),
+                do_update, None,
+            )
+            new_scaler = advance_scaler(scaler_state, overflow, dynamic,
+                                        scaler_kwargs)
+        else:
+            overflow = jnp.asarray(False)
+            new_p, new_a, new_state = do_update(None)
+            new_scaler = scaler_state
+        return new_p, new_a, new_state, new_scaler, loss, overflow
+
+    return jax.jit(train_step, donate_argnums=(0, 1, 2, 3))

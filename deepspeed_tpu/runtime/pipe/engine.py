@@ -69,6 +69,7 @@ class PipelineEngine:
         self.global_steps = 0
         self.global_samples = 0
         self.skipped_steps = 0
+        self._last_overflow = False
 
         if dist_init_required is None or dist_init_required:
             dist.init_distributed()
@@ -474,8 +475,6 @@ class PipelineEngine:
         reasons = []
         if getattr(self, "_compiled_unavailable", None):
             reasons.append(self._compiled_unavailable)
-        if self._fp16:
-            reasons.append("fp16 loss scaling")
         return reasons
 
     def _homogeneous_ok(self):
@@ -645,6 +644,8 @@ class PipelineEngine:
             step = C.build_pipeline_train_step(
                 block_fn, aux_loss, opt, mesh,
                 self.micro_batches, clip_grad=clip,
+                fp16=self._fp16, dynamic=self._dynamic_scale,
+                scaler_kwargs=self._scaler_kwargs,
             )
         else:
             per_layer = self._gather_layer_params()
@@ -657,6 +658,8 @@ class PipelineEngine:
             step = C.build_pipeline_train_step_hetero(
                 first_fn, block_fn, last_loss_fn, opt, mesh,
                 self.micro_batches, clip_grad=clip,
+                fp16=self._fp16, dynamic=self._dynamic_scale,
+                scaler_kwargs=self._scaler_kwargs,
             )
 
         opt_state = opt.init((stacked, aux))
@@ -963,9 +966,14 @@ class PipelineEngine:
         labels = jnp.stack([m[1] for m in micro])
         rng = jax.random.fold_in(self._base_rng, self.global_steps)
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
-        c["stacked"], c["aux"], c["opt_state"], loss = c["step"](
-            c["stacked"], c["aux"], c["opt_state"], x0, labels, rng, lr
+        (c["stacked"], c["aux"], c["opt_state"], self.scaler_state,
+         loss, overflow) = c["step"](
+            c["stacked"], c["aux"], c["opt_state"], self.scaler_state,
+            x0, labels, rng, lr
         )
+        self._last_overflow = bool(jax.device_get(overflow)) if self._fp16 else False
+        if self._last_overflow:
+            self.skipped_steps += 1
         self._stage_params_stale = True
         return loss
 
@@ -1056,11 +1064,20 @@ class PipelineEngine:
             self.agg_train_loss = float(jax.device_get(loss))
             self.global_steps += 1
             self.global_samples += self.micro_batch_size * self.micro_batches * self.dp_world_size
-            if self.lr_scheduler is not None:
+            if self.lr_scheduler is not None and not self._last_overflow:
+                # reference holds the lr schedule on overflow-skipped steps
                 self.lr_scheduler.step()
             if self.monitor is not None:
                 self.monitor.record("Train/Samples/train_loss", self.agg_train_loss, self.global_samples)
                 self.monitor.record("Train/Samples/lr", self.get_lr()[0], self.global_samples)
+                if self._fp16:
+                    # copy: the next compiled step donates scaler_state's
+                    # buffers, and the monitor flushes later (engine.py
+                    # fused-path pattern)
+                    self.monitor.record(
+                        "Train/Samples/loss_scale",
+                        self.scaler_state.cur_scale + 0, self.global_samples,
+                    )
             self.tput_timer.stop(self.global_steps % self._config.steps_per_print == 0)
             if self.global_steps % self._config.steps_per_print == 0:
                 log_dist(
@@ -1391,6 +1408,11 @@ class PipelineEngine:
             global_steps=self.global_steps,
             global_samples=self.global_samples,
             lr_scheduler=self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
+            # fp16 resume: without the scaler a dynamic-scale run restarts at
+            # the initial scale (default 2^32) and overflow-skips its way
+            # back down (non-pipe engine parity, runtime/engine.py save path)
+            scaler_state=jax.device_get(self.scaler_state),
+            skipped_steps=self.skipped_steps,
             client_state=client_state or {},
         )
         with open(os.path.join(path, "module-meta.pt"), "wb") as f:
@@ -1584,6 +1606,12 @@ class PipelineEngine:
         self._stage_params_stale = False
         self.global_steps = meta["global_steps"]
         self.global_samples = meta["global_samples"]
+        if meta.get("scaler_state") is not None:
+            saved = meta["scaler_state"]
+            self.scaler_state = type(self.scaler_state)(
+                *[jnp.asarray(v) for v in saved]
+            )
+        self.skipped_steps = meta.get("skipped_steps", self.skipped_steps)
         if self.lr_scheduler is not None and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         return path, meta.get("client_state", {})
