@@ -202,6 +202,13 @@ def child_main():
         "micro_batch": micro_batch,
         "remat": cfg.checkpoint_activations,
         "remat_policy": cfg.checkpoint_policy,
+        # which attention core ran (the DSTPU_ATTN A/B switch): "pallas"
+        # (fused flash kernel) or "xla" (einsum chain) — recorded so a sweep
+        # can promote whichever implementation measures faster. Mirrors the
+        # exact dispatch condition in ops/transformer/transformer.py so a
+        # malformed env value cannot mislabel the run.
+        "attn_impl": ("xla" if os.environ.get("DSTPU_ATTN", "").strip().lower() == "xla"
+                      else "pallas"),
         "final_loss": round(final_loss, 3),
     }))
     return 0
@@ -393,6 +400,7 @@ def main():
                 if ("tpu" in str(result.get("device_kind", "")).lower()
                         and os.environ.get("BENCH_MODEL", "bert") == "bert"
                         and os.environ.get("BENCH_SEQ", "128") == "128"
+                        and not os.environ.get("DSTPU_ATTN", "").strip()
                         and os.environ.get("BENCH_NO_CACHE") != "1"):
                     _record_tpu_result(result)
                 print(json.dumps(result))

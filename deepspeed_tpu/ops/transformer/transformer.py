@@ -105,7 +105,7 @@ def _attention_core(q, k, v, mask, dropout_ratio, deterministic, dropout_rng,
     # DSTPU_ATTN=xla forces the jnp einsum chain (XLA-fused attention) even on
     # TPU — the A/B switch for benchmarking the Pallas kernel against XLA's
     # own fusion at a given shape without code changes.
-    if os.environ.get("DSTPU_ATTN", "").lower() == "xla":
+    if os.environ.get("DSTPU_ATTN", "").strip().lower() == "xla":
         use_pallas = False
     if use_pallas:
         from deepspeed_tpu.ops.transformer.attention import flash_attention

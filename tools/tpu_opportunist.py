@@ -221,11 +221,15 @@ SWEEP_OUT = os.path.join(REPO, "TPU_SWEEP.json")
 # CPU fallback); the winner — if it beats the default-config record — becomes
 # the headline in TPU_BENCH.json. Remat off trades HBM for ~zero recompute
 # (the in-kernel attention dropout removed the biggest saved-mask stacks);
-# mb128 probes MXU utilization; "nothing" probes full-recompute.
+# mb128 probes MXU utilization; "nothing" probes full-recompute; DSTPU_ATTN
+# A/Bs the Pallas flash kernel against XLA's own fused attention at seq128
+# (SURVEY §7: measure before preferring hand-written kernels).
 SWEEP_CONFIGS = [
     {"BENCH_REMAT": "0", "BENCH_BATCH": "64"},
+    {"BENCH_REMAT": "0", "BENCH_BATCH": "32"},
     {"BENCH_BATCH": "128"},
     {"BENCH_REMAT_POLICY": "nothing", "BENCH_BATCH": "64"},
+    {"DSTPU_ATTN": "xla", "BENCH_BATCH": "64"},
 ]
 
 
@@ -275,6 +279,9 @@ def _matches_config(res, cfg):
         return False
     if ("BENCH_REMAT_POLICY" in cfg
             and res.get("remat_policy") != cfg["BENCH_REMAT_POLICY"]):
+        return False
+    if ("DSTPU_ATTN" in cfg
+            and res.get("attn_impl", "pallas") != cfg["DSTPU_ATTN"].lower()):
         return False
     return True
 
