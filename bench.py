@@ -202,13 +202,15 @@ def child_main():
         "micro_batch": micro_batch,
         "remat": cfg.checkpoint_activations,
         "remat_policy": cfg.checkpoint_policy,
-        # which attention core ran (the DSTPU_ATTN A/B switch): "pallas"
-        # (fused flash kernel) or "xla" (einsum chain) — recorded so a sweep
-        # can promote whichever implementation measures faster. Mirrors the
-        # exact dispatch condition in ops/transformer/transformer.py so a
-        # malformed env value cannot mislabel the run.
-        "attn_impl": ("xla" if os.environ.get("DSTPU_ATTN", "").strip().lower() == "xla"
-                      else "pallas"),
+        # which attention core ran: "xla" (env-forced einsum chain), "pallas"
+        # (fused flash kernel — the TPU default, attention.py:_on_tpu), or
+        # "reference" (jnp fallback on non-TPU backends, e.g. the CPU bench
+        # leg) — so A/B comparisons never attribute fallback numbers to the
+        # kernel
+        "attn_impl": (
+            "xla" if os.environ.get("DSTPU_ATTN", "").strip().lower() == "xla"
+            else ("pallas" if on_tpu else "reference")
+        ),
         "final_loss": round(final_loss, 3),
     }))
     return 0

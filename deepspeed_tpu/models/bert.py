@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
+from deepspeed_tpu.ops.cross_entropy import chunked_cross_entropy
 from deepspeed_tpu.ops.transformer.transformer import (
     DeepSpeedTransformerConfig,
     DeepSpeedTransformerLayer,
@@ -226,16 +227,20 @@ class BertForPreTraining(nn.Module):
         t = nn.Dense(cfg.hidden_size, name="mlm_transform")(h)
         t = nn.gelu(t, approximate=False)
         t = nn.LayerNorm(name="mlm_ln")(t)
-        mlm_logits = t @ word_table.T.astype(t.dtype) + self.param(
-            "mlm_bias", nn.initializers.zeros, (cfg.vocab_size,)
-        ).astype(t.dtype)
+        mlm_bias = self.param("mlm_bias", nn.initializers.zeros, (cfg.vocab_size,))
 
         nsp_logits = nn.Dense(2, name="nsp_head")(pooled)
 
         if masked_lm_labels is None:
+            mlm_logits = t @ word_table.T.astype(t.dtype) + mlm_bias.astype(t.dtype)
             return mlm_logits, nsp_logits
 
-        mlm_loss = cross_entropy(mlm_logits, masked_lm_labels, ignore_index=-1)
+        # Training path: chunked CE never materializes the [B,S,V] logits —
+        # the single largest transient of the step (ops/cross_entropy.py).
+        mlm_loss = chunked_cross_entropy(
+            t, word_table.T.astype(t.dtype), mlm_bias, masked_lm_labels,
+            ignore_index=-1,
+        )
         if next_sentence_label is not None:
             nsp_loss = cross_entropy(nsp_logits, next_sentence_label, ignore_index=-1)
         else:

@@ -14,7 +14,8 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from deepspeed_tpu.models.bert import cross_entropy
+from deepspeed_tpu.models.bert import cross_entropy  # noqa: F401 — public surface
+from deepspeed_tpu.ops.cross_entropy import chunked_cross_entropy
 from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
     resolve_remat_policy,
 )
@@ -109,7 +110,7 @@ class GPT2Model(nn.Module):
     needs_rng = True
 
     @nn.compact
-    def __call__(self, input_ids, deterministic=False):
+    def __call__(self, input_ids, deterministic=False, return_hidden=False):
         cfg = self.config
         init = nn.initializers.normal(stddev=cfg.initializer_range)
         word = nn.Embed(cfg.vocab_size, cfg.hidden_size, embedding_init=init, name="wte")
@@ -137,6 +138,10 @@ class GPT2Model(nn.Module):
         # nn.remat wraps the body (see models/bert.py BertEncoder).
         (h, _), _ = ScanStack(cfg.layer_config(), deterministic, name="layers")((h, mask), None)
         h = nn.LayerNorm(name="ln_f")(h)
+        if return_hidden:
+            # training path: hand (hidden, tied table) to a chunked loss so
+            # the [B,S,V] logits never materialize (ops/cross_entropy.py)
+            return h, word.embedding
         logits = h @ word.embedding.T.astype(h.dtype)
         return logits
 
@@ -149,11 +154,15 @@ class GPT2LMHeadModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, labels=None, deterministic=False):
-        logits = GPT2Model(self.config, name="transformer")(input_ids, deterministic)
+        mod = GPT2Model(self.config, name="transformer")
         if labels is None:
-            return logits
-        # next-token prediction
-        return cross_entropy(logits[:, :-1], labels[:, 1:], ignore_index=-1)
+            return mod(input_ids, deterministic)
+        # next-token prediction through the chunked CE (no [B,S,V] logits)
+        h, table = mod(input_ids, deterministic, return_hidden=True)
+        return chunked_cross_entropy(
+            h[:, :-1], table.T.astype(h.dtype), None, labels[:, 1:],
+            ignore_index=-1,
+        )
 
 
 def init_gpt2(config, batch_size=1, seq_len=64, seed=0):
