@@ -202,15 +202,7 @@ def child_main():
         "micro_batch": micro_batch,
         "remat": cfg.checkpoint_activations,
         "remat_policy": cfg.checkpoint_policy,
-        # which attention core ran: "xla" (env-forced einsum chain), "pallas"
-        # (fused flash kernel — the TPU default, attention.py:_on_tpu), or
-        # "reference" (jnp fallback on non-TPU backends, e.g. the CPU bench
-        # leg) — so A/B comparisons never attribute fallback numbers to the
-        # kernel
-        "attn_impl": (
-            "xla" if os.environ.get("DSTPU_ATTN", "").strip().lower() == "xla"
-            else ("pallas" if on_tpu else "reference")
-        ),
+        "attn_impl": _attn_impl_label(on_tpu),
         "final_loss": round(final_loss, 3),
     }))
     return 0
@@ -269,9 +261,20 @@ def gpt2_child_main():
         "micro_batch": micro_batch,
         "remat": cfg.checkpoint_activations,
         "remat_policy": cfg.checkpoint_policy,
+        "attn_impl": _attn_impl_label(on_tpu),
         "final_loss": round(final_loss, 3),
     }))
     return 0
+
+
+def _attn_impl_label(on_tpu):
+    """Which attention core actually ran (shared by every bench leg): "xla"
+    (env-forced einsum chain), "pallas" (the TPU default), or "reference"
+    (jnp fallback on non-TPU backends) — so A/B comparisons never attribute
+    fallback numbers to the kernel."""
+    if os.environ.get("DSTPU_ATTN", "").strip().lower() == "xla":
+        return "xla"
+    return "pallas" if on_tpu else "reference"
 
 
 # ---------------------------------------------------------------------------

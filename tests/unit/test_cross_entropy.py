@@ -7,19 +7,16 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.models.bert import cross_entropy
 from deepspeed_tpu.ops.cross_entropy import chunked_cross_entropy
 
 
 def _dense_ce(h, w, b, labels, ignore_index=-1):
-    logits = (h @ w).astype(jnp.float32)
+    """Oracle: the exact models-side dense CE the chunked op replaces."""
+    logits = h @ w
     if b is not None:
-        logits = logits + b.astype(jnp.float32)
-    valid = labels != ignore_index
-    safe = jnp.where(valid, labels, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-    nll = jnp.where(valid, nll, 0.0)
-    return nll.sum() / jnp.maximum(valid.sum(), 1)
+        logits = logits + b.astype(logits.dtype)
+    return cross_entropy(logits, labels, ignore_index=ignore_index)
 
 
 @pytest.mark.parametrize("rows_per_chunk", [7, 64, 512])
