@@ -161,3 +161,31 @@ def test_bert_remat_policies_equal_loss():
     for g in (g1, g2):
         for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_flagship_config_scales():
+    """The named configs are the sizes they claim (reference benchmark
+    subjects: BERT-large 336M, GPT-2 1.5B) — checked via eval_shape, no
+    weights materialized."""
+    def n_params(model, *args):
+        shapes = jax.eval_shape(
+            lambda: model.init(
+                {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+                *args,
+            )
+        )
+        return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(shapes))
+
+    ids = jnp.zeros((1, 8), jnp.int32)
+    n_bert = n_params(
+        BertForPreTraining(BertConfig.bert_large()),
+        ids, ids, jnp.ones((1, 8), jnp.int32),
+        jnp.full((1, 8), -1, jnp.int32), jnp.zeros((1,), jnp.int32),
+    )
+    assert 330e6 < n_bert < 345e6, n_bert
+
+    n_xl = n_params(GPT2LMHeadModel(GPT2Config.gpt2_xl()), ids, ids)
+    assert 1.5e9 < n_xl < 1.65e9, n_xl
+
+    n_med = n_params(GPT2LMHeadModel(GPT2Config.gpt2_medium()), ids, ids)
+    assert 330e6 < n_med < 420e6, n_med
