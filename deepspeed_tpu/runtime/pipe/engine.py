@@ -99,6 +99,12 @@ class PipelineEngine:
         )
         self.mp_world_size = mp
         self.dp_world_size = per_stage // mp
+        # Multi-HOST (jax.distributed with >1 process): stage devices span
+        # processes, so the per-stage eager structures (interpreter) cannot
+        # host-hop — stage params stay host-side and the compiled SPMD
+        # executor (global-mesh shard_map) is the only execution path, like
+        # any multi-host SPMD jax program.
+        self._multi_host = jax.process_count() > 1
         self.stage_meshes = []
         for s in range(self.num_stages):
             devs = np.asarray(devices[s * per_stage:(s + 1) * per_stage]).reshape(self.dp_world_size, mp)
@@ -284,6 +290,13 @@ class PipelineEngine:
                 for i in range(lo, hi)
             ]
             self._stage_params.append(stage)
+        if self._multi_host:
+            # interpreter structures (per-stage optimizers, eager acc grads)
+            # never run multi-host; the compiled executor owns optimizer state
+            self._stage_opt = None
+            self._stage_opt_state = []
+            self._acc_grads = None
+            return
         self._make_stage_optimizers()
         self._stage_opt_state = [
             self._stage_opt[s].init(self._stage_params[s]) for s in range(self.num_stages)
@@ -293,7 +306,11 @@ class PipelineEngine:
     def _place_stage_tree(self, tree, s):
         """Commit one layer's param tree to stage ``s``'s sub-mesh: replicated
         when mp == 1, Megatron TP shardings over the ``model`` axis otherwise
-        (GSPMD then inserts the in-stage collectives)."""
+        (GSPMD then inserts the in-stage collectives). Multi-host: stage
+        sub-meshes contain non-addressable devices — keep the tree host-side;
+        the compiled executor commits it to the GLOBAL mesh at stack time."""
+        if self._multi_host:
+            return jax.tree_util.tree_map(np.asarray, tree)
         if self.mp_world_size > 1:
             from deepspeed_tpu.parallel import tp as tp_rules
 
@@ -574,7 +591,18 @@ class PipelineEngine:
 
     def _compiled_mode(self):
         """Which compiled executor this step should use: 'homog', 'hetero', or
-        None (interpreter). Implements the "auto" default policy."""
+        None (interpreter). Implements the "auto" default policy. Multi-host
+        runs FORCE a compiled executor — the interpreter's per-stage eager
+        structures cannot cross process boundaries."""
+        if self._multi_host:
+            if self._homogeneous_ok():
+                return "homog"
+            if self._hetero_plan() is not None:
+                return "hetero"
+            raise RuntimeError(
+                "multi-host pipeline requires the compiled executor, but the "
+                "stages are neither homogeneous nor embed/blocks/head-shaped"
+            )
         if self._executor == "interpreted":
             return None
         base = self._compiled_base_reasons()
@@ -977,6 +1005,22 @@ class PipelineEngine:
         self._stage_params_stale = True
         return loss
 
+    def _gather_host(self, tree):
+        """Host copies of a multi-host global pytree via ``process_allgather``
+        — a COLLECTIVE: every process must reach this point together
+        (save_checkpoint/sync run on all ranks, like every collective in an
+        SPMD program). Single-host callers keep their arrays on device and
+        must not come here."""
+        assert self._multi_host, "_gather_host is for multi-host trees only"
+        import jax.experimental.multihost_utils as mhu
+
+        def g(a):
+            if hasattr(a, "is_fully_addressable") and not a.is_fully_addressable:
+                return np.asarray(mhu.process_allgather(a, tiled=True))
+            return np.asarray(jax.device_get(a))
+
+        return jax.tree_util.tree_map(g, tree)
+
     def _sync_from_compiled(self):
         """Materialize per-stage params/opt state from the stacked compiled
         state (for eval/checkpointing through the interpreter structures)."""
@@ -987,13 +1031,19 @@ class PipelineEngine:
             return
         from deepspeed_tpu.runtime.pipe import compiled as C
 
-        per_stage = C.unstack_stage_params(self._compiled["stacked"])
+        per_stage = C.unstack_stage_params(
+            self._gather_host(self._compiled["stacked"])
+            if self._multi_host else self._compiled["stacked"]
+        )
         for s in range(self.num_stages):
             self._stage_params[s] = self._place_stage_tree(per_stage[s], s)
         # Optimizer state mirrors the (stacked_tree, aux) param container:
         # per-param fields are that 2-tuple; slice stage s out of part 0.
         state = self._compiled["opt_state"]
         if hasattr(state, "_asdict") and self._stage_opt_state is not None:
+            if self._multi_host:
+                state = self._gather_host(state)
+
             def stage_field(val, s):
                 if val is None:
                     return None
@@ -1015,7 +1065,12 @@ class PipelineEngine:
         """Hetero inverse: compiled (stacked blocks + aux) -> per-stage
         interpreter structures, for eval/checkpoint/re-staging."""
         c = self._compiled
-        per_layer = self._unarrange_hetero(c["stacked"], c["aux"])
+        if self._multi_host:
+            per_layer = self._unarrange_hetero(
+                self._gather_host(c["stacked"]), self._gather_host(c["aux"])
+            )
+        else:
+            per_layer = self._unarrange_hetero(c["stacked"], c["aux"])
         for s in range(self.num_stages):
             lo, hi = self.module.stage_layer_range(s)
             self._stage_params[s] = self._place_stage_tree(
@@ -1023,6 +1078,9 @@ class PipelineEngine:
             )
         state = c["opt_state"]
         if hasattr(state, "_asdict") and self._stage_opt_state is not None:
+            if self._multi_host:
+                state = self._gather_host(state)
+
             def stage_field(val, s):
                 if val is None:
                     return None
@@ -1056,10 +1114,21 @@ class PipelineEngine:
             if isinstance(micro[0][0], jnp.ndarray) and isinstance(micro[0][1], jnp.ndarray)
             else None
         )
+        if mode is None and self._multi_host:
+            raise RuntimeError(
+                "multi-host pipeline supports only (input, label) array "
+                "batches through the compiled executor — the per-stage "
+                "interpreter cannot cross process boundaries"
+            )
         if mode is not None:
             loss = self._train_batch_compiled(micro, mode)
             if loss is None:
                 mode = None  # compiled bowed out (e.g. uncarryable state)
+                if self._multi_host:
+                    raise RuntimeError(
+                        "multi-host pipeline: the compiled executor bowed out "
+                        "and no interpreter fallback exists across processes"
+                    )
         if mode is not None:
             self.agg_train_loss = float(jax.device_get(loss))
             self.global_steps += 1
@@ -1114,6 +1183,12 @@ class PipelineEngine:
         """Evaluate micro_batches batches in EVAL mode: every stage program is
         built with deterministic=True so dropout is off (the reference's
         eval_batch switches the module to eval mode, pipe/engine.py:438)."""
+        if self._multi_host:
+            raise NotImplementedError(
+                "eval_batch runs the per-stage interpreter, which cannot cross "
+                "process boundaries — run evaluation in a single-process mesh "
+                "(load the checkpoint there), or use train-path losses"
+            )
         micro = [self._split_batch(next(data_iter)) for _ in range(self.micro_batches)]
         self._ensure_params(micro[0][0])
         self._sync_from_compiled()
@@ -1389,8 +1464,15 @@ class PipelineEngine:
         path = os.path.join(save_dir, str(tag))
         os.makedirs(path, exist_ok=True)
         assert self._stage_params is not None, "nothing to save: run a batch first"
+        # Every process runs the sync (multi-host: the allgather inside is a
+        # collective), but only rank 0 touches the files — N concurrent
+        # writers on a shared checkpoint dir would interleave/corrupt them
+        # (reference: dp_rank 0 saves, engine.py:1521).
         self._sync_from_compiled()
+        write = dist.get_rank() == 0
         layer_params = self._gather_layer_params()
+        if not write:
+            return True
         for idx, p in enumerate(layer_params):
             if p is None:
                 continue
@@ -1587,16 +1669,40 @@ class PipelineEngine:
                 )
                 for i in range(lo, hi)
             ])
-        self._make_stage_optimizers()
-        self._stage_opt_state = [
-            self._stage_opt[s].init(self._stage_params[s]) for s in range(self.num_stages)
-        ]
-        opt_file = os.path.join(path, "optim_states.pt")
-        if os.path.exists(opt_file):
-            with open(opt_file, "rb") as f:
-                if not self._restore_opt_state_per_layer(pickle.load(f)):
-                    logger.warning("could not restore optimizer state; reinitialized")
-        self._zero_acc_grads()
+        if self._multi_host:
+            # Per-stage optimizer objects need stage sub-meshes (devices that
+            # span processes) — keep resume host-side instead: templates from
+            # the basic optimizer over the host stage trees feed the compiled
+            # executor's restack at the next train_batch.
+            self._stage_opt = None
+            self._acc_grads = None
+            if self._config.zero_enabled:
+                logger.warning(
+                    "multi-host ZeRO pipeline checkpoint resume is not "
+                    "supported yet; optimizer moments REINITIALIZED"
+                )
+                self._stage_opt_state = []
+            else:
+                self._stage_opt_state = [
+                    self.basic_optimizer.init(self._stage_params[s])
+                    for s in range(self.num_stages)
+                ]
+                opt_file = os.path.join(path, "optim_states.pt")
+                if os.path.exists(opt_file):
+                    with open(opt_file, "rb") as f:
+                        if not self._restore_opt_state_per_layer(pickle.load(f)):
+                            logger.warning("could not restore optimizer state; reinitialized")
+        else:
+            self._make_stage_optimizers()
+            self._stage_opt_state = [
+                self._stage_opt[s].init(self._stage_params[s]) for s in range(self.num_stages)
+            ]
+            opt_file = os.path.join(path, "optim_states.pt")
+            if os.path.exists(opt_file):
+                with open(opt_file, "rb") as f:
+                    if not self._restore_opt_state_per_layer(pickle.load(f)):
+                        logger.warning("could not restore optimizer state; reinitialized")
+            self._zero_acc_grads()
         # Loaded per-stage params are now authoritative: a previously built
         # compiled (stacked) state would shadow them on the next sync. A prior
         # "uncarryable state" bow-out is also void — the freshly loaded state
