@@ -888,6 +888,26 @@ class PipelineEngine:
         except (TypeError, ValueError, KeyError):
             return None
 
+    def _host_stage_state_template(self, s):
+        """HOST-side per-stage optimizer-state template for multi-host resume:
+        same STRUCTURE the mesh-bound per-stage optimizers would build, but
+        eval_shape + host zeros only — stage sub-meshes span processes, so
+        nothing here may touch a device. The compiled executor's restack
+        re-commits the restored values to the global mesh; if restore fails,
+        the zeroed step counter makes the restack fall through to a fresh
+        init."""
+        stage = self._stage_params[s]
+        if not self._config.zero_enabled:
+            shapes = jax.eval_shape(self.basic_optimizer.init, stage)
+            return jax.tree_util.tree_map(
+                lambda sd: np.zeros(sd.shape, sd.dtype), shapes)
+        from deepspeed_tpu.runtime.zero.pytree_optimizer import host_state_template
+
+        return host_state_template(
+            self.basic_optimizer, stage,
+            keep_master=self.compute_dtype != jnp.float32,
+        )
+
     def _tp_stacked_specs(self, one_tree, lead_dims):
         """TP PartitionSpecs for a stacked tree: Megatron rules on ONE
         stage/block tree (rules count dims from the END, so the stacked
@@ -1676,32 +1696,20 @@ class PipelineEngine:
             # executor's restack at the next train_batch.
             self._stage_opt = None
             self._acc_grads = None
-            if self._config.zero_enabled:
-                logger.warning(
-                    "multi-host ZeRO pipeline checkpoint resume is not "
-                    "supported yet; optimizer moments REINITIALIZED"
-                )
-                self._stage_opt_state = []
-            else:
-                self._stage_opt_state = [
-                    self.basic_optimizer.init(self._stage_params[s])
-                    for s in range(self.num_stages)
-                ]
-                opt_file = os.path.join(path, "optim_states.pt")
-                if os.path.exists(opt_file):
-                    with open(opt_file, "rb") as f:
-                        if not self._restore_opt_state_per_layer(pickle.load(f)):
-                            logger.warning("could not restore optimizer state; reinitialized")
+            self._stage_opt_state = [
+                self._host_stage_state_template(s) for s in range(self.num_stages)
+            ]
         else:
             self._make_stage_optimizers()
             self._stage_opt_state = [
                 self._stage_opt[s].init(self._stage_params[s]) for s in range(self.num_stages)
             ]
-            opt_file = os.path.join(path, "optim_states.pt")
-            if os.path.exists(opt_file):
-                with open(opt_file, "rb") as f:
-                    if not self._restore_opt_state_per_layer(pickle.load(f)):
-                        logger.warning("could not restore optimizer state; reinitialized")
+        opt_file = os.path.join(path, "optim_states.pt")
+        if os.path.exists(opt_file):
+            with open(opt_file, "rb") as f:
+                if not self._restore_opt_state_per_layer(pickle.load(f)):
+                    logger.warning("could not restore optimizer state; reinitialized")
+        if not self._multi_host:
             self._zero_acc_grads()
         # Loaded per-stage params are now authoritative: a previously built
         # compiled (stacked) state would shadow them on the next sync. A prior

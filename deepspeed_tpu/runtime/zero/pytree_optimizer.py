@@ -164,3 +164,25 @@ class ZeroPytreeOptimizer:
             for t, b in zip(leaves_t, leaves_b)
         ]
         return jax.tree_util.tree_unflatten(treedef, restored)
+
+
+def host_state_template(inner, stage_params, keep_master):
+    """HOST-only structural template of the per-stage ZeRO state — the same
+    STRUCTURE ``ZeroPytreeOptimizer.init`` builds (master iff ``keep_master``,
+    inner state over the fp32 master), but shapes come from ``eval_shape``
+    and leaves are host zeros: nothing touches a device, so multi-host
+    engines (whose stage sub-meshes span processes) can restore checkpoints
+    into it. Lives here, next to init(), so the two cannot drift."""
+    def zeros(shapes):
+        return jax.tree_util.tree_map(
+            lambda sd: np.zeros(sd.shape, sd.dtype), shapes)
+
+    master_shapes = jax.eval_shape(
+        lambda t: jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), t),
+        stage_params,
+    )
+    master = zeros(master_shapes)
+    inner_state = zeros(jax.eval_shape(inner.init, master))
+    return ZeroPytreeState(master=master if keep_master else None,
+                           inner_state=inner_state)

@@ -16,6 +16,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
 
@@ -55,7 +56,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _run(rank, world, port, devices, child=CHILD, ckpt=None):
+def _run(rank, world, port, devices, child=CHILD, ckpt=None, zero=0, bf16=False):
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
@@ -64,6 +65,10 @@ def _run(rank, world, port, devices, child=CHILD, ckpt=None):
     })
     if ckpt:
         env["DSTPU_CKPT"] = ckpt
+    if zero:
+        env["DSTPU_ZERO"] = str(zero)
+    if bf16:
+        env["DSTPU_BF16"] = "1"
     for k in ("MASTER_ADDR", "MASTER_PORT", "WORLD_SIZE", "RANK"):
         env.pop(k, None)
     if world > 1:
@@ -126,7 +131,7 @@ class Block(nn.Module):
 mod = PipelineModule([LayerSpec(Block) for _ in range(4)], num_stages=2,
                      loss_fn=lambda o, y: jnp.mean((o - y) ** 2),
                      partition_method="uniform")
-engine, _, _, _ = deepspeed_tpu.initialize(model=mod, config_params={
+CFG = {
     "train_batch_size": 4 * 2 * 2,
     "train_micro_batch_size_per_gpu": 4,
     "gradient_accumulation_steps": 2,
@@ -135,7 +140,12 @@ engine, _, _, _ = deepspeed_tpu.initialize(model=mod, config_params={
     # path is forced onto (interpreter==compiled equivalence is asserted in
     # test_pipe_compiled.py)
     "pipeline": {"executor": "compiled"},
-})
+}
+if os.environ.get("DSTPU_ZERO"):
+    CFG["zero_optimization"] = {"stage": int(os.environ["DSTPU_ZERO"])}
+if os.environ.get("DSTPU_BF16"):
+    CFG["bf16"] = {"enabled": True}
+engine, _, _, _ = deepspeed_tpu.initialize(model=mod, config_params=CFG)
 rng = np.random.RandomState(0)
 losses = []
 for i in range(3):
@@ -158,13 +168,7 @@ if ckpt:
     mod2 = PipelineModule([LayerSpec(Block) for _ in range(4)], num_stages=2,
                           loss_fn=lambda o, y: jnp.mean((o - y) ** 2),
                           partition_method="uniform")
-    e2, _, _, _ = deepspeed_tpu.initialize(model=mod2, config_params={
-        "train_batch_size": 4 * 2 * 2,
-        "train_micro_batch_size_per_gpu": 4,
-        "gradient_accumulation_steps": 2,
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
-        "pipeline": {"executor": "compiled"},
-    })
+    e2, _, _, _ = deepspeed_tpu.initialize(model=mod2, config_params=dict(CFG))
     e2.load_checkpoint(ckpt, tag="mh")
     res = [round(float(e2.train_batch(iter(d))), 6) for d in next_data]
     assert res == cont, (res, cont)
@@ -172,7 +176,8 @@ print("LOSSES", losses)
 '''
 
 
-def test_two_host_pipeline_matches_single_process(tmp_path):
+@pytest.mark.parametrize("zero,bf16", [(0, False), (1, False), (1, True)])
+def test_two_host_pipeline_matches_single_process(tmp_path, zero, bf16):
     """Pipeline stages SPLIT ACROSS PROCESSES: stage 0 on host A's devices,
     stage 1 on host B's — the ppermute rides the cross-process fabric (the
     reference's multi-node pipeline over NCCL). Multi-host forces the
@@ -182,7 +187,8 @@ def test_two_host_pipeline_matches_single_process(tmp_path):
     host-side resume) must continue the trajectory exactly."""
     port = _free_port()
     procs = [_run(r, 2, port, devices=2, child=PIPE_CHILD,
-                  ckpt=str(tmp_path / "mh")) for r in range(2)]
+                  ckpt=str(tmp_path / "mh"), zero=zero, bf16=bf16)
+             for r in range(2)]
     try:
         outs = [p.communicate(timeout=240)[0] for p in procs]
     finally:
@@ -194,7 +200,7 @@ def test_two_host_pipeline_matches_single_process(tmp_path):
     l0, l1 = _losses(outs[0]), _losses(outs[1])
     assert l0 == l1, (l0, l1)
 
-    p = _run(0, 1, port, devices=4, child=PIPE_CHILD)
+    p = _run(0, 1, port, devices=4, child=PIPE_CHILD, zero=zero, bf16=bf16)
     try:
         out = p.communicate(timeout=240)[0]
     finally:
