@@ -1,0 +1,17 @@
+"""ds_report smoke: the environment/op matrix renders without error and names
+the ops and versions it promises (reference env_report.py op_report)."""
+
+import contextlib
+import io
+
+
+def test_env_report_renders():
+    from deepspeed_tpu import env_report
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        env_report.main()
+    out = buf.getvalue()
+    for needle in ("op name", "cpu_adam", "sparse_attn", "transformer",
+                   "jax version", "device count", "deepspeed_tpu version"):
+        assert needle in out, f"missing {needle!r} in ds_report output"

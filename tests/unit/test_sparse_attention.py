@@ -175,3 +175,51 @@ def test_sparsity_config_from_dict_all_modes():
 
     with pytest.raises(NotImplementedError):
         sparsity_config_from_dict({"mode": "nope"}, num_heads=2)
+
+
+def test_sparse_attention_utils():
+    """HF-integration helpers (reference sparse_attention_utils.py): position
+    table tiling, block padding/unpadding round trip, and the sparse
+    self-attention factory wired from a model config."""
+    from types import SimpleNamespace
+
+    from deepspeed_tpu.ops.sparse_attention import (
+        FixedSparsityConfig,
+        SparseAttentionUtils,
+    )
+
+    # position-embedding extension tiles trained rows up to max_position
+    table = jnp.asarray(np.arange(12, dtype=np.float32).reshape(4, 3))
+    ext = SparseAttentionUtils.extend_position_embedding(table, 10)
+    assert ext.shape == (10, 3)
+    np.testing.assert_array_equal(np.asarray(ext[4:8]), np.asarray(table))
+    assert SparseAttentionUtils.extend_position_embedding(table, 3).shape == (4, 3)
+
+    # pad to block multiple + unpad round trip
+    ids = jnp.ones((2, 10), jnp.int32)
+    mask = jnp.ones((2, 10), jnp.int32)
+    pad_len, p_ids, p_mask, p_tt, p_pos, p_emb = SparseAttentionUtils.pad_to_block_size(
+        16, ids, attention_mask=mask, pad_token_id=7
+    )
+    assert pad_len == 6 and p_ids.shape == (2, 16) and p_mask.shape == (2, 16)
+    assert int(p_ids[0, -1]) == 7 and int(p_mask[0, -1]) == 0
+    assert p_tt is None and p_emb is None
+    out = jnp.zeros((2, 16, 4))
+    assert SparseAttentionUtils.unpad_sequence_output(pad_len, out).shape == (2, 10, 4)
+    # already aligned: no-op
+    pad_len2, a_ids, *_ = SparseAttentionUtils.pad_to_block_size(16, p_ids)
+    assert pad_len2 == 0 and a_ids is p_ids
+
+    # factory builds a module matching the model config's shape
+    cfg = SimpleNamespace(hidden_size=32, num_attention_heads=4)
+    attn = SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+        cfg, FixedSparsityConfig(num_heads=4, block=16)
+    )
+    h = jnp.asarray(np.random.RandomState(0).randn(1, 32, 32).astype(np.float32))
+    variables = attn.init(jax.random.PRNGKey(0), h)
+    out = attn.apply(variables, h)
+    assert out.shape == (1, 32, 32)
+
+    tok = SimpleNamespace(model_max_length=512, init_kwargs={})
+    tok = SparseAttentionUtils.update_tokenizer_model_max_length(tok, 4096)
+    assert tok.model_max_length == 4096 and tok.init_kwargs["model_max_length"] == 4096
