@@ -21,10 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-try:  # jax >= 0.8 moved shard_map out of experimental
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from deepspeed_tpu.utils.shard_map_compat import shard_map
 
 
 def _local_attention_partial(q, k, v, bias, q_offset, k_offset, causal):

@@ -22,12 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
 from deepspeed_tpu.ops.transformer.attention import flash_attention
+from deepspeed_tpu.utils.shard_map_compat import shard_map
 
 
 def _seq_to_heads(x, axis_name, W):
@@ -89,10 +85,7 @@ def ulysses_attention(q, k, v, mask=None, mesh=None, axis_name="data", causal=Fa
         mesh=mesh, in_specs=(seq, seq, seq, bseq), out_specs=seq,
     )
     local = functools.partial(ulysses_attention_local, axis_name=axis_name, causal=causal)
-    try:
-        # new-style shard_map: vma checking must be off for pallas_call
-        # (the flash kernel's ShapeDtypeStructs carry no vma annotations)
-        fn = shard_map(local, check_vma=False, **kwargs)
-    except TypeError:  # pragma: no cover — older jax
-        fn = shard_map(local, check_rep=False, **kwargs)
+    # vma/rep checking must be off for pallas_call (the flash kernel's
+    # ShapeDtypeStructs carry no vma annotations)
+    fn = shard_map(local, check_rep=False, **kwargs)
     return fn(q, k, v, bias)

@@ -677,7 +677,7 @@ class DeepSpeedEngine:
         its compressed collective at step time."""
         key = ("fwd_bwd_onebit", needs_rng, batch_ndims)
         if key not in self._jit_cache:
-            from jax.experimental.shard_map import shard_map
+            from deepspeed_tpu.utils.shard_map_compat import shard_map
 
             compute_dtype = self.compute_dtype
             apply_fn = self.apply_fn
@@ -721,7 +721,7 @@ class DeepSpeedEngine:
         if "onebit_step" in self._jit_cache:
             return self._jit_cache["onebit_step"]
 
-        from jax.experimental.shard_map import shard_map
+        from deepspeed_tpu.utils.shard_map_compat import shard_map
 
         from deepspeed_tpu.ops.utils_op import flatten_dense_tensors, tree_spec, unflatten_dense_tensors
         from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdamState

@@ -38,24 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-try:  # jax >= 0.8: top-level shard_map with check_vma instead of check_rep
-    from jax import shard_map as _new_shard_map
-
-    def shard_map(f, mesh, in_specs, out_specs, check_rep=False, axis_names=None):
-        # axis_names = the MANUAL axes; any other mesh axis (the TP ``model``
-        # axis) stays automatic and GSPMD handles its collectives inside f
-        return _new_shard_map(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=check_rep, axis_names=frozenset(axis_names or ()),
-        )
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _old_shard_map
-
-    def shard_map(f, mesh, in_specs, out_specs, check_rep=False, axis_names=None):
-        auto = (frozenset(mesh.axis_names) - frozenset(axis_names)
-                if axis_names else frozenset())
-        return _old_shard_map(f, mesh, in_specs=in_specs, out_specs=out_specs,
-                              check_rep=check_rep, auto=auto)
+from deepspeed_tpu.utils.shard_map_compat import shard_map
 
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"

@@ -5,7 +5,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
-from jax.experimental.shard_map import shard_map
+from deepspeed_tpu.utils.shard_map_compat import shard_map
 
 from deepspeed_tpu.runtime.csr_tensor import CSRTensor, sparse_allreduce
 

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 from jax.sharding import Mesh, PartitionSpec
-from jax.experimental.shard_map import shard_map
+from deepspeed_tpu.utils.shard_map_compat import shard_map
 
 import deepspeed_tpu
 from deepspeed_tpu.runtime.fp16.onebit_adam import OnebitAdam
