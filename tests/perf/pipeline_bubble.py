@@ -17,6 +17,11 @@ Run manually:
 
 import time
 
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
 import numpy as np
 
 import jax
