@@ -495,21 +495,42 @@ class PipelineEngine:
         return reasons
 
     def _homogeneous_ok(self):
-        """Every stage runs an identically-shaped program (compiled v1 scope);
-        ties are handled by the heterogeneous executor, not this one."""
+        """Every stage runs an interchangeable program (compiled v1 scope):
+        same layer CONFIGS (flax dataclass equality — same type+shape but a
+        different num_heads etc. must NOT pass, since the executor applies
+        stage 0's modules to every stage's params), same param structure, and
+        single-array stage IO with output shape == input shape (the scan
+        carry / ppermute contract). Ties go to the heterogeneous executor.
+        Cached: staging cannot change mid-run (mirrors _hetero_cache)."""
+        cached = getattr(self, "_homog_cache", "unset")
+        if cached != "unset":
+            return cached
+        self._homog_cache = self._homogeneous_ok_uncached()
+        return self._homog_cache
+
+    def _homogeneous_ok_uncached(self):
         if self.module.tied_specs:
             return False
+        built = self.module._built
+        lo0, hi0 = self.module.stage_layer_range(0)
         sig0 = None
         for s in range(self.num_stages):
             lo, hi = self.module.stage_layer_range(s)
-            sig = tuple(type(self.module._built[i]).__name__ for i in range(lo, hi))
+            if hi - lo != hi0 - lo0:
+                return False
+            # interchangeability: dataclass equality against stage 0's layer
+            # at the same offset, exactly like _hetero_plan's block check
+            for off in range(hi - lo):
+                a, b = built[lo + off], built[lo0 + off]
+                if type(a) is not type(b) or a != b:
+                    return False
             tdef = jax.tree_util.tree_structure(self._stage_params[s])
             shapes = tuple(
                 l.shape for l in jax.tree_util.tree_leaves(self._stage_params[s])
             )
             if sig0 is None:
-                sig0 = (sig, tdef, shapes)
-            elif (sig, tdef, shapes) != sig0:
+                sig0 = (tdef, shapes)
+            elif (tdef, shapes) != sig0:
                 return False
         return True
 
@@ -607,12 +628,18 @@ class PipelineEngine:
             return None
         base = self._compiled_base_reasons()
         if self._executor == "auto":
-            # default: only TIED embed/head pipelines (gpt2-style) auto-compile
-            # — the tied plan is unambiguous; untied modules keep the
-            # interpreter (and its RNG/opt-state layout) unless opted in.
+            # default: compiled whenever an executor fits — tied embed/head
+            # pipelines take the heterogeneous executor, homogeneous stacks
+            # the plain one (both are loss-equivalent to the interpreter,
+            # test_pipe_compiled.py, and 5-12x its step rate). Anything
+            # shaped differently keeps the interpreter.
+            if base:
+                return None
             plan = self._hetero_plan() if self.module.tied_specs else None
-            if not base and plan is not None and plan["tied_head_idx"] is not None:
+            if plan is not None and plan["tied_head_idx"] is not None:
                 return "hetero"
+            if self._homogeneous_ok():
+                return "homog"
             return None
         # executor == "compiled": force, preferring the homogeneous executor
         reasons = list(base)
@@ -1006,19 +1033,42 @@ class PipelineEngine:
             return None
 
     def _train_batch_compiled(self, micro, mode):
-        self._ensure_compiled(mode)
-        if self._compiled is None:
-            return None
-        c = self._compiled
-        x0 = jnp.stack([m[0] for m in micro])
-        labels = jnp.stack([m[1] for m in micro])
-        rng = jax.random.fold_in(self._base_rng, self.global_steps)
-        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
-        (c["stacked"], c["aux"], c["opt_state"], self.scaler_state,
-         loss, overflow) = c["step"](
-            c["stacked"], c["aux"], c["opt_state"], self.scaler_state,
-            x0, labels, rng, lr
+        # Auto-selected runs may bow out to the interpreter on the FIRST
+        # step if the model violates the compiled v1 contract the static
+        # checks cannot see (e.g. tuple activations between stages — the
+        # scan carry is a single array). A forced executor, a multi-host
+        # run, or a pipeline that already stepped compiled must raise: the
+        # first two have no fallback, the last must not switch numerics
+        # streams mid-run.
+        can_bow_out = (
+            self._executor == "auto" and not self._multi_host
+            and (self._compiled is None or not self._compiled.get("ran"))
         )
+        try:
+            self._ensure_compiled(mode)
+            if self._compiled is None:
+                return None
+            c = self._compiled
+            x0 = jnp.stack([m[0] for m in micro])
+            labels = jnp.stack([m[1] for m in micro])
+            rng = jax.random.fold_in(self._base_rng, self.global_steps)
+            lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+            (c["stacked"], c["aux"], c["opt_state"], self.scaler_state,
+             loss, overflow) = c["step"](
+                c["stacked"], c["aux"], c["opt_state"], self.scaler_state,
+                x0, labels, rng, lr
+            )
+            c["ran"] = True
+        except (TypeError, ValueError) as e:
+            if not can_bow_out:
+                raise
+            logger.warning(
+                "compiled pipeline executor rejected this model at trace time "
+                "(%s); falling back to the interpreter", e,
+            )
+            self._compiled_unavailable = "model shape outside compiled v1 contract"
+            self._compiled = None
+            return None
         self._last_overflow = bool(jax.device_get(overflow)) if self._fp16 else False
         if self._last_overflow:
             self.skipped_steps += 1

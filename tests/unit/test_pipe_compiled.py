@@ -641,3 +641,46 @@ def test_engine_pipe_fp16_scaler_resumes(tmp_path):
     assert e2.skipped_steps == skipped_before
     assert int(jax.device_get(e2.scaler_state.cur_iter)) == int(
         jax.device_get(e1.scaler_state.cur_iter))
+
+
+class TupleBlock(nn.Module):
+    """Stage block that threads a (hidden, gate) TUPLE between stages —
+    outside the compiled executor's single-array carry contract. The first
+    layer receives the plain array microbatch and fabricates the gate."""
+
+    @nn.compact
+    def __call__(self, x, g=None):
+        if g is None:
+            g = jnp.ones_like(x)
+        return x + nn.Dense(HID)(jax.nn.relu(x)) * g, g
+
+
+def test_auto_bows_out_for_tuple_activations():
+    """A homogeneous pipeline passing tuple activations passes the static
+    homogeneity checks but violates the compiled v1 carry contract; under
+    'auto' the engine must bow out to the interpreter on the first step
+    (warning, not a crash) and keep training."""
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+    mod = PipelineModule(
+        [LayerSpec(TupleBlock) for _ in range(4)], num_stages=2,
+        loss_fn=lambda out, y: jnp.mean((out[0] - y) ** 2),
+        partition_method="uniform",
+    )
+    engine, _, _, _ = deepspeed_tpu.initialize(model=mod, config_params={
+        "train_batch_size": 4 * 2 * 4,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+    })
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(2):
+        data = [(rng.randn(16, HID).astype(np.float32),
+                 rng.randn(16, HID).astype(np.float32))
+                for _ in range(2)]
+        losses.append(float(engine.train_batch(iter(data))))
+    assert engine._compiled is None
+    assert getattr(engine, "_compiled_unavailable", None) is not None
+    assert np.isfinite(losses).all()
