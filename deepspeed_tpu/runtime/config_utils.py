@@ -11,6 +11,16 @@ def get_scalar_param(param_dict, param_name, param_default_value):
     return param_dict.get(param_name, param_default_value)
 
 
+def as_config_dict(config):
+    """The raw config dict behind ``config`` (dict or JSON path); {} if neither."""
+    if isinstance(config, dict):
+        return config
+    if isinstance(config, str) and os.path.isfile(config):
+        with open(config) as f:
+            return json.load(f)
+    return {}
+
+
 def resolve_tp_size(config, mpu=None):
     """Tensor-parallel (``model``) axis size, resolved identically by the
     DeepSpeedEngine and the PipelineEngine: an mpu reporting > 1 wins,
@@ -19,11 +29,21 @@ def resolve_tp_size(config, mpu=None):
         mp = int(mpu.get_model_parallel_world_size() or 1)
         if mp > 1:
             return mp
-    cfg_dict = config if isinstance(config, dict) else None
-    if cfg_dict is None and isinstance(config, str) and os.path.isfile(config):
-        with open(config) as f:
-            cfg_dict = json.load(f)
-    return int(((cfg_dict or {}).get("tensor_parallel", {}) or {}).get("size", 1) or 1)
+    return int((as_config_dict(config).get("tensor_parallel", {}) or {}).get("size", 1) or 1)
+
+
+def resolve_dp_size(config):
+    """Optional explicit data-parallel degree: ``mesh.data_parallel_size``.
+
+    ``None`` (the default) means "all remaining devices after tensor/pipe
+    parallelism" — the standard SPMD layout. An explicit value makes the
+    engine build its mesh over only the first ``dp * mp`` visible devices,
+    which is how a *smaller* job runs on a larger pool and how elastic
+    checkpoint tests exercise a changed dp degree on one host (reference
+    elastic resume: ``runtime/zero/stage2.py:1648-1841`` re-partitions saved
+    shards across whatever dp degree the new run has)."""
+    val = (as_config_dict(config).get("mesh", {}) or {}).get("data_parallel_size")
+    return int(val) if val else None
 
 
 def get_list_param(param_dict, param_name, param_default_value):

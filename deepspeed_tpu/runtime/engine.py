@@ -147,10 +147,32 @@ class DeepSpeedEngine:
         assert config is not None, "DeepSpeed requires --deepspeed_config to specify configuration file"
 
         # --- mesh ---------------------------------------------------------
-        from deepspeed_tpu.runtime.config_utils import resolve_tp_size
+        from deepspeed_tpu.runtime.config_utils import resolve_dp_size, resolve_tp_size
 
         mp_size = resolve_tp_size(config, mpu)
-        self.mesh = create_mesh(model_parallel_size=mp_size, pipe_parallel_size=1)
+        dp_size = resolve_dp_size(config)
+        devices = None
+        if dp_size is not None:
+            # Slicing the global device list is only coherent when one process
+            # owns every device; a multi-host sub-pool mesh needs per-process
+            # device selection (not implemented — fail loudly, don't hang in
+            # the first collective).
+            assert jax.process_count() == 1, (
+                "mesh.data_parallel_size is single-process only: with "
+                f"{jax.process_count()} processes the first {dp_size * mp_size} "
+                "global devices would not cover every process"
+            )
+            need = dp_size * mp_size
+            pool = jax.devices()
+            assert need <= len(pool), (
+                f"mesh.data_parallel_size={dp_size} x tensor_parallel={mp_size} "
+                f"needs {need} devices, have {len(pool)}"
+            )
+            devices = pool[:need]
+        self.mesh = create_mesh(
+            data_parallel_size=dp_size, model_parallel_size=mp_size,
+            pipe_parallel_size=1, devices=devices,
+        )
         self.dp_world_size = dp_world_size(self.mesh)
         self.mp_world_size = mp_world_size(self.mesh)
 
@@ -1458,6 +1480,8 @@ class DeepSpeedEngine:
             checkpoint = pickle.load(f)
 
         self.load_module_state_dict(checkpoint["module"], strict=load_module_strict)
+        # set before _load_zero_checkpoint so its log reports the true saved dp
+        self.loaded_checkpoint_dp_world_size = checkpoint.get("dp_world_size", None)
 
         if load_optimizer_states:
             if self.zero_optimization():
@@ -1479,7 +1503,6 @@ class DeepSpeedEngine:
         self.global_steps = checkpoint.get("global_steps", 0)
         self.global_samples = checkpoint.get("global_samples", self.global_steps * self.train_batch_size())
         self.skipped_steps = checkpoint.get("skipped_steps", 0)
-        self.loaded_checkpoint_dp_world_size = checkpoint.get("dp_world_size", None)
 
         deepspeed_states = [
             "module", "optimizer", "lr_scheduler", "scaler", "csr_tensor_module_names",

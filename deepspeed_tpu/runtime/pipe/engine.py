@@ -82,18 +82,32 @@ class PipelineEngine:
 
         self.num_stages = model.num_pipeline_stages()
         devices = jax.devices()
-        assert len(devices) % self.num_stages == 0, (
-            f"device count {len(devices)} not divisible by num_stages {self.num_stages}"
-        )
-        per_stage = len(devices) // self.num_stages
         # 3D parallelism: tensor parallel INSIDE each pipeline stage
         # (reference PipeModelDataParallelTopology, pipe/topology.py:246-250).
         # TP here is sharding-based (parallel/tp.py): stage params commit to
         # the stage sub-mesh's ``model`` axis and GSPMD inserts the Megatron
         # collectives inside the per-stage programs.
-        from deepspeed_tpu.runtime.config_utils import resolve_tp_size
+        from deepspeed_tpu.runtime.config_utils import resolve_dp_size, resolve_tp_size
 
         mp = resolve_tp_size(config, mpu)
+        dp_explicit = resolve_dp_size(config)
+        if dp_explicit is not None:
+            # Same contract as the DeepSpeedEngine: pin dp and use only the
+            # first stages*dp*mp devices. Single-process only — a global
+            # device-list slice cannot cover every process of a multi-host run.
+            assert jax.process_count() == 1, (
+                "mesh.data_parallel_size is single-process only"
+            )
+            need = self.num_stages * dp_explicit * mp
+            assert need <= len(devices), (
+                f"mesh.data_parallel_size={dp_explicit} x tensor_parallel={mp} "
+                f"x stages={self.num_stages} needs {need} devices, have {len(devices)}"
+            )
+            devices = devices[:need]
+        assert len(devices) % self.num_stages == 0, (
+            f"device count {len(devices)} not divisible by num_stages {self.num_stages}"
+        )
+        per_stage = len(devices) // self.num_stages
         assert per_stage % mp == 0, (
             f"devices per stage {per_stage} not divisible by tensor_parallel size {mp}"
         )

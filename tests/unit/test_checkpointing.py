@@ -143,6 +143,79 @@ def test_zero_checkpoint_save_before_step(tmpdir):
     assert engine.save_checkpoint(save_dir)
 
 
+def _cfg_dp(zero_stage, dp, variant):
+    """Config pinned to an explicit dp degree (mesh.data_parallel_size) so
+    save and load can run at different degrees on the one 8-device pool."""
+    cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "mesh": {"data_parallel_size": dp},
+        "zero_optimization": {"stage": zero_stage},
+    }
+    if variant in ("fp16", "offload"):
+        cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    elif variant == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    if variant == "offload":
+        cfg["zero_optimization"]["cpu_offload"] = True
+    return cfg
+
+
+def _merged_master(engine):
+    """Concatenate the engine's logical ZeRO master shards (unpadded)."""
+    shards = engine.optimizer.shard_state_dicts(engine.opt_state)
+    if shards[0].get("master_from_params"):
+        return None
+    return np.concatenate([np.asarray(s["flat_master"], np.float32) for s in shards])
+
+
+@pytest.mark.parametrize(
+    "zero_stage,load_dp,variant",
+    [
+        (1, 2, "fp16"),
+        (2, 2, "fp16"),
+        (2, 8, "fp16"),
+        (2, 2, "offload"),
+        (2, 2, "bf16"),
+        (2, 8, "fp32"),
+    ],
+)
+def test_zero_elastic_checkpoint_cross_dp(tmpdir, zero_stage, load_dp, variant):
+    """Elastic ZeRO resume at a CHANGED dp degree (save dp=4, load dp=2/8):
+    the saved per-rank shards are merged and re-partitioned for the new
+    degree (sharded_optimizer.load_shard_state_dicts; reference mechanism
+    runtime/zero/stage2.py:1648-1841, covered by the reference's
+    tests/unit/test_checkpointing.py elastic cases)."""
+    save_dir = str(tmpdir.join("ckpt"))
+    cfg_save = _cfg_dp(zero_stage, dp=4, variant=variant)
+
+    engine = _make_engine(tmpdir, cfg_save)
+    assert engine.dp_world_size == 4
+    _train_steps(engine, 4)
+    engine.save_checkpoint(save_dir)
+    saved_params = jax.device_get(engine.params)
+    saved_master = _merged_master(engine)
+
+    cfg_load = _cfg_dp(zero_stage, dp=load_dp, variant=variant)
+    engine2 = _make_engine(tmpdir, cfg_load, seed=99)  # different init
+    assert engine2.dp_world_size == load_dp
+    tag, _ = engine2.load_checkpoint(save_dir)
+    assert tag is not None
+    _tree_equal(engine2.params, saved_params)
+    if saved_master is not None:
+        # the re-partitioned master must be the SAME logical vector
+        np.testing.assert_allclose(_merged_master(engine2), saved_master, rtol=0, atol=0)
+
+    # Continued training must match the never-stopped oracle (same data).
+    l1 = _train_steps(engine, 3, seed=17)
+    l2 = _train_steps(engine2, 3, seed=17)
+    rtol = 2e-3 if variant == "bf16" else 1e-4
+    np.testing.assert_allclose(
+        float(jax.device_get(l1)), float(jax.device_get(l2)), rtol=rtol
+    )
+
+
 def test_zero_checkpoint_shard_files(tmpdir):
     save_dir = str(tmpdir.join("ckpt"))
     engine = _make_engine(tmpdir, _cfg(zero_stage=2, fp16=True))
