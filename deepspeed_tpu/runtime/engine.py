@@ -1030,6 +1030,16 @@ class DeepSpeedEngine:
                 fwd_bwd, self.params, self.scaler_state.cur_scale, self._next_rng(), theta_p, *batch
             ))
             self.flops_profiler.set_params(self.params)
+            # per-module table from the FORWARD graph (the reference's hooks
+            # are forward hooks too); totals above stay fwd+bwd. Observe-only:
+            # a model the fwd-only path can't trace (e.g. unconditional
+            # make_rng with no deterministic kwarg) must not kill training.
+            try:
+                self.flops_profiler.analyze_modules(
+                    self._get_fwd_only(needs_rng), self.params, *batch, params=self.params
+                )
+            except Exception as e:  # noqa: BLE001
+                logger.warning(f"flops profiler: per-module analysis skipped ({e})")
             self.flops_profiler.print_model_profile(
                 profile_step=self.global_steps,
                 module_depth=self._config.flops_profiler_config.module_depth,
