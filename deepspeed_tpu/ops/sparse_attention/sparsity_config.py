@@ -29,7 +29,7 @@ class SparsityConfig:
     def setup_layout(self, seq_len):
         if seq_len % self.block != 0:
             raise ValueError(
-                f"Sequence Length, {seq_len}, needs to be dividable by Block size {self.block}!"
+                f"Sequence Length, {seq_len}, must be divisible by the block size {self.block}!"
             )
         num_blocks = seq_len // self.block
         return np.zeros((self.num_heads, num_blocks, num_blocks), dtype=np.int64)
@@ -69,7 +69,7 @@ class FixedSparsityConfig(SparsityConfig):
         super().__init__(num_heads, block, different_layout_per_head)
         if num_local_blocks % num_global_blocks != 0:
             raise ValueError(
-                f"Number of local blocks, {num_local_blocks}, must be dividable by "
+                f"Number of local blocks, {num_local_blocks}, must be divisible by "
                 f"number of global blocks, {num_global_blocks}!"
             )
         self.num_local_blocks = num_local_blocks

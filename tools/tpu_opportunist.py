@@ -215,6 +215,43 @@ def _bench_file_ok(path):
 
 AB_OUT = os.path.join(REPO, "ATTENTION_AB.txt")
 SWEEP_OUT = os.path.join(REPO, "TPU_SWEEP.json")
+LONGSEQ_OUT = os.path.join(REPO, "LONGSEQ_BENCH.json")
+
+
+def _longseq_tpu_ok():
+    """LONGSEQ_BENCH.json counts as landed only once it holds TPU rows (the
+    CPU ratio-shape artifact is kept separately as LONGSEQ_BENCH_CPU.json)."""
+    try:
+        with open(LONGSEQ_OUT) as f:
+            return json.load(f).get("platform") == "tpu"
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def run_longseq():
+    """Long-sequence dense-vs-sparse demonstration on the real chip
+    (tests/perf/longseq_bench.py writes LONGSEQ_BENCH.json itself — only for
+    all-TPU runs; CPU/mixed runs land in LONGSEQ_BENCH_CPU.json). Success
+    requires a FRESH TPU artifact, not a stale file left from before the
+    refresh (the other legs' _fresh_tpu equivalent)."""
+    try:
+        mtime_before = os.path.getmtime(LONGSEQ_OUT)
+    except OSError:
+        mtime_before = None
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tests", "perf", "longseq_bench.py")],
+            capture_output=True, text=True, timeout=BENCH_TIMEOUT, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return False, "longseq timed out"
+    try:
+        fresh = os.path.getmtime(LONGSEQ_OUT) != mtime_before
+    except OSError:
+        fresh = False
+    if fresh and _longseq_tpu_ok():
+        return True, None
+    return False, f"rc={r.returncode}: {(r.stderr or r.stdout).strip()[-400:]}"
 
 # seq128 config sweep: alternates to the bench default (mb64 + remat "dots").
 # Each runs as a full bench child with BENCH_NO_CACHE=1 (no cache clobber, no
@@ -369,6 +406,7 @@ def main():
     ab_done = os.path.exists(AB_OUT)
     gpt2_done = _bench_file_ok(GPT2_OUT)
     sweep_done = _sweep_complete()
+    longseq_done = _longseq_tpu_ok()
     if os.environ.get("TPU_REFRESH") == "1":
         # re-measure even though artifacts exist (e.g. after a perf change);
         # the existing TPU_BENCH.json stays as the fallback until the new
@@ -382,6 +420,7 @@ def main():
         ab_done = False
         gpt2_done = False
         sweep_done = False
+        longseq_done = False
         try:
             os.remove(SWEEP_OUT)
         except OSError:
@@ -389,7 +428,7 @@ def main():
     sleep = SLEEP_MIN
     attempt = 0
     while not (smoke_done and bench_done and seq512_done and ab_done
-               and gpt2_done and sweep_done):
+               and gpt2_done and sweep_done and longseq_done):
         attempt += 1
         ok, info = probe()
         if not ok:
@@ -460,12 +499,19 @@ def main():
                 ab_done = True
             else:
                 log(f"attention A/B FAILED: {err}")
+        if bench_done and not longseq_done:
+            ok2, err = run_longseq()
+            if ok2:
+                longseq_done = True
+                log("longseq bench recorded on TPU")
+            else:
+                log(f"longseq FAILED: {err}")
         if bench_done and not sweep_done:
             sweep_done = run_sweep()
         if not (smoke_done and bench_done and seq512_done and ab_done
-                and gpt2_done and sweep_done):
+                and gpt2_done and sweep_done and longseq_done):
             time.sleep(SLEEP_MIN)
-    log("all done: smoke + bench (seq128 + seq512 + gpt2) + A/B + sweep recorded on TPU")
+    log("all done: smoke + bench (seq128 + seq512 + gpt2) + A/B + longseq + sweep recorded on TPU")
     return 0
 
 
