@@ -451,10 +451,26 @@ class DeepSpeedEngine:
         # shardings along the model axis (parallel/tp.py) — XLA inserts the
         # tensor-parallel collectives in forward/backward.
         fp32 = jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), model_parameters)
+        self._zero3 = (
+            self.zero_optimization() and self.zero_optimization_stage() >= 3
+        )
         if self.mp_world_size > 1:
+            assert not self._zero3, (
+                "ZeRO-3 with tensor parallelism is not supported yet: TP "
+                "already shards params along the model axis; use stage <= 2"
+            )
             from deepspeed_tpu.parallel.tp import shard_params
 
             self.params = shard_params(fp32, self.mesh)
+        elif self._zero3:
+            # Stage 3: params are STORED sharded along the data axis and
+            # gathered on use (runtime/zero/sharded_optimizer.py:
+            # zero3_param_shardings) — the per-device param footprint between
+            # steps is ~1/dp of the model.
+            from deepspeed_tpu.runtime.zero.sharded_optimizer import zero3_param_shardings
+
+            self._zero3_shardings = zero3_param_shardings(self.mesh, fp32)
+            self.params = jax.device_put(fp32, self._zero3_shardings)
         else:
             replicated = NamedSharding(self.mesh, PartitionSpec())
             self.params = jax.device_put(fp32, replicated)
@@ -573,6 +589,7 @@ class DeepSpeedEngine:
             basic_optimizer,
             stage=stage,
             mesh=self.mesh,
+            param_shardings=getattr(self, "_zero3_shardings", None),
             cpu_offload=self.zero_cpu_offload(),
             reduce_scatter=self.zero_reduce_scatter(),
             reduce_bucket_size=self.zero_reduce_bucket_size(),
@@ -646,10 +663,11 @@ class DeepSpeedEngine:
         apply_fn = self.apply_fn
         pld = self.progressive_layer_drop is not None
         remat = getattr(self, "_remat_apply_fn", False)
+        gather = self._gather_params_fn()
 
         def fwd_bwd(params, scale, rng, theta, *batch):
             def loss_fn(p):
-                p_c = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p)
+                p_c = gather(jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p))
                 kwargs = {}
                 if needs_rng:
                     kwargs["rngs"] = {"dropout": rng}
@@ -820,6 +838,18 @@ class DeepSpeedEngine:
         self._jit_cache["onebit_step"] = jax.jit(step_fn, donate_argnums=(0, 1, 2))
         return self._jit_cache["onebit_step"]
 
+    def _gather_params_fn(self):
+        """Identity, except under ZeRO-3: constrain every leaf to replicated
+        INSIDE the jitted step — GSPMD inserts the gather-on-use all-gathers
+        there (the reference stage-3 design's prefetch all-gathers), and the
+        replicated copy lives only for the step."""
+        if not getattr(self, "_zero3", False):
+            return lambda p: p
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+        return lambda p: jax.tree_util.tree_map(
+            lambda x: jax.lax.with_sharding_constraint(x, replicated), p
+        )
+
     def _get_fwd_only(self, needs_rng):
         """Inference path: dropout disabled (deterministic=True when the module
         accepts it; no dropout rng otherwise)."""
@@ -828,9 +858,10 @@ class DeepSpeedEngine:
             compute_dtype = self.compute_dtype
             apply_fn = self.apply_fn
             pass_det = self._module_accepts_deterministic()
+            gather = self._gather_params_fn()
 
             def fwd(params, *batch):
-                p_c = jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), params)
+                p_c = gather(jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), params))
                 kwargs = {"deterministic": True} if pass_det else {}
                 return apply_fn(p_c, *batch, **kwargs)
 
@@ -1390,10 +1421,13 @@ class DeepSpeedEngine:
         return jax.device_get(self.params)
 
     def load_module_state_dict(self, state_dict, strict=True):
+        fp32 = jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), state_dict)
+        if getattr(self, "_zero3", False):
+            # stage-3 storage layout: load straight into the sharded placement
+            self.params = jax.device_put(fp32, self._zero3_shardings)
+            return
         replicated = NamedSharding(self.mesh, PartitionSpec())
-        self.params = jax.device_put(
-            jax.tree_util.tree_map(lambda p: jnp.asarray(p, jnp.float32), state_dict), replicated
-        )
+        self.params = jax.device_put(fp32, replicated)
 
     def optimizer_state_dict(self):
         self._ensure_opt_state()

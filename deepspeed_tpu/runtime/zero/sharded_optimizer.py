@@ -51,6 +51,25 @@ class ZeroState(NamedTuple):
     inner_state: object  # inner optimizer state over the flat vector (sharded)
 
 
+def zero3_param_shardings(mesh, params):
+    """Stage-3 storage layout: each leaf's leading dim sharded along ``data``
+    when divisible (small/indivisible leaves stay replicated — their memory
+    is negligible). This is the TPU-native form of the reference's never-
+    shipped stage 3 (param partitioning with gather-on-use): params LIVE
+    sharded between steps; the training step constrains them to replicated at
+    use, so XLA inserts the all-gather exactly where the reference would have
+    issued its prefetch all-gathers, and re-shards on update output."""
+    dp = dp_world_size(mesh)
+
+    def spec(p):
+        shape = getattr(p, "shape", ())
+        if len(shape) >= 1 and shape[0] >= dp and shape[0] % dp == 0:
+            return NamedSharding(mesh, PartitionSpec(DATA_AXIS, *([None] * (len(shape) - 1))))
+        return NamedSharding(mesh, PartitionSpec())
+
+    return jax.tree_util.tree_map(spec, params)
+
+
 class ZeroShardedOptimizer:
     """Optimizer wrapper implementing ZeRO-1/2 semantics on a mesh."""
 
@@ -58,7 +77,8 @@ class ZeroShardedOptimizer:
                  reduce_bucket_size=DEFAULT_BUCKET_SIZE,
                  allgather_bucket_size=DEFAULT_BUCKET_SIZE,
                  elastic_checkpoint=True, clip_grad=0.0, postscale_gradients=True,
-                 gradient_predivide_factor=1.0, keep_master=True):
+                 gradient_predivide_factor=1.0, keep_master=True,
+                 param_shardings=None):
         assert mesh is not None, "ZeroShardedOptimizer requires a mesh"
         self.inner = inner
         self.stage = stage
@@ -97,6 +117,7 @@ class ZeroShardedOptimizer:
         self._spec = None  # (treedef, shapes, dtypes, sizes)
         self._numel = None
         self._padded = None
+        self._param_shardings = param_shardings  # stage-3 storage layout
         self.lr = getattr(inner, "lr", 1e-3)
         self.name = getattr(inner, "name", "zero")
 
@@ -106,6 +127,16 @@ class ZeroShardedOptimizer:
 
     def init(self, params):
         self._spec = tree_spec(params)
+        if self.stage >= 3:
+            assert not self.cpu_offload, (
+                "ZeRO-3 + cpu_offload is not supported: stage 3's win is "
+                "sharded on-device param storage; combine offload with stage 2"
+            )
+            # the engine passes ITS storage layout so there is exactly one
+            # definition of where stage-3 params live (engine.py builds it
+            # via zero3_param_shardings and device_puts params accordingly)
+            if self._param_shardings is None:
+                self._param_shardings = zero3_param_shardings(self.mesh, params)
         flat = flatten_dense_tensors(params, jnp.float32)
         self._numel = int(flat.shape[0])
         flat, _ = pad_to_multiple(flat, self.dp)
@@ -147,16 +178,26 @@ class ZeroShardedOptimizer:
         new_master, new_inner = self.inner.update(flat_grads, opt_state.inner_state, master, lr=lr)
         new_master = jax.lax.with_sharding_constraint(new_master, self._shard_sharding())
 
-        # Rebuild replicated params in their original dtypes: XLA inserts the
-        # all-gather over ICI here (the reference's sharded sequential
-        # all_gather, stage2.py:1444-1477).
-        full = jax.lax.with_sharding_constraint(
-            new_master[: self._numel], NamedSharding(self.mesh, PartitionSpec())
-        )
-        # Rebuild in the dtypes the engine currently holds (compute dtype under
-        # mixed precision — the fp32 master stays only in the shard).
+        # Rebuild params in their original dtypes (compute dtype under mixed
+        # precision — the fp32 master stays only in the shard).
         out_dtypes = [l.dtype for l in jax.tree_util.tree_leaves(params)]
-        new_params = unflatten_dense_tensors(full, treedef, shapes, out_dtypes)
+        if self.stage >= 3:
+            # Stage 3: params STAY sharded between steps — each rebuilt leaf
+            # is constrained to its storage sharding, so the only replicated
+            # copy ever materialized is the transient one the forward gathers.
+            new_params = unflatten_dense_tensors(
+                new_master[: self._numel], treedef, shapes, out_dtypes
+            )
+            new_params = jax.tree_util.tree_map(
+                jax.lax.with_sharding_constraint, new_params, self._param_shardings
+            )
+        else:
+            # Stages 1/2: XLA inserts the all-gather over ICI here (the
+            # reference's sharded sequential all_gather, stage2.py:1444-1477).
+            full = jax.lax.with_sharding_constraint(
+                new_master[: self._numel], NamedSharding(self.mesh, PartitionSpec())
+            )
+            new_params = unflatten_dense_tensors(full, treedef, shapes, out_dtypes)
         if not self.keep_master:
             new_master = jnp.zeros((0,), jnp.float32)
         return new_params, ZeroState(flat_master=new_master, inner_state=new_inner)

@@ -138,10 +138,18 @@ def main():
             row = _spawn(impl, S, iters)
             rows.append(row)
             print(json.dumps(row), flush=True)
+            # persist after EVERY row: if the parent is killed mid-sweep
+            # (watcher timeout, tunnel wedge) the completed measurements
+            # survive instead of being discarded with the process
+            _write_summary(rows, seqs)
+    _write_summary(rows, seqs)
 
+
+def _write_summary(rows, seqs):
     by = {(r["impl"], r["seq"]): r for r in rows}
     summary = {"rows": rows, "block": BLOCK, "band": BAND,
-               "shape": {"B": B, "H": H, "D": D}}
+               "shape": {"B": B, "H": H, "D": D},
+               "complete": len(rows) == len(seqs) * 4}
     ok = [r for r in rows if "ms" in r]
     if ok:
         platforms = {r["platform"] for r in ok}

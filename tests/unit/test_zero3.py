@@ -1,0 +1,149 @@
+"""ZeRO-3 (param sharding + gather-on-use) tests.
+
+The reference never shipped stage 3 (its constants cap at stage 2:
+reference deepspeed/runtime/zero/constants.py:33); this is the TPU-native
+realization of the published design (ZeRO paper §5: params partitioned
+across dp ranks, all-gathered on use, re-partitioned after update):
+``zero3_param_shardings`` stores each leaf sharded along ``data``; the
+jitted step constrains to replicated at use (GSPMD inserts the all-gather)
+and the optimizer re-constrains the rebuilt params to the sharded layout.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel.mesh import DATA_AXIS
+from tests.unit.simple_model import args_from_dict, create_simple_model, random_dataloader
+
+HIDDEN = 16
+
+
+def _cfg(stage, fp16=True, dp=None):
+    cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "zero_optimization": {"stage": stage},
+    }
+    if fp16:
+        cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    if dp is not None:
+        cfg["mesh"] = {"data_parallel_size": dp}
+    return cfg
+
+
+def _make_engine(tmpdir, cfg, seed=5):
+    model, params = create_simple_model(hidden_dim=HIDDEN, seed=seed)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params
+    )
+    return engine
+
+
+def _train(engine, steps, seed=3):
+    loader = random_dataloader(engine, total_samples=steps * engine.train_batch_size(),
+                               hidden_dim=HIDDEN, seed=seed)
+    losses = []
+    for x, y in loader:
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    return losses
+
+
+@pytest.mark.parametrize("fp16", [True, False])
+def test_zero3_matches_zero2(tmpdir, fp16):
+    """Stage 3 is a memory layout, not an algorithm change: losses must match
+    stage 2 step for step."""
+    l2 = _train(_make_engine(tmpdir, _cfg(2, fp16=fp16)), 6)
+    l3 = _train(_make_engine(tmpdir, _cfg(3, fp16=fp16)), 6)
+    np.testing.assert_allclose(l2, l3, rtol=1e-5)
+
+
+def test_zero3_params_stored_sharded(tmpdir):
+    """Between steps every shardable leaf lives 1/dp-sized per device."""
+    engine = _make_engine(tmpdir, _cfg(3))
+    dp = engine.dp_world_size
+    _train(engine, 2)
+    checked = 0
+    for leaf in jax.tree_util.tree_leaves(engine.params):
+        if leaf.shape and leaf.shape[0] >= dp and leaf.shape[0] % dp == 0:
+            assert leaf.sharding.spec[0] == DATA_AXIS, (leaf.shape, leaf.sharding)
+            shard = leaf.addressable_shards[0].data
+            assert shard.shape[0] == leaf.shape[0] // dp, (leaf.shape, shard.shape)
+            checked += 1
+    assert checked >= 2, "no sharded leaves found"
+
+
+def test_zero3_gather_on_use_in_hlo(tmpdir):
+    """The fwd+bwd program must contain the gather-on-use collective."""
+    engine = _make_engine(tmpdir, _cfg(3))
+    engine._ensure_opt_state()
+    x = jnp.ones((8, HIDDEN), jnp.float32)
+    y = jnp.zeros((8, HIDDEN), jnp.float32)
+    fwd_bwd = engine._get_fwd_bwd(False)
+    hlo = fwd_bwd.lower(
+        engine.params, jnp.float32(1.0), jax.random.PRNGKey(0),
+        jnp.float32(1.0), engine._shard_batch(x), engine._shard_batch(y),
+    ).compile().as_text()
+    assert "all-gather" in hlo, hlo[-1500:]
+
+
+def test_zero3_checkpoint_roundtrip(tmpdir):
+    save_dir = str(tmpdir.join("ckpt"))
+    cfg = _cfg(3)
+    engine = _make_engine(tmpdir, cfg)
+    _train(engine, 3)
+    engine.save_checkpoint(save_dir)
+    saved = jax.device_get(engine.params)
+
+    engine2 = _make_engine(tmpdir, cfg, seed=99)
+    tag, _ = engine2.load_checkpoint(save_dir)
+    assert tag is not None
+    for a, b in zip(jax.tree_util.tree_leaves(saved),
+                    jax.tree_util.tree_leaves(jax.device_get(engine2.params))):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b, np.float32))
+
+    l1 = _train(engine, 3, seed=17)
+    l2 = _train(engine2, 3, seed=17)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_zero3_elastic_cross_dp(tmpdir):
+    """Stage-3 shard files re-partition across a changed dp degree like
+    stages 1/2 (same merge path)."""
+    save_dir = str(tmpdir.join("ckpt"))
+    engine = _make_engine(tmpdir, _cfg(3, dp=4))
+    assert engine.dp_world_size == 4
+    _train(engine, 3)
+    engine.save_checkpoint(save_dir)
+
+    engine2 = _make_engine(tmpdir, _cfg(3, dp=8), seed=99)
+    tag, _ = engine2.load_checkpoint(save_dir)
+    assert tag is not None
+    l1 = _train(engine, 3, seed=17)
+    l2 = _train(engine2, 3, seed=17)
+    np.testing.assert_allclose(l1, l2, rtol=1e-4)
+
+
+def test_zero3_offload_rejected(tmpdir):
+    cfg = _cfg(3)
+    cfg["zero_optimization"]["cpu_offload"] = True
+    engine = _make_engine(tmpdir, cfg)
+    x = jnp.ones((8, HIDDEN), jnp.float32)
+    with pytest.raises(AssertionError, match="ZeRO-3"):
+        loss = engine(x, jnp.zeros((8, HIDDEN), jnp.float32))
+        engine.backward(loss)
+        engine.step()
+
+
+def test_zero3_tp_rejected(tmpdir):
+    cfg = _cfg(3)
+    cfg["tensor_parallel"] = {"size": 2}
+    with pytest.raises(AssertionError, match="ZeRO-3"):
+        _make_engine(tmpdir, cfg)

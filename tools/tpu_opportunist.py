@@ -219,11 +219,14 @@ LONGSEQ_OUT = os.path.join(REPO, "LONGSEQ_BENCH.json")
 
 
 def _longseq_tpu_ok():
-    """LONGSEQ_BENCH.json counts as landed only once it holds TPU rows (the
-    CPU ratio-shape artifact is kept separately as LONGSEQ_BENCH_CPU.json)."""
+    """LONGSEQ_BENCH.json counts as landed only once it holds a COMPLETE
+    all-TPU sweep (the CPU ratio-shape artifact is kept separately as
+    LONGSEQ_BENCH_CPU.json; the script writes incrementally, so a partial
+    file can exist after a mid-sweep kill)."""
     try:
         with open(LONGSEQ_OUT) as f:
-            return json.load(f).get("platform") == "tpu"
+            d = json.load(f)
+        return d.get("platform") == "tpu" and d.get("complete")
     except Exception:  # noqa: BLE001
         return False
 
@@ -238,10 +241,16 @@ def run_longseq():
         mtime_before = os.path.getmtime(LONGSEQ_OUT)
     except OSError:
         mtime_before = None
+    # budget = every cell hitting its child timeout, plus slack — a single
+    # BENCH_TIMEOUT was smaller than the children's combined worst case, so
+    # a flaky tunnel could kill the sweep with all completed rows lost
+    n_cells = 5 * 4  # default LONGSEQ_SEQS x impls
+    child_t = int(os.environ.get("LONGSEQ_CHILD_TIMEOUT", "900"))
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "tests", "perf", "longseq_bench.py")],
-            capture_output=True, text=True, timeout=BENCH_TIMEOUT, cwd=REPO,
+            capture_output=True, text=True,
+            timeout=n_cells * child_t + 600, cwd=REPO,
         )
     except subprocess.TimeoutExpired:
         return False, "longseq timed out"
