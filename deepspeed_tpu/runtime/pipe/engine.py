@@ -698,18 +698,7 @@ class PipelineEngine:
                 self._stage_params, mesh, specs=tp_specs(self._stage_params[0], 1)
             )
             aux = {}
-            stage_fn = self.module.stage_forward(0)
-            dtype = self.compute_dtype
-
-            def block_fn(stage_params, x, rng):
-                p = jax.tree_util.tree_map(lambda a: a.astype(dtype), stage_params)
-                return stage_fn(p, x, rngs={"dropout": rng})
-
-            loss_fn = self.module.loss_fn
-
-            def aux_loss(a, y, label):
-                return loss_fn(y, label)
-
+            block_fn, aux_loss = self._homog_fns()
             step = C.build_pipeline_train_step(
                 block_fn, aux_loss, opt, mesh,
                 self.micro_batches, clip_grad=clip,
@@ -757,10 +746,31 @@ class PipelineEngine:
         self._compiled = {"step": step, "stacked": stacked, "aux": aux,
                           "opt_state": opt_state, "mesh": mesh, "mode": mode}
 
+    def _homog_fns(self, deterministic=False):
+        """(block_fn, aux_loss) for the homogeneous executor — ONE definition
+        for the train and eval programs so their numerics cannot drift
+        (deterministic=True builds the dropout-off eval variant)."""
+        stage_fn = self.module.stage_forward(
+            0, deterministic=True if deterministic else None
+        )
+        dtype = self.compute_dtype
+
+        def block_fn(stage_params, x, rng):
+            p = jax.tree_util.tree_map(lambda a: a.astype(dtype), stage_params)
+            return stage_fn(p, x, rngs={"dropout": rng})
+
+        loss_fn = self.module.loss_fn
+
+        def aux_loss(a, y, label):
+            return loss_fn(y, label)
+
+        return block_fn, aux_loss
+
     # -- heterogeneous executor plumbing --------------------------------
-    def _hetero_fns(self):
+    def _hetero_fns(self, deterministic=False):
         """(first_fn, block_fn, last_loss_fn) for the hetero executor, built
-        from the module's layer appliers (pipe/module.py:_apply_layer)."""
+        from the module's layer appliers (pipe/module.py:_apply_layer).
+        ``deterministic=True`` builds the eval-mode variants (dropout off)."""
         plan = self._hetero_plan()
         m = self.module
         dtype = self.compute_dtype
@@ -768,12 +778,14 @@ class PipelineEngine:
         b_rep = plan["block_rep"]
         tail_idx = plan["tail_idx"]
         tied_head = plan["tied_head_idx"]
+        det = True if deterministic else None
 
         def cast(t):
             return jax.tree_util.tree_map(lambda a: a.astype(dtype), t)
 
         def first_fn(aux, inp, rng):
-            return m._apply_layer(0, cast(aux["first"]), inp, rngs={"dropout": rng})
+            return m._apply_layer(0, cast(aux["first"]), inp,
+                                  rngs={"dropout": rng}, deterministic=det)
 
         def block_fn(stage_params, x, rng):
             # stage_params: this stage's k blocks stacked on a leading axis;
@@ -783,6 +795,7 @@ class PipelineEngine:
                 h = m._apply_layer(
                     b_rep, cast(sp), h,
                     rngs={"dropout": jax.random.fold_in(rng, j)},
+                    deterministic=det,
                 )
                 return h, None
 
@@ -794,9 +807,9 @@ class PipelineEngine:
         def last_loss_fn(aux, y, label):
             h = y
             for t, i in enumerate(tail_idx):
-                h = m._apply_layer(i, cast(aux["tail"][t]), h)
+                h = m._apply_layer(i, cast(aux["tail"][t]), h, deterministic=det)
             if tied_head is not None:
-                h = m._apply_layer(tied_head, cast(aux["first"]), h)
+                h = m._apply_layer(tied_head, cast(aux["first"]), h, deterministic=det)
             return m.loss_fn(h, label)
 
         return first_fn, block_fn, last_loss_fn
@@ -1263,18 +1276,77 @@ class PipelineEngine:
                 self.monitor.flush()
         return self.agg_train_loss
 
+    def _ensure_compiled_eval(self):
+        """Deterministic (dropout-off) compiled loss program over the same
+        stacked params the train step uses — the eval path for multi-host
+        runs (and for any compiled pipeline, avoiding a stacked->per-stage
+        sync just to evaluate)."""
+        c = self._compiled
+        if c.get("eval") is not None:
+            return
+        from deepspeed_tpu.runtime.pipe import compiled as C
+
+        mesh = c["mesh"]
+        if c["mode"] == "homog":
+            block_fn, aux_loss = self._homog_fns(deterministic=True)
+            ev = C.build_pipeline_loss(block_fn, aux_loss, mesh, self.micro_batches)
+        else:
+            first_fn, block_fn, last_loss_fn = self._hetero_fns(deterministic=True)
+            ev = C.build_pipeline_loss_hetero(
+                first_fn, block_fn, last_loss_fn, mesh, self.micro_batches
+            )
+        c["eval"] = jax.jit(ev)
+
     def eval_batch(self, data_iter):
         """Evaluate micro_batches batches in EVAL mode: every stage program is
         built with deterministic=True so dropout is off (the reference's
-        eval_batch switches the module to eval mode, pipe/engine.py:438)."""
-        if self._multi_host:
-            raise NotImplementedError(
-                "eval_batch runs the per-stage interpreter, which cannot cross "
-                "process boundaries — run evaluation in a single-process mesh "
-                "(load the checkpoint there), or use train-path losses"
-            )
+        eval_batch switches the module to eval mode, pipe/engine.py:438).
+
+        Compiled pipelines (including EVERY multi-host pipeline) evaluate
+        through a deterministic variant of the single SPMD program; the
+        per-stage interpreter below is the eager fallback."""
         micro = [self._split_batch(next(data_iter)) for _ in range(self.micro_batches)]
         self._ensure_params(micro[0][0])
+        mode = (
+            self._compiled_mode()
+            if isinstance(micro[0][0], jnp.ndarray) and isinstance(micro[0][1], jnp.ndarray)
+            else None
+        )
+        if mode is not None:
+            # Same trace-time bow-out contract as _train_batch_compiled: an
+            # auto-selected model outside the compiled v1 contract falls back
+            # to the interpreter instead of crashing eval. NOTE: this path
+            # reuses _ensure_compiled (full train-step build incl. optimizer
+            # state) deliberately — eval shares the train step's stacked
+            # params, so a separate eval-only stacking could drift.
+            can_bow_out = (
+                self._executor == "auto" and not self._multi_host
+                and (self._compiled is None or not self._compiled.get("ran"))
+            )
+            try:
+                self._ensure_compiled(mode)
+                if self._compiled is not None:
+                    self._ensure_compiled_eval()
+                    c = self._compiled
+                    x0 = jnp.stack([m[0] for m in micro])
+                    labels = jnp.stack([m[1] for m in micro])
+                    loss = c["eval"](c["stacked"], c["aux"], x0, labels, self._base_rng)
+                    return float(jax.device_get(loss))
+            except (TypeError, ValueError) as e:
+                if not can_bow_out:
+                    raise
+                logger.warning(
+                    "compiled pipeline eval rejected this model at trace time "
+                    "(%s); falling back to the interpreter", e,
+                )
+                self._compiled_unavailable = "model shape outside compiled v1 contract"
+                self._compiled = None
+        if self._multi_host:
+            raise NotImplementedError(
+                "multi-host eval_batch needs the compiled executor (the "
+                "per-stage interpreter cannot cross process boundaries) — "
+                "this pipeline fell back to the interpreter"
+            )
         self._sync_from_compiled()
         losses = []
         rng = self._base_rng

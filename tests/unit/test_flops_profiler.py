@@ -173,6 +173,32 @@ def test_engine_per_module_profile(capsys):
     assert "Top" in reports[0] and "MACs at depth" in reports[0]
 
 
+def test_scan_trip_count_multiplication():
+    """Scan-rolled layers (the BERT/GPT-2 encoders) report length x the body's
+    FLOPs, not one trip's."""
+    D = 32
+
+    class Layer(nn.Module):
+        @nn.compact
+        def __call__(self, x, _):
+            return nn.Dense(D)(x), None
+
+    def run(length):
+        Scanned = nn.scan(
+            Layer, variable_axes={"params": 0}, split_rngs={"params": True},
+            length=length,
+        )
+        m = Scanned()
+        x = jnp.ones((4, D))
+        params = m.init(jax.random.PRNGKey(0), x, None)
+        prof = FlopsProfiler()
+        prof.analyze_modules(lambda p, a: m.apply(p, a, None)[0], params, x)
+        return sum(prof.module_flops.values())
+
+    f1, f4 = run(1), run(4)
+    assert f4 >= 3.5 * f1, (f1, f4)
+
+
 def test_formatting():
     assert flops_to_string(2e12) == "2.00 TFLOPS"
     assert params_to_string(336e6).endswith("M")

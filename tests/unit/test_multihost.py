@@ -56,7 +56,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _run(rank, world, port, devices, child=CHILD, ckpt=None, zero=0, bf16=False):
+def _run(rank, world, port, devices, child=CHILD, ckpt=None, zero=0, bf16=False, tp=0):
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
@@ -69,6 +69,8 @@ def _run(rank, world, port, devices, child=CHILD, ckpt=None, zero=0, bf16=False)
         env["DSTPU_ZERO"] = str(zero)
     if bf16:
         env["DSTPU_BF16"] = "1"
+    if tp:
+        env["DSTPU_TP"] = str(tp)
     for k in ("MASTER_ADDR", "MASTER_PORT", "WORLD_SIZE", "RANK"):
         env.pop(k, None)
     if world > 1:
@@ -124,15 +126,21 @@ from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
 
 HID = 8
 class Block(nn.Module):
+    # ff1/ff2 names take the Megatron column/row TP rules (parallel/tp.py),
+    # so the DSTPU_TP variant actually shards the stage params
     @nn.compact
     def __call__(self, x):
-        return x + nn.Dense(HID)(jax.nn.relu(x))
+        h = jax.nn.relu(nn.Dense(2 * HID, name="ff1")(x))
+        return x + nn.Dense(HID, name="ff2")(h)
 
 mod = PipelineModule([LayerSpec(Block) for _ in range(4)], num_stages=2,
                      loss_fn=lambda o, y: jnp.mean((o - y) ** 2),
                      partition_method="uniform")
+TP = int(os.environ.get("DSTPU_TP", "1"))
+DP = jax.device_count() // 2 // TP  # stages=2
+ROWS = 4 * DP
 CFG = {
-    "train_batch_size": 4 * 2 * 2,
+    "train_batch_size": 4 * 2 * DP,
     "train_micro_batch_size_per_gpu": 4,
     "gradient_accumulation_steps": 2,
     "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
@@ -141,6 +149,8 @@ CFG = {
     # test_pipe_compiled.py)
     "pipeline": {"executor": "compiled"},
 }
+if TP > 1:
+    CFG["tensor_parallel"] = {"size": TP}
 if os.environ.get("DSTPU_ZERO"):
     CFG["zero_optimization"] = {"stage": int(os.environ["DSTPU_ZERO"])}
 if os.environ.get("DSTPU_BF16"):
@@ -149,10 +159,23 @@ engine, _, _, _ = deepspeed_tpu.initialize(model=mod, config_params=CFG)
 rng = np.random.RandomState(0)
 losses = []
 for i in range(3):
-    data = [(rng.randn(8, HID).astype(np.float32), rng.randn(8, HID).astype(np.float32))
+    data = [(rng.randn(ROWS, HID).astype(np.float32), rng.randn(ROWS, HID).astype(np.float32))
             for _ in range(2)]
     losses.append(round(float(engine.train_batch(iter(data))), 6))
 assert engine._compiled is not None, "expected the compiled executor"
+if TP > 1:
+    assert engine.mp_world_size == TP
+    assert any(
+        "model" in str(l.sharding.spec)
+        for l in jax.tree_util.tree_leaves(engine._compiled["stacked"])
+    ), "TP did not shard any stacked stage param"
+
+# multi-host eval: the deterministic compiled loss program (the per-stage
+# interpreter cannot cross processes)
+erng = np.random.RandomState(123)
+eval_data = [(erng.randn(ROWS, HID).astype(np.float32),
+              erng.randn(ROWS, HID).astype(np.float32)) for _ in range(2)]
+print("EVAL", round(engine.eval_batch(iter(eval_data)), 6))
 
 # checkpoint round trip under multi-host: every rank calls save (the sync's
 # allgather is a collective), rank 0 writes; a fresh engine resumes and must
@@ -174,6 +197,13 @@ if ckpt:
     assert res == cont, (res, cont)
 print("LOSSES", losses)
 '''
+
+
+def _eval_loss(out):
+    for line in out.splitlines():
+        if line.startswith("EVAL "):
+            return float(line[len("EVAL "):])
+    raise AssertionError(f"no EVAL line in child output:\n{out[-2000:]}")
 
 
 @pytest.mark.parametrize("zero,bf16", [(0, False), (1, False), (1, True)])
@@ -200,7 +230,41 @@ def test_two_host_pipeline_matches_single_process(tmp_path, zero, bf16):
     l0, l1 = _losses(outs[0]), _losses(outs[1])
     assert l0 == l1, (l0, l1)
 
+    e0, e1 = _eval_loss(outs[0]), _eval_loss(outs[1])
+    assert e0 == e1, (e0, e1)
+
     p = _run(0, 1, port, devices=4, child=PIPE_CHILD, zero=zero, bf16=bf16)
+    try:
+        out = p.communicate(timeout=240)[0]
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert p.returncode == 0, out[-2000:]
+    np.testing.assert_allclose(l0, _losses(out), rtol=1e-4)
+    np.testing.assert_allclose(e0, _eval_loss(out), rtol=1e-4)
+
+
+def test_two_host_pipeline_tensor_parallel(tmp_path):
+    """pp2 x tp2 ACROSS two processes: each stage's TP pair spans one host,
+    the stage exchange crosses hosts, and the stacked stage params carry the
+    model axis — the untested multi-host x compiled x TP combination."""
+    port = _free_port()
+    procs = [_run(r, 2, port, devices=2, child=PIPE_CHILD, tp=2)
+             for r in range(2)]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, o[-2000:]
+    l0, l1 = _losses(outs[0]), _losses(outs[1])
+    assert l0 == l1, (l0, l1)
+    assert _eval_loss(outs[0]) == _eval_loss(outs[1])
+
+    # single-process oracle: same pp2 x tp2 program on a 4-device mesh
+    p = _run(0, 1, port, devices=4, child=PIPE_CHILD, tp=2)
     try:
         out = p.communicate(timeout=240)[0]
     finally:
