@@ -1089,18 +1089,23 @@ class PipelineEngine:
         except (TypeError, ValueError) as e:
             if not can_bow_out:
                 raise
-            logger.warning(
-                "compiled pipeline executor rejected this model at trace time "
-                "(%s); falling back to the interpreter", e,
-            )
-            self._compiled_unavailable = "model shape outside compiled v1 contract"
-            self._compiled = None
+            self._note_compiled_bow_out(e)
             return None
         self._last_overflow = bool(jax.device_get(overflow)) if self._fp16 else False
         if self._last_overflow:
             self.skipped_steps += 1
         self._stage_params_stale = True
         return loss
+
+    def _note_compiled_bow_out(self, e):
+        """ONE definition of the trace-time bow-out bookkeeping (train and
+        eval must apply the identical contract)."""
+        logger.warning(
+            "compiled pipeline executor rejected this model at trace time "
+            "(%s); falling back to the interpreter", e,
+        )
+        self._compiled_unavailable = "model shape outside compiled v1 contract"
+        self._compiled = None
 
     def _gather_host(self, tree):
         """Host copies of a multi-host global pytree via ``process_allgather``
@@ -1325,27 +1330,37 @@ class PipelineEngine:
             )
             try:
                 self._ensure_compiled(mode)
-                if self._compiled is not None:
-                    self._ensure_compiled_eval()
-                    c = self._compiled
-                    x0 = jnp.stack([m[0] for m in micro])
-                    labels = jnp.stack([m[1] for m in micro])
-                    loss = c["eval"](c["stacked"], c["aux"], x0, labels, self._base_rng)
-                    return float(jax.device_get(loss))
             except (TypeError, ValueError) as e:
                 if not can_bow_out:
                     raise
+                self._note_compiled_bow_out(e)
+        if self._compiled is not None:
+            try:
+                self._ensure_compiled_eval()
+                c = self._compiled
+                x0 = jnp.stack([m[0] for m in micro])
+                labels = jnp.stack([m[1] for m in micro])
+                loss = c["eval"](c["stacked"], c["aux"], x0, labels, self._base_rng)
+                return float(jax.device_get(loss))
+            except (TypeError, ValueError) as e:
+                # An EVAL-only problem (eval-variant trace failure, or eval
+                # batch shapes that don't divide the mesh) must never disable
+                # the train executor — only this eval falls back.
+                if self._multi_host:
+                    raise
+                self._compiled.pop("eval", None)
                 logger.warning(
-                    "compiled pipeline eval rejected this model at trace time "
-                    "(%s); falling back to the interpreter", e,
+                    "compiled pipeline eval unavailable (%s); evaluating "
+                    "with the interpreter", e,
                 )
-                self._compiled_unavailable = "model shape outside compiled v1 contract"
-                self._compiled = None
         if self._multi_host:
             raise NotImplementedError(
                 "multi-host eval_batch needs the compiled executor (the "
-                "per-stage interpreter cannot cross process boundaries) — "
-                "this pipeline fell back to the interpreter"
+                "per-stage interpreter cannot cross process boundaries), and "
+                "this pipeline could not use it (non-array batches, or a "
+                "model outside the compiled contract) — run evaluation in a "
+                "single-process mesh (load the checkpoint there), or use "
+                "train-path losses"
             )
         self._sync_from_compiled()
         losses = []

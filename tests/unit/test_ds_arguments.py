@@ -1,0 +1,56 @@
+"""Argument-parsing parity (reference tests/unit/test_ds_arguments.py:
+add_config_arguments must compose with user parsers, not fight them)."""
+
+import argparse
+
+import pytest
+
+import deepspeed_tpu
+
+
+def _base_parser():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num_epochs", type=int)
+    return parser
+
+
+def test_no_ds_arguments():
+    parser = deepspeed_tpu.add_config_arguments(_base_parser())
+    args = parser.parse_args(["--num_epochs", "2"])
+    assert args.num_epochs == 2
+    assert args.deepspeed is False
+    assert args.deepspeed_config is None
+
+
+def test_core_deepspeed_arguments():
+    parser = deepspeed_tpu.add_config_arguments(_base_parser())
+    args = parser.parse_args(
+        ["--num_epochs", "2", "--deepspeed", "--deepspeed_config", "foo.json"]
+    )
+    assert args.deepspeed is True
+    assert args.deepspeed_config == "foo.json"
+
+
+def test_only_ds_arguments():
+    parser = deepspeed_tpu.add_config_arguments(_base_parser())
+    args = parser.parse_args(["--deepspeed"])
+    assert args.deepspeed is True
+    assert args.num_epochs is None
+
+
+def test_deprecated_deepscale_aliases():
+    parser = deepspeed_tpu.add_config_arguments(_base_parser())
+    args = parser.parse_args(["--deepscale", "--deepscale_config", "old.json"])
+    assert args.deepscale is True
+    assert args.deepscale_config == "old.json"
+
+
+def test_mpi_flag():
+    parser = deepspeed_tpu.add_config_arguments(_base_parser())
+    assert parser.parse_args(["--deepspeed_mpi"]).deepspeed_mpi is True
+
+
+def test_unknown_argument_rejected():
+    parser = deepspeed_tpu.add_config_arguments(_base_parser())
+    with pytest.raises(SystemExit):
+        parser.parse_args(["--not_a_flag"])

@@ -124,3 +124,43 @@ def test_fused_fp16_overflow_skips():
     y = np.zeros((8, 16), np.float32)
     engine.train_step([(x, y)])
     assert engine.skipped_steps >= 1
+
+
+def test_multi_output_model():
+    """Models returning (loss, aux_outputs) train on the FIRST element and a
+    weighted multi-loss model converges on the combined objective (reference
+    tests/unit/multi_output_model.py usage)."""
+    import flax.linen as nn
+
+    class MultiOut(nn.Module):
+        @nn.compact
+        def __call__(self, x, y1, y2):
+            h = nn.relu(nn.Dense(16)(x))
+            o1 = nn.Dense(16)(h)
+            o2 = nn.Dense(16)(h)
+            l1 = jnp.mean((o1 - y1) ** 2)
+            l2 = jnp.mean((o2 - y2) ** 2)
+            total = 0.7 * l1 + 0.3 * l2
+            return total, (l1, l2)
+
+    model = MultiOut()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    y1 = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    y2 = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    params = model.init(jax.random.PRNGKey(0), x, y1, y2)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        },
+    )
+    losses = []
+    for _ in range(6):
+        loss = engine(x, y1, y2)   # forward returns the scalar TOTAL loss
+        assert getattr(loss, "shape", None) == ()
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0] * 0.8, losses

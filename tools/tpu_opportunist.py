@@ -276,6 +276,8 @@ SWEEP_CONFIGS = [
     {"BENCH_BATCH": "128"},
     {"BENCH_REMAT_POLICY": "nothing", "BENCH_BATCH": "64"},
     {"DSTPU_ATTN": "xla", "BENCH_BATCH": "64"},
+    # the two best single-knob candidates combined
+    {"DSTPU_ATTN": "xla", "BENCH_REMAT": "0", "BENCH_BATCH": "64"},
 ]
 
 
