@@ -62,6 +62,7 @@ def _bench_knobs(on_tpu, default_mb, default_seq, default_steps, default_warmup)
         warmup=int(os.environ.get("BENCH_WARMUP", default_warmup if on_tpu else "1")),
         remat=os.environ.get("BENCH_REMAT", "1") == "1",
         policy=os.environ.get("BENCH_REMAT_POLICY", "dots"),
+        scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", "1")),
     )
 
 
@@ -149,7 +150,8 @@ def child_main():
     # Remat is requested through the ds_config activation_checkpointing
     # section — the ENGINE flips BertConfig.checkpoint_activations
     # (per-layer scanned remat), exercising the config wiring end-to-end.
-    cfg = BertConfig.bert_large(checkpoint_policy=knobs["policy"])
+    cfg = BertConfig.bert_large(checkpoint_policy=knobs["policy"],
+                            scan_unroll=knobs["scan_unroll"])
     model = BertForPreTraining(cfg)
 
     # The engine shards the given batch across the data axis as the GLOBAL
@@ -202,6 +204,7 @@ def child_main():
         "micro_batch": micro_batch,
         "remat": cfg.checkpoint_activations,
         "remat_policy": cfg.checkpoint_policy,
+        "scan_unroll": cfg.scan_unroll,
         "attn_impl": _attn_impl_label(on_tpu),
         "final_loss": round(final_loss, 3),
     }))
@@ -234,6 +237,7 @@ def gpt2_child_main():
     ctor = {"small": GPT2Config.gpt2_small, "medium": GPT2Config.gpt2_medium,
             "large": GPT2Config.gpt2_large, "xl": GPT2Config.gpt2_xl}[size]
     cfg = ctor(checkpoint_policy=knobs["policy"],
+               scan_unroll=knobs["scan_unroll"],
                max_position_embeddings=max(1024, seq_len))
     model = GPT2LMHeadModel(cfg)
     global_batch = micro_batch * n_dev
@@ -261,6 +265,7 @@ def gpt2_child_main():
         "micro_batch": micro_batch,
         "remat": cfg.checkpoint_activations,
         "remat_policy": cfg.checkpoint_policy,
+        "scan_unroll": cfg.scan_unroll,
         "attn_impl": _attn_impl_label(on_tpu),
         "final_loss": round(final_loss, 3),
     }))

@@ -47,6 +47,10 @@ class BertConfig:
     #     standard transformer trade: most of the memory win at a fraction
     #     of the recompute cost).
     checkpoint_policy: str = "nothing"
+    # lax.scan unroll factor for the layer stack: >1 trades compile time and
+    # code size for cross-layer XLA scheduling/fusion freedom (a perf knob;
+    # bench sweeps it via BENCH_SCAN_UNROLL)
+    scan_unroll: int = 1
 
     def __post_init__(self):
         resolve_remat_policy(self.checkpoint_policy)  # validates
@@ -153,6 +157,7 @@ class BertEncoder(nn.Module):
             variable_axes={"params": 0},
             split_rngs={"params": True, "dropout": True, "pld": True},
             length=L,
+            unroll=cfg.scan_unroll,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
         xs = None

@@ -39,6 +39,8 @@ class GPT2Config:
     # "nothing" (full recompute) or "dots" (save matmul outputs; recompute
     # only elementwise) — see models/bert.py BertConfig.checkpoint_policy.
     checkpoint_policy: str = "nothing"
+    # lax.scan unroll factor for the block stack (see BertConfig.scan_unroll)
+    scan_unroll: int = 1
 
     def __post_init__(self):
         resolve_remat_policy(self.checkpoint_policy)  # validates
@@ -132,6 +134,7 @@ class GPT2Model(nn.Module):
             variable_axes={"params": 0},
             split_rngs={"params": True, "dropout": True},
             length=cfg.num_hidden_layers,
+            unroll=cfg.scan_unroll,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )
         # Explicit stable name: keeps the param key identical whether or not

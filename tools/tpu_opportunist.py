@@ -278,6 +278,8 @@ SWEEP_CONFIGS = [
     {"DSTPU_ATTN": "xla", "BENCH_BATCH": "64"},
     # the two best single-knob candidates combined
     {"DSTPU_ATTN": "xla", "BENCH_REMAT": "0", "BENCH_BATCH": "64"},
+    # scan unroll: cross-layer scheduling/fusion freedom for XLA
+    {"BENCH_SCAN_UNROLL": "4", "BENCH_BATCH": "64"},
 ]
 
 
@@ -330,6 +332,9 @@ def _matches_config(res, cfg):
         return False
     if ("DSTPU_ATTN" in cfg
             and res.get("attn_impl", "pallas") != cfg["DSTPU_ATTN"].lower()):
+        return False
+    if ("BENCH_SCAN_UNROLL" in cfg
+            and res.get("scan_unroll") != int(cfg["BENCH_SCAN_UNROLL"])):
         return False
     return True
 
