@@ -69,3 +69,24 @@ def args_from_dict(tmpdir, config_dict):
     args.local_rank = 0
     args.deepscale_config = None
     return args
+
+
+def make_simple_engine(tmpdir, config_dict, hidden_dim=16, seed=5):
+    """Engine over a fresh SimpleModel from a config dict (the
+    create/args/initialize triple every checkpoint-style test repeats)."""
+    import deepspeed_tpu
+
+    model, params = create_simple_model(hidden_dim=hidden_dim, seed=seed)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args_from_dict(tmpdir, config_dict), model=model, model_parameters=params
+    )
+    return engine
+
+
+def free_port():
+    """An OS-assigned free TCP port (multi-process rendezvous tests)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

@@ -5,8 +5,7 @@ import pytest
 
 import jax
 
-import deepspeed_tpu
-from tests.unit.simple_model import args_from_dict, create_simple_model, random_dataloader
+from tests.unit.simple_model import make_simple_engine, random_dataloader
 
 
 def _cfg(zero_stage=0, fp16=False, scheduler=False):
@@ -22,14 +21,6 @@ def _cfg(zero_stage=0, fp16=False, scheduler=False):
     if scheduler:
         cfg["scheduler"] = {"type": "WarmupLR", "params": {"warmup_min_lr": 0, "warmup_max_lr": 0.01, "warmup_num_steps": 10}}
     return cfg
-
-
-def _make_engine(tmpdir, cfg, seed=5):
-    model, params = create_simple_model(hidden_dim=16, seed=seed)
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params
-    )
-    return engine
 
 
 def _train_steps(engine, steps, seed=3):
@@ -54,13 +45,13 @@ def test_checkpoint_roundtrip(tmpdir, zero_stage, fp16):
     save_dir = str(tmpdir.join("ckpt"))
     cfg = _cfg(zero_stage=zero_stage, fp16=fp16)
 
-    engine = _make_engine(tmpdir, cfg)
+    engine = make_simple_engine(tmpdir, cfg)
     _train_steps(engine, 4)
     engine.save_checkpoint(save_dir)
     saved_params = jax.device_get(engine.params)
     saved_steps = engine.global_steps
 
-    engine2 = _make_engine(tmpdir, cfg, seed=99)  # different init
+    engine2 = make_simple_engine(tmpdir, cfg, seed=99)  # different init
     tag, client = engine2.load_checkpoint(save_dir)
     assert tag is not None
     assert engine2.global_steps == saved_steps
@@ -74,24 +65,24 @@ def test_checkpoint_roundtrip(tmpdir, zero_stage, fp16):
 
 def test_checkpoint_latest_tag(tmpdir):
     save_dir = str(tmpdir.join("ckpt"))
-    engine = _make_engine(tmpdir, _cfg())
+    engine = make_simple_engine(tmpdir, _cfg())
     _train_steps(engine, 2)
     engine.save_checkpoint(save_dir, tag="tag_a")
     _train_steps(engine, 2)
     engine.save_checkpoint(save_dir, tag="tag_b")
     with open(f"{save_dir}/latest") as f:
         assert f.read().strip() == "tag_b"
-    engine2 = _make_engine(tmpdir, _cfg(), seed=42)
+    engine2 = make_simple_engine(tmpdir, _cfg(), seed=42)
     name, _ = engine2.load_checkpoint(save_dir)
     assert "tag_b" in name
 
 
 def test_checkpoint_client_state(tmpdir):
     save_dir = str(tmpdir.join("ckpt"))
-    engine = _make_engine(tmpdir, _cfg())
+    engine = make_simple_engine(tmpdir, _cfg())
     _train_steps(engine, 2)
     engine.save_checkpoint(save_dir, client_state={"epoch": 7, "note": "hello"})
-    engine2 = _make_engine(tmpdir, _cfg(), seed=42)
+    engine2 = make_simple_engine(tmpdir, _cfg(), seed=42)
     _, client = engine2.load_checkpoint(save_dir)
     assert client["epoch"] == 7
     assert client["note"] == "hello"
@@ -100,17 +91,17 @@ def test_checkpoint_client_state(tmpdir):
 def test_checkpoint_lr_scheduler(tmpdir):
     save_dir = str(tmpdir.join("ckpt"))
     cfg = _cfg(scheduler=True)
-    engine = _make_engine(tmpdir, cfg)
+    engine = make_simple_engine(tmpdir, cfg)
     _train_steps(engine, 4)
     it = engine.lr_scheduler.last_batch_iteration
     engine.save_checkpoint(save_dir)
-    engine2 = _make_engine(tmpdir, cfg, seed=42)
+    engine2 = make_simple_engine(tmpdir, cfg, seed=42)
     engine2.load_checkpoint(save_dir)
     assert engine2.lr_scheduler.last_batch_iteration == it
 
 
 def test_checkpoint_missing_dir(tmpdir):
-    engine = _make_engine(tmpdir, _cfg())
+    engine = make_simple_engine(tmpdir, _cfg())
     name, client = engine.load_checkpoint(str(tmpdir.join("nope")))
     assert name is None
     assert client == {}
@@ -123,11 +114,11 @@ def test_zero_offload_checkpoint_roundtrip(tmpdir):
     cfg = _cfg(zero_stage=2, fp16=True)
     cfg["zero_optimization"]["cpu_offload"] = True
 
-    engine = _make_engine(tmpdir, cfg)
+    engine = make_simple_engine(tmpdir, cfg)
     _train_steps(engine, 4)
     engine.save_checkpoint(save_dir)
 
-    engine2 = _make_engine(tmpdir, cfg, seed=99)
+    engine2 = make_simple_engine(tmpdir, cfg, seed=99)
     engine2.load_checkpoint(save_dir)
     _tree_equal(engine2.params, jax.device_get(engine.params))
 
@@ -139,7 +130,7 @@ def test_zero_offload_checkpoint_roundtrip(tmpdir):
 def test_zero_checkpoint_save_before_step(tmpdir):
     """Saving immediately after initialize (before any step) must work."""
     save_dir = str(tmpdir.join("ckpt"))
-    engine = _make_engine(tmpdir, _cfg(zero_stage=1, fp16=True))
+    engine = make_simple_engine(tmpdir, _cfg(zero_stage=1, fp16=True))
     assert engine.save_checkpoint(save_dir)
 
 
@@ -190,7 +181,7 @@ def test_zero_elastic_checkpoint_cross_dp(tmpdir, zero_stage, load_dp, variant):
     save_dir = str(tmpdir.join("ckpt"))
     cfg_save = _cfg_dp(zero_stage, dp=4, variant=variant)
 
-    engine = _make_engine(tmpdir, cfg_save)
+    engine = make_simple_engine(tmpdir, cfg_save)
     assert engine.dp_world_size == 4
     _train_steps(engine, 4)
     engine.save_checkpoint(save_dir)
@@ -198,7 +189,7 @@ def test_zero_elastic_checkpoint_cross_dp(tmpdir, zero_stage, load_dp, variant):
     saved_master = _merged_master(engine)
 
     cfg_load = _cfg_dp(zero_stage, dp=load_dp, variant=variant)
-    engine2 = _make_engine(tmpdir, cfg_load, seed=99)  # different init
+    engine2 = make_simple_engine(tmpdir, cfg_load, seed=99)  # different init
     assert engine2.dp_world_size == load_dp
     tag, _ = engine2.load_checkpoint(save_dir)
     assert tag is not None
@@ -218,7 +209,7 @@ def test_zero_elastic_checkpoint_cross_dp(tmpdir, zero_stage, load_dp, variant):
 
 def test_zero_checkpoint_shard_files(tmpdir):
     save_dir = str(tmpdir.join("ckpt"))
-    engine = _make_engine(tmpdir, _cfg(zero_stage=2, fp16=True))
+    engine = make_simple_engine(tmpdir, _cfg(zero_stage=2, fp16=True))
     _train_steps(engine, 2)
     engine.save_checkpoint(save_dir, tag="z")
     import glob

@@ -11,12 +11,13 @@ mesh spans 4 devices across both.
 """
 
 import os
-import socket
 import subprocess
 import sys
 
 import numpy as np
 import pytest
+
+from tests.unit.simple_model import free_port
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
 
@@ -48,12 +49,6 @@ for i in range(3):
     losses.append(float(jax.device_get(loss)))
 print("LOSSES", [round(l, 6) for l in losses])
 '''
-
-
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def _run(rank, world, port, devices, child=CHILD, ckpt=None, zero=0, bf16=False, tp=0):
@@ -89,7 +84,7 @@ def _losses(out):
 
 
 def test_two_host_engine_matches_single_process():
-    port = _free_port()
+    port = free_port()
     procs = [_run(r, 2, port, devices=2) for r in range(2)]
     try:
         outs = [p.communicate(timeout=240)[0] for p in procs]
@@ -215,7 +210,7 @@ def test_two_host_pipeline_matches_single_process(tmp_path, zero, bf16):
     cannot cross processes); losses must match a single-process run, and the
     in-child checkpoint round trip (rank-0 writes, all-rank collectives,
     host-side resume) must continue the trajectory exactly."""
-    port = _free_port()
+    port = free_port()
     procs = [_run(r, 2, port, devices=2, child=PIPE_CHILD,
                   ckpt=str(tmp_path / "mh"), zero=zero, bf16=bf16)
              for r in range(2)]
@@ -248,7 +243,7 @@ def test_two_host_pipeline_tensor_parallel(tmp_path):
     """pp2 x tp2 ACROSS two processes: each stage's TP pair spans one host,
     the stage exchange crosses hosts, and the stacked stage params carry the
     model axis — the untested multi-host x compiled x TP combination."""
-    port = _free_port()
+    port = free_port()
     procs = [_run(r, 2, port, devices=2, child=PIPE_CHILD, tp=2)
              for r in range(2)]
     try:

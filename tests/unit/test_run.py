@@ -5,12 +5,13 @@ run one engine step (reference launch flow: deepspeed/launcher/launch.py:65-129)
 
 import json
 import os
-import socket
 import stat
 import subprocess
 import sys
 
 import pytest
+
+from tests.unit.simple_model import free_port
 
 from deepspeed_tpu.launcher.runner import (
     decode_world_info,
@@ -177,12 +178,6 @@ exit $rc
 '''
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 def _launch_env(tmp_path, devices_per_proc):
     env = dict(os.environ)
     env.update({
@@ -210,7 +205,7 @@ def test_runner_single_node_end_to_end(tmp_path):
     r = subprocess.run(
         [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
          "--hostfile", str(tmp_path / "no_such_hostfile"),
-         "--master_port", str(_free_port()),
+         "--master_port", str(free_port()),
          script, str(tmp_path)],
         env=_launch_env(tmp_path, devices_per_proc=4),
         capture_output=True, text=True, timeout=240, cwd=REPO,
@@ -244,7 +239,7 @@ def test_runner_pdsh_two_hosts_end_to_end(tmp_path):
          "--hostfile", str(hostfile),
          "--launcher", "pdsh",
          "--master_addr", "127.0.0.1",
-         "--master_port", str(_free_port()),
+         "--master_port", str(free_port()),
          script, str(tmp_path)],
         env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
     )

@@ -15,9 +15,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-import deepspeed_tpu
 from deepspeed_tpu.parallel.mesh import DATA_AXIS
-from tests.unit.simple_model import args_from_dict, create_simple_model, random_dataloader
+from tests.unit.simple_model import make_simple_engine, random_dataloader
 
 HIDDEN = 16
 
@@ -36,14 +35,6 @@ def _cfg(stage, fp16=True, dp=None):
     return cfg
 
 
-def _make_engine(tmpdir, cfg, seed=5):
-    model, params = create_simple_model(hidden_dim=HIDDEN, seed=seed)
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        args=args_from_dict(tmpdir, cfg), model=model, model_parameters=params
-    )
-    return engine
-
-
 def _train(engine, steps, seed=3):
     loader = random_dataloader(engine, total_samples=steps * engine.train_batch_size(),
                                hidden_dim=HIDDEN, seed=seed)
@@ -60,14 +51,14 @@ def _train(engine, steps, seed=3):
 def test_zero3_matches_zero2(tmpdir, fp16):
     """Stage 3 is a memory layout, not an algorithm change: losses must match
     stage 2 step for step."""
-    l2 = _train(_make_engine(tmpdir, _cfg(2, fp16=fp16)), 6)
-    l3 = _train(_make_engine(tmpdir, _cfg(3, fp16=fp16)), 6)
+    l2 = _train(make_simple_engine(tmpdir, _cfg(2, fp16=fp16)), 6)
+    l3 = _train(make_simple_engine(tmpdir, _cfg(3, fp16=fp16)), 6)
     np.testing.assert_allclose(l2, l3, rtol=1e-5)
 
 
 def test_zero3_params_stored_sharded(tmpdir):
     """Between steps every shardable leaf lives 1/dp-sized per device."""
-    engine = _make_engine(tmpdir, _cfg(3))
+    engine = make_simple_engine(tmpdir, _cfg(3))
     dp = engine.dp_world_size
     _train(engine, 2)
     checked = 0
@@ -82,7 +73,7 @@ def test_zero3_params_stored_sharded(tmpdir):
 
 def test_zero3_gather_on_use_in_hlo(tmpdir):
     """The fwd+bwd program must contain the gather-on-use collective."""
-    engine = _make_engine(tmpdir, _cfg(3))
+    engine = make_simple_engine(tmpdir, _cfg(3))
     engine._ensure_opt_state()
     x = jnp.ones((8, HIDDEN), jnp.float32)
     y = jnp.zeros((8, HIDDEN), jnp.float32)
@@ -97,12 +88,12 @@ def test_zero3_gather_on_use_in_hlo(tmpdir):
 def test_zero3_checkpoint_roundtrip(tmpdir):
     save_dir = str(tmpdir.join("ckpt"))
     cfg = _cfg(3)
-    engine = _make_engine(tmpdir, cfg)
+    engine = make_simple_engine(tmpdir, cfg)
     _train(engine, 3)
     engine.save_checkpoint(save_dir)
     saved = jax.device_get(engine.params)
 
-    engine2 = _make_engine(tmpdir, cfg, seed=99)
+    engine2 = make_simple_engine(tmpdir, cfg, seed=99)
     tag, _ = engine2.load_checkpoint(save_dir)
     assert tag is not None
     for a, b in zip(jax.tree_util.tree_leaves(saved),
@@ -118,12 +109,12 @@ def test_zero3_elastic_cross_dp(tmpdir):
     """Stage-3 shard files re-partition across a changed dp degree like
     stages 1/2 (same merge path)."""
     save_dir = str(tmpdir.join("ckpt"))
-    engine = _make_engine(tmpdir, _cfg(3, dp=4))
+    engine = make_simple_engine(tmpdir, _cfg(3, dp=4))
     assert engine.dp_world_size == 4
     _train(engine, 3)
     engine.save_checkpoint(save_dir)
 
-    engine2 = _make_engine(tmpdir, _cfg(3, dp=8), seed=99)
+    engine2 = make_simple_engine(tmpdir, _cfg(3, dp=8), seed=99)
     tag, _ = engine2.load_checkpoint(save_dir)
     assert tag is not None
     l1 = _train(engine, 3, seed=17)
@@ -134,7 +125,7 @@ def test_zero3_elastic_cross_dp(tmpdir):
 def test_zero3_offload_rejected(tmpdir):
     cfg = _cfg(3)
     cfg["zero_optimization"]["cpu_offload"] = True
-    engine = _make_engine(tmpdir, cfg)
+    engine = make_simple_engine(tmpdir, cfg)
     x = jnp.ones((8, HIDDEN), jnp.float32)
     with pytest.raises(AssertionError, match="ZeRO-3"):
         loss = engine(x, jnp.zeros((8, HIDDEN), jnp.float32))
@@ -146,4 +137,4 @@ def test_zero3_tp_rejected(tmpdir):
     cfg = _cfg(3)
     cfg["tensor_parallel"] = {"size": 2}
     with pytest.raises(AssertionError, match="ZeRO-3"):
-        _make_engine(tmpdir, cfg)
+        make_simple_engine(tmpdir, cfg)
