@@ -35,6 +35,11 @@ def test_bert_pretrain():
     _run("bert_pretrain", ["--steps", "5", "--batch", "1", "--seq", "32"])
 
 
+def test_bert_pretrain_zero3():
+    _run("bert_pretrain", ["--steps", "5", "--batch", "1", "--seq", "32",
+                           "--zero", "3"])
+
+
 def test_gpt2_pipeline():
     _run("gpt2_pipeline", ["--steps", "4", "--batch", "2", "--seq", "16"])
 
