@@ -25,7 +25,8 @@ def parse_args():
     parser.add_argument("--world_info", default="e30=", type=str,
                         help="base64-encoded world layout dictionary")
     parser.add_argument("--node_rank", default=0, type=str,
-                        help="Rank of this node in the job (or 'OMPI' to read from mpirun env)")
+                        help="Rank of this node in the job, or 'MPI'/'OMPI' to read it "
+                             "from the MPI launcher env (OpenMPI/MVAPICH2/PMI)")
     parser.add_argument("--master_addr", default="127.0.0.1", type=str)
     parser.add_argument("--master_port", default=29500, type=int)
     parser.add_argument("training_script", type=str)
@@ -33,13 +34,23 @@ def parse_args():
     return parser.parse_args()
 
 
+def mpi_node_rank():
+    """Generic MPI rank discovery: OpenMPI, MVAPICH2, or PMI launchers."""
+    return int(
+        os.environ.get("OMPI_COMM_WORLD_RANK")
+        or os.environ.get("MV2_COMM_WORLD_RANK")
+        or os.environ.get("PMI_RANK")
+        or "0"
+    )
+
+
 def main():
     args = parse_args()
     world_info = decode_world_info(args.world_info)
     assert len(world_info) > 0, "got no world info"
 
-    if args.node_rank == "OMPI":
-        node_rank = int(os.environ.get("OMPI_COMM_WORLD_RANK", "0"))
+    if args.node_rank in ("OMPI", "MPI"):
+        node_rank = mpi_node_rank()
     else:
         node_rank = int(args.node_rank)
 

@@ -6,8 +6,11 @@ every node with its node rank and the encoded world layout."""
 import os
 import shutil
 import sys
+import tempfile
 from abc import ABC, abstractmethod
 from shlex import quote
+
+from deepspeed_tpu.launcher.runner import decode_world_info
 
 
 class MultiNodeRunner(ABC):
@@ -40,9 +43,7 @@ class PDSHRunner(MultiNodeRunner):
         return shutil.which("pdsh") is not None
 
     def get_cmd(self):
-        import json, base64
-
-        world = json.loads(base64.urlsafe_b64decode(self.world_info_base64))
+        world = decode_world_info(self.world_info_base64)
         hosts = ",".join(world.keys())
         pdsh_cmd = ["pdsh", "-f", "1024", "-w", hosts]
         if self.args.launcher_args:
@@ -66,9 +67,7 @@ class SSHRunner(MultiNodeRunner):
         return shutil.which("ssh") is not None
 
     def get_cmd(self):
-        import json, base64
-
-        world = json.loads(base64.urlsafe_b64decode(self.world_info_base64))
+        world = decode_world_info(self.world_info_base64)
         cmds = []
         for rank, host in enumerate(world.keys()):
             payload = (
@@ -89,9 +88,7 @@ class OpenMPIRunner(MultiNodeRunner):
         return shutil.which("mpirun") is not None
 
     def get_cmd(self):
-        import json, base64
-
-        world = json.loads(base64.urlsafe_b64decode(self.world_info_base64))
+        world = decode_world_info(self.world_info_base64)
         total_procs = len(world)  # one process per host (drives all local chips)
         hosts = ",".join(f"{h}:1" for h in world.keys())
         mpirun_cmd = [
@@ -106,4 +103,58 @@ class OpenMPIRunner(MultiNodeRunner):
         python_exec = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
                        f"--world_info={self.world_info_base64}", "--node_rank=OMPI",
                        f"--master_addr={self.master_addr}", f"--master_port={self.args.master_port}"]
+        return mpirun_cmd + export_cmd + python_exec + [self.user_script] + list(self.user_arguments)
+
+
+class MVAPICHRunner(MultiNodeRunner):
+    """MVAPICH2 backend (reference multinode_runner.py:118-177): mpirun over a
+    generated plain hostfile with the MV2 tuning environment. TPU adaptation:
+    one process per HOST drives all local chips, and the cuda-awareness knobs
+    (MV2_USE_CUDA / MV2_CUDA_USE_NAIVE) are dropped — DCN traffic rides
+    TCP/IB without GPUDirect."""
+
+    # reference's MV2 deep-learning tuning set, minus the cuda knobs
+    MV2_EXPORTS = {
+        "MV2_SMP_USE_CMA": "0",
+        "MV2_DEBUG_SHOW_BACKTRACE": "1",
+        "MV2_SUPPORT_DL": "1",
+        "MV2_ENABLE_AFFINITY": "0",
+        "MV2_INTER_ALLGATHER_TUNING": "5",
+    }
+
+    def backend_exists(self):
+        # mvapich installs `mpiname`; its output names the flavor
+        if shutil.which("mpiname") is None:
+            return False
+        import subprocess
+
+        try:
+            out = subprocess.check_output(["mpiname"], text=True)
+        except Exception:  # noqa: BLE001
+            return False
+        return "MVAPICH" in out
+
+    def get_cmd(self):
+        world = decode_world_info(self.world_info_base64)
+        # fresh temp hostfile per invocation: a fixed /tmp path would clobber
+        # between concurrent jobs and follow planted symlinks
+        fd, hostfile = tempfile.mkstemp(prefix="dstpu_mvapich_hosts_", text=True)
+        with os.fdopen(fd, "w") as f:
+            for host in world.keys():
+                f.write(f"{host}\n")
+        total_procs = len(world)  # one process per host
+        mpirun_cmd = [
+            "mpirun", "-np", str(total_procs),
+            "-hostfile", hostfile,
+        ]
+        if self.args.launcher_args:
+            mpirun_cmd += self.args.launcher_args.split()
+        export_cmd = []
+        for k, v in {**self.MV2_EXPORTS, **self.exports}.items():
+            # Hydra mpiexec takes TWO-token "-env <name> <value>"
+            export_cmd += ["-env", k, str(v)]
+        python_exec = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+                       f"--world_info={self.world_info_base64}", "--node_rank=MPI",
+                       f"--master_addr={self.master_addr}",
+                       f"--master_port={self.args.master_port}"]
         return mpirun_cmd + export_cmd + python_exec + [self.user_script] + list(self.user_arguments)

@@ -45,7 +45,7 @@ def parse_args(args=None):
     parser.add_argument("--master_addr", type=str, default="",
                         help="IP address of node 0; will be inferred via hostfile if not specified.")
     parser.add_argument("--launcher", type=str, default="pdsh",
-                        help="Multi-node launcher backend: pdsh, openmpi, ssh.")
+                        help="Multi-node launcher backend: pdsh, openmpi, mvapich, ssh.")
     parser.add_argument("--launcher_args", type=str, default="",
                         help="Flags to pass to the chosen launcher backend.")
     parser.add_argument("--force_multi", action="store_true",
@@ -236,9 +236,15 @@ def main(args=None):
     master_addr = fetch_master_addr(active_resources, args.master_addr)
     world_info = encode_world_info({h: s for h, s in active_resources.items()})
 
-    from deepspeed_tpu.launcher.multinode_runner import PDSHRunner, OpenMPIRunner, SSHRunner
+    from deepspeed_tpu.launcher.multinode_runner import (
+        MVAPICHRunner,
+        OpenMPIRunner,
+        PDSHRunner,
+        SSHRunner,
+    )
 
-    runner_cls = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner, "ssh": SSHRunner}.get(args.launcher.lower())
+    runner_cls = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner,
+                  "mvapich": MVAPICHRunner, "ssh": SSHRunner}.get(args.launcher.lower())
     if runner_cls is None:
         raise ValueError(f"Unknown launcher {args.launcher}")
     runner = runner_cls(args, world_info, master_addr, collect_env_exports())

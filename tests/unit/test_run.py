@@ -252,3 +252,61 @@ def test_runner_pdsh_two_hosts_end_to_end(tmp_path):
     assert all(o["world"] == 2 for o in outs), outs
     assert all(o["devices"] == 4 for o in outs), outs  # 2 procs x 2 devices
     assert outs[0]["loss"] == outs[1]["loss"]
+
+
+def _mk_args(**over):
+    import argparse
+
+    ns = argparse.Namespace(
+        launcher_args="", master_port=29500, user_script="train.py",
+        user_args=["--flag"],
+    )
+    for k, v in over.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_openmpi_runner_cmd():
+    from deepspeed_tpu.launcher.multinode_runner import OpenMPIRunner
+
+    world = encode_world_info({"worker-0": [0], "worker-1": [0]})
+    r = OpenMPIRunner(_mk_args(), world, "10.0.0.1", {"JAX_PLATFORMS": "tpu"})
+    cmd = r.get_cmd()
+    assert cmd[:3] == ["mpirun", "-n", "2"]  # one process per host
+    assert "--node_rank=OMPI" in " ".join(cmd)
+    assert "-x" in cmd and "JAX_PLATFORMS=tpu" in cmd
+    assert cmd[-2:] == ["train.py", "--flag"]
+
+
+def test_mvapich_runner_cmd():
+    from deepspeed_tpu.launcher import multinode_runner as mnr
+
+    world = encode_world_info({"worker-0": [0], "worker-1": [0]})
+    r = mnr.MVAPICHRunner(_mk_args(), world, "10.0.0.1", {})
+    cmd = r.get_cmd()
+    assert cmd[:3] == ["mpirun", "-np", "2"]
+    hostfile = cmd[cmd.index("-hostfile") + 1]
+    with open(hostfile) as f:
+        assert f.read().splitlines() == ["worker-0", "worker-1"]
+    os.unlink(hostfile)
+    joined = " ".join(cmd)
+    assert "--node_rank=MPI" in joined
+    # Hydra mpiexec two-token form: -env <name> <value>
+    i = cmd.index("-env")
+    assert "=" not in cmd[i + 1] and cmd[cmd.index("MV2_SUPPORT_DL") + 1] == "1"
+    # cuda knobs deliberately absent on TPU
+    assert "MV2_USE_CUDA" not in joined
+    assert cmd[-2:] == ["train.py", "--flag"]
+
+
+def test_launch_mpi_rank_discovery(monkeypatch):
+    """launch.py resolves --node_rank=MPI from OpenMPI, MVAPICH, or PMI env."""
+    from deepspeed_tpu.launcher.launch import mpi_node_rank
+
+    mpi_vars = ("OMPI_COMM_WORLD_RANK", "MV2_COMM_WORLD_RANK", "PMI_RANK")
+    for var in mpi_vars:
+        for v in mpi_vars:
+            monkeypatch.delenv(v, raising=False)
+        assert mpi_node_rank() == 0
+        monkeypatch.setenv(var, "3")
+        assert mpi_node_rank() == 3
