@@ -1,0 +1,92 @@
+"""Decision logic of the opportunistic TPU watcher (tools/tpu_opportunist.py).
+
+The watcher guards the round's only perf evidence, so its pure predicates —
+config-drift rejection, sweep settlement, artifact freshness — get the same
+unit coverage as the runtime. No jax, no subprocesses here.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _load_watcher():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_opportunist", os.path.join(REPO, "tools", "tpu_opportunist.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+W = _load_watcher()
+
+
+def test_matches_config_batch_and_remat():
+    ok = {"micro_batch": 64, "remat": False, "value": 1.0}
+    assert W._matches_config(ok, {"BENCH_REMAT": "0", "BENCH_BATCH": "64"})
+    # OOM-ladder drift: measured a smaller batch than requested
+    assert not W._matches_config(
+        {"micro_batch": 32, "remat": False}, {"BENCH_REMAT": "0", "BENCH_BATCH": "64"}
+    )
+    # engine kept remat on although the config turned it off
+    assert not W._matches_config(
+        {"micro_batch": 64, "remat": True}, {"BENCH_REMAT": "0", "BENCH_BATCH": "64"}
+    )
+
+
+def test_matches_config_attn_and_unroll():
+    assert W._matches_config(
+        {"micro_batch": 64, "attn_impl": "xla"}, {"DSTPU_ATTN": "xla", "BENCH_BATCH": "64"}
+    )
+    assert not W._matches_config(
+        {"micro_batch": 64, "attn_impl": "pallas"}, {"DSTPU_ATTN": "xla", "BENCH_BATCH": "64"}
+    )
+    assert W._matches_config(
+        {"micro_batch": 64, "scan_unroll": 4},
+        {"BENCH_SCAN_UNROLL": "4", "BENCH_BATCH": "64"},
+    )
+    # a record missing the field (old bench, or gpt2 leg without it) must not
+    # be attributed to an unroll config
+    assert not W._matches_config(
+        {"micro_batch": 64}, {"BENCH_SCAN_UNROLL": "4", "BENCH_BATCH": "64"}
+    )
+
+
+def test_sweep_settled():
+    assert W._sweep_settled({"result": {"value": 1.0}})
+    assert W._sweep_settled({"result": None, "terminal": True})
+    assert not W._sweep_settled({"result": None, "error": "x", "attempts": 1})
+
+
+def test_fresh_tpu():
+    assert W._fresh_tpu({"device_kind": "TPU v5 lite"})
+    assert not W._fresh_tpu({"device_kind": "TPU v5 lite", "cached": True})
+    assert not W._fresh_tpu({"device_kind": "cpu"})
+    assert not W._fresh_tpu(None)
+
+
+def test_longseq_tpu_ok(tmp_path, monkeypatch):
+    art = tmp_path / "LONGSEQ_BENCH.json"
+    monkeypatch.setattr(W, "LONGSEQ_OUT", str(art))
+    assert not W._longseq_tpu_ok()  # absent
+    art.write_text(json.dumps({"platform": "cpu", "complete": True}))
+    assert not W._longseq_tpu_ok()  # wrong platform
+    art.write_text(json.dumps({"platform": "tpu", "complete": False}))
+    assert not W._longseq_tpu_ok()  # partial (mid-sweep kill)
+    art.write_text(json.dumps({"platform": "tpu", "complete": True}))
+    assert W._longseq_tpu_ok()
+    art.write_text(json.dumps({"platform": "mixed", "complete": True}))
+    assert not W._longseq_tpu_ok()  # tunnel dropped mid-sweep
+
+
+def test_bench_file_ok(tmp_path, monkeypatch):
+    f = tmp_path / "b.json"
+    assert not W._bench_file_ok(str(f))
+    f.write_text(json.dumps({"device_kind": "TPU v5 lite"}))
+    assert W._bench_file_ok(str(f))
+    f.write_text(json.dumps({"device_kind": "cpu"}))
+    assert not W._bench_file_ok(str(f))
