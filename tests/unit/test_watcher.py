@@ -8,7 +8,6 @@ unit coverage as the runtime. No jax, no subprocesses here.
 import importlib.util
 import json
 import os
-import sys
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
 
