@@ -584,6 +584,19 @@ class DeepSpeedEngine:
                 clip_grad=self.gradient_clipping(),
                 keep_master=keep_master,
             )
+        # Like the bucket-size knobs (ZeroShardedOptimizer.__init__): these
+        # two schedule eager NCCL work in the reference (stage2.py overlap /
+        # IPG buffers); under XLA the step is ONE program whose collectives
+        # the latency-hiding scheduler already overlaps, and grads are
+        # compiler-managed buffers — accepted for parity, loudly a no-op.
+        for knob, val in (("overlap_comm", self.zero_overlap_comm()),
+                          ("contiguous_gradients", self.zero_contiguous_gradients())):
+            if val:
+                log_dist(
+                    f"ZeRO: '{knob}'={val} is accepted for parity but is a "
+                    "NO-OP on TPU (XLA schedules and overlaps the collectives "
+                    "inside the single compiled step)", ranks=[0],
+                )
         log_dist(f"Creating ZeRO stage {stage} optimizer", ranks=[0])
         return ZeroShardedOptimizer(
             basic_optimizer,
