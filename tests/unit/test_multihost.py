@@ -34,12 +34,17 @@ if os.environ.get("WORLD_SIZE"):
 assert jax.device_count() == 4, jax.device_count()
 
 model, params = create_simple_model(hidden_dim=8, seed=3)
+stage = int(os.environ.get("DSTPU_ZERO", "2"))
 engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params,
     config_params={"train_batch_size": 8,
                    "train_micro_batch_size_per_gpu": 2,
                    "gradient_accumulation_steps": 1,
                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
-                   "zero_optimization": {"stage": 2}})
+                   "zero_optimization": {"stage": stage}})
+if stage >= 3:
+    n_sharded = sum(1 for l in jax.tree_util.tree_leaves(engine.params)
+                    if l.sharding.spec and l.sharding.spec[0] == "data")
+    assert n_sharded > 0, "zero3 left no param leaf sharded"
 rng = np.random.RandomState(0)
 losses = []
 for i in range(3):
@@ -58,6 +63,9 @@ def _run(rank, world, port, devices, child=CHILD, ckpt=None, zero=0, bf16=False,
         "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices}",
         "DSTPU_REPO": REPO,
     })
+    for k in ("MASTER_ADDR", "MASTER_PORT", "WORLD_SIZE", "RANK",
+              "DSTPU_CKPT", "DSTPU_ZERO", "DSTPU_BF16", "DSTPU_TP"):
+        env.pop(k, None)
     if ckpt:
         env["DSTPU_CKPT"] = ckpt
     if zero:
@@ -66,8 +74,6 @@ def _run(rank, world, port, devices, child=CHILD, ckpt=None, zero=0, bf16=False,
         env["DSTPU_BF16"] = "1"
     if tp:
         env["DSTPU_TP"] = str(tp)
-    for k in ("MASTER_ADDR", "MASTER_PORT", "WORLD_SIZE", "RANK"):
-        env.pop(k, None)
     if world > 1:
         env.update({"MASTER_ADDR": "127.0.0.1", "MASTER_PORT": str(port),
                     "WORLD_SIZE": str(world), "RANK": str(rank)})
@@ -83,9 +89,13 @@ def _losses(out):
     raise AssertionError(f"no LOSSES line in child output:\n{out[-2000:]}")
 
 
-def test_two_host_engine_matches_single_process():
+@pytest.mark.parametrize("zero", [2, 3])
+def test_two_host_engine_matches_single_process(zero):
+    """zero=2: grad/optimizer sharding. zero=3: param STORAGE sharded over
+    the global data axis (each host holds ~1/4 of every leaf, fp32), the
+    gather-on-use all-gathers riding the cross-process fabric."""
     port = free_port()
-    procs = [_run(r, 2, port, devices=2) for r in range(2)]
+    procs = [_run(r, 2, port, devices=2, zero=zero) for r in range(2)]
     try:
         outs = [p.communicate(timeout=240)[0] for p in procs]
     finally:
@@ -100,7 +110,7 @@ def test_two_host_engine_matches_single_process():
     assert l0 == l1, (l0, l1)
 
     # single-process oracle: same 4-device global mesh, no DCN
-    p = _run(0, 1, port, devices=4)
+    p = _run(0, 1, port, devices=4, zero=zero)
     try:
         out = p.communicate(timeout=240)[0]
     finally:
