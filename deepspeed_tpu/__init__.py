@@ -7,9 +7,22 @@ mesh, mixed precision with (dynamic) loss scaling, fused TPU kernels, and
 checkpointing.
 """
 
-from deepspeed_tpu.version import __version__
+from deepspeed_tpu.version import __version__, git_branch, git_hash
 
 version = __version__
+__git_hash__ = git_hash
+__git_branch__ = git_branch
+
+
+def _parse_version(version_str):
+    """major/minor/patch ints (reference __init__.py:24-31)."""
+    import re
+
+    m = re.match(r"(\d+)\.(\d+)\.(\d+)", version_str)
+    return (int(m.group(1)), int(m.group(2)), int(m.group(3))) if m else (0, 0, 0)
+
+
+__version_major__, __version_minor__, __version_patch__ = _parse_version(__version__)
 
 # Public surface parity with the reference deepspeed/__init__.py:1-30:
 # transformer kernel layer + config, pipeline module machinery, activation
@@ -24,6 +37,18 @@ from deepspeed_tpu.runtime.pipe.module import (  # noqa: E402
     TiedLayerSpec,
 )
 from deepspeed_tpu.runtime.activation_checkpointing import checkpointing  # noqa: E402
+from deepspeed_tpu.runtime.config import (  # noqa: E402
+    DeepSpeedConfig,
+    DeepSpeedConfigError,
+)
+from deepspeed_tpu.runtime.constants import (  # noqa: E402
+    ADAM_OPTIMIZER,
+    LAMB_OPTIMIZER,
+)
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine  # noqa: E402
+from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments  # noqa: E402
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine  # noqa: E402
+from deepspeed_tpu.utils.logging import log_dist  # noqa: E402
 
 
 def initialize(args=None, model=None, optimizer=None, model_parameters=None,

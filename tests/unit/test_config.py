@@ -210,3 +210,23 @@ def test_zero_bucket_knobs_warn_loudly(caplog):
     text = caplog.text
     assert "reduce_bucket_size" in text and "IGNORED" in text
     assert "allgather_bucket_size" in text
+
+
+def test_top_level_api_surface():
+    """Every public symbol the reference exports from `deepspeed` is
+    importable from the top of this package (reference __init__.py:7-35)."""
+    import deepspeed_tpu as ds
+
+    for name in (
+        "initialize", "add_config_arguments", "init_distributed",
+        "DeepSpeedEngine", "PipelineEngine", "PipelineModule",
+        "DeepSpeedConfig", "DeepSpeedConfigError",
+        "ADAM_OPTIMIZER", "LAMB_OPTIMIZER",
+        "add_tuning_arguments", "checkpointing", "log_dist",
+        "DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig",
+        "ops", "version", "__version__",
+        "__version_major__", "__version_minor__", "__version_patch__",
+        "__git_hash__", "__git_branch__",
+    ):
+        assert hasattr(ds, name), f"missing top-level export: {name}"
+    assert (ds.__version_major__, ds.__version_minor__, ds.__version_patch__) == (0, 1, 0)
