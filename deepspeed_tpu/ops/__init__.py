@@ -1,0 +1,15 @@
+"""Op packages (surface parity: reference ``deepspeed/ops/__init__.py``)."""
+
+from deepspeed_tpu.ops import adam, lamb, sparse_attention, transformer
+from deepspeed_tpu.ops.transformer import (
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)
+
+# reference: `from .module_inject import replace_module`
+from deepspeed_tpu.module_inject import replace_module
+
+# reference: compatible_ops matrix from git_version_info; here the same
+# question ("which native ops are actually usable?") is answered live by the
+# op builder (built .so vs numpy fallback).
+from deepspeed_tpu.ops.op_builder import compatible_ops as __compatible_ops__

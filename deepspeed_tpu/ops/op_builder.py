@@ -84,6 +84,17 @@ ALL_OPS = {
 }
 
 
+def compatible_ops():
+    """{op name: compatible?} (reference git_version_info.compatible_ops —
+    a build-time matrix there; computed live here, where nothing is
+    precompiled)."""
+    out = {}
+    for name, builder_cls in ALL_OPS.items():
+        b = builder_cls() if builder_cls is not PallasOp else PallasOp(name)
+        out[name] = bool(b.is_compatible())
+    return out
+
+
 def op_report():
     """Install/compatibility matrix (reference env_report.py op_report)."""
     lines = ["op name " + "." * 20 + " installed .. compatible", "-" * 60]

@@ -1,0 +1,4 @@
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)

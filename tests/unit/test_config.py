@@ -230,3 +230,17 @@ def test_top_level_api_surface():
     ):
         assert hasattr(ds, name), f"missing top-level export: {name}"
     assert (ds.__version_major__, ds.__version_minor__, ds.__version_patch__) == (0, 1, 0)
+
+
+def test_ops_package_surface():
+    """`deepspeed_tpu.ops` mirrors the reference ops package exports
+    (reference deepspeed/ops/__init__.py)."""
+    from deepspeed_tpu import ops
+
+    for name in ("adam", "lamb", "sparse_attention", "transformer",
+                 "DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig",
+                 "replace_module", "__compatible_ops__"):
+        assert hasattr(ops, name), f"missing ops export: {name}"
+    compat = ops.__compatible_ops__()
+    assert set(compat) >= {"cpu_adam", "transformer", "sparse_attn"}
+    assert all(isinstance(v, bool) for v in compat.values())
