@@ -1,0 +1,2 @@
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam

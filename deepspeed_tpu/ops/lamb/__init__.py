@@ -1,0 +1,1 @@
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
