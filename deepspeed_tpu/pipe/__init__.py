@@ -1,0 +1,3 @@
+"""Alias package (reference ``deepspeed/pipe/__init__.py``)."""
+
+from deepspeed_tpu.runtime.pipe import LayerSpec, PipelineModule, TiedLayerSpec

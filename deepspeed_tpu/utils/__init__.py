@@ -1,0 +1,5 @@
+"""Public utils surface (reference ``deepspeed/utils/__init__.py``)."""
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.distributed import init_distributed
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader

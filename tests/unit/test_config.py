@@ -244,3 +244,16 @@ def test_ops_package_surface():
     compat = ops.__compatible_ops__()
     assert set(compat) >= {"cpu_adam", "transformer", "sparse_attn"}
     assert all(isinstance(v, bool) for v in compat.values())
+
+
+def test_alias_package_surfaces():
+    """deepspeed.pipe / deepspeed.utils / runtime.pipe import paths
+    (reference deepspeed/pipe/__init__.py, deepspeed/utils/__init__.py)."""
+    from deepspeed_tpu.pipe import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401
+    from deepspeed_tpu.runtime.pipe import PipelineModule as P2  # noqa: F401
+    from deepspeed_tpu.utils import (  # noqa: F401
+        RepeatingLoader,
+        init_distributed,
+        log_dist,
+        logger,
+    )
