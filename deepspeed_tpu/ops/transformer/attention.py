@@ -71,7 +71,14 @@ def _dropout_keep(seed_ref, bh, qi, kj, block_q, block_k, rate):
     kernels regenerate the identical mask instead of storing O(S^2) bits
     (the flash-dropout trick; reference stores the mask from its fused
     dropout kernels, csrc/transformer/dropout_kernels.cu)."""
-    pltpu.prng_seed(seed_ref[0], bh, qi, kj)
+    # Mosaic's tpu.prng_set_seed_32 accepts at most TWO seed words (real-TPU
+    # compile rejects more), so fold (seed, bh) and (qi, kj) into one word
+    # each, injectively: an odd multiplier permutes i32 space, and the kj
+    # block index is always < 2**16.
+    pltpu.prng_seed(
+        seed_ref[0] + bh * jnp.int32(-1640531527),
+        qi * jnp.int32(65536) + kj,
+    )
     bits = pltpu.prng_random_bits((block_q, block_k)).astype(jnp.uint32)
     threshold = jnp.uint32(min(int(rate * 2**32), 2**32 - 1))
     return jnp.where(bits >= threshold, 1.0 / (1.0 - rate), 0.0)
