@@ -65,20 +65,27 @@ def layout_to_lut(layout):
 # Pallas kernel
 # ---------------------------------------------------------------------------
 
+def _fold_dropout_seed(seed, bh, qi, kj):
+    """Fold the 4-word dropout-PRNG identity into the TWO seed words Mosaic's
+    tpu.prng_set_seed_32 accepts (real-TPU compile rejects more). Injective
+    for fixed ``seed``: an odd multiplier permutes i32 space (distinguishes
+    bh), and block indices are always < 2**16 (distinguishes (qi, kj)) —
+    distinct blocks must never share a dropout mask. Works on concrete ints
+    and traced i32 alike (unit-tested for injectivity; the kernel path is
+    only compilable on real TPU hardware)."""
+    return (
+        seed + bh * jnp.int32(-1640531527),
+        qi * jnp.int32(65536) + kj,
+    )
+
+
 def _dropout_keep(seed_ref, bh, qi, kj, block_q, block_k, rate):
     """[BQ, BK] keep/(1-rate) scale mask from the TPU PRNG, deterministically
     re-derivable from (seed, bh, qi, kj) — the forward and BOTH backward
     kernels regenerate the identical mask instead of storing O(S^2) bits
     (the flash-dropout trick; reference stores the mask from its fused
     dropout kernels, csrc/transformer/dropout_kernels.cu)."""
-    # Mosaic's tpu.prng_set_seed_32 accepts at most TWO seed words (real-TPU
-    # compile rejects more), so fold (seed, bh) and (qi, kj) into one word
-    # each, injectively: an odd multiplier permutes i32 space, and the kj
-    # block index is always < 2**16.
-    pltpu.prng_seed(
-        seed_ref[0] + bh * jnp.int32(-1640531527),
-        qi * jnp.int32(65536) + kj,
-    )
+    pltpu.prng_seed(*_fold_dropout_seed(seed_ref[0], bh, qi, kj))
     bits = pltpu.prng_random_bits((block_q, block_k)).astype(jnp.uint32)
     threshold = jnp.uint32(min(int(rate * 2**32), 2**32 - 1))
     return jnp.where(bits >= threshold, 1.0 / (1.0 - rate), 0.0)

@@ -350,3 +350,26 @@ def test_bias_shape_contract():
     np.testing.assert_allclose(np.asarray(out_ref_full),
                                np.asarray(attention_reference(q, k, v)),
                                atol=1e-6)
+
+
+def test_dropout_seed_fold_is_two_words_and_injective():
+    """Mosaic's tpu.prng_set_seed_32 accepts at most TWO seed words — more
+    fails to compile ONLY on real hardware (interpret mode cannot lower
+    prng_seed on CPU at all), so pin the fold in pure Python: exactly two
+    words out, and distinct (bh, qi, kj) never collide (a collision would
+    silently correlate dropout masks between attention blocks)."""
+    from deepspeed_tpu.ops.transformer.attention import _fold_dropout_seed
+
+    words = _fold_dropout_seed(jnp.int32(123), jnp.int32(1), jnp.int32(2),
+                               jnp.int32(3))
+    assert len(words) == 2
+
+    # realistic block-index ranges: bh = batch*heads (large), qi/kj = S/block;
+    # one vectorized fold call over the whole grid, then a uniqueness check
+    bh = np.asarray(list(range(64)) + [255, 1024, 4095, 65535], np.int32)
+    qi = np.arange(8, dtype=np.int32)
+    kj = np.arange(8, dtype=np.int32)
+    bh_g, qi_g, kj_g = (g.ravel() for g in np.meshgrid(bh, qi, kj))
+    a, b = _fold_dropout_seed(np.int32(123), bh_g, qi_g, kj_g)
+    pairs = np.stack([np.asarray(a), np.asarray(b)], axis=1)
+    assert len(np.unique(pairs, axis=0)) == len(pairs), "seed fold collision"
