@@ -1,0 +1,223 @@
+"""Mixture-of-Experts + expert parallelism (parallel/expert.py).
+
+Beyond the v0.3.10 reference (predates DeepSpeed-MoE); the oracle pattern
+mirrors the suite's strongest correctness tool (SURVEY §4): the same tokens
+through different parallel layouts must produce the same math.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.parallel.expert import (
+    MoEConfig,
+    MoELayer,
+    expert_parallel_ffn,
+    expert_shardings,
+    moe_ffn,
+    top1_gating,
+)
+from deepspeed_tpu.parallel.mesh import DATA_AXIS, create_mesh
+from deepspeed_tpu.utils.shard_map_compat import shard_map
+
+
+def _params(rng, E, d, f):
+    k = jax.random.split(jax.random.PRNGKey(rng), 5)
+    return {
+        "router": jax.random.normal(k[0], (d, E), jnp.float32) * 0.5,
+        "w1": jax.random.normal(k[1], (E, d, f), jnp.float32) * 0.1,
+        "b1": jax.random.normal(k[2], (E, f), jnp.float32) * 0.1,
+        "w2": jax.random.normal(k[3], (E, f, d), jnp.float32) * 0.1,
+        "b2": jax.random.normal(k[4], (E, d), jnp.float32) * 0.1,
+    }
+
+
+def test_top1_gating_capacity_and_balance_loss():
+    T, E, C = 64, 4, 8
+    logits = jax.random.normal(jax.random.PRNGKey(0), (T, E))
+    dispatch, combine, aux = top1_gating(logits, C)
+    assert dispatch.shape == (T, E, C)
+    # every expert receives at most C tokens, each slot at most one token
+    assert float(jnp.max(jnp.sum(dispatch, axis=(0, 2)))) <= C
+    assert float(jnp.max(jnp.sum(dispatch, axis=0))) <= 1.0 + 1e-6
+    # a kept token's combine weights sum to its top-1 softmax prob
+    probs = jax.nn.softmax(logits, axis=-1)
+    kept = jnp.sum(dispatch, axis=(1, 2)) > 0
+    got = jnp.sum(combine, axis=(1, 2))
+    want = jnp.max(probs, axis=-1)
+    np.testing.assert_allclose(
+        np.asarray(got[kept]), np.asarray(want[kept]), rtol=1e-5)
+    # the loss must DISCRIMINATE balance from concentration (uniform logits
+    # are degenerate: argmax ties to expert 0 yet aux=1 regardless, so they
+    # prove nothing). Balanced: token t -> expert t%E with a hard margin ->
+    # frac=[1/E..], sharp probs -> aux ~= 1. Concentrated: every token ->
+    # expert 0 sharply -> frac=[1,0..], mean_prob ~= [1,0..] -> aux ~= E.
+    balanced = 20.0 * jax.nn.one_hot(jnp.arange(T) % E, E)
+    _, _, aux_bal = top1_gating(balanced, C)
+    np.testing.assert_allclose(float(aux_bal), 1.0, rtol=1e-3)
+    concentrated = 20.0 * jax.nn.one_hot(jnp.zeros(T, jnp.int32), E)
+    _, _, aux_conc = top1_gating(concentrated, C)
+    np.testing.assert_allclose(float(aux_conc), E, rtol=1e-3)
+    # aux is O(1) and positive on random logits
+    assert 0.0 < float(aux) < E
+
+
+def test_moe_matches_per_token_reference():
+    """With capacity large enough that nothing drops, the one-hot dispatch
+    einsums must equal routing each token through its argmax expert."""
+    T, E, d, f = 32, 4, 16, 32
+    params = _params(0, E, d, f)
+    x = jax.random.normal(jax.random.PRNGKey(1), (T, d), jnp.float32)
+    out, aux = moe_ffn(params, x, capacity=T)
+
+    logits = x @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    idx = np.asarray(jnp.argmax(probs, axis=-1))
+    ref = np.zeros((T, d), np.float32)
+    for t in range(T):
+        e = idx[t]
+        h = np.asarray(x[t]) @ np.asarray(params["w1"][e]) + np.asarray(params["b1"][e])
+        h = np.asarray(jax.nn.gelu(jnp.asarray(h)))
+        y = h @ np.asarray(params["w2"][e]) + np.asarray(params["b2"][e])
+        ref[t] = float(probs[t, e]) * y
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4, rtol=1e-4)
+    assert np.isfinite(float(aux))
+
+
+def test_moe_layer_module_trains():
+    cfg = MoEConfig(num_experts=4, d_model=16, d_ff=32)
+    layer = MoELayer(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 16))
+    variables = layer.init(jax.random.PRNGKey(3), x)
+
+    def loss_fn(v):
+        out, aux = layer.apply(v, x)
+        return jnp.sum(out**2) + 0.01 * aux
+
+    g = jax.grad(loss_fn)(variables)
+    flat = jax.tree_util.tree_leaves(g)
+    assert all(np.isfinite(np.asarray(l)).all() for l in flat)
+    # router must receive gradient (the gate multiplies the output)
+    gr = g["params"]["router"]
+    assert float(jnp.max(jnp.abs(gr))) > 0
+
+
+def test_moe_layer_trains_through_engine(tmpdir):
+    """MoE inside a model under deepspeed_tpu.initialize: the aux loss flows
+    into the training loss and the loss decreases."""
+    import flax.linen as nn
+
+    import deepspeed_tpu
+
+    class TinyMoEModel(nn.Module):
+        @nn.compact
+        def __call__(self, x, y):
+            h = nn.Dense(16)(x)
+            h, aux = MoELayer(MoEConfig(num_experts=4, d_model=16, d_ff=32))(h)
+            logits = nn.Dense(4)(h)
+            return jnp.mean((logits - y) ** 2) + 0.01 * aux
+
+    model = TinyMoEModel()
+    rng = np.random.RandomState(0)
+    B = len(jax.devices())
+    x = jnp.asarray(rng.randn(B, 8, 8), jnp.float32)
+    y = jnp.asarray(rng.randn(B, 8, 4), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x, y)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params={"train_batch_size": B,
+                       "train_micro_batch_size_per_gpu": B // len(jax.devices()),
+                       "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}})
+    losses = []
+    for _ in range(8):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        losses.append(float(jax.device_get(loss)))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_expert_parallel_matches_single_device():
+    """EP=8 shard_map all_to_all program == single-device moe_ffn on the
+    same tokens (capacity generous so neither layout drops tokens)."""
+    W, E, d, f = 8, 8, 16, 32
+    T = 128
+    params = _params(4, E, d, f)
+    x = jax.random.normal(jax.random.PRNGKey(5), (T, d), jnp.float32)
+    capacity = T  # no drops in either layout
+
+    out_single, aux_single = moe_ffn(params, x, capacity)
+
+    mesh = create_mesh(data_parallel_size=W)
+    ep_params = {k: (v if k == "router"
+                     else jax.device_put(v, NamedSharding(
+                         mesh, PartitionSpec(DATA_AXIS, *[None] * (v.ndim - 1)))))
+                 for k, v in params.items()}
+
+    fn = shard_map(
+        lambda p, xx: expert_parallel_ffn(p, xx, capacity, DATA_AXIS),
+        mesh=mesh,
+        in_specs=({"router": PartitionSpec(),
+                   "w1": PartitionSpec(DATA_AXIS, None, None),
+                   "b1": PartitionSpec(DATA_AXIS, None),
+                   "w2": PartitionSpec(DATA_AXIS, None, None),
+                   "b2": PartitionSpec(DATA_AXIS, None)},
+                  PartitionSpec(DATA_AXIS, None)),
+        out_specs=(PartitionSpec(DATA_AXIS, None), PartitionSpec()),
+    )
+    out_ep, aux_ep = jax.jit(fn)(ep_params, x)
+    np.testing.assert_allclose(
+        np.asarray(out_ep), np.asarray(out_single), atol=1e-4, rtol=1e-4)
+    # aux under EP is the mean of per-shard losses (routing statistics are
+    # computed on each device's tokens) — a different, equally standard
+    # estimator than the global one; only sanity-bound it
+    assert 0.0 < float(aux_ep) < E
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_expert_parallel_hlo_contains_all_to_all():
+    W, E, d, f = 8, 8, 16, 32
+    T = 64
+    params = _params(6, E, d, f)
+    x = jnp.zeros((T, d), jnp.float32)
+    mesh = create_mesh(data_parallel_size=W)
+    fn = shard_map(
+        lambda p, xx: expert_parallel_ffn(p, xx, 16, DATA_AXIS),
+        mesh=mesh,
+        in_specs=({"router": PartitionSpec(),
+                   "w1": PartitionSpec(DATA_AXIS, None, None),
+                   "b1": PartitionSpec(DATA_AXIS, None),
+                   "w2": PartitionSpec(DATA_AXIS, None, None),
+                   "b2": PartitionSpec(DATA_AXIS, None)},
+                  PartitionSpec(DATA_AXIS, None)),
+        out_specs=(PartitionSpec(DATA_AXIS, None), PartitionSpec()),
+    )
+    hlo = jax.jit(fn).lower(params, x).compile().as_text()
+    assert "all-to-all" in hlo, "expert dispatch must lower to all-to-all"
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_expert_shardings_lays_out_params():
+    mesh = create_mesh(data_parallel_size=8)
+    params = _params(7, 8, 16, 32)
+    sh = expert_shardings(mesh, params)
+    assert sh["router"].spec == PartitionSpec()
+    assert sh["w1"].spec == PartitionSpec(DATA_AXIS, None, None)
+    placed = jax.device_put(params, sh)
+    # each device holds 1/8 of the expert dim of w1
+    shard_shape = placed["w1"].sharding.shard_shape(placed["w1"].shape)
+    assert shard_shape[0] == 1
+    # name alone must NOT shard: a dense block that happens to call its
+    # weights w1/w2 (no router sibling) stays replicated
+    tree = {"moe": params,
+            "dense": {"w1": jnp.zeros((6, 4)), "w2": jnp.zeros((4, 6))}}
+    sh2 = expert_shardings(mesh, tree)
+    assert sh2["dense"]["w1"].spec == PartitionSpec()
+    assert sh2["dense"]["w2"].spec == PartitionSpec()
+    assert sh2["moe"]["w1"].spec == PartitionSpec(DATA_AXIS, None, None)
+    assert sh2["moe"]["router"].spec == PartitionSpec()
