@@ -157,13 +157,17 @@ out["dropout_zero_frac"] = round(zero_frac, 3)  # ~= rate
 dv = jax.grad(lambda v_: jnp.sum(
     flash_attention(qi, qi, v_, dropout_rate=rate, dropout_rng=rngd)))(eye)
 mask_err = float(jnp.max(jnp.abs(dv[..., 0] - pd.sum(axis=2))))
-out["dropout_bwd_mask_err"] = mask_err  # 0 iff bwd regenerates fwd's mask
+# a WRONG bwd mask shows up as O(dropped-prob) ~ 1e-2..1e0 discrepancies;
+# a CORRECT one still differs by bf16-MXU rounding (the kernel's matmul
+# operands are bf16, rel ~4e-3 — measured 7e-4 on v5e, 2026-07-31), so the
+# gate sits between the two regimes
+out["dropout_bwd_mask_err"] = mask_err
 
 out["ok"] = bool(
     err < 2e-2 and gerr < 2e-1 and serr < 2e-2
     and out["dropout_deterministic"]
     and abs(zero_frac - rate) < 0.05
-    and mask_err < 1e-4
+    and mask_err < 5e-3
 )
 print("SMOKE_JSON " + json.dumps(out))
 """
