@@ -318,6 +318,10 @@ def _run_child(env_extra, timeout):
     """
     env = dict(os.environ)
     env.update(env_extra)
+    # persistent XLA compilation cache: after a tunnel wedge kills a child
+    # mid-measurement, the retry skips the multi-minute BERT-large recompile
+    # (harmless no-op on backends that don't support it)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", _JAX_CACHE)
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child"],
@@ -344,6 +348,9 @@ def _run_child(env_extra, timeout):
 
 _TPU_CACHE = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "TPU_BENCH.json"
+)
+_JAX_CACHE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
 )
 
 

@@ -23,6 +23,10 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# persistent XLA compilation cache for every TPU child this watcher spawns:
+# a tunnel wedge mid-leg no longer costs the retry a full recompile
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
 LOG = os.path.join(REPO, "TPU_ATTEMPTS.log")
 SMOKE_OUT = os.path.join(REPO, "TPU_SMOKE.json")
 SEQ512_OUT = os.path.join(REPO, "TPU_BENCH_SEQ512.json")
@@ -435,7 +439,9 @@ def main():
         # stale pre-change result could otherwise be promoted over the fresh
         # headline with a now() measured_at stamp.
         bench_done = False
-        smoke_done = False
+        # keep a smoke record that is already this code generation's (has
+        # the dropout legs and passed) — windows are too short to re-prove it
+        smoke_done = _smoke_current(SMOKE_OUT)
         seq512_done = False
         ab_done = False
         gpt2_done = False
@@ -519,6 +525,11 @@ def main():
                 ab_done = True
             else:
                 log(f"attention A/B FAILED: {err}")
+        # sweep BEFORE longseq: the sweep can raise the headline number
+        # (VERDICT item 1) while longseq (item 5) can take hours of cells —
+        # on a flaky tunnel the high-value leg must get the window first
+        if bench_done and not sweep_done:
+            sweep_done = run_sweep()
         if bench_done and not longseq_done:
             ok2, err = run_longseq()
             if ok2:
@@ -526,8 +537,6 @@ def main():
                 log("longseq bench recorded on TPU")
             else:
                 log(f"longseq FAILED: {err}")
-        if bench_done and not sweep_done:
-            sweep_done = run_sweep()
         if not (smoke_done and bench_done and seq512_done and ab_done
                 and gpt2_done and sweep_done and longseq_done):
             time.sleep(SLEEP_MIN)
@@ -539,6 +548,18 @@ def _smoke_ok(path):
     try:
         with open(path) as f:
             return bool(json.load(f).get("ok"))
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _smoke_current(path):
+    """True when the on-disk smoke record passed AND covers every leg the
+    current SMOKE_CODE measures (records predating the in-kernel-dropout
+    legs lack dropout_compile_s and must be re-run under TPU_REFRESH)."""
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        return bool(d.get("ok")) and "dropout_compile_s" in d
     except Exception:  # noqa: BLE001
         return False
 
