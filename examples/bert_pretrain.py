@@ -22,6 +22,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+import os
+# allow `python examples/<script>.py` from anywhere: the scripts live
+# one level below the repo root that holds deepspeed_tpu/
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import deepspeed_tpu
 from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
 

@@ -21,6 +21,10 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
+import os
+# allow `python examples/<script>.py` from anywhere: the scripts live
+# one level below the repo root that holds deepspeed_tpu/
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import deepspeed_tpu
 from deepspeed_tpu.ops.sparse_attention import (
     BertSparseSelfAttention,

@@ -54,6 +54,12 @@ def test_sparse_attention_layouts(layout):
          ["--steps", "4", "--batch", "1", "--seq", "64", "--layout", layout])
 
 
+def test_moe_transformer():
+    # small shapes; the EP pjit demo runs too when the mesh has >1 device
+    _run("moe_transformer", ["--steps", "6", "--batch", "1", "--seq", "16",
+                             "--experts", "4"])
+
+
 def test_onebit_adam_squad():
     # freeze_step 6 of 10 -> 4 steps on the compressed path (the lr/freeze
     # combination is stability-validated; see the example's freeze_step note)

@@ -15,6 +15,7 @@ Exits 0 once both smoke and bench have succeeded; runs until killed
 otherwise. Never imports jax in the parent process.
 """
 
+import hashlib
 import json
 import os
 import re
@@ -23,11 +24,20 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-# persistent XLA compilation cache for every TPU child this watcher spawns:
-# a tunnel wedge mid-leg no longer costs the retry a full recompile
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
 LOG = os.path.join(REPO, "TPU_ATTEMPTS.log")
+
+
+def _child_env(extra=None):
+    """Env for every TPU child this watcher spawns: the persistent XLA
+    compilation cache means a tunnel wedge mid-leg no longer costs the
+    retry a full recompile. Scoped to children — test processes import
+    this module and must not have their environment mutated."""
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+    env.update(extra or {})
+    return env
+
+
 SMOKE_OUT = os.path.join(REPO, "TPU_SMOKE.json")
 SEQ512_OUT = os.path.join(REPO, "TPU_BENCH_SEQ512.json")
 GPT2_OUT = os.path.join(REPO, "GPT2_BENCH.json")
@@ -177,11 +187,15 @@ print("SMOKE_JSON " + json.dumps(out))
 """
 
 
+_SMOKE_SHA = hashlib.sha1(SMOKE_CODE.encode()).hexdigest()[:12]
+
+
 def run_smoke():
     try:
         r = subprocess.run(
             [sys.executable, "-c", SMOKE_CODE],
             capture_output=True, text=True, timeout=SMOKE_TIMEOUT, cwd=REPO,
+            env=_child_env(),
         )
     except subprocess.TimeoutExpired:
         return None, f"smoke timed out after {SMOKE_TIMEOUT}s"
@@ -194,8 +208,7 @@ def run_smoke():
 def run_bench(env_extra=None):
     """Run bench.py's full orchestration (probe + OOM ladder); on success it
     writes the cached TPU measurement to TPU_BENCH.json itself."""
-    env = dict(os.environ)
-    env.update(env_extra or {})
+    env = _child_env(env_extra)
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
@@ -258,7 +271,7 @@ def run_longseq():
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "tests", "perf", "longseq_bench.py")],
             capture_output=True, text=True,
-            timeout=n_cells * child_t + 600, cwd=REPO,
+            timeout=n_cells * child_t + 600, cwd=REPO, env=_child_env(),
         )
     except subprocess.TimeoutExpired:
         return False, "longseq timed out"
@@ -298,6 +311,7 @@ def run_ab():
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "tests", "perf", "attention_ab.py")],
             capture_output=True, text=True, timeout=SMOKE_TIMEOUT * 2, cwd=REPO,
+            env=_child_env(),
         )
     except subprocess.TimeoutExpired:
         return None, "attention A/B timed out"
@@ -467,6 +481,7 @@ def main():
         if not smoke_done:
             res, err = run_smoke()
             if res is not None:
+                res["smoke_code_sha"] = _SMOKE_SHA
                 # never clobber a good smoke record with a failing one
                 if res.get("ok") or not _smoke_ok(SMOKE_OUT):
                     with open(SMOKE_OUT, "w") as f:
@@ -544,24 +559,25 @@ def main():
     return 0
 
 
-def _smoke_ok(path):
+def _load_smoke(path):
     try:
         with open(path) as f:
-            return bool(json.load(f).get("ok"))
+            return json.load(f)
     except Exception:  # noqa: BLE001
-        return False
+        return {}
+
+
+def _smoke_ok(path):
+    return bool(_load_smoke(path).get("ok"))
 
 
 def _smoke_current(path):
-    """True when the on-disk smoke record passed AND covers every leg the
-    current SMOKE_CODE measures (records predating the in-kernel-dropout
-    legs lack dropout_compile_s and must be re-run under TPU_REFRESH)."""
-    try:
-        with open(path) as f:
-            d = json.load(f)
-        return bool(d.get("ok")) and "dropout_compile_s" in d
-    except Exception:  # noqa: BLE001
-        return False
+    """True when the on-disk smoke record passed AND was produced by the
+    current SMOKE_CODE (the watcher stamps its sha into every record it
+    writes, so ANY edit to the smoke legs forces a re-run under
+    TPU_REFRESH — coverage is enforced structurally, not by convention)."""
+    d = _load_smoke(path)
+    return bool(d.get("ok")) and d.get("smoke_code_sha") == _SMOKE_SHA
 
 
 if __name__ == "__main__":
