@@ -1,0 +1,183 @@
+"""Consolidate a ZeRO checkpoint into a single fp32 state dict.
+
+Beyond the v0.3.10 reference (whose checkpoints kept per-rank optimizer
+partitions with no offline merge tool — later DeepSpeed shipped
+``zero_to_fp32.py`` inside every checkpoint for exactly this job): recover
+the full-precision master weights from a ``deepspeed_tpu`` checkpoint
+without constructing an engine, for export to inference / another
+framework / plain ``jax.device_put``.
+
+Handles every layout the engines write:
+
+- plain engine, no ZeRO          -> module states (cast to fp32)
+- flat ZeRO-1/2 (+offload)       -> concat per-rank ``flat_master`` slices
+  (``runtime/zero/sharded_optimizer.py shard_state_dicts``) and unflatten
+  into the module tree, regardless of the dp degree that saved them
+- pytree ZeRO (TP/ZeRO-3 compose)-> the fp32 master pytree saved by
+  ``runtime/zero/pytree_optimizer.py shard_state_dicts``
+- fp32 compute (``master_from_params`` / no master) -> module states ARE
+  the master
+- pipeline checkpoints (``module-meta.pt`` + per-layer files) -> per-layer
+  fp32 trees, masters preferred when the optimizer state carries them
+
+CLI::
+
+    python -m deepspeed_tpu.utils.zero_to_fp32 <checkpoint_dir> <output_file> [tag]
+
+writes a pickle of the consolidated fp32 state dict (module tree for the
+engine layout; ``{"layers": [...]}`` for the pipeline layout).
+"""
+
+import glob
+import os
+import pickle
+import re
+import sys
+
+import numpy as np
+
+
+def _read_tag(checkpoint_dir, tag):
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if not os.path.isfile(latest):
+            raise FileNotFoundError(
+                f"no tag given and no 'latest' file in {checkpoint_dir}")
+        with open(latest) as f:
+            tag = f.read().strip()
+    folder = os.path.join(checkpoint_dir, str(tag))
+    if not os.path.isdir(folder):
+        raise FileNotFoundError(f"checkpoint folder {folder} does not exist")
+    return folder
+
+
+def _to_fp32(tree):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(a, np.float32), tree)
+
+
+def _unflatten_like(flat, tree):
+    """Split a flat fp32 vector back into ``tree``'s structure (the flatten
+    order is tree_leaves order — ops/utils_op.py flatten_dense_tensors)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    sizes = [int(np.prod(l.shape)) if getattr(l, "ndim", 0) else 1
+             for l in leaves]
+    total = sum(sizes)
+    if flat.shape[0] != total:
+        raise ValueError(
+            f"master numel {flat.shape[0]} != module numel {total}: the zero "
+            "shards belong to a different model than the module states")
+    out, off = [], 0
+    for leaf, n in zip(leaves, sizes):
+        out.append(np.asarray(flat[off:off + n], np.float32)
+                   .reshape(np.shape(leaf)))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _consolidate_flat_shards(shards, module_states):
+    shards = sorted(shards, key=lambda s: s["rank"])
+    numel = shards[0]["numel"]
+    ranks = [s["rank"] for s in shards]
+    if ranks != list(range(shards[0]["dp_world_size"])):
+        raise ValueError(f"zero shard files incomplete: have ranks {ranks}, "
+                         f"expected 0..{shards[0]['dp_world_size'] - 1}")
+    flat = np.concatenate(
+        [np.asarray(s["flat_master"], np.float32) for s in shards])[:numel]
+    if flat.shape[0] != numel:
+        raise ValueError(
+            f"zero shards carry {flat.shape[0]} elements but declare "
+            f"numel={numel}: shard files are inconsistent or truncated")
+    return _unflatten_like(flat, module_states)
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
+    """Return the consolidated fp32 module state dict (a pytree of numpy
+    arrays) for an engine checkpoint saved by ``DeepSpeedEngine``."""
+    folder = _read_tag(checkpoint_dir, tag)
+
+    if os.path.isfile(os.path.join(folder, "module-meta.pt")):
+        return _pipeline_fp32_layers(folder)
+
+    module_files = sorted(glob.glob(
+        os.path.join(folder, "mp_rank_*_model_states.pt")))
+    if not module_files:
+        raise FileNotFoundError(f"no *_model_states.pt under {folder}")
+    if len(module_files) > 1:
+        raise NotImplementedError(
+            "multiple model-parallel rank files found; consolidate each "
+            f"mp_rank separately: {module_files}")
+    with open(module_files[0], "rb") as f:
+        module_states = pickle.load(f)["module"]
+
+    shard_files = sorted(
+        glob.glob(os.path.join(folder, "zero_pp_rank_*optim_states.pt")),
+        key=lambda p: int(re.search(r"zero_pp_rank_(\d+)_", p).group(1)))
+    if not shard_files:
+        return _to_fp32(module_states)  # no ZeRO: module states are it
+
+    shards = []
+    for p in shard_files:
+        with open(p, "rb") as f:
+            shards.append(pickle.load(f))
+
+    if shards[0].get("pytree_zero"):
+        master = getattr(shards[0]["state"], "master", None)
+        if master is None:  # fp32 compute: params are the master
+            return _to_fp32(module_states)
+        return _to_fp32(master)
+
+    if shards[0].get("master_from_params") or shards[0].get("flat_master") is None:
+        return _to_fp32(module_states)
+    return _consolidate_flat_shards(shards, module_states)
+
+
+def _pipeline_fp32_layers(folder):
+    """Pipeline layout: per-layer files, masters preferred when present."""
+    layer_files = sorted(glob.glob(
+        os.path.join(folder, "layer_*-model_states.pt")))
+    layers = {}
+    for p in layer_files:
+        idx = int(re.search(r"layer_(\d+)-", p).group(1))
+        with open(p, "rb") as f:
+            layers[idx] = _to_fp32(pickle.load(f))
+
+    opt_path = os.path.join(folder, "optim_states.pt")
+    if os.path.isfile(opt_path):
+        with open(opt_path, "rb") as f:
+            opt = pickle.load(f)
+        # per-layer dicts from PipelineEngine._split_opt_state_per_layer:
+        # the fp32 master (when ZeRO kept one) sits under "zero_master"
+        for idx, st in enumerate(opt.get("layers") or []):
+            master = st.get("zero_master") if isinstance(st, dict) else None
+            if master is not None and idx in layers:
+                layers[idx] = _to_fp32(master)
+    return {"layers": [layers[i] for i in sorted(layers)]}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file,
+                                               tag=None):
+    """Consolidate and write ``output_file`` (pickle). Returns the dict."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    with open(output_file, "wb") as f:
+        pickle.dump(sd, f)
+    return sd
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) not in (2, 3):
+        print(__doc__)
+        return 1
+    convert_zero_checkpoint_to_fp32_state_dict(
+        argv[0], argv[1], argv[2] if len(argv) == 3 else None)
+    print(f"consolidated fp32 state dict written to {argv[1]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,179 @@
+"""Offline ZeRO checkpoint consolidation (utils/zero_to_fp32.py).
+
+Beyond the v0.3.10 reference (later DeepSpeed ships zero_to_fp32.py inside
+every checkpoint for this): the consolidated dict must equal the engine's
+OWN fp32 master — not the low-precision module states — without building an
+engine."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.utils.zero_to_fp32 import (
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint,
+    main as zero_to_fp32_main,
+)
+from tests.unit.simple_model import make_simple_engine, random_dataloader
+from tests.unit.test_checkpointing import _cfg, _merged_master, _train_steps
+
+
+def _assert_tree_allclose(a, b, **kw):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(
+            np.asarray(x, np.float32), np.asarray(y, np.float32), **kw)
+
+
+@pytest.mark.parametrize("zero_stage", [1, 2])
+def test_consolidated_equals_engine_master(tmpdir, zero_stage):
+    """fp16 + ZeRO: the tool must reproduce the fp32 master exactly (which
+    differs from the fp16 module states it would get by naive casting)."""
+    save_dir = str(tmpdir.join("ckpt"))
+    engine = make_simple_engine(tmpdir, _cfg(zero_stage=zero_stage, fp16=True))
+    _train_steps(engine, 4)
+    engine.save_checkpoint(save_dir, tag="tag1")
+
+    sd = get_fp32_state_dict_from_zero_checkpoint(save_dir, tag="tag1")
+
+    # exact == the flat master, reshaped; and it must carry MORE precision
+    # than the fp16 module states
+    flat_master = _merged_master(engine)
+    flat_sd = np.concatenate(
+        [np.asarray(l, np.float32).ravel()
+         for l in jax.tree_util.tree_leaves(sd)])
+    np.testing.assert_array_equal(flat_sd, flat_master)
+    flat_params = np.concatenate(
+        [np.asarray(l, np.float32).ravel()
+         for l in jax.tree_util.tree_leaves(jax.device_get(engine.params))])
+    assert not np.array_equal(flat_sd, flat_params), (
+        "master should differ from the fp16 params in low bits")
+
+
+def test_consolidated_no_zero_is_module_states(tmpdir):
+    save_dir = str(tmpdir.join("ckpt"))
+    engine = make_simple_engine(tmpdir, _cfg())
+    _train_steps(engine, 2)
+    engine.save_checkpoint(save_dir, tag="t")
+    sd = get_fp32_state_dict_from_zero_checkpoint(save_dir, tag="t")
+    _assert_tree_allclose(sd, jax.device_get(engine.params), rtol=0)
+
+
+def test_consolidated_fp32_compute_master_from_params(tmpdir):
+    """fp32 compute + ZeRO: no stored master (master_from_params) — module
+    states are the master."""
+    save_dir = str(tmpdir.join("ckpt"))
+    engine = make_simple_engine(tmpdir, _cfg(zero_stage=2))
+    _train_steps(engine, 2)
+    engine.save_checkpoint(save_dir, tag="t")
+    sd = get_fp32_state_dict_from_zero_checkpoint(save_dir, tag="t")
+    _assert_tree_allclose(sd, jax.device_get(engine.params), rtol=0)
+
+
+def test_consolidated_offload(tmpdir):
+    save_dir = str(tmpdir.join("ckpt"))
+    cfg = _cfg(zero_stage=2, fp16=True)
+    cfg["zero_optimization"]["cpu_offload"] = True
+    engine = make_simple_engine(tmpdir, cfg)
+    _train_steps(engine, 3)
+    engine.save_checkpoint(save_dir, tag="t")
+    sd = get_fp32_state_dict_from_zero_checkpoint(save_dir, tag="t")
+    flat_sd = np.concatenate(
+        [np.asarray(l, np.float32).ravel()
+         for l in jax.tree_util.tree_leaves(sd)])
+    np.testing.assert_array_equal(flat_sd, _merged_master(engine))
+
+
+def test_cli_writes_pickle_and_latest_tag(tmpdir):
+    save_dir = str(tmpdir.join("ckpt"))
+    out = str(tmpdir.join("fp32.pkl"))
+    engine = make_simple_engine(tmpdir, _cfg(zero_stage=2, fp16=True))
+    _train_steps(engine, 2)
+    engine.save_checkpoint(save_dir)  # writes 'latest'
+
+    assert zero_to_fp32_main([save_dir, out]) == 0
+    with open(out, "rb") as f:
+        sd = pickle.load(f)
+    _assert_tree_allclose(
+        sd, get_fp32_state_dict_from_zero_checkpoint(save_dir))
+
+
+def test_shard_numel_mismatch_raises(tmpdir):
+    """Guard: zero shards from a DIFFERENT model than the module states."""
+    save_dir = str(tmpdir.join("ckpt"))
+    engine = make_simple_engine(tmpdir, _cfg(zero_stage=2, fp16=True))
+    _train_steps(engine, 2)
+    engine.save_checkpoint(save_dir, tag="t")
+
+    import glob as _glob
+    import os
+    shard = sorted(_glob.glob(os.path.join(
+        save_dir, "t", "zero_pp_rank_*optim_states.pt")))[0]
+    with open(shard, "rb") as f:
+        blob = pickle.load(f)
+    blob["numel"] = blob["numel"] + 7
+    with open(shard, "wb") as f:
+        pickle.dump(blob, f)
+    with pytest.raises(ValueError, match="numel"):
+        get_fp32_state_dict_from_zero_checkpoint(save_dir, tag="t")
+
+
+def test_pipeline_checkpoint_consolidates_layers(tmpdir):
+    """Pipeline layout: per-layer files -> {'layers': [...]} fp32 trees."""
+    import deepspeed_tpu
+    from tests.unit.test_pipe import ds_config, make_data, make_module
+
+    save_dir = str(tmpdir.join("ckpt"))
+    module = make_module(4)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, config_params=ds_config(dp=2))
+    it = iter(make_data(4, 8))
+    for _ in range(2):
+        engine.train_batch(it)
+    engine.save_checkpoint(save_dir, tag="t")
+
+    sd = get_fp32_state_dict_from_zero_checkpoint(save_dir, tag="t")
+    assert set(sd) == {"layers"}
+    assert len(sd["layers"]) == engine.module._num_layers
+    for layer in sd["layers"]:
+        for leaf in jax.tree_util.tree_leaves(layer):
+            assert np.asarray(leaf).dtype == np.float32
+
+
+def test_pipeline_fp16_zero_uses_master(tmpdir):
+    """Pipeline + fp16 + ZeRO: the consolidated layers must be the fp32
+    zero_master from optim_states.pt, not the fp16 layer params."""
+    import deepspeed_tpu
+    from tests.unit.test_pipe import ds_config, make_data, make_module
+
+    cfg = ds_config(dp=2)
+    cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    cfg["zero_optimization"] = {"stage": 1}
+
+    save_dir = str(tmpdir.join("ckpt"))
+    module = make_module(4)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=module, config_params=cfg)
+    it = iter(make_data(6, 8))
+    for _ in range(3):
+        engine.train_batch(it)
+    engine.save_checkpoint(save_dir, tag="t")
+
+    sd = get_fp32_state_dict_from_zero_checkpoint(save_dir, tag="t")
+
+    # engine-side oracle: per-layer fp32 masters in stage order
+    masters = []
+    for s in range(engine.num_stages):
+        st = engine._stage_opt_state[s]
+        masters.extend(jax.device_get(st.master))
+    assert len(masters) == len(sd["layers"])
+    for got, want in zip(sd["layers"], masters):
+        got_l = jax.tree_util.tree_leaves(got)
+        want_l = jax.tree_util.tree_leaves(want)
+        assert len(got_l) == len(want_l)
+        for g, w in zip(got_l, want_l):
+            np.testing.assert_array_equal(
+                np.asarray(g, np.float32), np.asarray(w, np.float32))
