@@ -1,0 +1,13 @@
+"""Autotuning: measured search over engine configs (beyond the v0.3.10
+reference — later DeepSpeed made ``deepspeed --autotuning`` a headline
+feature, spawning experiment jobs per config; on TPU the whole experiment
+is one jit-compile + a few steps in-process, so the tuner IS a loop)."""
+
+from deepspeed_tpu.autotuning.tuner import (
+    Candidate,
+    autotune,
+    autotune_engine,
+    default_candidates,
+)
+
+__all__ = ["Candidate", "autotune", "autotune_engine", "default_candidates"]

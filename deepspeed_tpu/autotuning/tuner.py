@@ -1,0 +1,197 @@
+"""Measured config search (the TPU-native DeepSpeed autotuner).
+
+Later DeepSpeed's autotuner (absent from the v0.3.10 reference) launches a
+separate experiment JOB per candidate config and harvests metrics files.
+On TPU every experiment is a jit compile + a few timed steps of one XLA
+program, so the whole search runs in-process: compile each candidate,
+time it, rank by throughput, return the winner. Infeasible candidates
+(HBM OOM at compile or first execution) are recorded, not fatal — the
+same contract as the bench harness's micro-batch OOM ladder.
+
+Two entry points:
+
+- ``autotune(build_fn, candidates, ...)`` — generic: ``build_fn(overrides)
+  -> (step_callable, samples_per_step)``. The tuner times
+  ``step_callable`` (blocking on its result) and maximizes
+  samples/sec.
+- ``autotune_engine(model, model_parameters, base_config, batches, ...)``
+  — convenience wrapper that deep-merges each candidate's overrides into
+  ``base_config``, builds a fresh engine via ``deepspeed_tpu.initialize``,
+  and returns ``(best_config, report)``.
+"""
+
+import time
+from dataclasses import dataclass, field
+
+from deepspeed_tpu.utils.logging import log_dist
+
+# error-text markers of an HBM allocation failure (same set bench.py keys
+# its OOM ladder off); anything else is a real error and still recorded,
+# so one broken candidate cannot kill a long search
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED", "Out of memory", "out of memory", "AllocateBuffer",
+)
+
+
+@dataclass
+class Candidate:
+    """One point in the search space: config overrides + a display label."""
+
+    overrides: dict
+    label: str = ""
+
+    def __post_init__(self):
+        if not self.label:
+            self.label = ",".join(
+                f"{k}={v}" for k, v in sorted(_flatten(self.overrides).items()))
+
+
+def _flatten(d, prefix=""):
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "."))
+        else:
+            out[key] = v
+    return out
+
+
+def deep_merge(base, overrides):
+    """Recursive dict merge: ``overrides`` wins, sub-dicts merge."""
+    out = dict(base)
+    for k, v in overrides.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def default_candidates(micro_batch, remat=True):
+    """The ladder that matters on TPU: micro-batch (MXU utilization vs HBM)
+    x activation remat (HBM vs recompute FLOPs). Largest-batch/no-remat
+    first — the fastest config whenever it fits."""
+    cands = []
+    for mb in (micro_batch * 2, micro_batch, max(1, micro_batch // 2)):
+        for r in ((False, True) if remat else (False,)):
+            cands.append(Candidate({
+                "train_micro_batch_size_per_gpu": mb,
+                "activation_checkpointing": {"enabled": r},
+            }))
+    return cands
+
+
+def _block_on(x):
+    import jax
+
+    jax.block_until_ready(x)
+    # a data fetch is the only thing that truly waits on some remote
+    # backends (see bench.py _timed_chain); a scalar fetch is cheap
+    leaves = jax.tree_util.tree_leaves(x)
+    if leaves and getattr(leaves[0], "size", 2) == 1:
+        float(jax.device_get(leaves[0]))
+
+
+def autotune(build_fn, candidates, steps=3, warmup=1, verbose=True):
+    """Time every candidate; return ``(best_candidate, report)``.
+
+    ``build_fn(overrides) -> (step_callable, samples_per_step)``; the
+    callable runs ONE training step and returns a value to block on.
+    ``report`` is a list of dicts (label, overrides, ok, compile_s,
+    step_ms, samples_per_sec | error, oom) in input order; ``best`` is
+    the feasible candidate with the highest samples/sec (None if all
+    candidates failed).
+    """
+    report = []
+    for cand in candidates:
+        entry = {"label": cand.label, "overrides": cand.overrides}
+        try:
+            t0 = time.perf_counter()
+            step, samples = build_fn(cand.overrides)
+            _block_on(step())  # compile + first execution
+            entry["compile_s"] = round(time.perf_counter() - t0, 2)
+            for _ in range(max(0, warmup - 1)):
+                step()
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(steps):
+                out = step()
+            _block_on(out)
+            dt = (time.perf_counter() - t0) / steps
+            entry.update(ok=True, step_ms=round(dt * 1000.0, 2),
+                         samples_per_sec=round(samples / dt, 2))
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:  # noqa: BLE001 — a candidate must not kill the search
+            msg = str(e)
+            entry.update(ok=False, error=msg[-500:],
+                         oom=any(m in msg for m in _OOM_MARKERS))
+        if verbose:
+            log_dist(f"autotune {cand.label}: "
+                     + (f"{entry['samples_per_sec']} samples/sec "
+                        f"({entry['step_ms']} ms/step)" if entry.get("ok")
+                        else ("OOM" if entry.get("oom") else "FAILED")),
+                     ranks=[0])
+        report.append(entry)
+    best = None
+    for cand, entry in zip(candidates, report):
+        if entry.get("ok") and (
+                best is None or entry["samples_per_sec"] > best[1]["samples_per_sec"]):
+            best = (cand, entry)
+    return (best[0] if best else None), report
+
+
+def autotune_engine(model, model_parameters, base_config, data_fn,
+                    candidates=None, steps=3, warmup=1, verbose=True):
+    """Search engine configs; returns ``(best_merged_config, report)``.
+
+    ``data_fn(global_batch_size) -> list of argument tuples`` for
+    ``engine(*args)`` — a factory, because candidates that move the micro
+    batch change the global batch each step consumes. ``candidates``
+    defaults to the micro-batch x remat ladder around the base config's
+    micro batch.
+    """
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+
+    if candidates is None:
+        base_mb = base_config.get("train_micro_batch_size_per_gpu", 1)
+        candidates = default_candidates(base_mb)
+
+    # engines donate their param buffers into the jitted step — every
+    # candidate needs a fresh device copy from one host snapshot (which
+    # also guarantees identical init across candidates)
+    host_params = jax.device_get(model_parameters)
+
+    def build(overrides):
+        cfg = deep_merge(base_config, overrides)
+        # keep the batch triple consistent when the search moves the
+        # micro batch: world size and gas stay, train_batch follows
+        cfg.pop("train_batch_size", None)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model,
+            model_parameters=jax.tree_util.tree_map(jnp.asarray, host_params),
+            config_params=cfg)
+        it = itertools.cycle(data_fn(engine.train_batch_size()))
+
+        def step():
+            args = next(it)
+            loss = engine(*args)
+            engine.backward(loss)
+            engine.step()
+            return loss
+
+        return step, engine.train_batch_size()
+
+    best, report = autotune(build, candidates, steps=steps, warmup=warmup,
+                            verbose=verbose)
+    if best is None:
+        return None, report
+    merged = deep_merge(base_config, best.overrides)
+    merged.pop("train_batch_size", None)
+    return merged, report

@@ -86,8 +86,11 @@ def _consolidate_flat_shards(shards, module_states):
     if ranks != list(range(shards[0]["dp_world_size"])):
         raise ValueError(f"zero shard files incomplete: have ranks {ranks}, "
                          f"expected 0..{shards[0]['dp_world_size'] - 1}")
+    # a consistent shard set concatenates to EXACTLY numel (each save-side
+    # slice is already truncated to the logical length) — check both
+    # directions before unflattening
     flat = np.concatenate(
-        [np.asarray(s["flat_master"], np.float32) for s in shards])[:numel]
+        [np.asarray(s["flat_master"], np.float32) for s in shards])
     if flat.shape[0] != numel:
         raise ValueError(
             f"zero shards carry {flat.shape[0]} elements but declare "
@@ -137,11 +140,16 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
 
 
 def _pipeline_fp32_layers(folder):
-    """Pipeline layout: per-layer files, masters preferred when present."""
-    layer_files = sorted(glob.glob(
-        os.path.join(folder, "layer_*-model_states.pt")))
-    layers = {}
-    for p in layer_files:
+    """Pipeline layout: per-layer files, masters preferred when present.
+
+    The returned list is indexed by GLOBAL layer index (module-meta.pt's
+    ``num_layers``): stateless layers — plain functions whose params the
+    engine never saves — appear as ``None`` so positions stay aligned
+    with the module's layer list."""
+    with open(os.path.join(folder, "module-meta.pt"), "rb") as f:
+        meta = pickle.load(f)
+    layers = [None] * meta["num_layers"]
+    for p in glob.glob(os.path.join(folder, "layer_*-model_states.pt")):
         idx = int(re.search(r"layer_(\d+)-", p).group(1))
         with open(p, "rb") as f:
             layers[idx] = _to_fp32(pickle.load(f))
@@ -154,9 +162,9 @@ def _pipeline_fp32_layers(folder):
         # the fp32 master (when ZeRO kept one) sits under "zero_master"
         for idx, st in enumerate(opt.get("layers") or []):
             master = st.get("zero_master") if isinstance(st, dict) else None
-            if master is not None and idx in layers:
+            if master is not None and layers[idx] is not None:
                 layers[idx] = _to_fp32(master)
-    return {"layers": [layers[i] for i in sorted(layers)]}
+    return {"layers": layers}
 
 
 def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir, output_file,
