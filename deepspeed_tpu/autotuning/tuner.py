@@ -73,7 +73,9 @@ def default_candidates(micro_batch, remat=True):
     x activation remat (HBM vs recompute FLOPs). Largest-batch/no-remat
     first — the fastest config whenever it fits."""
     cands = []
-    for mb in (micro_batch * 2, micro_batch, max(1, micro_batch // 2)):
+    rungs = sorted({micro_batch * 2, micro_batch, max(1, micro_batch // 2)},
+                   reverse=True)  # dedup: mb=1 collapses two rungs
+    for mb in rungs:
         for r in ((False, True) if remat else (False,)):
             cands.append(Candidate({
                 "train_micro_batch_size_per_gpu": mb,
@@ -104,17 +106,23 @@ def autotune(build_fn, candidates, steps=3, warmup=1, verbose=True):
     candidates failed).
     """
     report = []
+    step = None
     for cand in candidates:
+        # free the previous candidate's engine (params, optimizer state,
+        # batches hang off the step closure) BEFORE the next build — two
+        # co-resident engines would falsely OOM configs that fit alone
+        step = None  # noqa: F841
         entry = {"label": cand.label, "overrides": cand.overrides}
         try:
             t0 = time.perf_counter()
             step, samples = build_fn(cand.overrides)
             _block_on(step())  # compile + first execution
             entry["compile_s"] = round(time.perf_counter() - t0, 2)
-            for _ in range(max(0, warmup - 1)):
-                step()
-            t0 = time.perf_counter()
             out = None
+            for _ in range(max(0, warmup - 1)):
+                out = step()
+            _block_on(out)  # warmup must not leak into the timed window
+            t0 = time.perf_counter()
             for _ in range(steps):
                 out = step()
             _block_on(out)

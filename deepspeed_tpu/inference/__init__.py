@@ -1,0 +1,10 @@
+"""Inference: KV-cache autoregressive generation for the GPT-2 family.
+
+Beyond the v0.3.10 reference (DeepSpeed-Inference came later) but part of
+the model-family story users expect: decode with the SAME trained params
+the training stack produces (scan-stacked fused layers), O(1) work per
+new token via a static-shape KV cache."""
+
+from deepspeed_tpu.inference.generation import generate, greedy_generate  # noqa: F401
+
+__all__ = ["generate", "greedy_generate"]

@@ -1,0 +1,166 @@
+"""KV-cache autoregressive decoding over the training stack's params.
+
+Beyond the v0.3.10 reference (DeepSpeed-Inference came later). TPU-first
+design: the whole decode is ONE jitted ``lax.scan`` over positions — no
+per-token host round-trips — with an inner ``lax.scan`` over the
+scan-stacked layer params (the same [L, ...] stacking the training path
+uses, so a trained checkpoint drops in unchanged). Static shapes
+throughout: the KV cache is [L, B, nh, S_max, hd] and future positions
+are masked, so XLA compiles one program for any prompt/continuation
+split.
+
+The per-layer math mirrors ``DeepSpeedTransformerLayer`` (pre-LN:
+x + attn(LN(x)), x + ffn(LN(x)), fused qkv GEMM) — asserted equal to the
+full forward in ``tests/unit/test_generation.py``.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _layer_tree(params):
+    """The stacked per-layer param tree and the names of its blocks.
+
+    The scan body (models/gpt2.py ``_ScannedDecoderLayer``) holds ONE child
+    module (the fused layer); its params sit one level below ``layers``."""
+    layers = params["params"]["transformer"]["layers"]
+    children = list(layers.values())
+    assert len(children) == 1, f"expected one scanned child, got {list(layers)}"
+    return children[0]
+
+
+def _ln(x, p):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) / jnp.sqrt(var + 1e-6) * p["scale"] + p["bias"]
+
+
+def _decode_one(layer_p, h, cache_k, cache_v, pos, nh):
+    """One token through one layer against the cache.
+
+    h [B, H]; cache_k/v [B, nh, S_max, hd]; pos scalar. Returns updated
+    (h, cache_k, cache_v)."""
+    B, H = h.shape
+    hd = H // nh
+
+    a_in = _ln(h, layer_p["ln_attn"])
+    qkv = a_in @ layer_p["qkv"]["kernel"] + layer_p["qkv"]["bias"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, nh, hd)
+    k = k.reshape(B, nh, hd)
+    v = v.reshape(B, nh, hd)
+
+    cache_k = jax.lax.dynamic_update_index_in_dim(cache_k, k, pos, axis=2)
+    cache_v = jax.lax.dynamic_update_index_in_dim(cache_v, v, pos, axis=2)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, h.dtype))
+    scores = jnp.einsum("bnd,bnsd->bns", q, cache_k) * scale     # [B,nh,S]
+    S_max = cache_k.shape[2]
+    valid = jnp.arange(S_max) <= pos
+    scores = jnp.where(valid[None, None, :], scores,
+                       jnp.asarray(-1e30, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(h.dtype)
+    ctx = jnp.einsum("bns,bnsd->bnd", probs, cache_v).reshape(B, H)
+    a = ctx @ layer_p["attn_out"]["kernel"] + layer_p["attn_out"]["bias"]
+    h = h + a
+
+    f_in = _ln(h, layer_p["ln_ffn"])
+    f = f_in @ layer_p["ff1"]["kernel"] + layer_p["ff1"]["bias"]
+    f = jax.nn.gelu(f, approximate=False)
+    f = f @ layer_p["ff2"]["kernel"] + layer_p["ff2"]["bias"]
+    return h + f, cache_k, cache_v
+
+
+def _step(params, nh, caches, token, pos):
+    """Embed one token, run the layer stack against the caches, return
+    (next-token logits [B, V], updated caches)."""
+    tr = params["params"]["transformer"]
+    wte = tr["wte"]["embedding"]
+    wpe = tr["wpe"]["embedding"]
+    layer_p = _layer_tree(params)
+
+    h = wte[token] + wpe[pos]                                    # [B, H]
+
+    # scan over the stacked layer dim with per-layer cache slices as
+    # scanned inputs — mirrors the training stack's nn.scan
+    def layer_body(h, inputs):
+        lp, ck_l, cv_l = inputs
+        h, ck_l, cv_l = _decode_one(lp, h, ck_l, cv_l, pos, nh)
+        return h, (ck_l, cv_l)
+
+    h, caches = jax.lax.scan(layer_body, h, (layer_p,) + tuple(caches))
+
+    h = _ln(h, tr["ln_f"])
+    logits = h @ wte.T.astype(h.dtype)
+    return logits, caches
+
+
+@partial(jax.jit, static_argnames=("n_layers", "n_heads", "head_dim",
+                                   "max_new_tokens", "greedy"))
+def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
+                  max_new_tokens, greedy, temperature, rng):
+    B, S = prompt_ids.shape
+    total = S + max_new_tokens
+    shape = (n_layers, B, n_heads, total, head_dim)
+    caches = (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+
+    # prefill: scan the prompt through the cache (same step as decode —
+    # one program; prompt logits are discarded except the last)
+    def prefill_body(caches, pos):
+        logits, caches = _step(params, n_heads, caches, prompt_ids[:, pos], pos)
+        return caches, logits
+
+    caches, prompt_logits = jax.lax.scan(prefill_body, caches, jnp.arange(S))
+    last_logits = prompt_logits[-1]                              # [B, V]
+
+    def decode_body(carry, pos):
+        caches, logits, rng = carry
+        if greedy:
+            token = jnp.argmax(logits, axis=-1)
+        else:
+            # temperature is a TRACED operand: sweeping it reuses one
+            # compiled program instead of recompiling per value
+            rng, sub = jax.random.split(rng)
+            token = jax.random.categorical(
+                sub, logits.astype(jnp.float32) / temperature, axis=-1)
+        logits, caches = _step(params, n_heads, caches, token, pos)
+        return (caches, logits, rng), token
+
+    (_, _, _), tokens = jax.lax.scan(
+        decode_body, (caches, last_logits, rng), jnp.arange(S, total))
+    return jnp.swapaxes(tokens, 0, 1)                            # [B, T_new]
+
+
+def generate(params, config, prompt_ids, max_new_tokens, temperature=0.0,
+             rng=None):
+    """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, S].
+
+    ``temperature=0`` -> greedy argmax; otherwise categorical sampling
+    with ``rng`` (required). Returns the new tokens [B, max_new_tokens].
+    One compiled program per (config, shapes, greedy-vs-sampling) —
+    nonzero temperatures share a program."""
+    if temperature != 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires rng")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    total = prompt_ids.shape[1] + int(max_new_tokens)
+    if total > config.max_position_embeddings:
+        # JAX clamps out-of-bounds gathers, so an oversized sequence would
+        # silently reuse the last position embedding — fail loudly instead
+        raise ValueError(
+            f"prompt ({prompt_ids.shape[1]}) + max_new_tokens "
+            f"({max_new_tokens}) = {total} exceeds "
+            f"max_position_embeddings={config.max_position_embeddings}")
+    return _generate_jit(
+        params, prompt_ids, config.num_hidden_layers,
+        config.num_attention_heads,
+        config.hidden_size // config.num_attention_heads,
+        int(max_new_tokens), temperature == 0.0,
+        jnp.asarray(max(temperature, 1e-8), jnp.float32), rng)
+
+
+def greedy_generate(params, config, prompt_ids, max_new_tokens):
+    return generate(params, config, prompt_ids, max_new_tokens)

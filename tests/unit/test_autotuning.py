@@ -26,6 +26,11 @@ def test_default_candidates_ladder():
     assert remats == [False, True] * 3
     assert all(c.label for c in cands)
 
+    # mb=1 collapses two rungs — no duplicate candidates
+    small = default_candidates(1)
+    assert [c.overrides["train_micro_batch_size_per_gpu"] for c in small] == \
+        [2, 2, 1, 1]
+
 
 def test_autotune_picks_fastest_and_records_failures():
     import time as _time
