@@ -106,14 +106,18 @@ def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
     shape = (n_layers, B, n_heads, total, head_dim)
     caches = (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
 
-    # prefill: scan the prompt through the cache (same step as decode —
-    # one program; prompt logits are discarded except the last)
-    def prefill_body(caches, pos):
+    # prefill: scan the prompt through the cache (same step as decode).
+    # Only the LAST position's logits matter — carry them instead of
+    # stacking [S, B, V] scan outputs (S x B x vocab f32 would dwarf the
+    # KV cache for long prompts)
+    def prefill_body(carry, pos):
+        caches, _ = carry
         logits, caches = _step(params, n_heads, caches, prompt_ids[:, pos], pos)
-        return caches, logits
+        return (caches, logits), None
 
-    caches, prompt_logits = jax.lax.scan(prefill_body, caches, jnp.arange(S))
-    last_logits = prompt_logits[-1]                              # [B, V]
+    V = params["params"]["transformer"]["wte"]["embedding"].shape[0]
+    (caches, last_logits), _ = jax.lax.scan(
+        prefill_body, (caches, jnp.zeros((B, V), jnp.float32)), jnp.arange(S))
 
     def decode_body(carry, pos):
         caches, logits, rng = carry
@@ -141,6 +145,8 @@ def generate(params, config, prompt_ids, max_new_tokens, temperature=0.0,
     with ``rng`` (required). Returns the new tokens [B, max_new_tokens].
     One compiled program per (config, shapes, greedy-vs-sampling) —
     nonzero temperatures share a program."""
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature != 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
     if rng is None:

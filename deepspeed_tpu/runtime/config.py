@@ -321,6 +321,14 @@ def get_progressive_layer_drop(param_dict):
     return enabled, theta, gamma
 
 
+def get_curriculum_learning(param_dict):
+    """Curriculum-learning section (beyond the v0.3.10 reference; schema of
+    later DeepSpeed's data_pipeline). Returns (enabled, params); parameter
+    validation happens in CurriculumScheduler, which parses ``params``."""
+    cl_dict = param_dict.get("curriculum_learning", {})
+    return bool(cl_dict.get("enabled", False)), cl_dict
+
+
 class DeepSpeedConfig:
     def __init__(self, json_file_or_dict, mpu=None, param_dict=None, world_size=None):
         if param_dict is None:
@@ -466,6 +474,11 @@ class DeepSpeedConfig:
             self.pld_theta,
             self.pld_gamma,
         ) = get_progressive_layer_drop(param_dict)
+
+        (
+            self.curriculum_enabled,
+            self.curriculum_params,
+        ) = get_curriculum_learning(param_dict)
 
     def _batch_assertion(self):
         train_batch = self.train_batch_size

@@ -1,0 +1,9 @@
+"""Data-pipeline efficiency features (beyond the v0.3.10 reference —
+curriculum learning arrived in later DeepSpeed's runtime/data_pipeline)."""
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler,
+    truncate_to_difficulty,
+)
+
+__all__ = ["CurriculumScheduler", "truncate_to_difficulty"]

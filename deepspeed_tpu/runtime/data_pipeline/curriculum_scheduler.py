@@ -1,0 +1,127 @@
+"""Curriculum learning: difficulty (sequence length) scheduling.
+
+Beyond the v0.3.10 reference — later DeepSpeed's curriculum learning
+(``runtime/data_pipeline/curriculum_scheduler.py`` upstream, the
+"Curriculum Learning: A Regularization Method" recipe): train early steps
+on short sequences and ramp up, which both stabilizes large-batch LM
+training and speeds up wall-clock (short-seq steps are cheap).
+
+TPU-first note: every DISTINCT difficulty value is a distinct XLA program
+(static shapes), so the quantization knob ``difficulty_step`` is not just
+a data-efficiency nicety here — it bounds the number of compiles to
+``(max - min) / difficulty_step``. Schedules match upstream semantics:
+
+- ``fixed_linear``: difficulty ramps linearly from ``min_difficulty`` to
+  ``max_difficulty`` over ``total_curriculum_step`` steps, quantized DOWN
+  to a multiple of ``difficulty_step``.
+- ``fixed_root``: same but along ``step^(1/root_degree)``.
+- ``fixed_discrete``: explicit ``difficulty`` list + ``max_step``
+  boundaries.
+
+Config::
+
+    "curriculum_learning": {
+        "enabled": true,
+        "curriculum_type": "seqlen",
+        "min_difficulty": 8,
+        "max_difficulty": 1024,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10000,
+                            "difficulty_step": 8}
+    }
+"""
+
+import math
+
+CURRICULUM_LEARNING = "curriculum_learning"
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+
+
+class CurriculumScheduler:
+    """Maps a global step to a difficulty value per the configured schedule."""
+
+    def __init__(self, config):
+        self.enabled = bool(config.get("enabled", False))
+        self.curriculum_type = config.get("curriculum_type", "seqlen")
+        self.min_difficulty = int(config.get("min_difficulty", 8))
+        self.max_difficulty = int(config.get("max_difficulty", 64))
+        self.schedule_type = config.get("schedule_type", FIXED_LINEAR)
+        sc = config.get("schedule_config", {})
+        if self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            self.total_step = int(sc.get("total_curriculum_step", 1000))
+            self.difficulty_step = int(sc.get("difficulty_step", 8))
+            self.root_degree = int(sc.get("root_degree", 2))
+            if self.total_step <= 0:
+                raise ValueError("total_curriculum_step must be positive")
+            if self.difficulty_step <= 0:
+                raise ValueError("difficulty_step must be positive")
+        elif self.schedule_type == FIXED_DISCRETE:
+            self.difficulties = [int(d) for d in sc["difficulty"]]
+            self.max_steps = [int(s) for s in sc["max_step"]]
+            if len(self.max_steps) != len(self.difficulties) - 1:
+                raise ValueError(
+                    "fixed_discrete needs len(max_step) == len(difficulty)-1 "
+                    f"(got {len(self.max_steps)} vs {len(self.difficulties)})")
+        else:
+            raise ValueError(f"unknown schedule_type {self.schedule_type!r}")
+        self.current_difficulty = self.get_difficulty(0)
+
+    def _ramp_fraction(self, step):
+        frac = min(1.0, step / self.total_step)
+        if self.schedule_type == FIXED_ROOT:
+            frac = frac ** (1.0 / self.root_degree)
+        return frac
+
+    def get_difficulty(self, global_step):
+        """Difficulty at ``global_step`` (pure — no internal state)."""
+        if self.schedule_type == FIXED_DISCRETE:
+            for bound, diff in zip(self.max_steps, self.difficulties):
+                if global_step < bound:
+                    return diff
+            return self.difficulties[-1]
+        span = self.max_difficulty - self.min_difficulty
+        raw = self.min_difficulty + span * self._ramp_fraction(global_step)
+        # quantize DOWN to the difficulty grid (bounds the compile count:
+        # each distinct value is a distinct XLA program), but never below
+        # the floor, and snap exactly to the ceiling when the ramp is done
+        quant = self.min_difficulty + self.difficulty_step * int(
+            math.floor((raw - self.min_difficulty) / self.difficulty_step))
+        return min(max(quant, self.min_difficulty), self.max_difficulty) \
+            if raw < self.max_difficulty else self.max_difficulty
+
+    def update_difficulty(self, global_step):
+        """Advance to ``global_step``; returns the (possibly new) difficulty.
+        Difficulty is a pure function of the step, so checkpoint resume just
+        calls this with the restored step — no persisted state."""
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty
+
+
+def truncate_to_difficulty(batch, difficulty, seq_axis=1, keys=None):
+    """Truncate sequence arrays in ``batch`` to ``difficulty`` along
+    ``seq_axis`` — the seqlen-curriculum data transform.
+
+    The shape test cannot distinguish a sequence axis from any other axis
+    that happens to exceed ``difficulty`` (e.g. a one-hot label's vocab
+    axis), so for dict batches holding non-sequence data pass ``keys``:
+    only those top-level entries are touched. Without ``keys``, EVERY
+    array with that axis is truncated — the contract is that ``batch``
+    contains sequence tensors only."""
+    import jax
+
+    def trunc(a):
+        if getattr(a, "ndim", 0) > seq_axis and a.shape[seq_axis] > difficulty:
+            idx = [slice(None)] * a.ndim
+            idx[seq_axis] = slice(0, difficulty)
+            return a[tuple(idx)]
+        return a
+
+    if keys is not None:
+        if not isinstance(batch, dict):
+            raise TypeError("keys= requires a dict batch")
+        return {k: (jax.tree_util.tree_map(trunc, v) if k in keys else v)
+                for k, v in batch.items()}
+    return jax.tree_util.tree_map(trunc, batch)

@@ -251,6 +251,14 @@ class DeepSpeedEngine:
         self._configure_optimizer(optimizer, model_parameters)
         self._configure_lr_scheduler(lr_scheduler)
 
+        # --- curriculum learning (beyond the v0.3.10 reference) -----------
+        self.curriculum_scheduler = None
+        if self._config.curriculum_enabled:
+            from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(
+                self._config.curriculum_params)
+
         # --- loss scaling state -------------------------------------------
         self._configure_loss_scaler()
 
@@ -305,6 +313,15 @@ class DeepSpeedEngine:
 
     def fp16_enabled(self):
         return self._config.fp16_enabled
+
+    def curriculum_enabled(self):
+        return self.curriculum_scheduler is not None
+
+    def curriculum_difficulty(self):
+        """Current curriculum difficulty (e.g. the sequence length to feed);
+        pair with data_pipeline.truncate_to_difficulty on each batch."""
+        assert self.curriculum_scheduler is not None, "curriculum not enabled"
+        return self.curriculum_scheduler.current_difficulty
 
     def bfloat16_enabled(self):
         return self._config.bfloat16_enabled
@@ -1237,6 +1254,8 @@ class DeepSpeedEngine:
         self._acc_grads = jax.tree_util.tree_map(jnp.zeros_like, self._acc_grads)
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
+        if self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.update_difficulty(self.global_steps)
 
     def _monitor_step(self):
         """Record the per-step scalar streams (reference engine.py:1010-1025:
@@ -1394,6 +1413,8 @@ class DeepSpeedEngine:
                 self.lr_scheduler.step()
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
+        if self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.update_difficulty(self.global_steps)
 
     def train_batch(self, data_iter=None):
         """Convenience: run gas micro-steps + optimizer step, return mean loss.
@@ -1560,6 +1581,9 @@ class DeepSpeedEngine:
         self.global_steps = checkpoint.get("global_steps", 0)
         self.global_samples = checkpoint.get("global_samples", self.global_steps * self.train_batch_size())
         self.skipped_steps = checkpoint.get("skipped_steps", 0)
+        if self.curriculum_scheduler is not None:
+            # difficulty is a pure function of the step — recompute, don't store
+            self.curriculum_scheduler.update_difficulty(self.global_steps)
 
         deepspeed_states = [
             "module", "optimizer", "lr_scheduler", "scaler", "csr_tensor_module_names",

@@ -1,0 +1,131 @@
+"""Curriculum learning: schedules, data transform, engine wiring
+(beyond the v0.3.10 reference — later DeepSpeed's
+runtime/data_pipeline/curriculum_scheduler.py semantics)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler,
+    truncate_to_difficulty,
+)
+from tests.unit.simple_model import make_simple_engine, random_dataloader
+
+
+def _sched(**over):
+    cfg = {
+        "enabled": True,
+        "min_difficulty": 8,
+        "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 8},
+    }
+    cfg.update(over)
+    return CurriculumScheduler(cfg)
+
+
+def test_fixed_linear_ramp():
+    s = _sched()
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(100) == 64
+    assert s.get_difficulty(10_000) == 64
+    # monotone non-decreasing, quantized to the grid, within bounds
+    prev = 0
+    for step in range(0, 120, 5):
+        d = s.get_difficulty(step)
+        assert d >= prev and 8 <= d <= 64 and (d - 8) % 8 == 0
+        prev = d
+    # halfway: 8 + 56*0.5 = 36 -> floor to grid = 32
+    assert s.get_difficulty(50) == 32
+
+
+def test_fixed_root_ramps_faster_early():
+    lin, root = _sched(), _sched(schedule_type="fixed_root")
+    assert root.get_difficulty(25) >= lin.get_difficulty(25)
+    assert root.get_difficulty(100) == 64
+
+
+def test_fixed_discrete():
+    s = _sched(schedule_type="fixed_discrete",
+               schedule_config={"difficulty": [16, 32, 64],
+                                "max_step": [10, 20]})
+    assert s.get_difficulty(0) == 16
+    assert s.get_difficulty(9) == 16
+    assert s.get_difficulty(10) == 32
+    assert s.get_difficulty(25) == 64
+
+
+def test_bad_configs_raise():
+    with pytest.raises(ValueError, match="schedule_type"):
+        _sched(schedule_type="nope")
+    with pytest.raises(ValueError, match="difficulty_step"):
+        _sched(schedule_config={"total_curriculum_step": 10,
+                                "difficulty_step": 0})
+    with pytest.raises(ValueError, match="max_step"):
+        _sched(schedule_type="fixed_discrete",
+               schedule_config={"difficulty": [8, 16], "max_step": [1, 2]})
+
+
+def test_truncate_to_difficulty():
+    batch = {"ids": jnp.ones((4, 32), jnp.int32),
+             "mask": jnp.ones((4, 32)),
+             "label": jnp.ones((4,))}
+    out = truncate_to_difficulty(batch, 16)
+    assert out["ids"].shape == (4, 16)
+    assert out["mask"].shape == (4, 16)
+    assert out["label"].shape == (4,)  # no seq axis: untouched
+    # already short enough: untouched
+    assert truncate_to_difficulty(batch, 64)["ids"].shape == (4, 32)
+
+
+def test_truncate_keys_protects_non_sequence_axes():
+    """One-hot labels share the axis shape test with sequences — keys=
+    scopes the transform so they survive untouched."""
+    batch = {"ids": jnp.ones((4, 32), jnp.int32),
+             "onehot": jnp.ones((4, 100))}
+    out = truncate_to_difficulty(batch, 16, keys=("ids",))
+    assert out["ids"].shape == (4, 16)
+    assert out["onehot"].shape == (4, 100)
+    with pytest.raises(TypeError, match="dict"):
+        truncate_to_difficulty([jnp.ones((4, 32))], 16, keys=("ids",))
+
+
+def test_engine_wiring(tmpdir):
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+        "curriculum_learning": {
+            "enabled": True,
+            "min_difficulty": 4,
+            "max_difficulty": 16,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 4},
+        },
+    }
+    engine = make_simple_engine(tmpdir, cfg)
+    assert engine.curriculum_enabled()
+    assert engine.curriculum_difficulty() == 4
+
+    loader = random_dataloader(engine, total_samples=4 * 8, hidden_dim=16)
+    difficulties = []
+    for x, y in loader:
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+        difficulties.append(engine.curriculum_difficulty())
+    # ramps with global_steps and reaches the max at total_curriculum_step
+    assert difficulties == sorted(difficulties)
+    assert difficulties[-1] == 16
+
+
+def test_engine_without_curriculum(tmpdir):
+    engine = make_simple_engine(tmpdir, {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}}})
+    assert not engine.curriculum_enabled()
+    with pytest.raises(AssertionError):
+        engine.curriculum_difficulty()

@@ -62,6 +62,9 @@ def test_sampling_requires_rng():
     _, params = init_gpt2(cfg, batch_size=1, seq_len=2, seed=2)
     with pytest.raises(ValueError, match="rng"):
         generate(params, cfg, jnp.zeros((1, 2), jnp.int32), 2, temperature=1.0)
+    with pytest.raises(ValueError, match="temperature"):
+        generate(params, cfg, jnp.zeros((1, 2), jnp.int32), 2,
+                 temperature=-0.5, rng=jax.random.PRNGKey(0))
 
 
 def test_exceeding_max_positions_raises():
