@@ -68,6 +68,10 @@ class DeepSpeedCPUAdam(FusedAdam):
 
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, amsgrad=False, adam_w_mode=True, **kwargs):
+        if kwargs.get("no_decay_names"):
+            raise ValueError(
+                "no_decay_names is not supported by the host (offload) Adam: "
+                "the C++ kernel applies decay uniformly")
         super().__init__(lr=lr, bias_correction=bias_correction, betas=betas, eps=eps,
                          weight_decay=weight_decay, adam_w_mode=adam_w_mode, amsgrad=amsgrad)
         self._host_state = None

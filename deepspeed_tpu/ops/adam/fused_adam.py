@@ -24,9 +24,23 @@ class AdamState(NamedTuple):
     exp_avg_sq: object  # pytree like params
 
 
+def decay_scales(params, no_decay_names):
+    """Per-leaf weight-decay multipliers (1.0 / 0.0) from key-path substring
+    matching — the pytree equivalent of torch param groups' standard
+    "no decay for bias/LayerNorm" recipe. Paths are static under jit."""
+    subs = [s.lower() for s in no_decay_names]
+
+    def scale(path, _):
+        path_str = "/".join(str(getattr(k, "key", k)) for k in path).lower()
+        return 0.0 if any(s in path_str for s in subs) else 1.0
+
+    return jax.tree_util.tree_map_with_path(scale, params)
+
+
 class FusedAdam:
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999), eps=1e-8,
-                 weight_decay=0.0, adam_w_mode=True, amsgrad=False, **kwargs):
+                 weight_decay=0.0, adam_w_mode=True, amsgrad=False,
+                 no_decay_names=None, **kwargs):
         if amsgrad:
             raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
         self.lr = lr
@@ -35,6 +49,9 @@ class FusedAdam:
         self.eps = eps
         self.weight_decay = weight_decay
         self.adam_w_mode = adam_w_mode
+        # param-group parity: leaves whose key path contains any of these
+        # substrings (case-insensitive) get NO weight decay (bias/LN recipe)
+        self.no_decay_names = list(no_decay_names or [])
 
     def init(self, params):
         zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
@@ -44,16 +61,25 @@ class FusedAdam:
             exp_avg_sq=jax.tree_util.tree_map(zeros, params),
         )
 
-    def update(self, grads, state, params, lr=None):
+    def update(self, grads, state, params, lr=None, decay_mask=None):
+        """``decay_mask``: optional per-leaf weight-decay multiplier (scalar
+        or array broadcastable to the leaf) — ZeRO's flat path passes the
+        flattened mask here since key paths are gone after flattening. When
+        absent, ``no_decay_names`` is resolved against ``params``' paths."""
         lr = self.lr if lr is None else lr
         beta1, beta2 = self.betas
         step = state.step + 1
+        if decay_mask is None:
+            if self.no_decay_names and self.weight_decay != 0.0:
+                decay_mask = decay_scales(params, self.no_decay_names)
+            else:
+                decay_mask = jax.tree_util.tree_map(lambda _: 1.0, params)
 
-        def upd(g, m, v, p):
+        def upd(g, m, v, p, dscale):
             g = g.astype(jnp.float32)
             p32 = p.astype(jnp.float32)
             if self.weight_decay != 0.0 and not self.adam_w_mode:
-                g = g + self.weight_decay * p32
+                g = g + self.weight_decay * dscale * p32
             m_new = beta1 * m + (1 - beta1) * g
             v_new = beta2 * v + (1 - beta2) * jnp.square(g)
             if self.bias_correction:
@@ -64,13 +90,13 @@ class FusedAdam:
             else:
                 update = m_new / (jnp.sqrt(v_new) + self.eps)
             if self.weight_decay != 0.0 and self.adam_w_mode:
-                update = update + self.weight_decay * p32
+                update = update + self.weight_decay * dscale * p32
             return (p32 - lr * update).astype(p.dtype), m_new, v_new
 
         from deepspeed_tpu.ops.utils_op import tree_map_multi
 
         new_params, new_m, new_v = tree_map_multi(
-            upd, 3, grads, state.exp_avg, state.exp_avg_sq, params
+            upd, 3, grads, state.exp_avg, state.exp_avg_sq, params, decay_mask
         )
         return new_params, AdamState(step=step, exp_avg=new_m, exp_avg_sq=new_v)
 

@@ -25,6 +25,9 @@ class FusedLamb:
                  max_coeff=10.0, min_coeff=0.01, amsgrad=False, **kwargs):
         if amsgrad:
             raise RuntimeError("FusedLamb does not support the AMSGrad variant.")
+        if kwargs.get("no_decay_names"):
+            raise ValueError(
+                "no_decay_names is only supported by Adam/AdamW (FusedAdam)")
         self.lr = lr
         self.bias_correction = bias_correction
         self.betas = tuple(betas)

@@ -14,6 +14,9 @@ class SGDState(NamedTuple):
 
 class SGD:
     def __init__(self, lr=1e-3, momentum=0.0, weight_decay=0.0, nesterov=False, **kwargs):
+        if kwargs.get("no_decay_names"):
+            raise ValueError(
+                "no_decay_names is only supported by Adam/AdamW (FusedAdam)")
         self.lr = lr
         self.momentum = momentum
         self.weight_decay = weight_decay

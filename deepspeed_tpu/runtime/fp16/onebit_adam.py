@@ -120,6 +120,9 @@ class OnebitAdam:
                  amsgrad=False, cuda_aware=False, **kwargs):
         if amsgrad:
             raise RuntimeError("1-bit Adam does not support the AMSGrad variant.")
+        if kwargs.get("no_decay_names"):
+            raise ValueError(
+                "no_decay_names is only supported by Adam/AdamW (FusedAdam)")
         self.lr = lr
         self.freeze_step = freeze_step
         self.bias_correction = bias_correction

@@ -127,6 +127,17 @@ class ZeroShardedOptimizer:
 
     def init(self, params):
         self._spec = tree_spec(params)
+        if getattr(self.inner, "no_decay_names", None):
+            if self.cpu_offload:
+                # ValueError, not assert: must fire under python -O too (a
+                # silently-uniform decay would be wrong training, not a bug)
+                raise ValueError(
+                    "no_decay_names is not supported with cpu_offload (the "
+                    "host C++ Adam applies decay uniformly); drop one of the two")
+            from deepspeed_tpu.ops.adam.fused_adam import decay_scales
+
+            self._leaf_decay_scales = jax.tree_util.tree_leaves(
+                decay_scales(params, self.inner.no_decay_names))
         if self.stage >= 3:
             assert not self.cpu_offload, (
                 "ZeRO-3 + cpu_offload is not supported: stage 3's win is "
@@ -154,6 +165,17 @@ class ZeroShardedOptimizer:
             return ZeroState(flat_master=jnp.zeros((0,), jnp.float32), inner_state=inner_state)
         return ZeroState(flat_master=flat, inner_state=inner_state)
 
+    def _flat_decay_mask(self):
+        """Per-element decay multiplier aligned with the flat master layout
+        (padding decays-0). Built in-trace from scalar broadcasts — XLA
+        keeps it as fused broadcast+concat, never a materialized literal."""
+        _, _, _, sizes = self._spec
+        parts = [jnp.full((n,), s, jnp.float32)
+                 for n, s in zip(sizes, self._leaf_decay_scales)]
+        mask = jnp.concatenate(parts) if parts else jnp.zeros((0,), jnp.float32)
+        mask, _ = pad_to_multiple(mask, self.dp)
+        return jax.lax.with_sharding_constraint(mask, self._shard_sharding())
+
     # -- device path (jit-traceable) --------------------------------------
     def update(self, grads, opt_state, params, lr=None):
         """One sharded step. grads: pytree (full, replicated under jit); the
@@ -175,7 +197,16 @@ class ZeroShardedOptimizer:
             master = flatten_dense_tensors(params, jnp.float32)
             master, _ = pad_to_multiple(master, self.dp)
             master = jax.lax.with_sharding_constraint(master, self._shard_sharding())
-        new_master, new_inner = self.inner.update(flat_grads, opt_state.inner_state, master, lr=lr)
+        if getattr(self.inner, "no_decay_names", None) and \
+                getattr(self.inner, "weight_decay", 0.0) != 0.0:
+            # key paths are gone after flattening — rebuild the per-element
+            # decay mask as a concat of scalar broadcasts (no materialized
+            # literal; XLA fuses it) in the SAME leaf order as the master
+            new_master, new_inner = self.inner.update(
+                flat_grads, opt_state.inner_state, master, lr=lr,
+                decay_mask=self._flat_decay_mask())
+        else:
+            new_master, new_inner = self.inner.update(flat_grads, opt_state.inner_state, master, lr=lr)
         new_master = jax.lax.with_sharding_constraint(new_master, self._shard_sharding())
 
         # Rebuild params in their original dtypes (compute dtype under mixed

@@ -1,0 +1,145 @@
+"""Weight-decay param grouping (no_decay_names) — the pytree equivalent of
+torch param groups' "no decay for bias/LayerNorm" recipe the reference's
+examples configure in user code. Must hold on the plain pytree path AND
+through ZeRO's flattened master (where key paths are gone and the mask is
+rebuilt from the layout spec)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam, decay_scales
+from tests.unit.simple_model import make_simple_engine, random_dataloader
+
+
+def _params():
+    return {
+        "dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))},
+        "LayerNorm_0": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+    }
+
+
+def test_decay_scales_path_matching():
+    scales = decay_scales(_params(), ["bias", "layernorm"])
+    assert scales["dense"]["kernel"] == 1.0
+    assert scales["dense"]["bias"] == 0.0
+    assert scales["LayerNorm_0"]["scale"] == 0.0  # matched via parent path
+
+
+def test_fused_adam_pytree_no_decay():
+    """Zero grads isolate the decay term: decayed leaves shrink by
+    lr*wd*p, excluded leaves must not move at all."""
+    lr, wd = 0.1, 0.5
+    params = _params()
+    opt = FusedAdam(lr=lr, weight_decay=wd, no_decay_names=["bias", "layernorm"])
+    state = opt.init(params)
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+    new_params, _ = opt.update(grads, state, params)
+
+    np.testing.assert_allclose(
+        np.asarray(new_params["dense"]["kernel"]),
+        np.asarray(params["dense"]["kernel"]) * (1 - lr * wd), rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(new_params["dense"]["bias"]),
+        np.asarray(params["dense"]["bias"]))
+    np.testing.assert_array_equal(
+        np.asarray(new_params["LayerNorm_0"]["scale"]),
+        np.asarray(params["LayerNorm_0"]["scale"]))
+
+
+def test_fused_adam_uniform_decay_unchanged():
+    """Without no_decay_names the behavior is the pre-existing uniform
+    decay — regression guard on the default path."""
+    lr, wd = 0.1, 0.5
+    params = _params()
+    opt = FusedAdam(lr=lr, weight_decay=wd)
+    state = opt.init(params)
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+    new_params, _ = opt.update(grads, state, params)
+    for leaf, new in zip(jax.tree_util.tree_leaves(params),
+                         jax.tree_util.tree_leaves(new_params)):
+        np.testing.assert_allclose(
+            np.asarray(new), np.asarray(leaf) * (1 - lr * wd), rtol=1e-6)
+
+
+@pytest.mark.parametrize("zero_stage", [1, 2])
+def test_no_decay_through_zero_flat_master(tmpdir, zero_stage):
+    """Through the engine + flat ZeRO: train with real grads, then compare
+    against a no-ZeRO oracle engine with identical config — the mask
+    rebuilt from the flat layout must reproduce the pytree behavior."""
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {
+            "lr": 0.01, "weight_decay": 0.1,
+            "no_decay_names": ["bias"]}},
+    }
+    zcfg = dict(cfg, zero_optimization={"stage": zero_stage})
+
+    def run(c):
+        engine = make_simple_engine(tmpdir, c)
+        loader = random_dataloader(engine, total_samples=3 * 8, hidden_dim=16,
+                                   seed=11)
+        for x, y in loader:
+            loss = engine(x, y)
+            engine.backward(loss)
+            engine.step()
+        return jax.device_get(engine.params)
+
+    plain, zero = run(cfg), run(zcfg)
+    for a, b in zip(jax.tree_util.tree_leaves(plain),
+                    jax.tree_util.tree_leaves(zero)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_no_decay_moves_only_decayed_leaves(tmpdir):
+    """Direct engine check: with zero-gradient loss, only non-excluded
+    leaves move (the decay term)."""
+    import flax.linen as nn
+
+    import deepspeed_tpu
+
+    class Frozen(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.Dense(4)(x)
+            # loss independent of params would give zero grads for ALL;
+            # multiply by 0 to zero the grads while keeping params in the graph
+            return 0.0 * jnp.sum(h)
+
+    model = Frozen()
+    x = jnp.ones((8, 4))
+    params = model.init(jax.random.PRNGKey(0), x)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {
+                "lr": 0.1, "weight_decay": 0.5,
+                "no_decay_names": ["bias"]}}})
+    before = jax.device_get(engine.params)
+    loss = engine(x)
+    engine.backward(loss)
+    engine.step()
+    after = jax.device_get(engine.params)
+
+    kb = np.asarray(before["params"]["Dense_0"]["kernel"])
+    ka = np.asarray(after["params"]["Dense_0"]["kernel"])
+    np.testing.assert_allclose(ka, kb * (1 - 0.1 * 0.5), rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(after["params"]["Dense_0"]["bias"]),
+        np.asarray(before["params"]["Dense_0"]["bias"]))
+
+
+def test_other_optimizers_reject_no_decay():
+    from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+    from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+    from deepspeed_tpu.ops.sgd import SGD
+
+    with pytest.raises(ValueError, match="no_decay_names"):
+        FusedLamb(no_decay_names=["bias"])
+    with pytest.raises(ValueError, match="no_decay_names"):
+        DeepSpeedCPUAdam(no_decay_names=["bias"])
+    with pytest.raises(ValueError, match="no_decay_names"):
+        SGD(no_decay_names=["bias"])
