@@ -1,0 +1,99 @@
+"""Module injection vs the REAL transformers library (torch CPU).
+
+test_module_inject.py checks the conversion against a jnp re-derivation of
+the HF layer; this file checks against the actual ``transformers``
+BertLayer — the strongest parity proof available offline (random weights,
+no network): torch forward == fused DeepSpeedTransformerLayer forward
+after convert_hf_layer_params."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from deepspeed_tpu.module_inject.replace_module import (  # noqa: E402
+    convert_hf_layer_params,
+)
+from deepspeed_tpu.ops.transformer.transformer import (  # noqa: E402
+    DeepSpeedTransformerConfig,
+    DeepSpeedTransformerLayer,
+)
+
+H, HEADS, FF, S, B = 64, 4, 128, 16, 2
+
+
+def _torch_layer():
+    cfg = transformers.BertConfig(
+        hidden_size=H, num_attention_heads=HEADS, intermediate_size=FF,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        # our fused layer's LayerNorm eps (flax default); HF's default 1e-12
+        # differs only in the eps constant, pinned here to isolate layout
+        layer_norm_eps=1e-6)
+    cfg._attn_implementation = "eager"  # direct BertLayer construction
+    torch.manual_seed(0)
+    layer = transformers.models.bert.modeling_bert.BertLayer(cfg)
+    return layer.eval()
+
+
+def _flax_hf_params(layer):
+    """torch state dict -> the flax-layout HF tree convert_hf_layer_params
+    documents (torch Linear weight is [out, in]; flax kernel is [in, out])."""
+    sd = {k: v.detach().numpy() for k, v in layer.state_dict().items()}
+
+    def lin(prefix):
+        return {"kernel": jnp.asarray(sd[f"{prefix}.weight"].T),
+                "bias": jnp.asarray(sd[f"{prefix}.bias"])}
+
+    def ln(prefix):
+        return {"scale": jnp.asarray(sd[f"{prefix}.weight"]),
+                "bias": jnp.asarray(sd[f"{prefix}.bias"])}
+
+    return {
+        "attention": {
+            "self": {"query": lin("attention.self.query"),
+                     "key": lin("attention.self.key"),
+                     "value": lin("attention.self.value")},
+            "output": {"dense": lin("attention.output.dense"),
+                       "LayerNorm": ln("attention.output.LayerNorm")},
+        },
+        "intermediate": {"dense": lin("intermediate.dense")},
+        "output": {"dense": lin("output.dense"),
+                   "LayerNorm": ln("output.LayerNorm")},
+    }
+
+
+def test_fused_layer_matches_real_transformers_bert_layer():
+    layer = _torch_layer()
+    rng = np.random.RandomState(3)
+    x = rng.randn(B, S, H).astype(np.float32)
+
+    with torch.no_grad():
+        want = layer(torch.from_numpy(x))[0].numpy()
+
+    ds_params = convert_hf_layer_params(_flax_hf_params(layer))
+    ds_cfg = DeepSpeedTransformerConfig(
+        hidden_size=H, intermediate_size=FF, heads=HEADS,
+        attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+        num_hidden_layers=1, initializer_range=0.02,
+        pre_layer_norm=False,  # HF BERT is post-LN
+        training=False)
+    got = DeepSpeedTransformerLayer(ds_cfg).apply(
+        ds_params, jnp.asarray(x), None, deterministic=True)
+
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
+
+
+def test_roundtrip_preserves_real_weights():
+    """convert -> revert must reproduce the torch-derived HF tree exactly."""
+    from deepspeed_tpu.module_inject.replace_module import revert_hf_layer_params
+
+    hf = _flax_hf_params(_torch_layer())
+    back = revert_hf_layer_params(convert_hf_layer_params(hf), H)
+    import jax
+
+    for a, b in zip(jax.tree_util.tree_leaves(hf),
+                    jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
