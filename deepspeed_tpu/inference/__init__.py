@@ -6,5 +6,11 @@ the training stack produces (scan-stacked fused layers), O(1) work per
 new token via a static-shape KV cache."""
 
 from deepspeed_tpu.inference.generation import generate, greedy_generate  # noqa: F401
+from deepspeed_tpu.inference.quantization import (  # noqa: F401
+    dequantize_tensor,
+    quantize_for_decode,
+    quantize_tensor,
+)
 
-__all__ = ["generate", "greedy_generate"]
+__all__ = ["generate", "greedy_generate", "quantize_for_decode",
+           "quantize_tensor", "dequantize_tensor"]

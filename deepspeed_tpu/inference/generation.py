@@ -19,6 +19,13 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.inference.quantization import (
+    embed_rows,
+    logits_table,
+    maybe_dequant,
+    vocab_size,
+)
+
 
 def _layer_tree(params):
     """The stacked per-layer param tree and the names of its blocks.
@@ -46,7 +53,7 @@ def _decode_one(layer_p, h, cache_k, cache_v, pos, nh):
     hd = H // nh
 
     a_in = _ln(h, layer_p["ln_attn"])
-    qkv = a_in @ layer_p["qkv"]["kernel"] + layer_p["qkv"]["bias"]
+    qkv = a_in @ maybe_dequant(layer_p["qkv"]) + layer_p["qkv"]["bias"]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, nh, hd)
     k = k.reshape(B, nh, hd)
@@ -63,13 +70,13 @@ def _decode_one(layer_p, h, cache_k, cache_v, pos, nh):
                        jnp.asarray(-1e30, scores.dtype))
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(h.dtype)
     ctx = jnp.einsum("bns,bnsd->bnd", probs, cache_v).reshape(B, H)
-    a = ctx @ layer_p["attn_out"]["kernel"] + layer_p["attn_out"]["bias"]
+    a = ctx @ maybe_dequant(layer_p["attn_out"]) + layer_p["attn_out"]["bias"]
     h = h + a
 
     f_in = _ln(h, layer_p["ln_ffn"])
-    f = f_in @ layer_p["ff1"]["kernel"] + layer_p["ff1"]["bias"]
+    f = f_in @ maybe_dequant(layer_p["ff1"]) + layer_p["ff1"]["bias"]
     f = jax.nn.gelu(f, approximate=False)
-    f = f @ layer_p["ff2"]["kernel"] + layer_p["ff2"]["bias"]
+    f = f @ maybe_dequant(layer_p["ff2"]) + layer_p["ff2"]["bias"]
     return h + f, cache_k, cache_v
 
 
@@ -77,11 +84,10 @@ def _step(params, nh, caches, token, pos):
     """Embed one token, run the layer stack against the caches, return
     (next-token logits [B, V], updated caches)."""
     tr = params["params"]["transformer"]
-    wte = tr["wte"]["embedding"]
     wpe = tr["wpe"]["embedding"]
     layer_p = _layer_tree(params)
 
-    h = wte[token] + wpe[pos]                                    # [B, H]
+    h = embed_rows(tr["wte"], token) + wpe[pos]                  # [B, H]
 
     # scan over the stacked layer dim with per-layer cache slices as
     # scanned inputs — mirrors the training stack's nn.scan
@@ -93,7 +99,7 @@ def _step(params, nh, caches, token, pos):
     h, caches = jax.lax.scan(layer_body, h, (layer_p,) + tuple(caches))
 
     h = _ln(h, tr["ln_f"])
-    logits = h @ wte.T.astype(h.dtype)
+    logits = h @ logits_table(tr["wte"], h.dtype).T
     return logits, caches
 
 
@@ -115,7 +121,7 @@ def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
         logits, caches = _step(params, n_heads, caches, prompt_ids[:, pos], pos)
         return (caches, logits), None
 
-    V = params["params"]["transformer"]["wte"]["embedding"].shape[0]
+    V = vocab_size(params["params"]["transformer"]["wte"])
     (caches, last_logits), _ = jax.lax.scan(
         prefill_body, (caches, jnp.zeros((B, V), jnp.float32)), jnp.arange(S))
 

@@ -1,0 +1,112 @@
+"""Weight-only int8 quantization for the decode path.
+
+Beyond the v0.3.10 reference (DeepSpeed-Inference's INT8 kernels came
+later), realized for the TPU decode regime: autoregressive decoding is
+HBM-bandwidth-bound (every step streams all weights for one token), so
+storing the big matmul kernels in int8 with per-output-channel fp32
+scales cuts the streamed bytes ~4x. Dequantization happens AT USE —
+``int8 -> f32 * scale`` fuses into the surrounding matmul under XLA, so
+nothing is ever materialized in fp32 at rest.
+
+Scope: the per-layer GEMM kernels (qkv, attn_out, ff1, ff2) and the
+token embedding. LayerNorms, biases, and the position embedding stay
+fp32 (negligible bytes, precision-critical).
+
+    qparams = quantize_for_decode(params)
+    tokens = generate(qparams, cfg, prompt, 64)   # same API
+"""
+
+import jax
+import jax.numpy as jnp
+
+_LAYER_KERNELS = ("qkv", "attn_out", "ff1", "ff2")
+
+
+def quantize_tensor(w, axis=-1):
+    """Symmetric per-channel int8: returns {"kernel_q": int8, "scale": f32}
+    with ``scale`` shaped to broadcast against the dequantized tensor."""
+    w = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return {"kernel_q": q, "scale": scale.astype(jnp.float32)}
+
+
+def dequantize_tensor(qt, dtype=jnp.float32):
+    return qt["kernel_q"].astype(dtype) * qt["scale"].astype(dtype)
+
+
+def maybe_dequant(p, name="kernel", dtype=None):
+    """Read a possibly-quantized kernel out of a param block. The decode
+    path calls this instead of indexing ``p["kernel"]`` directly.
+
+    ``dtype=None`` keeps the unquantized kernel's NATIVE dtype (a bf16
+    checkpoint keeps streaming bf16 bytes — the bandwidth-bound regime
+    this module exists for) and dequantizes int8 to fp32."""
+    if "kernel_q" in p:
+        return dequantize_tensor(p, dtype or jnp.float32)
+    w = p[name]
+    return w if dtype is None else jnp.asarray(w, dtype)
+
+
+def embed_rows(wte_blk, token):
+    """Gather embedding rows from a possibly-quantized token table
+    (per-row dequant of only the gathered rows on the int8 layout)."""
+    if "kernel_q" in wte_blk:
+        return (wte_blk["kernel_q"][token].astype(jnp.float32)
+                * wte_blk["scale"][token])
+    return wte_blk["embedding"][token]
+
+
+def vocab_size(wte_blk):
+    return (wte_blk["kernel_q"] if "kernel_q" in wte_blk
+            else wte_blk["embedding"]).shape[0]
+
+
+def logits_table(wte_blk, dtype):
+    """The full (tied) output table in ``dtype`` — streamed every step by
+    the logits head, so the int8 layout's dequant fuses into that matmul."""
+    if "kernel_q" in wte_blk:
+        return dequantize_tensor(wte_blk, dtype)
+    return wte_blk["embedding"].astype(dtype)
+
+
+def quantize_for_decode(params):
+    """Quantize a GPT-2 param tree (models/gpt2.py layout, scan-stacked
+    layers) for ``inference.generate``: layer GEMM kernels and the token
+    embedding go int8; everything else passes through unchanged."""
+    tr = params["params"]["transformer"]
+    layers = dict(tr["layers"])
+    if len(layers) != 1:
+        raise ValueError(
+            f"expected the scan-stacked GPT-2 layout (one child under "
+            f"'layers'), got {sorted(layers)}")
+    (child_name, child), = layers.items()
+    child = dict(child)
+    for k in _LAYER_KERNELS:
+        blk = dict(child[k])
+        if "kernel_q" in blk:
+            raise ValueError("params are already quantized (kernel_q present)")
+        # stacked [L, in, out]: quantize per (layer, out-channel)
+        qt = quantize_tensor(blk["kernel"], axis=-2)
+        blk.pop("kernel")
+        blk.update(qt)
+        child[k] = blk
+    layers[child_name] = child
+
+    wte = dict(tr["wte"])
+    wte.update(quantize_tensor(wte.pop("embedding"), axis=-1))
+
+    new_tr = dict(tr)
+    new_tr["layers"] = layers
+    new_tr["wte"] = wte
+    new_params = dict(params)
+    new_params["params"] = dict(params["params"])
+    new_params["params"]["transformer"] = new_tr
+    return new_params
+
+
+def quantized_bytes(tree):
+    return sum(l.size * l.dtype.itemsize
+               for l in jax.tree_util.tree_leaves(tree)
+               if hasattr(l, "dtype"))

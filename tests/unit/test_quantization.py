@@ -1,0 +1,81 @@
+"""Weight-only int8 decode quantization (inference/quantization.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference import generate, quantize_for_decode
+from deepspeed_tpu.inference.quantization import (
+    dequantize_tensor,
+    quantize_tensor,
+    quantized_bytes,
+)
+from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2
+
+
+def test_quantize_roundtrip_error_bound():
+    """Per-channel symmetric int8: |W - deq(W)| <= scale/2 per channel."""
+    w = jax.random.normal(jax.random.PRNGKey(0), (32, 64)) * 3.0
+    qt = quantize_tensor(w, axis=-2)
+    assert qt["kernel_q"].dtype == jnp.int8
+    err = jnp.abs(dequantize_tensor(qt) - w)
+    assert bool(jnp.all(err <= qt["scale"] * 0.5 + 1e-7))
+    # zero channels stay exactly zero (scale guard against div-by-zero)
+    qt0 = quantize_tensor(jnp.zeros((4, 4)))
+    np.testing.assert_array_equal(np.asarray(dequantize_tensor(qt0)), 0.0)
+
+
+def _tiny():
+    cfg = GPT2Config(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=32,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    _, params = init_gpt2(cfg, batch_size=2, seq_len=4, seed=0)
+    return cfg, params
+
+
+def test_quantized_tree_shrinks_and_generates():
+    cfg, params = _tiny()
+    qparams = quantize_for_decode(params)
+
+    # the big kernels went int8: total bytes shrink substantially
+    full = quantized_bytes(params)
+    quant = quantized_bytes(qparams)
+    assert quant < 0.45 * full, (quant, full)
+
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 4)), jnp.int32)
+    toks_q = generate(qparams, cfg, prompt, 6)
+    toks_f = generate(params, cfg, prompt, 6)
+    assert toks_q.shape == toks_f.shape == (2, 6)
+    # int8 weight error perturbs logits slightly; greedy argmax still agrees
+    # on the large majority of steps for this model
+    agree = float(np.mean(np.asarray(toks_q) == np.asarray(toks_f)))
+    assert agree >= 0.5, (agree, np.asarray(toks_q), np.asarray(toks_f))
+
+
+def test_double_quantization_rejected():
+    cfg, params = _tiny()
+    q = quantize_for_decode(params)
+    with pytest.raises(ValueError, match="already quantized"):
+        quantize_for_decode(q)
+
+
+def test_quantized_structure():
+    cfg, params = _tiny()
+    qparams = quantize_for_decode(params)
+    tr = qparams["params"]["transformer"]
+    (child,) = tr["layers"].values()
+    for k in ("qkv", "attn_out", "ff1", "ff2"):
+        assert child[k]["kernel_q"].dtype == jnp.int8
+        assert "kernel" not in child[k]
+        assert "bias" in child[k]  # biases stay fp32
+    assert tr["wte"]["kernel_q"].dtype == jnp.int8
+    assert "embedding" in tr["wpe"]  # position table untouched
+    (ln_f,) = [tr["ln_f"]]
+    assert "scale" in ln_f and "bias" in ln_f  # LNs untouched
+    # original tree untouched (no mutation)
+    (orig_child,) = params["params"]["transformer"]["layers"].values()
+    assert "kernel" in orig_child["qkv"]
