@@ -28,14 +28,16 @@ from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def naive_generate(model, params, prompt, n_new):
-    """The no-cache baseline: full forward on the growing sequence."""
-    ids = prompt
-    for _ in range(n_new):
-        logits = model.apply(params, ids, deterministic=True)
-        ids = jnp.concatenate(
-            [ids, jnp.argmax(logits[:, -1], axis=-1)[:, None]], axis=1)
-    return ids[:, prompt.shape[1]:]
+def _timed_forward(model, params, ids, reps=3):
+    """Mean seconds for one JITTED full forward at ``ids``' length (the
+    fair baseline: a real naive loop would jit per length too)."""
+    fwd = jax.jit(lambda p, i: model.apply(p, i, deterministic=True))
+    jax.block_until_ready(fwd(params, ids))  # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fwd(params, ids)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
 
 
 def main():
@@ -48,31 +50,42 @@ def main():
     prompt = jnp.asarray(
         np.random.RandomState(0).randint(0, cfg.vocab_size, (1, 8)), jnp.int32)
 
+    # correctness anchor: cache and naive paths emit identical greedy
+    # tokens (small n so the naive per-length compiles stay cheap)
+    ids = prompt
+    for _ in range(8):
+        logits = model.apply(params, ids, deterministic=True)
+        ids = jnp.concatenate(
+            [ids, jnp.argmax(logits[:, -1], axis=-1)[:, None]], axis=1)
+    assert np.array_equal(
+        np.asarray(generate(params, cfg, prompt, 8)),
+        np.asarray(ids[:, prompt.shape[1]:])), "paths disagree"
+
     rows = []
     for n_new in (32, 128, 512):
-        # warm both compiles, then time
-        out_c = generate(params, cfg, prompt, n_new)
+        out_c = generate(params, cfg, prompt, n_new)  # compile
+        jax.block_until_ready(out_c)
         t0 = time.perf_counter()
         out_c = generate(params, cfg, prompt, n_new)
         jax.block_until_ready(out_c)
         t_cache = time.perf_counter() - t0
 
-        # warm EVERY per-length compile first so the timed pass measures
-        # execution only (in real use naive also pays one compile per
-        # distinct length — an additional cost not counted here)
-        naive_generate(model, params, prompt, n_new)
-        t0 = time.perf_counter()
-        out_n = naive_generate(model, params, prompt, n_new)
-        jax.block_until_ready(out_n)
-        t_naive = time.perf_counter() - t0
+        # Naive baseline cost ESTIMATED, not looped: the no-cache loop runs
+        # one full forward per token on the growing sequence (plus one XLA
+        # compile per distinct length, not counted here). Its execution
+        # cost is n_new x the mean of the compiled forward at the start
+        # and end lengths (the forward is ~linear in S at these sizes).
+        S = prompt.shape[1]
+        f_lo = _timed_forward(model, params, jnp.zeros((1, S + 1), jnp.int32))
+        f_hi = _timed_forward(model, params,
+                              jnp.zeros((1, S + n_new), jnp.int32))
+        t_naive = n_new * (f_lo + f_hi) / 2.0
 
-        assert np.array_equal(np.asarray(out_c), np.asarray(out_n)), (
-            "cache and naive paths must emit identical greedy tokens")
         rows.append({
             "new_tokens": n_new,
             "kv_cache_tok_per_s": round(n_new / t_cache, 1),
-            "naive_tok_per_s": round(n_new / t_naive, 1),
-            "speedup": round(t_naive / t_cache, 2),
+            "naive_tok_per_s_est": round(n_new / t_naive, 1),
+            "speedup_vs_naive_est": round(t_naive / t_cache, 2),
         })
         print(rows[-1], flush=True)
 
