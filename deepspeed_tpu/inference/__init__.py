@@ -5,6 +5,10 @@ the model-family story users expect: decode with the SAME trained params
 the training stack produces (scan-stacked fused layers), O(1) work per
 new token via a static-shape KV cache."""
 
+from deepspeed_tpu.inference.convert import (  # noqa: F401
+    lm_params_from_pipeline_checkpoint,
+    pipe_layers_to_lm_params,
+)
 from deepspeed_tpu.inference.generation import generate, greedy_generate  # noqa: F401
 from deepspeed_tpu.inference.quantization import (  # noqa: F401
     dequantize_tensor,
@@ -13,4 +17,5 @@ from deepspeed_tpu.inference.quantization import (  # noqa: F401
 )
 
 __all__ = ["generate", "greedy_generate", "quantize_for_decode",
-           "quantize_tensor", "dequantize_tensor"]
+           "quantize_tensor", "dequantize_tensor",
+           "pipe_layers_to_lm_params", "lm_params_from_pipeline_checkpoint"]

@@ -1,0 +1,74 @@
+"""Train -> serve bridge: pipeline checkpoints into the decode layout.
+
+A model trained as a ``PipelineModule`` (``models/gpt2_pipe.py``) stores
+per-layer param files; ``inference.generate`` wants the scan-stacked
+``GPT2LMHeadModel`` layout (``models/gpt2.py``). This module restacks one
+into the other so "train with pipeline parallelism, consolidate with
+zero_to_fp32, serve with generate()" is a working end-to-end path —
+later DeepSpeed's checkpoint-conversion-for-inference story."""
+
+import jax
+import jax.numpy as jnp
+
+
+def pipe_layers_to_lm_params(layers):
+    """Per-layer pipeline trees (the ``{"layers": [...]}`` list from
+    ``utils.zero_to_fp32`` on a pipeline checkpoint, or
+    ``PipelineEngine._gather_layer_params()``) -> the scan-stacked
+    ``GPT2LMHeadModel`` param tree ``generate()`` consumes.
+
+    Expected layer sequence (``build_gpt2_pipeline``): embedding
+    (wte/wpe), N transformer blocks, final LayerNorm, tied LM head
+    (weightless or sharing the embedding)."""
+
+    def p(layer):
+        return layer["params"] if "params" in layer else layer
+
+    embed = blocks = ln_f = None
+    block_list = []
+    for layer in layers:
+        if layer is None:
+            continue
+        lp = p(layer)
+        if "wte" in lp:
+            if embed is None:  # the tied head repeats the embed params
+                embed = lp
+        elif "ln_f" in lp:
+            ln_f = lp["ln_f"]
+        else:
+            # a block layer: exactly one child module (the fused layer)
+            children = [v for v in lp.values()]
+            if len(children) != 1:
+                raise ValueError(
+                    f"unrecognized pipeline layer with keys {sorted(lp)}")
+            block_list.append(children[0])
+    if embed is None or ln_f is None or not block_list:
+        raise ValueError(
+            "not a GPT-2 pipeline layer list: need an embedding layer "
+            f"(wte/wpe), blocks, and a final norm; got {len(layers)} layers")
+
+    stacked = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls, axis=0), *block_list)
+    # the name GPT2Model's nn.scan body gives its one compact child — must
+    # match so the restacked tree loads into GPT2LMHeadModel.apply too
+    blocks = {"DeepSpeedTransformerLayer_0": stacked}
+
+    return {"params": {"transformer": {
+        "wte": dict(embed["wte"]),
+        "wpe": dict(embed["wpe"]),
+        "layers": blocks,
+        "ln_f": dict(ln_f),
+    }}}
+
+
+def lm_params_from_pipeline_checkpoint(checkpoint_dir, tag=None):
+    """One call from a pipeline checkpoint dir to decode-ready fp32 params
+    (consolidation via ``utils.zero_to_fp32`` + restacking)."""
+    from deepspeed_tpu.utils.zero_to_fp32 import (
+        get_fp32_state_dict_from_zero_checkpoint,
+    )
+
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    if not (isinstance(sd, dict) and set(sd) == {"layers"}):
+        raise ValueError("not a pipeline checkpoint (no per-layer files)")
+    return pipe_layers_to_lm_params(sd["layers"])

@@ -61,6 +61,10 @@ def main():
         np.asarray(generate(params, cfg, prompt, 8)),
         np.asarray(ids[:, prompt.shape[1]:])), "paths disagree"
 
+    S = prompt.shape[1]
+    f_lo = _timed_forward(model, params, jnp.zeros((1, S + 1), jnp.int32))
+    fwd = jax.jit(lambda p, i: model.apply(p, i, deterministic=True))
+
     rows = []
     for n_new in (32, 128, 512):
         out_c = generate(params, cfg, prompt, n_new)  # compile
@@ -70,13 +74,19 @@ def main():
         jax.block_until_ready(out_c)
         t_cache = time.perf_counter() - t0
 
+        # deep-length parity anchor: the cache's LAST token at this full
+        # length must equal the full forward's argmax on the sequence so
+        # far (one compile, catches cache/position bugs past any boundary)
+        ids_full = jnp.concatenate([prompt, out_c[:, :-1]], axis=1)
+        last_ref = jnp.argmax(fwd(params, ids_full)[:, -1], axis=-1)
+        assert np.array_equal(np.asarray(out_c[:, -1]), np.asarray(last_ref)), (
+            f"cache diverges from full forward at length {S + n_new}")
+
         # Naive baseline cost ESTIMATED, not looped: the no-cache loop runs
         # one full forward per token on the growing sequence (plus one XLA
         # compile per distinct length, not counted here). Its execution
         # cost is n_new x the mean of the compiled forward at the start
         # and end lengths (the forward is ~linear in S at these sizes).
-        S = prompt.shape[1]
-        f_lo = _timed_forward(model, params, jnp.zeros((1, S + 1), jnp.int32))
         f_hi = _timed_forward(model, params,
                               jnp.zeros((1, S + n_new), jnp.int32))
         t_naive = n_new * (f_lo + f_hi) / 2.0
