@@ -40,6 +40,9 @@ def main(argv=None):
     p.add_argument("--stages", type=int, default=2, help="pipeline stages")
     p.add_argument("--zero", type=int, default=1, choices=(0, 1, 2))
     p.add_argument("--xl", action="store_true", help="GPT-2 1.5B (default: tiny)")
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after training, restack the pipeline params and "
+                        "greedy-decode N tokens (inference/convert.py)")
     args = p.parse_args(argv)
 
     if args.xl:
@@ -82,6 +85,20 @@ def main(argv=None):
     print(f"pp{args.stages} x dp{dp}, ZeRO-{args.zero}  "
           f"loss: {losses[0]:.4f} -> {losses[-1]:.4f}  ({tokens / dt:.0f} tokens/sec)")
     assert losses[-1] < losses[0], "loss did not decrease"
+
+    if args.generate:
+        # train -> serve: restack the pipeline layers into the decode layout
+        # (inference/convert.py) and sample a continuation
+        from deepspeed_tpu.inference import generate, pipe_layers_to_lm_params
+
+        engine._sync_from_compiled()
+        layers = [jax.device_get(p) if p is not None else None
+                  for p in engine._gather_layer_params()]
+        params = pipe_layers_to_lm_params(layers)
+        prompt = np.asarray(rng.randint(0, 32, (1, 8)), np.int32)
+        toks = generate(params, cfg, prompt, args.generate)
+        print(f"generated {args.generate} tokens from the trained pipeline: "
+              f"{np.asarray(toks)[0].tolist()}")
     return 0
 
 

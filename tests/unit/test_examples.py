@@ -41,7 +41,9 @@ def test_bert_pretrain_zero3():
 
 
 def test_gpt2_pipeline():
-    _run("gpt2_pipeline", ["--steps", "4", "--batch", "2", "--seq", "16"])
+    # --generate exercises the train->serve restack (inference/convert.py)
+    _run("gpt2_pipeline", ["--steps", "4", "--batch", "2", "--seq", "16",
+                           "--generate", "4"])
 
 
 def test_sparse_attention_bert():
