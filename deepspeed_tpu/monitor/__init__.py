@@ -1,3 +1,45 @@
+from deepspeed_tpu.monitor.csv_monitor import CsvMonitor
 from deepspeed_tpu.monitor.tensorboard import SummaryWriter, TensorBoardMonitor
 
-__all__ = ["SummaryWriter", "TensorBoardMonitor"]
+
+class MultiMonitor:
+    """Fan a record/flush/close stream out to several monitor backends
+    (tensorboard + csv both enabled — later DeepSpeed's Monitor group)."""
+
+    def __init__(self, monitors):
+        self.monitors = list(monitors)
+        self.enabled = any(m.enabled for m in self.monitors)
+
+    def record(self, tag, value, step):
+        for m in self.monitors:
+            m.record(tag, value, step)
+
+    def flush(self):
+        for m in self.monitors:
+            m.flush()
+
+    def close(self):
+        for m in self.monitors:
+            m.close()
+
+
+def monitor_from_config(config, rank):
+    """Build the configured monitor (None / one backend / MultiMonitor) —
+    the ONE construction path shared by every engine, so a new backend
+    cannot be wired into one engine and silently ignored by another."""
+    monitors = []
+    if config.tensorboard_enabled:
+        monitors.append(TensorBoardMonitor(
+            config.tensorboard_output_path, config.tensorboard_job_name,
+            rank=rank))
+    if config.csv_monitor_enabled:
+        monitors.append(CsvMonitor(
+            config.csv_monitor_output_path, config.csv_monitor_job_name,
+            rank=rank))
+    if not monitors:
+        return None
+    return monitors[0] if len(monitors) == 1 else MultiMonitor(monitors)
+
+
+__all__ = ["SummaryWriter", "TensorBoardMonitor", "CsvMonitor",
+           "MultiMonitor", "monitor_from_config"]

@@ -1,0 +1,60 @@
+"""CSV scalar monitor (beyond the v0.3.10 reference — later DeepSpeed's
+``csv_monitor`` config section): same buffered record/flush interface as
+``TensorBoardMonitor``, one CSV file per scalar tag, no dependencies.
+
+Config::
+
+    "csv_monitor": {"enabled": true,
+                    "output_path": "runs/",        # default
+                    "job_name": "DeepSpeedJobName"} # default
+"""
+
+import os
+import time
+
+
+class CsvMonitor:
+    """One ``<output_path>/<job_name>/<tag>.csv`` per tag, rows
+    ``step,value,walltime``. Buffered like TensorBoardMonitor: ``record``
+    defers the host transfer, ``flush`` converts and appends."""
+
+    def __init__(self, output_path, job_name, rank=0):
+        base = output_path or os.path.join("runs", "deepspeed_tpu")
+        self.enabled = rank == 0
+        self.dir = os.path.join(base, job_name)
+        if self.enabled:
+            os.makedirs(self.dir, exist_ok=True)
+        self._pending = []
+        self._headers_written = set()
+
+    def record(self, tag, value, step):
+        if self.enabled:
+            self._pending.append((tag, value, int(step), time.time()))
+
+    def _path(self, tag):
+        safe = "".join(c if (c.isalnum() or c in "-_.") else "_" for c in tag)
+        return os.path.join(self.dir, f"{safe}.csv")
+
+    def flush(self):
+        if not self.enabled or not self._pending:
+            return
+        by_tag = {}
+        for tag, value, step, wall in self._pending:
+            by_tag.setdefault(tag, []).append((step, float(value), wall))
+        self._pending.clear()
+        for tag, rows in by_tag.items():
+            path = self._path(tag)
+            # first write of a tag in THIS run truncates: appending onto a
+            # previous run's file would interleave two step sequences in
+            # one CSV (TensorBoardMonitor gets per-run uniqueness from its
+            # event filenames; here use a distinct job_name to keep runs)
+            new = tag not in self._headers_written
+            with open(path, "w" if new else "a") as f:
+                if new:
+                    f.write("step,value,walltime\n")
+                for step, value, wall in rows:
+                    f.write(f"{step},{value},{wall}\n")
+            self._headers_written.add(tag)
+
+    def close(self):
+        self.flush()

@@ -300,6 +300,17 @@ def get_tensorboard_job_name(param_dict):
     return TENSORBOARD_JOB_NAME_DEFAULT
 
 
+def get_csv_monitor(param_dict):
+    """``csv_monitor`` section (beyond the v0.3.10 reference; later
+    DeepSpeed's schema): (enabled, output_path, job_name)."""
+    sec = param_dict.get("csv_monitor", {})
+    return (
+        bool(sec.get("enabled", False)),
+        sec.get("output_path", TENSORBOARD_OUTPUT_PATH_DEFAULT),
+        sec.get("job_name", TENSORBOARD_JOB_NAME_DEFAULT),
+    )
+
+
 def get_checkpoint_tag_validation_mode(param_dict):
     """checkpoint: {tag_validation: Ignore|Warn|Fail} (reference
     runtime/config.py:483-495)."""
@@ -461,6 +472,11 @@ class DeepSpeedConfig:
         self.tensorboard_enabled = get_tensorboard_enabled(param_dict)
         self.tensorboard_output_path = get_tensorboard_output_path(param_dict)
         self.tensorboard_job_name = get_tensorboard_job_name(param_dict)
+        (
+            self.csv_monitor_enabled,
+            self.csv_monitor_output_path,
+            self.csv_monitor_job_name,
+        ) = get_csv_monitor(param_dict)
 
         self.sparse_attention = get_sparse_attention(param_dict)
         self.pipeline = get_pipeline_config(param_dict)

@@ -280,14 +280,9 @@ class DeepSpeedEngine:
         self.monitor = None
         self._last_loss = None
         self._loss_sum = None
-        if self._config.tensorboard_enabled:
-            from deepspeed_tpu.monitor import TensorBoardMonitor
+        from deepspeed_tpu.monitor import monitor_from_config
 
-            self.monitor = TensorBoardMonitor(
-                self._config.tensorboard_output_path,
-                self._config.tensorboard_job_name,
-                rank=self.global_rank,
-            )
+        self.monitor = monitor_from_config(self._config, self.global_rank)
 
         if self.global_rank == 0:
             self._config.print("DeepSpeedEngine configuration")

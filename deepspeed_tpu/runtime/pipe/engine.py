@@ -207,16 +207,12 @@ class PipelineEngine:
         self._compiled_warned = False
         self._hetero_cache = "unset"
 
-        # monitoring: rank-0 TensorBoard scalars (reference engine.py:1010-1025)
-        self.monitor = None
-        if self._config.tensorboard_enabled:
-            from deepspeed_tpu.monitor import TensorBoardMonitor
+        # monitoring: rank-0 scalars (reference engine.py:1010-1025);
+        # construction shared with DeepSpeedEngine so every configured
+        # backend (tensorboard, csv, both) works identically here
+        from deepspeed_tpu.monitor import monitor_from_config
 
-            self.monitor = TensorBoardMonitor(
-                self._config.tensorboard_output_path,
-                self._config.tensorboard_job_name,
-                rank=dist.get_rank(),
-            )
+        self.monitor = monitor_from_config(self._config, dist.get_rank())
 
         log_dist(
             f"PipelineEngine: stages={self.num_stages} dp={self.dp_world_size} "

@@ -136,3 +136,81 @@ def test_engine_writes_tensorboard_scalars(tmpdir):
     assert "Train/Samples/lr" in scalars
     # keyed by global sample count (8 per step), matching the reference
     assert [s for s, _ in scalars["Train/Samples/train_loss"]] == [8, 16, 24, 32]
+
+
+def test_csv_monitor_writes_and_buffers(tmpdir):
+    from deepspeed_tpu.monitor import CsvMonitor
+
+    out = str(tmpdir.join("csv"))
+    m = CsvMonitor(out, "job", rank=0)
+    m.record("Train/loss", 1.5, 8)
+    m.record("Train/loss", 1.25, 16)
+    m.record("Train/lr", 0.1, 8)
+    path = os.path.join(out, "job", "Train_loss.csv")
+    assert not os.path.exists(path)  # buffered until flush
+    m.flush()
+    with open(path) as f:
+        lines = f.read().strip().splitlines()
+    assert lines[0] == "step,value,walltime"
+    assert lines[1].startswith("8,1.5,")
+    assert lines[2].startswith("16,1.25,")
+    assert os.path.exists(os.path.join(out, "job", "Train_lr.csv"))
+    # append across flushes, header written once
+    m.record("Train/loss", 1.0, 24)
+    m.close()
+    with open(path) as f:
+        lines = f.read().strip().splitlines()
+    assert len(lines) == 4 and lines[3].startswith("24,1.0,")
+
+    # a NEW run (new monitor instance) truncates instead of interleaving
+    # two runs' step sequences in one file
+    m2 = CsvMonitor(out, "job", rank=0)
+    m2.record("Train/loss", 9.0, 8)
+    m2.flush()
+    with open(path) as f:
+        lines = f.read().strip().splitlines()
+    assert len(lines) == 2 and lines[1].startswith("8,9.0,")
+
+    # non-zero rank writes nothing
+    m1 = CsvMonitor(str(tmpdir.join("r1")), "job", rank=1)
+    m1.record("x", 1.0, 1)
+    m1.flush()
+    assert not os.path.exists(os.path.join(str(tmpdir.join("r1")), "job"))
+
+
+def test_engine_writes_csv_scalars(tmpdir):
+    """csv_monitor config section: per-step loss/lr rows land in CSV files
+    (and can combine with tensorboard via MultiMonitor)."""
+    import jax.numpy as jnp
+    import deepspeed_tpu
+
+    out = str(tmpdir.join("csv_engine"))
+
+    def model(params, x, y):
+        return jnp.mean((x @ params["w"] - y) ** 2)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters={"w": jnp.ones((4, 2))},
+        config_params={
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "steps_per_print": 2,
+            "csv_monitor": {"enabled": True, "output_path": out,
+                            "job_name": "unit"},
+        })
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+    y = jnp.asarray(rng.randn(8, 2).astype(np.float32))
+    for _ in range(3):
+        loss = engine(x, y)
+        engine.backward(loss)
+        engine.step()
+    engine.monitor.flush()
+
+    path = os.path.join(out, "unit", "Train_Samples_train_loss.csv")
+    with open(path) as f:
+        rows = f.read().strip().splitlines()
+    assert rows[0] == "step,value,walltime"
+    assert [int(r.split(",")[0]) for r in rows[1:]] == [8, 16, 24]
