@@ -1,0 +1,71 @@
+"""Direct unit coverage for runtime/config_utils.py (reference
+deepspeed/runtime/config_utils.py helpers) — exercised indirectly by every
+config test, pinned directly here."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config_utils import (
+    ScientificNotationEncoder,
+    as_config_dict,
+    dict_raise_error_on_duplicate_keys,
+    get_scalar_param,
+    resolve_dp_size,
+    resolve_tp_size,
+)
+
+
+def test_get_scalar_param_default():
+    assert get_scalar_param({"a": 1}, "a", 9) == 1
+    assert get_scalar_param({}, "a", 9) == 9
+
+
+def test_as_config_dict(tmp_path):
+    assert as_config_dict({"x": 1}) == {"x": 1}
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"y": 2}))
+    assert as_config_dict(str(p)) == {"y": 2}
+    assert as_config_dict(None) == {}
+    assert as_config_dict("/nonexistent/path.json") == {}
+
+
+class _Mpu:
+    def __init__(self, mp):
+        self._mp = mp
+
+    def get_model_parallel_world_size(self):
+        return self._mp
+
+
+def test_resolve_tp_size():
+    assert resolve_tp_size({}) == 1
+    assert resolve_tp_size({"tensor_parallel": {"size": 4}}) == 4
+    # an mpu reporting > 1 wins over the config
+    assert resolve_tp_size({"tensor_parallel": {"size": 4}}, _Mpu(2)) == 2
+    # mpu reporting 1 defers to the config
+    assert resolve_tp_size({"tensor_parallel": {"size": 4}}, _Mpu(1)) == 4
+    assert resolve_tp_size({"tensor_parallel": None}) == 1
+
+
+def test_resolve_dp_size():
+    assert resolve_dp_size({}) is None
+    assert resolve_dp_size({"mesh": {"data_parallel_size": 4}}) == 4
+    assert resolve_dp_size({"mesh": {}}) is None
+
+
+def test_duplicate_keys_raise():
+    good = json.loads('{"a": 1, "b": 2}',
+                      object_pairs_hook=dict_raise_error_on_duplicate_keys)
+    assert good == {"a": 1, "b": 2}
+    with pytest.raises(ValueError, match="Duplicate"):
+        json.loads('{"a": 1, "a": 2}',
+                   object_pairs_hook=dict_raise_error_on_duplicate_keys)
+
+
+def test_scientific_notation_encoder():
+    out = json.dumps({"bucket": 500000000, "lr": 1e-4, "flag": True,
+                      "nest": [100000, 5]}, cls=ScientificNotationEncoder)
+    assert "e+08" in out
+    assert '"flag": true' in out  # bools never reformatted to 1.0/0.0
+    assert "5]" in out  # small ints untouched
