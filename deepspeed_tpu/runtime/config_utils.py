@@ -67,19 +67,23 @@ def dict_raise_error_on_duplicate_keys(ordered_pairs):
 
 
 class ScientificNotationEncoder(json.JSONEncoder):
-    """JSON encoder that renders large numbers in scientific notation, so dumped
-    configs stay readable (e.g. bucket sizes like 5e8)."""
+    """JSON encoder rendering large numbers as BARE scientific-notation
+    tokens (``"bucket": 5.000000e+08``), so dumped configs stay readable
+    AND round-trip through ``json.loads`` as numbers (scientific tokens
+    parse as floats, never as quoted strings)."""
 
     def iterencode(self, o, _one_shot=False):
-        def reformat(obj):
-            if isinstance(obj, bool):
-                return obj
-            if isinstance(obj, (int, float)) and abs(obj) >= 1e5:
-                return f"{obj:e}"
+        def enc(obj):
+            if isinstance(obj, bool) or obj is None or isinstance(obj, str):
+                return json.dumps(obj)
+            if isinstance(obj, (int, float)):
+                return f"{obj:e}" if abs(obj) >= 1e5 else json.dumps(obj)
             if isinstance(obj, dict):
-                return {k: reformat(v) for k, v in obj.items()}
+                return ("{" + ", ".join(
+                    f"{json.dumps(str(k))}: {enc(v)}"
+                    for k, v in obj.items()) + "}")
             if isinstance(obj, (list, tuple)):
-                return [reformat(v) for v in obj]
-            return obj
+                return "[" + ", ".join(enc(v) for v in obj) + "]"
+            return json.dumps(obj)
 
-        return super().iterencode(reformat(o), _one_shot=_one_shot)
+        yield enc(o)

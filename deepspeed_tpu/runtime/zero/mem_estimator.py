@@ -1,0 +1,106 @@
+"""ZeRO memory-needs estimators (beyond the v0.3.10 reference — later
+DeepSpeed's ``deepspeed.runtime.zero.stage_1_and_2.estimate_zero2_model_states_mem_needs``
+family): answer "will this model fit under this config?" BEFORE building
+an engine.
+
+Accounting model (bytes per device unless noted), for P params trained
+with Adam under mixed precision (bf16/fp16 compute, fp32 master),
+matching THIS framework's mechanism (runtime/zero/sharded_optimizer.py):
+
+- replicated compute params:   2P (bf16) — all stages < 3
+- compute-dtype gradients:     2P, transient out of backward (all stages)
+- flat fp32 gradients:         4P (stage < 2, replicated)
+                               4P / dp (stage 2+: reduce-scattered —
+                               only the owner shard materializes)
+- fp32 master:                 4P / dp (stages 1/2; HOST under offload;
+                               absent for fp32 compute)
+- Adam moments (m, v):         8P / dp (with the master)
+- stage 3: compute params live sharded, 2P / dp at rest
+
+Activations are model/batch-dependent and NOT included — measure those
+with the flops profiler or the autotuner's OOM ladder.
+"""
+
+
+def _fmt(n):
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0:
+            return f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}PB"
+
+
+def estimate_zero_model_states_mem_needs(
+        num_params, stage=2, dp=1, cpu_offload=False, compute_bytes=2):
+    """Model-state memory for one training replica.
+
+    Returns ``{"device_bytes", "host_bytes", "breakdown"}`` — per-device
+    HBM and per-host RAM for params + gradients + optimizer states.
+    ``compute_bytes=2`` is bf16/fp16 compute; use 4 for fp32 compute
+    (then no separate master is stored — master_from_params).
+    """
+    if stage not in (0, 1, 2, 3):
+        raise ValueError(f"stage must be 0..3, got {stage}")
+    if cpu_offload and stage not in (1, 2):
+        raise ValueError("cpu_offload composes with ZeRO stage 1/2 only")
+    if dp < 1:
+        raise ValueError(f"dp must be >= 1, got {dp}")
+    P = int(num_params)
+    keep_master = compute_bytes != 4
+
+    breakdown = {}
+    device = host = 0
+
+    if stage >= 3:
+        breakdown["params (sharded at rest)"] = compute_bytes * P // dp
+    else:
+        breakdown["params (replicated)"] = compute_bytes * P
+    device += breakdown[next(iter(breakdown))]
+
+    if compute_bytes != 4:
+        # backward's compute-dtype grads exist transiently alongside the
+        # flat fp32 copy (for fp32 compute the flat copy IS that buffer)
+        breakdown["gradients (compute, transient)"] = compute_bytes * P
+        device += compute_bytes * P
+    grad_bytes = 4 * P // dp if stage >= 2 else 4 * P
+    breakdown["gradients (fp32 flat)"] = grad_bytes
+    device += grad_bytes
+
+    master_bytes = 4 * P // dp if keep_master else 0
+    moments_bytes = 8 * P // dp
+    if stage == 0:
+        master_bytes = 4 * P if keep_master else 0
+        moments_bytes = 8 * P
+    if cpu_offload:
+        breakdown["fp32 master (host)"] = master_bytes
+        breakdown["Adam moments (host)"] = moments_bytes
+        host += master_bytes + moments_bytes
+    else:
+        breakdown["fp32 master"] = master_bytes
+        breakdown["Adam moments"] = moments_bytes
+        device += master_bytes + moments_bytes
+
+    return {"device_bytes": device, "host_bytes": host,
+            "breakdown": breakdown}
+
+
+def estimate_zero2_model_states_mem_needs(num_params, dp=1, cpu_offload=False):
+    """The reference-family entry point name (later DeepSpeed API)."""
+    return estimate_zero_model_states_mem_needs(
+        num_params, stage=2, dp=dp, cpu_offload=cpu_offload)
+
+
+def mem_needs_report(num_params, dp_sizes=(1, 8, 64), stages=(0, 1, 2, 3)):
+    """Human-readable table over (stage, dp) — the later-DeepSpeed
+    estimator's printed form."""
+    lines = [f"model states for {num_params / 1e6:.0f}M params (Adam, "
+             "bf16 compute + fp32 master; activations excluded):"]
+    lines.append(f"{'stage':>6} {'dp':>5} {'per-device':>12} {'per-host':>10}")
+    for stage in stages:
+        for dp in dp_sizes:
+            est = estimate_zero_model_states_mem_needs(
+                num_params, stage=stage, dp=dp)
+            lines.append(f"{stage:>6} {dp:>5} "
+                         f"{_fmt(est['device_bytes']):>12} "
+                         f"{_fmt(est['host_bytes']):>10}")
+    return "\n".join(lines)

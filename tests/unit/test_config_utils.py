@@ -64,8 +64,14 @@ def test_duplicate_keys_raise():
 
 
 def test_scientific_notation_encoder():
-    out = json.dumps({"bucket": 500000000, "lr": 1e-4, "flag": True,
-                      "nest": [100000, 5]}, cls=ScientificNotationEncoder)
-    assert "e+08" in out
+    cfg = {"bucket": 500000000, "lr": 1e-4, "flag": True,
+           "nest": [100000, 5], "name": "x"}
+    out = json.dumps(cfg, cls=ScientificNotationEncoder)
+    assert "e+08" in out and '"5.000000e+08"' not in out  # bare token
     assert '"flag": true' in out  # bools never reformatted to 1.0/0.0
-    assert "5]" in out  # small ints untouched
+    # round-trips as NUMBERS (scientific tokens parse as floats)
+    back = json.loads(out)
+    assert back["bucket"] == 5e8 and isinstance(back["bucket"], float)
+    assert back["lr"] == 1e-4
+    assert back["nest"] == [1e5, 5]
+    assert back["flag"] is True and back["name"] == "x"

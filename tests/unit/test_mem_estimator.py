@@ -1,0 +1,70 @@
+"""ZeRO memory estimators (beyond the v0.3.10 reference; later DeepSpeed's
+estimate_zero2_model_states_mem_needs family)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.zero.mem_estimator import (
+    estimate_zero2_model_states_mem_needs,
+    estimate_zero_model_states_mem_needs,
+    mem_needs_report,
+)
+
+P = 336_000_000  # BERT-large-ish
+
+
+def test_stage_progression_shrinks_device_memory():
+    prev = None
+    for stage in (0, 1, 2, 3):
+        est = estimate_zero_model_states_mem_needs(P, stage=stage, dp=8)
+        if prev is not None:
+            assert est["device_bytes"] <= prev, (stage, est)
+        prev = est["device_bytes"]
+
+
+def test_stage2_accounting():
+    est = estimate_zero2_model_states_mem_needs(P, dp=8)
+    b = est["breakdown"]
+    assert b["params (replicated)"] == 2 * P
+    assert b["gradients (compute, transient)"] == 2 * P
+    assert b["gradients (fp32 flat)"] == 4 * P // 8
+    assert b["fp32 master"] == 4 * P // 8
+    assert b["Adam moments"] == 8 * P // 8
+    assert est["host_bytes"] == 0
+    assert est["device_bytes"] == sum(b.values())
+
+
+def test_offload_moves_states_to_host():
+    on = estimate_zero2_model_states_mem_needs(P, dp=8)
+    off = estimate_zero2_model_states_mem_needs(P, dp=8, cpu_offload=True)
+    assert off["host_bytes"] == 12 * P // 8  # master + moments
+    assert off["device_bytes"] == on["device_bytes"] - 12 * P // 8
+
+
+def test_stage3_shards_params():
+    est = estimate_zero_model_states_mem_needs(P, stage=3, dp=8)
+    assert est["breakdown"]["params (sharded at rest)"] == 2 * P // 8
+
+
+def test_fp32_compute_no_master():
+    est = estimate_zero_model_states_mem_needs(P, stage=2, dp=8,
+                                               compute_bytes=4)
+    assert est["breakdown"]["fp32 master"] == 0
+    assert est["breakdown"]["params (replicated)"] == 4 * P
+    # the flat fp32 grads ARE the compute grads — no extra transient row
+    assert "gradients (compute, transient)" not in est["breakdown"]
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="stage"):
+        estimate_zero_model_states_mem_needs(P, stage=5)
+    with pytest.raises(ValueError, match="cpu_offload"):
+        estimate_zero_model_states_mem_needs(P, stage=3, cpu_offload=True)
+    with pytest.raises(ValueError, match="dp"):
+        estimate_zero_model_states_mem_needs(P, stage=2, dp=0)
+
+
+def test_report_renders():
+    rep = mem_needs_report(P)
+    assert "336M params" in rep
+    assert "GB" in rep or "MB" in rep
+    assert len(rep.splitlines()) == 2 + 4 * 3  # header x2 + stages x dps
