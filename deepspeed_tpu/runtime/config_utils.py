@@ -67,23 +67,41 @@ def dict_raise_error_on_duplicate_keys(ordered_pairs):
 
 
 class ScientificNotationEncoder(json.JSONEncoder):
-    """JSON encoder rendering large numbers as BARE scientific-notation
-    tokens (``"bucket": 5.000000e+08``), so dumped configs stay readable
-    AND round-trip through ``json.loads`` as numbers (scientific tokens
-    parse as floats, never as quoted strings)."""
+    """JSON encoder rendering large round numbers as BARE scientific
+    tokens (``"bucket": 5.000000e+08``) so dumped configs stay readable
+    AND round-trip through ``json.loads`` as numbers.
+
+    Safety rules: a value only gets the scientific form when the 6-digit
+    token parses back EXACTLY equal (123456789 stays ``123456789``);
+    non-finite floats and any unsupported option (``indent``) fall back
+    to the stdlib encoder wholesale. ``sort_keys`` and ``default`` are
+    honored."""
 
     def iterencode(self, o, _one_shot=False):
+        if self.indent is not None:
+            # hand-rolled single-line walker below can't indent — correct
+            # output beats pretty scientific tokens
+            yield from super().iterencode(o, _one_shot=_one_shot)
+            return
+
         def enc(obj):
             if isinstance(obj, bool) or obj is None or isinstance(obj, str):
                 return json.dumps(obj)
             if isinstance(obj, (int, float)):
-                return f"{obj:e}" if abs(obj) >= 1e5 else json.dumps(obj)
+                import math
+
+                if abs(obj) >= 1e5 and math.isfinite(obj):
+                    tok = f"{obj:e}"
+                    if float(tok) == obj:  # exactness guard
+                        return tok
+                return json.dumps(obj)
             if isinstance(obj, dict):
+                items = sorted(obj.items()) if self.sort_keys else obj.items()
                 return ("{" + ", ".join(
                     f"{json.dumps(str(k))}: {enc(v)}"
-                    for k, v in obj.items()) + "}")
+                    for k, v in items) + "}")
             if isinstance(obj, (list, tuple)):
                 return "[" + ", ".join(enc(v) for v in obj) + "]"
-            return json.dumps(obj)
+            return enc(self.default(obj))  # user hook, like the base class
 
         yield enc(o)

@@ -52,10 +52,12 @@ def estimate_zero_model_states_mem_needs(
     device = host = 0
 
     if stage >= 3:
-        breakdown["params (sharded at rest)"] = compute_bytes * P // dp
+        param_bytes = compute_bytes * P // dp
+        breakdown["params (sharded at rest)"] = param_bytes
     else:
-        breakdown["params (replicated)"] = compute_bytes * P
-    device += breakdown[next(iter(breakdown))]
+        param_bytes = compute_bytes * P
+        breakdown["params (replicated)"] = param_bytes
+    device += param_bytes
 
     if compute_bytes != 4:
         # backward's compute-dtype grads exist transiently alongside the

@@ -75,3 +75,20 @@ def test_scientific_notation_encoder():
     assert back["lr"] == 1e-4
     assert back["nest"] == [1e5, 5]
     assert back["flag"] is True and back["name"] == "x"
+
+
+def test_scientific_notation_encoder_safety():
+    # exactness guard: a value the 6-digit token would corrupt stays exact
+    out = json.dumps({"n": 123456789}, cls=ScientificNotationEncoder)
+    assert json.loads(out)["n"] == 123456789
+    # non-finite floats use the stdlib token json.loads accepts
+    out = json.dumps({"clip": float("inf")}, cls=ScientificNotationEncoder)
+    assert json.loads(out)["clip"] == float("inf")
+    # indent falls back to the stdlib encoder wholesale (correct output)
+    out = json.dumps({"bucket": 500000000}, cls=ScientificNotationEncoder,
+                     indent=2)
+    assert json.loads(out)["bucket"] == 500000000 and "\n" in out
+    # sort_keys honored
+    out = json.dumps({"b": 1, "a": 2}, cls=ScientificNotationEncoder,
+                     sort_keys=True)
+    assert out.index('"a"') < out.index('"b"')
