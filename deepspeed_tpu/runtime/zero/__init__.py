@@ -1,0 +1,25 @@
+"""Public ZeRO surface (reference ``deepspeed/runtime/zero/__init__.py``;
+the extra entry points mirror later DeepSpeed's ``deepspeed.zero``
+namespace: Init-style sharded construction + memory estimators)."""
+
+from deepspeed_tpu.runtime.zero.init import zero3_sharded_init
+from deepspeed_tpu.runtime.zero.mem_estimator import (
+    estimate_zero2_model_states_mem_needs,
+    estimate_zero_model_states_mem_needs,
+    mem_needs_report,
+)
+from deepspeed_tpu.runtime.zero.pytree_optimizer import ZeroPytreeOptimizer
+from deepspeed_tpu.runtime.zero.sharded_optimizer import (
+    ZeroShardedOptimizer,
+    zero3_param_shardings,
+)
+
+__all__ = [
+    "ZeroPytreeOptimizer",
+    "ZeroShardedOptimizer",
+    "zero3_param_shardings",
+    "zero3_sharded_init",
+    "estimate_zero_model_states_mem_needs",
+    "estimate_zero2_model_states_mem_needs",
+    "mem_needs_report",
+]

@@ -1,0 +1,14 @@
+"""Alias package (later DeepSpeed's ``deepspeed.zero`` namespace — the
+v0.3.10 reference has no such alias; kept for forward import parity):
+``deepspeed_tpu.zero.zero3_sharded_init`` is the ``zero.Init``-shaped
+entry point, next to the memory estimators."""
+
+from deepspeed_tpu.runtime.zero import (  # noqa: F401
+    ZeroPytreeOptimizer,
+    ZeroShardedOptimizer,
+    estimate_zero2_model_states_mem_needs,
+    estimate_zero_model_states_mem_needs,
+    mem_needs_report,
+    zero3_param_shardings,
+    zero3_sharded_init,
+)

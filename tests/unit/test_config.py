@@ -257,3 +257,7 @@ def test_alias_package_surfaces():
         log_dist,
         logger,
     )
+    from deepspeed_tpu.zero import (  # noqa: F401
+        estimate_zero2_model_states_mem_needs,
+        zero3_sharded_init,
+    )
