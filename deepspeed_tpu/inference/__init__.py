@@ -5,6 +5,7 @@ the model-family story users expect: decode with the SAME trained params
 the training stack produces (scan-stacked fused layers), O(1) work per
 new token via a static-shape KV cache."""
 
+from deepspeed_tpu.inference.beam import beam_search  # noqa: F401
 from deepspeed_tpu.inference.convert import (  # noqa: F401
     lm_params_from_pipeline_checkpoint,
     pipe_layers_to_lm_params,
@@ -16,6 +17,6 @@ from deepspeed_tpu.inference.quantization import (  # noqa: F401
     quantize_tensor,
 )
 
-__all__ = ["generate", "greedy_generate", "quantize_for_decode",
-           "quantize_tensor", "dequantize_tensor",
+__all__ = ["generate", "greedy_generate", "beam_search",
+           "quantize_for_decode", "quantize_tensor", "dequantize_tensor",
            "pipe_layers_to_lm_params", "lm_params_from_pipeline_checkpoint"]

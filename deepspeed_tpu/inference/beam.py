@@ -1,0 +1,129 @@
+"""Length-synchronous beam search over the KV-cache decode path.
+
+Beyond the v0.3.10 reference (DeepSpeed-Inference came later); the
+missing third decoding mode next to greedy/sampling. TPU-first shape:
+the whole search is ONE jitted program — beams live as extra batch lanes
+([B*W] through the same ``_step`` the greedy path uses), each step does
+a per-prompt top-W over the W*V continuation scores and gathers the KV
+caches along the lane axis (static shapes, ``jnp.take`` — no host
+round-trips).
+
+EOS semantics: a finished beam is frozen — its only continuation is EOS
+at zero additional log-prob, so finished hypotheses compete with live
+ones under the standard length-normalized score.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.generation import _prefill, _step
+from deepspeed_tpu.inference.quantization import vocab_size
+
+
+@partial(jax.jit, static_argnames=("n_layers", "n_heads", "head_dim",
+                                   "max_new_tokens", "num_beams",
+                                   "eos_token_id"))
+def _beam_jit(params, prompt_ids, n_layers, n_heads, head_dim,
+              max_new_tokens, num_beams, eos_token_id, length_penalty):
+    B, S = prompt_ids.shape
+    W = num_beams
+    total = S + max_new_tokens
+    NEG = jnp.asarray(-1e9, jnp.float32)
+
+    # prefill on [B] lanes, then tile the caches to [B*W] beam lanes
+    caches, last_logits = _prefill(
+        params, prompt_ids, n_layers, n_heads, head_dim, total)
+    caches = tuple(jnp.repeat(c, W, axis=1) for c in caches)   # [L,B*W,...]
+    logits = jnp.repeat(last_logits, W, axis=0)                # [B*W, V]
+
+    # beam state: scores [B, W] (beam 0 live, others dead at start so the
+    # first expansion draws W distinct tokens from ONE beam), tokens
+    # [B, W, T], finished [B, W], lengths [B, W] (tokens before freezing)
+    scores = jnp.where(jnp.arange(W)[None, :] == 0, 0.0, NEG)
+    scores = jnp.broadcast_to(scores, (B, W)).astype(jnp.float32)
+    tokens0 = jnp.zeros((B, W, max_new_tokens), jnp.int32)
+    finished0 = jnp.zeros((B, W), bool)
+    lengths0 = jnp.zeros((B, W), jnp.float32)
+
+    def step(carry, t):
+        caches, logits, scores, tokens, finished, lengths = carry
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = logp.reshape(B, W, -1)                          # [B, W, V]
+        V = logp.shape[-1]
+        if eos_token_id is not None:
+            # frozen beams: only EOS continues, at no additional cost
+            eos_onehot = jnp.where(jnp.arange(V) == eos_token_id, 0.0, NEG)
+            logp = jnp.where(finished[:, :, None], eos_onehot[None, None, :],
+                             logp)
+        cand = scores[:, :, None] + logp                       # [B, W, V]
+        flat = cand.reshape(B, W * V)
+        top_scores, top_idx = jax.lax.top_k(flat, W)           # [B, W]
+        beam_idx = top_idx // V                                # source beam
+        tok = (top_idx % V).astype(jnp.int32)
+
+        # reorder per-beam state to the chosen source beams
+        lane = (jnp.arange(B)[:, None] * W + beam_idx).reshape(-1)  # [B*W]
+        caches = tuple(jnp.take(c, lane, axis=1) for c in caches)
+        tokens = jnp.take_along_axis(tokens, beam_idx[:, :, None], axis=1)
+        tokens = tokens.at[:, :, t].set(tok)
+        was_finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+        lengths = jnp.take_along_axis(lengths, beam_idx, axis=1)
+        # the EOS-emitting step still counts; frozen steps don't
+        lengths = lengths + jnp.where(was_finished, 0.0, 1.0)
+        if eos_token_id is not None:
+            finished = was_finished | (tok == eos_token_id)
+        else:
+            finished = was_finished
+
+        logits, caches = _step(params, n_heads, caches, tok.reshape(-1), S + t)
+        return (caches, logits, top_scores, tokens, finished, lengths), None
+
+    (caches, logits, scores, tokens, finished, lengths), _ = jax.lax.scan(
+        step, (caches, logits, scores, tokens0, finished0, lengths0),
+        jnp.arange(max_new_tokens))
+
+    # length-normalized ranking over each hypothesis's ACTUAL length (a
+    # beam frozen at step k is a k-token hypothesis — HF-style scoring)
+    norm = scores / (lengths ** length_penalty)
+    order = jnp.argsort(-norm, axis=1)
+    tokens = jnp.take_along_axis(tokens, order[:, :, None], axis=1)
+    norm = jnp.take_along_axis(norm, order, axis=1)
+    return tokens, norm
+
+
+def beam_search(params, config, prompt_ids, max_new_tokens, num_beams=4,
+                eos_token_id=None, length_penalty=1.0):
+    """Beam-search continuations of ``prompt_ids`` [B, S].
+
+    Returns ``(tokens [B, num_beams, max_new_tokens], scores [B,
+    num_beams])`` sorted best-first; ``scores`` are length-normalized
+    total log-probs. ``eos_token_id`` freezes finished beams. One
+    compiled program per (config, shapes, num_beams, eos)."""
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    total = prompt_ids.shape[1] + int(max_new_tokens)
+    if total > config.max_position_embeddings:
+        raise ValueError(
+            f"prompt + max_new_tokens = {total} exceeds "
+            f"max_position_embeddings={config.max_position_embeddings}")
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if num_beams > config.vocab_size:
+        # the first expansion has only vocab_size finite candidates; wider
+        # widths would return dead-lane garbage hypotheses
+        raise ValueError(
+            f"num_beams={num_beams} exceeds vocab_size={config.vocab_size}")
+    if eos_token_id is not None and not (
+            0 <= int(eos_token_id) < config.vocab_size):
+        raise ValueError(
+            f"eos_token_id={eos_token_id} outside vocab "
+            f"[0, {config.vocab_size}) — EOS freezing would silently never "
+            "trigger")
+    return _beam_jit(
+        params, prompt_ids, config.num_hidden_layers,
+        config.num_attention_heads,
+        config.hidden_size // config.num_attention_heads,
+        int(max_new_tokens), int(num_beams),
+        None if eos_token_id is None else int(eos_token_id),
+        jnp.asarray(length_penalty, jnp.float32))

@@ -103,19 +103,16 @@ def _step(params, nh, caches, token, pos):
     return logits, caches
 
 
-@partial(jax.jit, static_argnames=("n_layers", "n_heads", "head_dim",
-                                   "max_new_tokens", "greedy"))
-def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
-                  max_new_tokens, greedy, temperature, rng):
+def _prefill(params, prompt_ids, n_layers, n_heads, head_dim, total):
+    """Allocate the KV caches for ``total`` positions and scan the prompt
+    through them (same step as decode). Only the LAST position's logits
+    matter — carried in the scan state instead of stacking [S, B, V]
+    outputs (S x B x vocab f32 would dwarf the KV cache for long
+    prompts). Shared by every decode mode (greedy/sampling/beam)."""
     B, S = prompt_ids.shape
-    total = S + max_new_tokens
     shape = (n_layers, B, n_heads, total, head_dim)
     caches = (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
 
-    # prefill: scan the prompt through the cache (same step as decode).
-    # Only the LAST position's logits matter — carry them instead of
-    # stacking [S, B, V] scan outputs (S x B x vocab f32 would dwarf the
-    # KV cache for long prompts)
     def prefill_body(carry, pos):
         caches, _ = carry
         logits, caches = _step(params, n_heads, caches, prompt_ids[:, pos], pos)
@@ -124,6 +121,17 @@ def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
     V = vocab_size(params["params"]["transformer"]["wte"])
     (caches, last_logits), _ = jax.lax.scan(
         prefill_body, (caches, jnp.zeros((B, V), jnp.float32)), jnp.arange(S))
+    return caches, last_logits
+
+
+@partial(jax.jit, static_argnames=("n_layers", "n_heads", "head_dim",
+                                   "max_new_tokens", "greedy"))
+def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
+                  max_new_tokens, greedy, temperature, rng):
+    B, S = prompt_ids.shape
+    total = S + max_new_tokens
+    caches, last_logits = _prefill(
+        params, prompt_ids, n_layers, n_heads, head_dim, total)
 
     def decode_body(carry, pos):
         caches, logits, rng = carry

@@ -1,0 +1,89 @@
+"""Beam search over the KV-cache decode (inference/beam.py).
+
+Oracles: num_beams=1 == greedy generate; an EXHAUSTIVE brute force over
+all 2-token continuations (tiny vocab) must match beam search with
+W=vocab, which is exact at that depth."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference import generate
+from deepspeed_tpu.inference.beam import beam_search
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel, init_gpt2
+
+
+def _tiny(vocab=16):
+    cfg = GPT2Config(
+        vocab_size=vocab, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=32,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    model, params = init_gpt2(cfg, batch_size=2, seq_len=4, seed=0)
+    return cfg, model, params
+
+
+def test_beam1_equals_greedy():
+    cfg, _, params = _tiny()
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 4)), jnp.int32)
+    toks, scores = beam_search(params, cfg, prompt, 6, num_beams=1)
+    want = generate(params, cfg, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(toks[:, 0]), np.asarray(want))
+    assert np.all(np.isfinite(np.asarray(scores)))
+
+
+def test_beam_exact_vs_brute_force():
+    """W = vocab makes 2-token beam search exhaustive: must find the true
+    argmax over all vocab^2 continuations, scored by the full forward."""
+    V = 8
+    cfg, model, params = _tiny(vocab=V)
+    prompt = jnp.asarray([[3, 1, 4]], jnp.int32)
+
+    toks, scores = beam_search(params, cfg, prompt, 2, num_beams=V)
+
+    # brute force: total log-prob of every (a, b) continuation
+    best, best_lp = None, -np.inf
+    logits0 = model.apply(params, prompt, deterministic=True)
+    lp0 = jax.nn.log_softmax(logits0[0, -1].astype(jnp.float32))
+    for a in range(V):
+        seq = jnp.concatenate([prompt, jnp.asarray([[a]], jnp.int32)], axis=1)
+        logits1 = model.apply(params, seq, deterministic=True)
+        lp1 = jax.nn.log_softmax(logits1[0, -1].astype(jnp.float32))
+        for b in range(V):
+            total = float(lp0[a]) + float(lp1[b])
+            if total > best_lp:
+                best_lp, best = total, (a, b)
+
+    assert tuple(np.asarray(toks[0, 0])) == best
+    # scores are length-normalized total log-probs
+    np.testing.assert_allclose(float(scores[0, 0]), best_lp / 2, rtol=1e-4)
+    # returned beams are sorted best-first
+    s = np.asarray(scores[0])
+    assert np.all(s[:-1] >= s[1:] - 1e-7)
+
+
+def test_beam_eos_freezes():
+    """A beam that emits EOS stays frozen: subsequent slots hold EOS and
+    the score stops accumulating (finished beams still rank)."""
+    cfg, _, params = _tiny()
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    eos = int(np.asarray(generate(params, cfg, prompt, 1))[0, 0])  # the
+    # greedy first token WILL be emitted by the best beam -> it finishes
+    toks, scores = beam_search(params, cfg, prompt, 5, num_beams=3,
+                               eos_token_id=eos)
+    row = np.asarray(toks[0])
+    done = row == eos
+    for w in range(row.shape[0]):
+        hit = np.argmax(done[w]) if done[w].any() else None
+        if hit is not None:
+            assert np.all(row[w, hit:] == eos), row[w]
+
+
+def test_beam_validation():
+    cfg, _, params = _tiny()
+    with pytest.raises(ValueError, match="num_beams"):
+        beam_search(params, cfg, jnp.zeros((1, 2), jnp.int32), 2, num_beams=0)
+    with pytest.raises(ValueError, match="max_position"):
+        beam_search(params, cfg, jnp.zeros((1, 30), jnp.int32), 10)
