@@ -103,6 +103,39 @@ def _step(params, nh, caches, token, pos):
     return logits, caches
 
 
+def filter_logits(logits, top_k=0, top_p=1.0):
+    """Standard sampling controls, jit-traceable with TRACED knobs (no
+    recompile per value): keep the top_k highest logits (0 = disabled),
+    THEN the smallest set whose renormalized probabilities reach top_p
+    (1.0 = disabled) — the sequential HF warper semantics, so top_p mass
+    is computed over the top_k survivors. Everything else goes to -inf.
+
+    Call AFTER temperature scaling (nucleus mass is defined on the
+    distribution actually sampled), as the decode path does."""
+    logits = logits.astype(jnp.float32)
+    NEG = jnp.asarray(-1e30, jnp.float32)
+    V = logits.shape[-1]
+
+    order = jnp.argsort(-logits, axis=-1)                  # desc
+    ranks = jnp.argsort(order, axis=-1)                    # rank of each id
+
+    # top-k: rank must be < k (k<=0 disables)
+    k = jnp.where(top_k > 0, top_k, V)
+    logits = jnp.where(ranks < k, logits, NEG)
+
+    # top-p over the top_k SURVIVORS (softmax renormalizes over them):
+    # keep ids whose exclusive cumulative prob is < top_p — the best
+    # token always survives; top_p >= 1 is an exact no-op (fp32 cumsum
+    # error over a big vocab could otherwise mask tail tokens)
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs               # exclusive
+    keep_sorted = (cum < top_p) | (top_p >= 1.0)
+    keep = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
+
+    return jnp.where(keep, logits, NEG)
+
+
 def _prefill(params, prompt_ids, n_layers, n_heads, head_dim, total):
     """Allocate the KV caches for ``total`` positions and scan the prompt
     through them (same step as decode). Only the LAST position's logits
@@ -125,9 +158,10 @@ def _prefill(params, prompt_ids, n_layers, n_heads, head_dim, total):
 
 
 @partial(jax.jit, static_argnames=("n_layers", "n_heads", "head_dim",
-                                   "max_new_tokens", "greedy"))
+                                   "max_new_tokens", "greedy", "filtered"))
 def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
-                  max_new_tokens, greedy, temperature, rng):
+                  max_new_tokens, greedy, filtered, temperature, top_k,
+                  top_p, rng):
     B, S = prompt_ids.shape
     total = S + max_new_tokens
     caches, last_logits = _prefill(
@@ -138,11 +172,17 @@ def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
         if greedy:
             token = jnp.argmax(logits, axis=-1)
         else:
-            # temperature is a TRACED operand: sweeping it reuses one
-            # compiled program instead of recompiling per value
+            # temperature/top_k/top_p are TRACED operands: sweeping them
+            # reuses one compiled program instead of recompiling per
+            # value. ``filtered`` is STATIC so plain temperature sampling
+            # never pays the per-token argsort/cumsum machinery.
             rng, sub = jax.random.split(rng)
-            token = jax.random.categorical(
-                sub, logits.astype(jnp.float32) / temperature, axis=-1)
+            scaled = logits.astype(jnp.float32) / temperature
+            if filtered:
+                # temperature FIRST: the nucleus is taken over the
+                # distribution actually sampled (HF warper order)
+                scaled = filter_logits(scaled, top_k, top_p)
+            token = jax.random.categorical(sub, scaled, axis=-1)
         logits, caches = _step(params, n_heads, caches, token, pos)
         return (caches, logits, rng), token
 
@@ -152,17 +192,25 @@ def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
 
 
 def generate(params, config, prompt_ids, max_new_tokens, temperature=0.0,
-             rng=None):
+             rng=None, top_k=0, top_p=1.0):
     """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, S].
 
     ``temperature=0`` -> greedy argmax; otherwise categorical sampling
-    with ``rng`` (required). Returns the new tokens [B, max_new_tokens].
+    with ``rng`` (required), optionally filtered by ``top_k`` (keep the k
+    best ids; 0 disables) and/or ``top_p`` (nucleus: smallest set with
+    cumulative probability >= top_p; 1.0 disables) — both traced, so
+    sweeping them reuses one program. Returns [B, max_new_tokens].
     One compiled program per (config, shapes, greedy-vs-sampling) —
     nonzero temperatures share a program."""
     if temperature < 0.0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature != 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
+    if top_k < 0 or top_k > config.vocab_size:
+        raise ValueError(f"top_k must be in [0, {config.vocab_size}], "
+                         f"got {top_k}")
+    if not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
@@ -179,7 +227,10 @@ def generate(params, config, prompt_ids, max_new_tokens, temperature=0.0,
         config.num_attention_heads,
         config.hidden_size // config.num_attention_heads,
         int(max_new_tokens), temperature == 0.0,
-        jnp.asarray(max(temperature, 1e-8), jnp.float32), rng)
+        top_k > 0 or top_p < 1.0,
+        jnp.asarray(max(temperature, 1e-8), jnp.float32),
+        jnp.asarray(int(top_k), jnp.int32),
+        jnp.asarray(float(top_p), jnp.float32), rng)
 
 
 def greedy_generate(params, config, prompt_ids, max_new_tokens):

@@ -137,3 +137,47 @@ def test_generate_batch_independence():
     solo1 = generate(params, cfg, prompt[1:], 5)
     np.testing.assert_array_equal(np.asarray(both[0]), np.asarray(solo0[0]))
     np.testing.assert_array_equal(np.asarray(both[1]), np.asarray(solo1[0]))
+
+
+def test_filter_logits_topk_topp():
+    from deepspeed_tpu.inference.generation import filter_logits
+
+    logits = jnp.asarray([[3.0, 1.0, 2.0, 0.0]])
+    # top_k=2 keeps ids 0 and 2
+    out = np.asarray(filter_logits(logits, top_k=2))
+    assert out[0, 0] == 3.0 and out[0, 2] == 2.0
+    assert out[0, 1] < -1e20 and out[0, 3] < -1e20
+    # top_k=0 / top_p=1.0 disabled: unchanged
+    np.testing.assert_array_equal(
+        np.asarray(filter_logits(logits)), np.asarray(logits))
+    # top_p: probs ~ [.66, .09, .24, .03] sorted desc [.66, .24, .09, .03];
+    # top_p=0.7 keeps the first two (exclusive cum .0, .66 < .7)
+    out = np.asarray(filter_logits(logits, top_p=0.7))
+    assert out[0, 0] == 3.0 and out[0, 2] == 2.0
+    assert out[0, 1] < -1e20 and out[0, 3] < -1e20
+    # the best token always survives even a tiny top_p
+    out = np.asarray(filter_logits(logits, top_p=1e-9))
+    assert out[0, 0] == 3.0 and (out[0, 1:] < -1e20).all()
+
+
+def test_generate_topk1_matches_greedy():
+    """top_k=1 sampling collapses to greedy regardless of temperature."""
+    cfg = _tiny_config()
+    _, params = init_gpt2(cfg, batch_size=1, seq_len=4, seed=6)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    greedy = generate(params, cfg, prompt, 6)
+    sampled = generate(params, cfg, prompt, 6, temperature=1.5,
+                       rng=jax.random.PRNGKey(3), top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+
+def test_generate_sampling_knob_validation():
+    cfg = _tiny_config()
+    _, params = init_gpt2(cfg, batch_size=1, seq_len=2, seed=6)
+    p = jnp.zeros((1, 2), jnp.int32)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(params, cfg, p, 2, temperature=1.0,
+                 rng=jax.random.PRNGKey(0), top_k=-1)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(params, cfg, p, 2, temperature=1.0,
+                 rng=jax.random.PRNGKey(0), top_p=0.0)
