@@ -198,10 +198,11 @@ def generate(params, config, prompt_ids, max_new_tokens, temperature=0.0,
     ``temperature=0`` -> greedy argmax; otherwise categorical sampling
     with ``rng`` (required), optionally filtered by ``top_k`` (keep the k
     best ids; 0 disables) and/or ``top_p`` (nucleus: smallest set with
-    cumulative probability >= top_p; 1.0 disables) — both traced, so
-    sweeping them reuses one program. Returns [B, max_new_tokens].
-    One compiled program per (config, shapes, greedy-vs-sampling) —
-    nonzero temperatures share a program."""
+    cumulative probability >= top_p; 1.0 disables). The knob VALUES are
+    traced (sweeps share a program); crossing the filters-disabled /
+    enabled boundary is one extra compile (static, keeps plain sampling
+    off the argsort path). Returns [B, max_new_tokens]. One compiled
+    program per (config, shapes, greedy-vs-sampling, filtering on/off)."""
     if temperature < 0.0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature != 0.0 and rng is None:

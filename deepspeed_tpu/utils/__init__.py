@@ -2,7 +2,7 @@
 
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.distributed import init_distributed
-from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+from deepspeed_tpu.runtime.dataloader import PrefetchLoader, RepeatingLoader
 from deepspeed_tpu.utils.zero_to_fp32 import (
     convert_zero_checkpoint_to_fp32_state_dict,
     get_fp32_state_dict_from_zero_checkpoint,
