@@ -110,18 +110,12 @@ def model_parallel_cuda_manual_seed(seed):
 # ---------------------------------------------------------------------------
 
 def _remat_policy():
-    """Derive the jax.checkpoint policy from configured flags."""
-    cps = jax.checkpoint_policies
+    """Derive the jax.checkpoint policy from configured flags: with
+    cpu_checkpointing, the SAME offload policy the engine uses (matmul
+    outputs saved to pinned_host — one implementation of the flag)."""
     if _CPU_CHECKPOINT:
-        try:
-            return cps.save_and_offload_only_these_names(
-                names_which_can_be_saved=[],
-                names_which_can_be_offloaded=[],
-                offload_src="device", offload_dst="pinned_host",
-            )
-        except Exception:
-            return cps.nothing_saveable
-    return cps.nothing_saveable
+        return resolve_remat_policy("offload_dots")
+    return jax.checkpoint_policies.nothing_saveable
 
 
 def checkpoint(function, *args):
@@ -148,18 +142,29 @@ def checkpoint_wrapper(fn):
 
 # Named remat policies shared by the model configs (BertConfig/GPT2Config
 # checkpoint_policy): ONE vocabulary and mapping, so models can't drift.
-REMAT_POLICIES = ("nothing", "dots")
+REMAT_POLICIES = ("nothing", "dots", "offload_dots")
 
 
 def resolve_remat_policy(name):
     """checkpoint_policy name -> jax.checkpoint policy (None = save nothing).
-    'dots' saves matmul outputs so backward recomputes only elementwise ops."""
+
+    - 'nothing': full recompute (minimum memory, maximum FLOPs)
+    - 'dots': save matmul outputs in HBM; backward recomputes only
+      elementwise ops
+    - 'offload_dots': save matmul outputs to HOST memory (pinned_host) —
+      the reference's ``cpu_checkpointing``/PA_TO_CPU realized natively:
+      activations leave HBM between forward and backward, XLA schedules
+      the D2H/H2D transfers
+    """
     if name not in REMAT_POLICIES:
         raise ValueError(
             f"checkpoint_policy must be one of {REMAT_POLICIES}, got {name!r}"
         )
     if name == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if name == "offload_dots":
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
     return None
 
 

@@ -199,9 +199,16 @@ class DeepSpeedEngine:
         from deepspeed_tpu.runtime.activation_checkpointing import (
             checkpointing as _ckpt_mod,
         )
+        from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+            resolve_remat_policy,
+        )
 
         _ckpt_mod.configure(mpu, deepspeed_config=self._config._param_dict)
         self._remat_apply_fn = False
+        # cpu_checkpointing (reference PA_TO_CPU): checkpointed activations
+        # live in HOST memory between forward and backward instead of HBM
+        ac_cfg = self._config.activation_checkpointing_config
+        offload_acts = ac_cfg.enabled and ac_cfg.cpu_checkpointing
         if self._config.activation_checkpointing_config.enabled:
             applied = False
             mcfg = getattr(self.module, "config", None)
@@ -226,12 +233,37 @@ class DeepSpeedEngine:
                     applied = True
                 except (AttributeError, TypeError, dataclasses.FrozenInstanceError):
                     pass
+                if applied and offload_acts:
+                    # separate guard: a failure here must NOT undo
+                    # `applied` (per-layer remat is active either way;
+                    # falling through would stack whole-apply remat on top)
+                    try:
+                        assert hasattr(mcfg, "checkpoint_policy")
+                        mcfg.checkpoint_policy = "offload_dots"
+                        log_dist(
+                            "cpu_checkpointing: checkpoint_policy="
+                            "'offload_dots' — saved activations go to host "
+                            "memory (pinned_host)", ranks=[0])
+                    except (AssertionError, AttributeError, TypeError,
+                            dataclasses.FrozenInstanceError):
+                        logger.warning(
+                            "cpu_checkpointing requested but "
+                            f"{type(mcfg).__name__} exposes no settable "
+                            "checkpoint_policy — activations stay in HBM "
+                            "(per-layer remat still active)")
             if not applied:
                 # Generic fallback: remat the whole apply_fn. Backward then
-                # recomputes the forward instead of saving its intermediates.
+                # recomputes the forward instead of saving its intermediates
+                # (offloading what the policy marks saveable when
+                # cpu_checkpointing is on).
                 self._remat_apply_fn = True
+                self._remat_fallback_policy = (
+                    resolve_remat_policy("offload_dots") if offload_acts
+                    else None)
                 log_dist("activation checkpointing: wrapping model apply in "
-                         "jax.checkpoint (model exposes no per-layer switch)",
+                         "jax.checkpoint (model exposes no per-layer switch)"
+                         + (" with host-offloaded saves" if offload_acts
+                            else ""),
                          ranks=[0])
 
         # --- timers -------------------------------------------------------
@@ -705,8 +737,11 @@ class DeepSpeedEngine:
                 if remat:
                     # config-driven activation checkpointing (engine-level
                     # fallback; per-layer remat preferred when the model
-                    # exposes a switch — see __init__)
-                    run = jax.checkpoint(run, prevent_cse=False)
+                    # exposes a switch — see __init__); cpu_checkpointing
+                    # offloads the policy's saves to host memory
+                    run = jax.checkpoint(
+                        run, prevent_cse=False,
+                        policy=getattr(self, "_remat_fallback_policy", None))
                 out = run(p_c, *batch)
                 loss = out[0] if isinstance(out, tuple) else out
                 return loss.astype(jnp.float32) * scale

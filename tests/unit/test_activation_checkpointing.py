@@ -9,6 +9,9 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+    resolve_remat_policy,
+)
 
 
 @pytest.fixture(autouse=True)
@@ -163,3 +166,94 @@ def test_engine_flips_model_config_switch():
     )
     assert cfg.checkpoint_activations, "engine did not flip the model switch"
     assert not engine._remat_apply_fn
+
+
+def test_offload_dots_policy_resolves_and_runs():
+    """'offload_dots' (cpu_checkpointing realized): saved matmul outputs go
+    to pinned_host; grads equal the in-HBM 'dots' policy exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    pol = resolve_remat_policy("offload_dots")
+    assert pol is not None
+
+    w = jnp.ones((32, 32)) * 0.01
+    x = jnp.ones((4, 32))
+
+    def block(h, w):
+        return jnp.tanh(jnp.tanh(h @ w) @ w.T)
+
+    def loss(w, policy):
+        f = jax.checkpoint(lambda h: block(h, w), policy=policy)
+        return jnp.sum(f(x) ** 2)
+
+    g_off = jax.jit(jax.grad(lambda w: loss(w, pol)))(w)
+    g_dots = jax.jit(jax.grad(
+        lambda w: loss(w, resolve_remat_policy("dots"))))(w)
+    np.testing.assert_array_equal(np.asarray(g_off), np.asarray(g_dots))
+
+
+def test_engine_cpu_checkpointing_fallback_numerics():
+    """cpu_checkpointing on the engine fallback path: the traced step keeps
+    its remat and training matches the plain engine."""
+    import jax
+    import deepspeed_tpu
+    from tests.unit.simple_model import create_simple_model
+
+    model, params = create_simple_model(hidden_dim=16, seed=3)
+    e_off, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params=_engine_cfg(activation_checkpointing={
+            "enabled": True, "cpu_checkpointing": True}),
+    )
+    assert e_off._remat_apply_fn
+    assert e_off._remat_fallback_policy is not None
+
+    model2, params2 = create_simple_model(hidden_dim=16, seed=3)
+    e_plain, _, _, _ = deepspeed_tpu.initialize(
+        model=model2, model_parameters=params2, config_params=_engine_cfg(),
+    )
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(8, 16).astype(np.float32),
+             rng.randn(8, 16).astype(np.float32)) for _ in range(3)]
+    la = [float(jax.device_get(e_off.train_step([mb]))) for mb in data]
+    lb = [float(jax.device_get(e_plain.train_step([mb]))) for mb in data]
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
+
+
+def test_engine_cpu_checkpointing_sets_model_policy():
+    """Model path: cpu_checkpointing switches the model's checkpoint_policy
+    to 'offload_dots' and training still converges."""
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu
+    from deepspeed_tpu.models.bert import BertConfig, BertForPreTraining
+
+    cfg = BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=32,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    model = BertForPreTraining(cfg)
+    rng = np.random.RandomState(0)
+    B, S = 8, 16
+    batch = (
+        rng.randint(0, 64, (B, S)).astype(np.int32),
+        np.zeros((B, S), np.int32),
+        np.ones((B, S), np.int32),
+        np.where(rng.rand(B, S) < 0.15,
+                 rng.randint(0, 64, (B, S)), -1).astype(np.int32),
+        rng.randint(0, 2, (B,)).astype(np.int32),
+    )
+    params = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        *[jnp.asarray(a) for a in batch])
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params=_engine_cfg(activation_checkpointing={
+            "enabled": True, "cpu_checkpointing": True}),
+    )
+    assert cfg.checkpoint_activations
+    assert cfg.checkpoint_policy == "offload_dots"
+    loss = engine.train_step([batch])
+    assert np.isfinite(float(jax.device_get(loss)))
