@@ -500,6 +500,12 @@ def main():
                 bench_done = True
             else:
                 log(f"bench FAILED: {err or res}")
+        # sweep IMMEDIATELY after the headline bench: it can RAISE the
+        # headline (VERDICT item 1), which outranks the secondary legs
+        # (seq512/gpt2, item 2) and the multi-hour longseq (item 5) — on a
+        # flaky tunnel the highest-value leg gets the window first
+        if bench_done and not sweep_done:
+            sweep_done = run_sweep()
         if bench_done and not seq512_done:
             # secondary headline: seq512 (reference: 53 Tflops / 52
             # samples/sec on V100, fastest-bert post :38-39). mb ladder
@@ -540,11 +546,6 @@ def main():
                 ab_done = True
             else:
                 log(f"attention A/B FAILED: {err}")
-        # sweep BEFORE longseq: the sweep can raise the headline number
-        # (VERDICT item 1) while longseq (item 5) can take hours of cells —
-        # on a flaky tunnel the high-value leg must get the window first
-        if bench_done and not sweep_done:
-            sweep_done = run_sweep()
         if bench_done and not longseq_done:
             ok2, err = run_longseq()
             if ok2:
