@@ -214,6 +214,15 @@ class PipelineEngine:
 
         self.monitor = monitor_from_config(self._config, dist.get_rank())
 
+        # curriculum learning (beyond the v0.3.10 reference) — same wiring
+        # as DeepSpeedEngine so the config section works under pipelines too
+        self.curriculum_scheduler = None
+        if self._config.curriculum_enabled:
+            from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(
+                self._config.curriculum_params)
+
         log_dist(
             f"PipelineEngine: stages={self.num_stages} dp={self.dp_world_size} "
             f"micro_batches={self.micro_batches}\n{model.describe_partitions()}",
@@ -1234,6 +1243,8 @@ class PipelineEngine:
             if self.lr_scheduler is not None and not self._last_overflow:
                 # reference holds the lr schedule on overflow-skipped steps
                 self.lr_scheduler.step()
+            if self.curriculum_scheduler is not None:
+                self.curriculum_scheduler.update_difficulty(self.global_steps)
             if self.monitor is not None:
                 self.monitor.record("Train/Samples/train_loss", self.agg_train_loss, self.global_samples)
                 self.monitor.record("Train/Samples/lr", self.get_lr()[0], self.global_samples)
@@ -1262,6 +1273,8 @@ class PipelineEngine:
         self.agg_train_loss = float(np.mean([float(jax.device_get(l)) for l in self._losses]))
         self.global_steps += 1
         self.global_samples += self.micro_batch_size * self.micro_batches * self.dp_world_size
+        if self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.update_difficulty(self.global_steps)
         if self.monitor is not None:
             self.monitor.record("Train/Samples/train_loss", self.agg_train_loss, self.global_samples)
             self.monitor.record("Train/Samples/lr", self.get_lr()[0], self.global_samples)
@@ -1607,6 +1620,14 @@ class PipelineEngine:
                 return self.lr_scheduler.get_lr()
         return [getattr(self.basic_optimizer, "lr", 1e-3)]
 
+    def curriculum_enabled(self):
+        return self.curriculum_scheduler is not None
+
+    def curriculum_difficulty(self):
+        """Current curriculum difficulty (DeepSpeedEngine-parity surface)."""
+        assert self.curriculum_scheduler is not None, "curriculum not enabled"
+        return self.curriculum_scheduler.current_difficulty
+
     def train_micro_batch_size_per_gpu(self):
         return self.micro_batch_size
 
@@ -1867,6 +1888,9 @@ class PipelineEngine:
         self._stage_params_stale = False
         self.global_steps = meta["global_steps"]
         self.global_samples = meta["global_samples"]
+        if self.curriculum_scheduler is not None:
+            # difficulty is a pure function of the step — recompute on resume
+            self.curriculum_scheduler.update_difficulty(self.global_steps)
         if meta.get("scaler_state") is not None:
             saved = meta["scaler_state"]
             self.scaler_state = type(self.scaler_state)(

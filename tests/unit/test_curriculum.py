@@ -129,3 +129,35 @@ def test_engine_without_curriculum(tmpdir):
     assert not engine.curriculum_enabled()
     with pytest.raises(AssertionError):
         engine.curriculum_difficulty()
+
+
+def test_pipeline_engine_wiring():
+    """The curriculum section works under PipelineEngine too (same surface
+    as DeepSpeedEngine — a config feature must not silently no-op under a
+    different engine)."""
+    import jax
+
+    import deepspeed_tpu
+    from tests.unit.test_pipe import ds_config, make_data, make_module
+
+    cfg = ds_config(dp=2)
+    cfg["curriculum_learning"] = {
+        "enabled": True,
+        "min_difficulty": 4,
+        "max_difficulty": 16,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 3,
+                            "difficulty_step": 4},
+    }
+    module = make_module(4)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=module, config_params=cfg)
+    assert engine.curriculum_enabled()
+    assert engine.curriculum_difficulty() == 4
+
+    it = iter(make_data(8, 8))
+    difficulties = []
+    for _ in range(3):
+        engine.train_batch(it)
+        difficulties.append(engine.curriculum_difficulty())
+    assert difficulties == sorted(difficulties)
+    assert difficulties[-1] == 16
