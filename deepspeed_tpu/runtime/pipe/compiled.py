@@ -118,7 +118,8 @@ def unstack_stage_params(stacked):
     ]
 
 
-def build_pipeline_loss(block_fn, loss_fn, mesh, num_micro, remat=True):
+def build_pipeline_loss(block_fn, loss_fn, mesh, num_micro, remat=True,
+                        remat_policy=None):
     """Return ``fn(stacked_params, aux_params, x0, labels, rng) -> mean loss``.
 
     - ``block_fn(stage_params, x, rng)``: one stage's computation (output
@@ -132,7 +133,8 @@ def build_pipeline_loss(block_fn, loss_fn, mesh, num_micro, remat=True):
     S = mesh.shape[PIPE_AXIS]
     M = num_micro
     T = M + S - 1
-    block = jax.checkpoint(block_fn) if remat else block_fn
+    block = (jax.checkpoint(block_fn, policy=remat_policy)
+             if remat else block_fn)
     P = PartitionSpec
 
     def pipelined(stacked_params, aux_params, x0, labels, rng):
@@ -172,7 +174,7 @@ def build_pipeline_loss(block_fn, loss_fn, mesh, num_micro, remat=True):
 
 
 def build_pipeline_loss_hetero(first_fn, block_fn, last_loss_fn, mesh, num_micro,
-                               remat=True):
+                               remat=True, remat_policy=None):
     """Heterogeneous-stage pipelined loss (generalizes ``build_pipeline_loss``
     to embedding/head stages and tied weights — reference tied-layer grads,
     pipe/module.py:405-474, pipe/engine.py:208).
@@ -200,7 +202,8 @@ def build_pipeline_loss_hetero(first_fn, block_fn, last_loss_fn, mesh, num_micro
     S = mesh.shape[PIPE_AXIS]
     M = num_micro
     T = M + S - 1
-    block = jax.checkpoint(block_fn) if remat else block_fn
+    block = (jax.checkpoint(block_fn, policy=remat_policy)
+             if remat else block_fn)
     P = PartitionSpec
 
     def pipelined(stacked_params, aux_params, x0, labels, rng):
@@ -258,7 +261,8 @@ def build_pipeline_loss_hetero(first_fn, block_fn, last_loss_fn, mesh, num_micro
 
 def build_pipeline_train_step(block_fn, loss_fn, optimizer, mesh, num_micro,
                               clip_grad=0.0, remat=True, fp16=False,
-                              dynamic=False, scaler_kwargs=None):
+                              dynamic=False, scaler_kwargs=None,
+                              remat_policy=None):
     """Fused pipelined train step: loss + backward pipeline + per-stage update
     in one jitted program with donated params/optimizer state.
 
@@ -273,7 +277,8 @@ def build_pipeline_train_step(block_fn, loss_fn, optimizer, mesh, num_micro,
     advances — the reference FP16_Optimizer semantics inside the pipeline
     program.
     """
-    fn = build_pipeline_loss(block_fn, loss_fn, mesh, num_micro, remat=remat)
+    fn = build_pipeline_loss(block_fn, loss_fn, mesh, num_micro, remat=remat,
+                             remat_policy=remat_policy)
     loss_grad = jax.value_and_grad(
         lambda sp, ap, x0, lb, rng, scale: fn(sp, ap, x0, lb, rng) * scale,
         argnums=(0, 1),
@@ -285,12 +290,14 @@ def build_pipeline_train_step(block_fn, loss_fn, optimizer, mesh, num_micro,
 
 def build_pipeline_train_step_hetero(first_fn, block_fn, last_loss_fn, optimizer,
                                      mesh, num_micro, clip_grad=0.0, remat=True,
-                                     fp16=False, dynamic=False, scaler_kwargs=None):
+                                     fp16=False, dynamic=False, scaler_kwargs=None,
+                                     remat_policy=None):
     """Fused pipelined train step over the heterogeneous executor; same
     (stacked, aux, opt_state, scaler_state, x0, labels, rng, lr) signature as
     the homogeneous variant so the engine can use either interchangeably."""
     fn = build_pipeline_loss_hetero(first_fn, block_fn, last_loss_fn, mesh,
-                                    num_micro, remat=remat)
+                                    num_micro, remat=remat,
+                                    remat_policy=remat_policy)
     loss_grad = jax.value_and_grad(
         lambda sp, ap, x0, lb, rng, scale: fn(sp, ap, x0, lb, rng) * scale,
         argnums=(0, 1),

@@ -223,6 +223,36 @@ class PipelineEngine:
             self.curriculum_scheduler = CurriculumScheduler(
                 self._config.curriculum_params)
 
+        # activation checkpointing under pipelines: the compiled executor
+        # ALWAYS remats each block (per-layer jax.checkpoint inside the
+        # scan+ppermute program — "enabled" is inherent to the design);
+        # what the config controls here is the remat POLICY:
+        # cpu_checkpointing saves the policy's activations to HOST memory.
+        ac_cfg = self._config.activation_checkpointing_config
+        self._remat_policy = None
+        if ac_cfg.enabled and ac_cfg.cpu_checkpointing:
+            from deepspeed_tpu.runtime.activation_checkpointing.checkpointing import (
+                resolve_remat_policy,
+            )
+
+            self._remat_policy = resolve_remat_policy("offload_dots")
+            log_dist(
+                "pipeline cpu_checkpointing: compiled executor's per-block "
+                "remat saves matmul outputs to host memory (pinned_host)",
+                ranks=[0])
+
+        # engine-only config sections must not silently no-op here
+        if getattr(self._config, "flops_profiler_config", None) is not None \
+                and getattr(self._config.flops_profiler_config, "enabled", False):
+            logger.warning(
+                "flops_profiler is not implemented for PipelineEngine "
+                "(per-module attribution works on DeepSpeedEngine's forward "
+                "graph) — section ignored")
+        if getattr(self._config, "sparse_gradients_enabled", False):
+            logger.warning(
+                "sparse_gradients (CSR embedding grads) is a DeepSpeedEngine "
+                "path — section ignored under PipelineEngine")
+
         log_dist(
             f"PipelineEngine: stages={self.num_stages} dp={self.dp_world_size} "
             f"micro_batches={self.micro_batches}\n{model.describe_partitions()}",
@@ -709,6 +739,7 @@ class PipelineEngine:
                 self.micro_batches, clip_grad=clip,
                 fp16=self._fp16, dynamic=self._dynamic_scale,
                 scaler_kwargs=self._scaler_kwargs,
+                remat_policy=self._remat_policy,
             )
         else:
             per_layer = self._gather_layer_params()
@@ -723,6 +754,7 @@ class PipelineEngine:
                 self.micro_batches, clip_grad=clip,
                 fp16=self._fp16, dynamic=self._dynamic_scale,
                 scaler_kwargs=self._scaler_kwargs,
+                remat_policy=self._remat_policy,
             )
 
         opt_state = opt.init((stacked, aux))
@@ -1303,11 +1335,13 @@ class PipelineEngine:
         mesh = c["mesh"]
         if c["mode"] == "homog":
             block_fn, aux_loss = self._homog_fns(deterministic=True)
-            ev = C.build_pipeline_loss(block_fn, aux_loss, mesh, self.micro_batches)
+            ev = C.build_pipeline_loss(block_fn, aux_loss, mesh, self.micro_batches,
+                                       remat_policy=self._remat_policy)
         else:
             first_fn, block_fn, last_loss_fn = self._hetero_fns(deterministic=True)
             ev = C.build_pipeline_loss_hetero(
-                first_fn, block_fn, last_loss_fn, mesh, self.micro_batches
+                first_fn, block_fn, last_loss_fn, mesh, self.micro_batches,
+                remat_policy=self._remat_policy,
             )
         c["eval"] = jax.jit(ev)
 
