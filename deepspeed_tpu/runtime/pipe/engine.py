@@ -252,6 +252,12 @@ class PipelineEngine:
             logger.warning(
                 "sparse_gradients (CSR embedding grads) is a DeepSpeedEngine "
                 "path — section ignored under PipelineEngine")
+        if self._config.prescale_gradients or \
+                self._config.gradient_predivide_factor != 1.0:
+            logger.warning(
+                "prescale_gradients/gradient_predivide_factor are applied by "
+                "the flat ZeRO optimizer (DeepSpeedEngine path) — ignored "
+                "under PipelineEngine's per-leaf ZeRO")
 
         log_dist(
             f"PipelineEngine: stages={self.num_stages} dp={self.dp_world_size} "
@@ -1294,6 +1300,18 @@ class PipelineEngine:
                     f"step={self.global_steps}, loss={self.agg_train_loss:.4f}, lr={self.get_lr()}",
                     ranks=[0],
                 )
+                if self._config.wall_clock_breakdown:
+                    # the compiled executor is ONE program — step wall time
+                    # is the only meaningful breakdown granularity
+                    sps = self.tput_timer.avg_samples_per_sec()
+                    if np.isfinite(sps):
+                        log_dist(
+                            f"wall_clock: train_batch {sps:.1f} samples/sec "
+                            "(compiled single-program step)", ranks=[0])
+                if self._config.memory_breakdown:
+                    from deepspeed_tpu.runtime.utils import memory_status
+
+                    memory_status(f"pipe step {self.global_steps}")
                 if self.monitor is not None:
                     self.monitor.flush()
             return self.agg_train_loss
