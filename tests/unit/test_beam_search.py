@@ -87,3 +87,16 @@ def test_beam_validation():
         beam_search(params, cfg, jnp.zeros((1, 2), jnp.int32), 2, num_beams=0)
     with pytest.raises(ValueError, match="max_position"):
         beam_search(params, cfg, jnp.zeros((1, 30), jnp.int32), 10)
+
+
+def test_beam_over_quantized_params():
+    """Beam search runs the same decode step as greedy, so int8-quantized
+    params drop in; W=1 must equal quantized greedy decode."""
+    from deepspeed_tpu.inference import quantize_for_decode
+
+    cfg, _, params = _tiny()
+    q = quantize_for_decode(params)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    toks, _ = beam_search(q, cfg, prompt, 5, num_beams=1)
+    want = generate(q, cfg, prompt, 5)
+    np.testing.assert_array_equal(np.asarray(toks[:, 0]), np.asarray(want))
