@@ -19,7 +19,6 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.inference.generation import _prefill, _step
-from deepspeed_tpu.inference.quantization import vocab_size
 
 
 @partial(jax.jit, static_argnames=("n_layers", "n_heads", "head_dim",
@@ -107,6 +106,11 @@ def beam_search(params, config, prompt_ids, max_new_tokens, num_beams=4,
         raise ValueError(
             f"prompt + max_new_tokens = {total} exceeds "
             f"max_position_embeddings={config.max_position_embeddings}")
+    if max_new_tokens < 1:
+        # zero steps would rank with lengths==0: the length-penalty divide
+        # is 0/0 -> NaN scores and arbitrary hypothesis order
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if num_beams < 1:
         raise ValueError(f"num_beams must be >= 1, got {num_beams}")
     if num_beams > config.vocab_size:

@@ -143,17 +143,26 @@ def _prefill(params, prompt_ids, n_layers, n_heads, head_dim, total):
     outputs (S x B x vocab f32 would dwarf the KV cache for long
     prompts). Shared by every decode mode (greedy/sampling/beam)."""
     B, S = prompt_ids.shape
+    tr = params["params"]["transformer"]
+    # Compute dtype = what `_step` actually produces: int8-quantized tables
+    # dequantize to f32; otherwise the embedding dtype flows through the
+    # residual stream, so a bf16 checkpoint decodes (and caches) in bf16.
+    # Hardcoding f32 here made the cache/carry dtypes disagree with the bf16
+    # k/v slices and logits and crashed at trace time.
+    emb_dtype = (jnp.float32 if "kernel_q" in tr["wte"]
+                 else tr["wte"]["embedding"].dtype)
+    dtype = jnp.result_type(emb_dtype, tr["wpe"]["embedding"].dtype)
     shape = (n_layers, B, n_heads, total, head_dim)
-    caches = (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+    caches = (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
     def prefill_body(carry, pos):
         caches, _ = carry
         logits, caches = _step(params, n_heads, caches, prompt_ids[:, pos], pos)
         return (caches, logits), None
 
-    V = vocab_size(params["params"]["transformer"]["wte"])
+    V = vocab_size(tr["wte"])
     (caches, last_logits), _ = jax.lax.scan(
-        prefill_body, (caches, jnp.zeros((B, V), jnp.float32)), jnp.arange(S))
+        prefill_body, (caches, jnp.zeros((B, V), dtype)), jnp.arange(S))
     return caches, last_logits
 
 

@@ -1,0 +1,47 @@
+"""Fault-tolerant checkpoint subsystem (storage + manifest + fault injection).
+
+Both engines (``runtime/engine.py`` and ``runtime/pipe/engine.py``) route
+their save/load paths through :class:`CheckpointStorage`:
+
+- atomic per-file writes (``.tmp`` -> fsync -> ``os.replace``),
+- a per-tag ``manifest.json`` with crc32/sha256 digests written last as
+  the commit record,
+- bounded retry-with-backoff on transient I/O errors,
+- keep-last-k rotation that never deletes the newest committed tag,
+- load-time verification with automatic fallback to the previous
+  committed tag when the newest is corrupt or partial.
+
+See ``docs/checkpointing.md`` for the protocol and config keys.
+"""
+
+from deepspeed_tpu.runtime.checkpoint.fault_injection import (
+    ENV_VAR as FAULT_ENV_VAR,
+    FaultInjector,
+    InjectedCrash,
+    InjectedFault,
+)
+from deepspeed_tpu.runtime.checkpoint.manifest import (
+    MANIFEST_NAME,
+    CheckpointCorruptionError,
+    read_manifest,
+    verify_tag_dir,
+)
+from deepspeed_tpu.runtime.checkpoint.storage import (
+    CheckpointConfig,
+    CheckpointStorage,
+    TagWriter,
+)
+
+__all__ = [
+    "CheckpointConfig",
+    "CheckpointCorruptionError",
+    "CheckpointStorage",
+    "FaultInjector",
+    "FAULT_ENV_VAR",
+    "InjectedCrash",
+    "InjectedFault",
+    "MANIFEST_NAME",
+    "TagWriter",
+    "read_manifest",
+    "verify_tag_dir",
+]

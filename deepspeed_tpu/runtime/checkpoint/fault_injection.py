@@ -1,0 +1,149 @@
+"""Deterministic I/O fault injection for the checkpoint storage layer.
+
+Used by the fault-injection test suite (and reproducible by hand via an
+env var) to prove the atomic-commit protocol: for EVERY crash point the
+save either commits fully or leaves the previous committed tag loadable.
+
+Fault points the storage layer consults (see storage.py):
+
+    tmp_write        opening/writing a shard's .tmp file
+    fsync            fsync of any .tmp file before rename
+    rename           os.replace of a shard .tmp into place
+    manifest_write   writing manifest.json.tmp (the commit record)
+    manifest_rename  os.replace of manifest.json.tmp (the commit point)
+    latest_write     writing the 'latest' convenience pointer
+    read             reading any checkpoint file back
+
+Modes:
+
+    crash       raise InjectedCrash before the op (simulated preemption;
+                never retried)
+    transient   raise OSError(EIO) for the first ``times`` hits, then
+                succeed (exercises retry-with-backoff)
+    after_bytes crash after exactly N bytes of the payload reached the
+                .tmp file (torn/truncated write)
+
+Programmatic::
+
+    fi = FaultInjector()
+    fi.arm("rename", mode="crash")
+    fi.arm("tmp_write", after_bytes=10)
+    fi.arm("fsync", mode="transient", times=2)
+
+Env (``DS_TPU_CKPT_FAULTS``), ';'-separated::
+
+    DS_TPU_CKPT_FAULTS="rename:crash;tmp_write:crash:after_bytes=10"
+
+Config (``checkpoint.fault_injection`` section)::
+
+    {"checkpoint": {"fault_injection": {"rename": {"mode": "crash"}}}}
+"""
+
+import errno
+import os
+
+ENV_VAR = "DS_TPU_CKPT_FAULTS"
+
+
+class InjectedCrash(RuntimeError):
+    """Simulated hard crash (preemption) at a fault point. Deliberately
+    NOT an OSError so the storage retry loop never swallows it."""
+
+
+class InjectedFault(OSError):
+    """Simulated transient I/O error; carries EIO so the storage layer's
+    retry-with-backoff treats it like a real flaky disk."""
+
+    def __init__(self, point):
+        super().__init__(errno.EIO, f"injected transient EIO at '{point}'")
+
+
+class _Arm:
+    __slots__ = ("mode", "times", "after_bytes")
+
+    def __init__(self, mode="crash", times=1, after_bytes=None):
+        if mode not in ("crash", "transient"):
+            raise ValueError(f"unknown fault mode '{mode}'")
+        self.mode = mode
+        self.times = int(times)
+        self.after_bytes = None if after_bytes is None else int(after_bytes)
+
+
+class FaultInjector:
+    """Holds armed fault points; the storage layer calls ``check`` /
+    ``crash_after_bytes`` at each protocol step. ``fired`` counts
+    triggers per point for test assertions."""
+
+    def __init__(self, spec=None):
+        self._arms = {}
+        self.fired = {}
+        if spec:
+            for point, cfg in dict(spec).items():
+                self.arm(point, **dict(cfg or {}))
+
+    @classmethod
+    def from_env(cls):
+        """Injector from DS_TPU_CKPT_FAULTS, or None when unset."""
+        raw = os.environ.get(ENV_VAR, "").strip()
+        if not raw:
+            return None
+        fi = cls()
+        for part in raw.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            point, kwargs = fields[0], {}
+            for field in fields[1:]:
+                if "=" in field:
+                    k, v = field.split("=", 1)
+                    kwargs[k] = int(v) if v.lstrip("-").isdigit() else v
+                else:
+                    kwargs["mode"] = field
+            fi.arm(point, **kwargs)
+        return fi
+
+    def arm(self, point, mode=None, times=1, after_bytes=None):
+        if mode is None:
+            mode = "crash"
+        self._arms[point] = _Arm(mode=mode, times=times, after_bytes=after_bytes)
+        return self
+
+    def disarm(self, point=None):
+        if point is None:
+            self._arms.clear()
+        else:
+            self._arms.pop(point, None)
+
+    def _fire(self, point):
+        self.fired[point] = self.fired.get(point, 0) + 1
+
+    def check(self, point):
+        """Raise the armed fault for ``point`` (no-op when unarmed or a
+        byte-budget arm, which triggers via ``crash_after_bytes``)."""
+        arm = self._arms.get(point)
+        if arm is None or arm.after_bytes is not None:
+            return
+        if arm.mode == "crash":
+            self._fire(point)
+            raise InjectedCrash(f"injected crash at checkpoint fault point '{point}'")
+        # transient: fail the first `times` hits, then heal
+        if arm.times > 0:
+            arm.times -= 1
+            self._fire(point)
+            raise InjectedFault(point)
+
+    def crash_after_bytes(self, point):
+        """Byte budget for a torn-write arm at ``point`` (None = unarmed).
+        The storage layer writes exactly this many payload bytes to the
+        .tmp file and then calls ``tear(point)``."""
+        arm = self._arms.get(point)
+        if arm is None or arm.after_bytes is None:
+            return None
+        return arm.after_bytes
+
+    def tear(self, point):
+        self._fire(point)
+        raise InjectedCrash(
+            f"injected torn write at '{point}' (crashed mid-file)"
+        )

@@ -1,0 +1,146 @@
+"""Per-tag integrity manifests: the commit record of a checkpoint.
+
+A checkpoint tag directory is COMMITTED if and only if it contains a
+valid ``manifest.json``. The manifest is written last (atomically, after
+every shard has been fsynced into place), so its presence proves that
+every file it inventories was durably written; a crash at any earlier
+point leaves the tag uncommitted and the previous committed tag intact.
+
+Manifest schema (format_version 1)::
+
+    {
+      "format_version": 1,
+      "tag": "global_step10",
+      "sequence": 3,                 # monotonic commit counter per save dir
+      "files": {
+        "mp_rank_00_model_states.pt": {
+          "bytes": 123456,
+          "crc32": "89abcdef",
+          "sha256": "..."
+        },
+        ...
+      },
+      "extra": {...}                 # engine bookkeeping (steps, world sizes)
+    }
+
+``sequence`` orders committed tags for rotation and crash-recovery
+fallback without trusting filesystem mtimes or tag-name lexicography.
+"""
+
+import hashlib
+import json
+import os
+import zlib
+
+MANIFEST_NAME = "manifest.json"
+FORMAT_VERSION = 1
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint tag failed integrity verification (missing file,
+    size/checksum mismatch, unreadable manifest, or truncated pickle).
+
+    Raised by the load path only after every fallback candidate has been
+    exhausted; callers can catch this one named error instead of the
+    grab-bag of ``EOFError``/``UnpicklingError``/``KeyError`` a raw
+    pickle load of a torn file produces."""
+
+
+def digests_of_bytes(data):
+    """(size, crc32-hex, sha256-hex) of an in-memory blob."""
+    return (
+        len(data),
+        format(zlib.crc32(data) & 0xFFFFFFFF, "08x"),
+        hashlib.sha256(data).hexdigest(),
+    )
+
+
+def file_digests(path, chunk_size=1 << 20):
+    """(size, crc32-hex, sha256-hex) of a file, streamed."""
+    size, crc, sha = 0, 0, hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                break
+            size += len(chunk)
+            crc = zlib.crc32(chunk, crc)
+            sha.update(chunk)
+    return size, format(crc & 0xFFFFFFFF, "08x"), sha.hexdigest()
+
+
+def build_manifest(tag, files, sequence, extra=None):
+    """Assemble the manifest dict for ``files``: {name: (size, crc, sha)}."""
+    return {
+        "format_version": FORMAT_VERSION,
+        "tag": str(tag),
+        "sequence": int(sequence),
+        "files": {
+            name: {"bytes": size, "crc32": crc, "sha256": sha}
+            for name, (size, crc, sha) in sorted(files.items())
+        },
+        "extra": extra or {},
+    }
+
+
+def manifest_path(tag_dir):
+    return os.path.join(tag_dir, MANIFEST_NAME)
+
+
+def read_manifest(tag_dir):
+    """The tag's manifest dict, or None when absent/unparseable (an
+    uncommitted or torn tag — never an exception: the load path treats
+    both the same way, as 'not committed')."""
+    path = manifest_path(tag_dir)
+    try:
+        with open(path, "r") as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(m, dict) or "files" not in m or "sequence" not in m:
+        return None
+    return m
+
+
+def verify_entry(name, entry, size, crc, sha):
+    """Raise CheckpointCorruptionError if digests disagree with ``entry``."""
+    if size != entry.get("bytes"):
+        raise CheckpointCorruptionError(
+            f"checkpoint file '{name}' is {size} bytes, manifest says "
+            f"{entry.get('bytes')} (truncated or partial write)"
+        )
+    if crc != entry.get("crc32"):
+        raise CheckpointCorruptionError(
+            f"checkpoint file '{name}' crc32 {crc} != manifest {entry.get('crc32')}"
+        )
+    if sha is not None and entry.get("sha256") is not None and sha != entry["sha256"]:
+        raise CheckpointCorruptionError(
+            f"checkpoint file '{name}' sha256 mismatch (bit corruption)"
+        )
+
+
+def verify_tag_dir(tag_dir, manifest=None, deep=False):
+    """Check a committed tag's inventory against the filesystem.
+
+    Shallow (default): every inventoried file exists with the recorded
+    size. Deep: additionally stream crc32+sha256 of every file. Returns
+    the manifest; raises CheckpointCorruptionError on any mismatch."""
+    if manifest is None:
+        manifest = read_manifest(tag_dir)
+    if manifest is None:
+        raise CheckpointCorruptionError(
+            f"no valid {MANIFEST_NAME} in {tag_dir} (tag never committed)"
+        )
+    for name, entry in manifest["files"].items():
+        path = os.path.join(tag_dir, name)
+        if not os.path.isfile(path):
+            raise CheckpointCorruptionError(
+                f"checkpoint file '{name}' inventoried in manifest is missing "
+                f"from {tag_dir}"
+            )
+        if deep:
+            size, crc, sha = file_digests(path)
+        else:
+            size, crc, sha = os.path.getsize(path), entry.get("crc32"), None
+        verify_entry(name, entry, size, crc, sha)
+    return manifest

@@ -1,0 +1,354 @@
+"""Fault-tolerant checkpoint storage: atomic writes, retries, rotation.
+
+The durability contract both engines route through:
+
+1. Every file is written as ``<name>.tmp`` -> ``fsync`` -> ``os.replace``
+   so a reader never observes a half-written file under its final name.
+2. A per-tag ``manifest.json`` (see manifest.py) inventories every file
+   with sizes and crc32/sha256 digests and is written LAST, atomically:
+   its presence IS the commit record. A crash at any earlier point
+   leaves the tag uncommitted and the prior committed tag untouched.
+3. Transient I/O errors (EIO & friends) are retried with bounded
+   exponential backoff; anything else propagates immediately.
+4. Rotation keeps the last-k COMMITTED tags; the newest committed tag is
+   never deleted, and uncommitted/foreign directories are never touched.
+5. On load, ``latest`` is only a hint: candidates are verified against
+   their manifest, and a corrupt/partial tag falls back (loudly) to the
+   previous committed one instead of dying on a truncated pickle.
+"""
+
+import dataclasses
+import errno
+import os
+import shutil
+import time
+
+from deepspeed_tpu.runtime.checkpoint.fault_injection import FaultInjector
+from deepspeed_tpu.runtime.checkpoint.manifest import (
+    MANIFEST_NAME,
+    CheckpointCorruptionError,
+    build_manifest,
+    digests_of_bytes,
+    file_digests,
+    manifest_path,
+    read_manifest,
+    verify_entry,
+    verify_tag_dir,
+)
+from deepspeed_tpu.utils.logging import logger
+
+# errnos worth retrying: flaky NFS/FUSE mounts and interrupted syscalls.
+# ENOSPC/EACCES/ENOENT are deterministic — retrying them just hides bugs.
+TRANSIENT_ERRNOS = frozenset(
+    {errno.EIO, errno.EAGAIN, errno.EBUSY, errno.ETIMEDOUT, errno.EINTR}
+)
+
+_WRITE_CHUNK = 1 << 20
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Typed view of the ds_config ``checkpoint`` section (storage keys;
+    ``tag_validation`` stays on DeepSpeedConfig)."""
+
+    keep_last_k: int = 0          # 0 = keep everything
+    max_retries: int = 3
+    retry_backoff_s: float = 0.05
+    verify_on_load: bool = True
+    fault_injection: dict = None  # test hook; None disables
+
+
+class CheckpointStorage:
+    """Atomic, retrying, manifest-committed checkpoint I/O for one run."""
+
+    def __init__(self, max_retries=3, retry_backoff_s=0.05, keep_last_k=0,
+                 verify_on_load=True, fault_injector=None):
+        self.max_retries = max(0, int(max_retries))
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.keep_last_k = int(keep_last_k)
+        self.verify_on_load = bool(verify_on_load)
+        # env arms win over config so an operator can inject faults into
+        # an unmodified training script.
+        self.fault_injector = FaultInjector.from_env() or fault_injector
+
+    @classmethod
+    def from_ds_config(cls, ds_config):
+        """Build from a DeepSpeedConfig carrying ``checkpoint_config``."""
+        ckpt = getattr(ds_config, "checkpoint_config", None) or CheckpointConfig()
+        injector = (
+            FaultInjector(ckpt.fault_injection)
+            if ckpt.fault_injection is not None else None
+        )
+        return cls(
+            max_retries=ckpt.max_retries,
+            retry_backoff_s=ckpt.retry_backoff_s,
+            keep_last_k=ckpt.keep_last_k,
+            verify_on_load=ckpt.verify_on_load,
+            fault_injector=injector,
+        )
+
+    # ------------------------------------------------------------------
+    # retry / low-level atomic protocol
+    # ------------------------------------------------------------------
+    def _retry(self, fn, what):
+        """Run ``fn`` retrying transient OSErrors with exponential backoff."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except OSError as e:
+                if e.errno not in TRANSIENT_ERRNOS or attempt >= self.max_retries:
+                    raise
+                delay = min(self.retry_backoff_s * (2 ** attempt), 2.0)
+                attempt += 1
+                logger.warning(
+                    f"checkpoint: transient I/O error during {what} "
+                    f"({e}); retry {attempt}/{self.max_retries} in {delay:.3f}s"
+                )
+                if delay > 0:
+                    time.sleep(delay)
+
+    def _check(self, point):
+        if self.fault_injector is not None:
+            self.fault_injector.check(point)
+
+    def atomic_write_bytes(self, path, data, write_point="tmp_write",
+                           fsync_point="fsync", rename_point="rename"):
+        """write ``<path>.tmp`` -> fsync -> ``os.replace(tmp, path)``.
+
+        Readers of ``path`` see either the old content or the complete
+        new content, never a prefix. The write and the rename are retried
+        independently on transient errors (a rewrite restarts the .tmp
+        from scratch, so a torn retry cannot compound)."""
+        tmp = path + ".tmp"
+        fi = self.fault_injector
+        budget = fi.crash_after_bytes(write_point) if fi is not None else None
+
+        def do_write():
+            self._check(write_point)
+            with open(tmp, "wb") as f:
+                if budget is not None:
+                    f.write(data[:budget])
+                    f.flush()
+                    os.fsync(f.fileno())  # make the torn prefix durable
+                    fi.tear(write_point)
+                for off in range(0, len(data), _WRITE_CHUNK):
+                    f.write(data[off:off + _WRITE_CHUNK])
+                f.flush()
+                self._check(fsync_point)
+                os.fsync(f.fileno())
+
+        self._retry(do_write, f"write of {os.path.basename(path)}")
+
+        def do_rename():
+            self._check(rename_point)
+            os.replace(tmp, path)
+
+        self._retry(do_rename, f"rename of {os.path.basename(path)}")
+        self._fsync_dir(os.path.dirname(path))
+
+    @staticmethod
+    def _fsync_dir(dirname):
+        """Durably record a rename in its directory; best-effort (some
+        filesystems refuse O_RDONLY dir fsync — the rename itself is
+        still atomic there)."""
+        try:
+            fd = os.open(dirname or ".", os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
+
+    def read_bytes(self, path, entry=None, name=None, point="read"):
+        """Read a checkpoint file, retrying transient errors; when a
+        manifest ``entry`` is given (and verify_on_load is on), verify
+        size+crc32+sha256 before returning. Missing files and digest
+        mismatches raise CheckpointCorruptionError."""
+        name = name or os.path.basename(path)
+
+        def do_read():
+            self._check(point)
+            with open(path, "rb") as f:
+                return f.read()
+
+        try:
+            data = self._retry(do_read, f"read of {name}")
+        except FileNotFoundError:
+            raise CheckpointCorruptionError(
+                f"checkpoint file '{name}' is missing ({path})"
+            )
+        if entry is not None and self.verify_on_load:
+            size, crc, sha = digests_of_bytes(data)
+            verify_entry(name, entry, size, crc, sha)
+        return data
+
+    # ------------------------------------------------------------------
+    # tag-level protocol
+    # ------------------------------------------------------------------
+    def tag_writer(self, root, tag, uncommit=True):
+        return TagWriter(self, root, tag, uncommit=uncommit)
+
+    def write_latest(self, root, tag):
+        """Atomically update the ``latest`` convenience pointer. Purely a
+        hint: load order is derived from manifest sequences, so a stale,
+        torn, or deleted ``latest`` cannot strand a run."""
+        self.atomic_write_bytes(
+            os.path.join(root, "latest"), str(tag).encode(),
+            write_point="latest_write",
+        )
+
+    def committed_tags(self, root):
+        """[(sequence, tag)] of every committed tag under ``root``,
+        ascending by commit order."""
+        out = []
+        try:
+            entries = os.listdir(root)
+        except OSError:
+            return out
+        for name in entries:
+            tag_dir = os.path.join(root, name)
+            if not os.path.isdir(tag_dir):
+                continue
+            m = read_manifest(tag_dir)
+            if m is not None:
+                out.append((int(m["sequence"]), name, m))
+        out.sort(key=lambda x: (x[0], x[1]))
+        return [(seq, tag) for seq, tag, _ in out]
+
+    def next_sequence(self, root):
+        tags = self.committed_tags(root)
+        return (tags[-1][0] + 1) if tags else 1
+
+    def read_latest_hint(self, root):
+        path = os.path.join(root, "latest")
+        try:
+            with open(path, "r") as f:
+                return f.read().strip() or None
+        except OSError:
+            return None
+
+    def load_candidates(self, root, tag=None):
+        """Ordered [(tag, manifest_or_None)] to attempt loading from.
+
+        Explicit ``tag``: that tag first (manifest may be None for a
+        legacy/uncommitted dir that still exists). Then every committed
+        tag newest-first by manifest sequence — NOT the ``latest`` hint,
+        which can be stale (crash between commit and hint update) or
+        deleted without stranding anything. The hint is consulted LAST,
+        purely so legacy manifest-less checkpoint dirs stay loadable.
+        Duplicates removed, order kept."""
+        seen, out = set(), []
+
+        def add(name, manifest):
+            if name is not None and name not in seen:
+                seen.add(name)
+                out.append((name, manifest))
+
+        def add_if_exists(name):
+            if name is None:
+                return
+            tag_dir = os.path.join(root, str(name))
+            if os.path.isdir(tag_dir):
+                add(str(name), read_manifest(tag_dir))
+
+        if tag is not None:
+            add_if_exists(str(tag))
+        for _, name in reversed(self.committed_tags(root)):
+            add(name, read_manifest(os.path.join(root, name)))
+        if tag is None:
+            add_if_exists(self.read_latest_hint(root))
+        return out
+
+    def verify_tag(self, root, tag, manifest=None, deep=None):
+        """Verify a committed tag; deep (checksums) follows verify_on_load
+        unless overridden. Raises CheckpointCorruptionError."""
+        deep = self.verify_on_load if deep is None else deep
+        return verify_tag_dir(os.path.join(root, str(tag)), manifest, deep=deep)
+
+    def rotate(self, root, keep_last_k=None):
+        """Delete committed tags beyond the newest ``keep_last_k``.
+
+        Only manifest-committed tags are candidates, so an in-flight save
+        by a concurrent writer (uncommitted dir) and unrelated files are
+        never touched — and with k >= 1 the newest committed tag is never
+        deleted. Returns the tags removed."""
+        k = self.keep_last_k if keep_last_k is None else int(keep_last_k)
+        if k <= 0:
+            return []
+        tags = self.committed_tags(root)
+        removed = []
+        for _, name in tags[:-k]:
+            tag_dir = os.path.join(root, name)
+            # drop the manifest FIRST (atomicity in reverse: the tag stops
+            # being a load candidate before its shards disappear, so a
+            # crash mid-rmtree can't leave a committed-but-holey tag)
+            try:
+                os.unlink(manifest_path(tag_dir))
+            except OSError:
+                continue
+            shutil.rmtree(tag_dir, ignore_errors=True)
+            removed.append(name)
+        if removed:
+            logger.info(
+                f"checkpoint rotation: removed {removed} (keep_last_k={k})"
+            )
+        return removed
+
+
+class TagWriter:
+    """Accumulates one tag's files and commits them with a manifest.
+
+    Usage::
+
+        w = storage.tag_writer(save_dir, tag)
+        w.write_file("mp_rank_00_model_states.pt", blob)
+        ...
+        w.commit(extra={"global_steps": 10})   # the atomic commit point
+    """
+
+    def __init__(self, storage, root, tag, uncommit=True):
+        self.storage = storage
+        self.root = root
+        self.tag = str(tag)
+        self.tag_dir = os.path.join(root, self.tag)
+        self._files = {}
+        os.makedirs(self.tag_dir, exist_ok=True)
+        # A manifest from a previous identically-tagged save would make a
+        # half-overwritten tag look committed — uncommit before rewriting.
+        # Non-committing ranks in a shared dir pass uncommit=False so a
+        # straggler can't delete the committing rank's fresh manifest.
+        if uncommit:
+            try:
+                os.unlink(manifest_path(self.tag_dir))
+            except OSError:
+                pass
+
+    def write_file(self, name, data):
+        """Atomically write one shard and record its digests."""
+        self.storage.atomic_write_bytes(os.path.join(self.tag_dir, name), data)
+        self._files[name] = digests_of_bytes(data)
+
+    def record_external_file(self, name):
+        """Inventory a file some other component already wrote into the
+        tag dir (digests streamed from disk)."""
+        self._files[name] = file_digests(os.path.join(self.tag_dir, name))
+
+    def commit(self, extra=None):
+        """Write manifest.json last — the commit record. After this
+        returns, the tag is durable and becomes the newest committed."""
+        manifest = build_manifest(
+            self.tag, self._files,
+            sequence=self.storage.next_sequence(self.root), extra=extra,
+        )
+        import json
+
+        self.storage.atomic_write_bytes(
+            manifest_path(self.tag_dir),
+            json.dumps(manifest, indent=1, sort_keys=True).encode(),
+            write_point="manifest_write", rename_point="manifest_rename",
+        )
+        return manifest

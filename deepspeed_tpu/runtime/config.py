@@ -324,6 +324,41 @@ def get_checkpoint_tag_validation_mode(param_dict):
     return mode
 
 
+def get_checkpoint_config(param_dict):
+    """checkpoint: storage keys for the fault-tolerant subsystem
+    (runtime/checkpoint/): keep_last_k rotation, retry bounds, load-time
+    verification, and the test-only fault_injection hook."""
+    from deepspeed_tpu.runtime.checkpoint import CheckpointConfig
+
+    checkpoint_params = param_dict.get(CHECKPOINT, {})
+    keep_last_k = get_scalar_param(
+        checkpoint_params, CHECKPOINT_KEEP_LAST_K, CHECKPOINT_KEEP_LAST_K_DEFAULT
+    )
+    if keep_last_k < 0:
+        raise ValueError(
+            f"checkpoint.{CHECKPOINT_KEEP_LAST_K} must be >= 0 (0 keeps "
+            f"everything), got {keep_last_k}"
+        )
+    max_retries = get_scalar_param(
+        checkpoint_params, CHECKPOINT_MAX_RETRIES, CHECKPOINT_MAX_RETRIES_DEFAULT
+    )
+    if max_retries < 0:
+        raise ValueError(
+            f"checkpoint.{CHECKPOINT_MAX_RETRIES} must be >= 0, got {max_retries}"
+        )
+    return CheckpointConfig(
+        keep_last_k=keep_last_k,
+        max_retries=max_retries,
+        retry_backoff_s=get_scalar_param(
+            checkpoint_params, CHECKPOINT_RETRY_BACKOFF, CHECKPOINT_RETRY_BACKOFF_DEFAULT
+        ),
+        verify_on_load=get_scalar_param(
+            checkpoint_params, CHECKPOINT_VERIFY_ON_LOAD, CHECKPOINT_VERIFY_ON_LOAD_DEFAULT
+        ),
+        fault_injection=checkpoint_params.get(CHECKPOINT_FAULT_INJECTION, None),
+    )
+
+
 def get_progressive_layer_drop(param_dict):
     pld_dict = param_dict.get(PROGRESSIVE_LAYER_DROP, {})
     enabled = get_scalar_param(pld_dict, PLD_ENABLED, PLD_ENABLED_DEFAULT)
@@ -484,6 +519,7 @@ class DeepSpeedConfig:
         mode = get_checkpoint_tag_validation_mode(param_dict)
         self.checkpoint_tag_validation_enabled = mode != CHECKPOINT_TAG_VALIDATION_IGNORE
         self.checkpoint_tag_validation_fail = mode == CHECKPOINT_TAG_VALIDATION_FAIL
+        self.checkpoint_config = get_checkpoint_config(param_dict)
 
         (
             self.pld_enabled,

@@ -154,6 +154,18 @@ CHECKPOINT_TAG_VALIDATION_MODES = [
     CHECKPOINT_TAG_VALIDATION_FAIL,
 ]
 
+# Fault-tolerant storage keys (runtime/checkpoint/ subsystem; beyond the
+# v0.3.10 reference — durable checkpointing for preemptible fleets)
+CHECKPOINT_KEEP_LAST_K = "keep_last_k"
+CHECKPOINT_KEEP_LAST_K_DEFAULT = 0  # 0 = keep every committed tag
+CHECKPOINT_MAX_RETRIES = "max_retries"
+CHECKPOINT_MAX_RETRIES_DEFAULT = 3
+CHECKPOINT_RETRY_BACKOFF = "retry_backoff_s"
+CHECKPOINT_RETRY_BACKOFF_DEFAULT = 0.05
+CHECKPOINT_VERIFY_ON_LOAD = "verify_on_load"
+CHECKPOINT_VERIFY_ON_LOAD_DEFAULT = True
+CHECKPOINT_FAULT_INJECTION = "fault_injection"
+
 #############################################
 # Sparse attention
 #############################################

@@ -176,21 +176,37 @@ class PrefetchLoader:
             raise payload
         raise StopIteration
 
-    def close(self):
-        """Stop the worker and drop the prefetched batches. Idempotent."""
+    def close(self, timeout=5.0):
+        """Stop the worker and drop the prefetched batches. Idempotent.
+
+        The drain loop is bounded by ``timeout`` seconds total: a source
+        iterator blocked inside ``next()`` (e.g. a stalled network read)
+        cannot be interrupted from here, and draining the queue only
+        unblocks a worker stuck in ``put()``. On timeout the worker is
+        abandoned — it is a daemon thread, so a wedged source never
+        blocks interpreter exit, it just leaks until the process ends."""
         self._closed = True
         self._done = True
         if not self._started:
             return
         import queue
+        import time
 
         # unblock a worker stuck in put(), then let it observe _closed
-        while self._thread.is_alive():
+        deadline = time.monotonic() + timeout
+        while self._thread.is_alive() and time.monotonic() < deadline:
             try:
                 self._queue.get_nowait()
             except queue.Empty:
                 pass
             self._thread.join(timeout=0.05)
+        if self._thread.is_alive():
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(
+                f"PrefetchLoader.close: worker still alive after {timeout}s "
+                "(source iterator blocked in next()?); abandoning daemon "
+                "thread")
         # release any batches still queued after the thread exited
         while True:
             try:

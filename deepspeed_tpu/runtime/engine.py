@@ -237,20 +237,30 @@ class DeepSpeedEngine:
                     # separate guard: a failure here must NOT undo
                     # `applied` (per-layer remat is active either way;
                     # falling through would stack whole-apply remat on top)
-                    try:
-                        assert hasattr(mcfg, "checkpoint_policy")
-                        mcfg.checkpoint_policy = "offload_dots"
-                        log_dist(
-                            "cpu_checkpointing: checkpoint_policy="
-                            "'offload_dots' — saved activations go to host "
-                            "memory (pinned_host)", ranks=[0])
-                    except (AssertionError, AttributeError, TypeError,
-                            dataclasses.FrozenInstanceError):
+                    # Explicit hasattr branch (not an assert: `python -O`
+                    # strips asserts, and a bare setattr on a config without
+                    # the field would silently invent the attribute and claim
+                    # offloading that never happens).
+                    if not hasattr(mcfg, "checkpoint_policy"):
                         logger.warning(
                             "cpu_checkpointing requested but "
                             f"{type(mcfg).__name__} exposes no settable "
                             "checkpoint_policy — activations stay in HBM "
                             "(per-layer remat still active)")
+                    else:
+                        try:
+                            mcfg.checkpoint_policy = "offload_dots"
+                            log_dist(
+                                "cpu_checkpointing: checkpoint_policy="
+                                "'offload_dots' — saved activations go to host "
+                                "memory (pinned_host)", ranks=[0])
+                        except (AttributeError, TypeError,
+                                dataclasses.FrozenInstanceError):
+                            logger.warning(
+                                "cpu_checkpointing requested but "
+                                f"{type(mcfg).__name__} exposes no settable "
+                                "checkpoint_policy — activations stay in HBM "
+                                "(per-layer remat still active)")
             if not applied:
                 # Generic fallback: remat the whole apply_fn. Backward then
                 # recomputes the forward instead of saving its intermediates
@@ -1469,8 +1479,19 @@ class DeepSpeedEngine:
         return float(np.mean([float(jax.device_get(l)) for l in losses]))
 
     # ------------------------------------------------------------------
-    # checkpointing (parity: engine.py:1271-1561)
+    # checkpointing (parity: engine.py:1271-1561), routed through the
+    # fault-tolerant runtime/checkpoint/ subsystem: atomic writes, a
+    # manifest commit record per tag, retry/backoff, rotation, and
+    # crash-recovery fallback on load.
     # ------------------------------------------------------------------
+    @property
+    def checkpoint_storage(self):
+        if getattr(self, "_ckpt_storage", None) is None:
+            from deepspeed_tpu.runtime.checkpoint import CheckpointStorage
+
+            self._ckpt_storage = CheckpointStorage.from_ds_config(self._config)
+        return self._ckpt_storage
+
     def _get_ckpt_name(self, checkpoints_path, tag):
         mp_rank = 0 if self.mpu is None else self.mpu.get_model_parallel_rank()
         return os.path.join(checkpoints_path, str(tag), f"mp_rank_{mp_rank:02d}_model_states.pt")
@@ -1529,7 +1550,8 @@ class DeepSpeedEngine:
         client_state = client_state or {}
         self._checkpoint_tag_validation(tag)
 
-        os.makedirs(os.path.join(save_dir, str(tag)), exist_ok=True)
+        storage = self.checkpoint_storage
+        writer = storage.tag_writer(save_dir, tag, uncommit=self.global_rank == 0)
         if self.global_rank == 0:
             state = dict(
                 module=self.module_state_dict(),
@@ -1544,56 +1566,134 @@ class DeepSpeedEngine:
                 mp_world_size=self.mp_world_size,
             )
             state.update(client_state)
-            with open(self._get_ckpt_name(save_dir, tag), "wb") as f:
-                pickle.dump(state, f)
+            writer.write_file(
+                os.path.basename(self._get_ckpt_name(save_dir, tag)),
+                pickle.dumps(state),
+            )
             log_dist(f"Saving model checkpoint: {self._get_ckpt_name(save_dir, tag)}", ranks=[0])
 
         if self.zero_optimization():
-            self._save_zero_checkpoint(save_dir, tag)
+            self._save_zero_checkpoint(save_dir, tag, writer)
 
-        if save_latest and self.global_rank == 0:
-            with open(os.path.join(save_dir, "latest"), "w") as fd:
-                fd.write(str(tag))
+        if self.global_rank == 0:
+            # The manifest is the commit record: written LAST, atomically.
+            # Any crash before this point leaves the tag uncommitted and
+            # the previous committed tag untouched.
+            writer.commit(extra=dict(
+                global_steps=self.global_steps,
+                dp_world_size=self.dp_world_size,
+                mp_world_size=self.mp_world_size,
+            ))
+            if save_latest:
+                storage.write_latest(save_dir, tag)
+            storage.rotate(save_dir)
         if self.monitor is not None:
             self.monitor.flush()
         return True
 
-    def _save_zero_checkpoint(self, save_path, tag):
+    def _save_zero_checkpoint(self, save_path, tag, writer):
         """Every dp shard gets its own optim-states file (reference engine.py:1557)."""
         self._ensure_opt_state()
         shards = self.optimizer.shard_state_dicts(self.opt_state)
         for pp_rank, shard in enumerate(shards):
-            with open(self._get_zero_ckpt_name(save_path, tag, pp_rank), "wb") as f:
-                pickle.dump(shard, f)
+            name = os.path.basename(self._get_zero_ckpt_name(save_path, tag, pp_rank))
+            writer.write_file(name, pickle.dumps(shard))
         log_dist(f"Saved {len(shards)} zero checkpoint shards under tag {tag}", ranks=[0])
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True):
-        if tag is None:
-            latest_path = os.path.join(load_dir, "latest")
-            if os.path.isfile(latest_path):
-                with open(latest_path, "r") as fd:
-                    tag = fd.read().strip()
-            else:
-                logger.warning(f"Unable to find latest file at {latest_path}, if trying to load latest "
-                               "checkpoint please pass a valid tag")
-                return None, {}
+        """Restore from the requested tag — or, when it is corrupt or
+        partial, fall back (loudly) to the newest committed tag. Raises
+        CheckpointCorruptionError only when every candidate is corrupt;
+        returns (None, {}) when no checkpoint exists at all."""
+        from deepspeed_tpu.runtime.checkpoint import CheckpointCorruptionError
 
-        ckpt_name = self._get_ckpt_name(load_dir, tag)
-        if not os.path.exists(ckpt_name):
-            logger.warning(f"Client provided checkpoint load path: {ckpt_name} does not exist")
+        storage = self.checkpoint_storage
+        candidates = storage.load_candidates(load_dir, tag)
+        if not candidates:
+            logger.warning(
+                f"No checkpoint found under {load_dir} (no committed tags, "
+                "no usable 'latest' pointer" + (f", tag '{tag}' absent)" if tag else ")")
+            )
             return None, {}
+        failures = []
+        for cand_tag, manifest in candidates:
+            try:
+                checkpoint = self._read_checkpoint_blobs(
+                    load_dir, cand_tag, manifest,
+                    read_zero=load_optimizer_states and self.zero_optimization(),
+                )
+            except CheckpointCorruptionError as e:
+                failures.append((cand_tag, str(e)))
+                logger.error(
+                    f"CHECKPOINT CORRUPT: tag '{cand_tag}' under {load_dir} "
+                    f"failed verification ({e}); falling back to the previous "
+                    "committed tag"
+                )
+                continue
+            return self._apply_checkpoint(
+                load_dir, cand_tag, checkpoint,
+                load_module_strict=load_module_strict,
+                load_optimizer_states=load_optimizer_states,
+                load_lr_scheduler_states=load_lr_scheduler_states,
+            )
+        raise CheckpointCorruptionError(
+            f"every checkpoint candidate under {load_dir} is corrupt: "
+            + "; ".join(f"{t}: {m}" for t, m in failures)
+        )
 
-        with open(ckpt_name, "rb") as f:
-            checkpoint = pickle.load(f)
+    def _read_checkpoint_blobs(self, load_dir, tag, manifest, read_zero=False):
+        """Read + verify + unpickle everything the tag needs BEFORE any
+        engine state mutates, so a torn shard can never leave the engine
+        half-restored. Raises CheckpointCorruptionError on any defect."""
+        from deepspeed_tpu.runtime.checkpoint import CheckpointCorruptionError
 
+        storage = self.checkpoint_storage
+        if manifest is not None and storage.verify_on_load:
+            storage.verify_tag(load_dir, tag, manifest, deep=False)
+        entries = manifest["files"] if manifest is not None else {}
+
+        def read_pickle(path):
+            name = os.path.basename(path)
+            data = storage.read_bytes(path, entry=entries.get(name), name=name)
+            try:
+                return pickle.loads(data)
+            except Exception as e:  # torn/garbage pickle — a named error instead
+                raise CheckpointCorruptionError(
+                    f"checkpoint file '{name}' does not unpickle ({type(e).__name__}: {e})"
+                )
+
+        checkpoint = read_pickle(self._get_ckpt_name(load_dir, tag))
+        if not isinstance(checkpoint, dict):
+            raise CheckpointCorruptionError(
+                f"checkpoint state for tag '{tag}' is a "
+                f"{type(checkpoint).__name__}, expected dict"
+            )
+        zero_shards = []
+        if read_zero:
+            pp_rank = 0
+            while True:
+                zname = self._get_zero_ckpt_name(load_dir, tag, pp_rank)
+                if os.path.basename(zname) not in entries and not os.path.exists(zname):
+                    break
+                zero_shards.append(read_pickle(zname))
+                pp_rank += 1
+        checkpoint["_zero_shards"] = zero_shards
+        checkpoint["_tag"] = tag
+        return checkpoint
+
+    def _apply_checkpoint(self, load_dir, tag, checkpoint, load_module_strict,
+                          load_optimizer_states, load_lr_scheduler_states):
+        ckpt_name = self._get_ckpt_name(load_dir, tag)
+        zero_shards = checkpoint.pop("_zero_shards")
+        checkpoint.pop("_tag")
         self.load_module_state_dict(checkpoint["module"], strict=load_module_strict)
-        # set before _load_zero_checkpoint so its log reports the true saved dp
+        # set before _load_zero_shards so its log reports the true saved dp
         self.loaded_checkpoint_dp_world_size = checkpoint.get("dp_world_size", None)
 
         if load_optimizer_states:
             if self.zero_optimization():
-                self._load_zero_checkpoint(load_dir, tag)
+                self._load_zero_shards(load_dir, tag, zero_shards)
             elif checkpoint.get("optimizer") is not None:
                 self._ensure_opt_state()
                 self.opt_state = _restore_like(self.opt_state, checkpoint["optimizer"])
@@ -1623,19 +1723,11 @@ class DeepSpeedEngine:
         log_dist(f"Loaded checkpoint {ckpt_name} at global step {self.global_steps}", ranks=[0])
         return ckpt_name, client_state
 
-    def _load_zero_checkpoint(self, load_dir, tag):
-        """Load ALL saved dp shards and re-partition for the current dp degree
-        (elastic checkpoints, reference engine.py:1376-1442)."""
+    def _load_zero_shards(self, load_dir, tag, shards):
+        """Re-partition the saved dp shards (already read + verified) for
+        the current dp degree (elastic checkpoints, reference
+        engine.py:1376-1442)."""
         saved_dp = self.loaded_checkpoint_dp_world_size or self.dp_world_size
-        shards = []
-        pp_rank = 0
-        while True:
-            name = self._get_zero_ckpt_name(load_dir, tag, pp_rank)
-            if not os.path.exists(name):
-                break
-            with open(name, "rb") as f:
-                shards.append(pickle.load(f))
-            pp_rank += 1
         if not shards:
             logger.warning(f"No zero checkpoint shards found in {load_dir}/{tag}")
             return

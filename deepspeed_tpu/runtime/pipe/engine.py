@@ -36,6 +36,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from deepspeed_tpu.runtime.checkpoint import (
+    CheckpointCorruptionError,
+    CheckpointStorage,
+)
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.fp16.loss_scaler import (
     init_dynamic_scaler_state,
@@ -1698,11 +1702,18 @@ class PipelineEngine:
     # ------------------------------------------------------------------
     # checkpointing: per-layer files (reference pipe/module.py:510-567)
     # ------------------------------------------------------------------
+    @property
+    def checkpoint_storage(self):
+        """Fault-tolerant storage shared with the non-pipe engine: atomic
+        writes, manifest commits, retry/backoff, rotation (lazy so config
+        changes before the first save are honored)."""
+        if getattr(self, "_ckpt_storage", None) is None:
+            self._ckpt_storage = CheckpointStorage.from_ds_config(self._config)
+        return self._ckpt_storage
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
         if tag is None:
             tag = f"global_step{self.global_steps}"
-        path = os.path.join(save_dir, str(tag))
-        os.makedirs(path, exist_ok=True)
         assert self._stage_params is not None, "nothing to save: run a batch first"
         # Every process runs the sync (multi-host: the allgather inside is a
         # collective), but only rank 0 touches the files — N concurrent
@@ -1713,17 +1724,23 @@ class PipelineEngine:
         layer_params = self._gather_layer_params()
         if not write:
             return True
+        storage = self.checkpoint_storage
+        writer = storage.tag_writer(save_dir, tag)
         for idx, p in enumerate(layer_params):
             if p is None:
                 continue
-            with open(os.path.join(path, f"layer_{idx:02d}-model_states.pt"), "wb") as f:
-                pickle.dump(jax.device_get(p), f)
+            writer.write_file(
+                f"layer_{idx:02d}-model_states.pt",
+                pickle.dumps(jax.device_get(p)),
+            )
         # Optimizer state, regrouped per LAYER so a different stage count can
         # re-assemble it (reference keeps optimizer state in per-rank files;
         # per-layer is the pipeline-elastic variant of that).
         opt_global, opt_layers = self._split_opt_state_per_layer()
-        with open(os.path.join(path, "optim_states.pt"), "wb") as f:
-            pickle.dump({"global": opt_global, "layers": opt_layers}, f)
+        writer.write_file(
+            "optim_states.pt",
+            pickle.dumps({"global": opt_global, "layers": opt_layers}),
+        )
         meta = dict(
             num_layers=self.module._num_layers,
             num_stages=self.num_stages,
@@ -1737,11 +1754,15 @@ class PipelineEngine:
             skipped_steps=self.skipped_steps,
             client_state=client_state or {},
         )
-        with open(os.path.join(path, "module-meta.pt"), "wb") as f:
-            pickle.dump(meta, f)
+        writer.write_file("module-meta.pt", pickle.dumps(meta))
+        # Commit point: manifest.json lands last. A crash anywhere above
+        # leaves the prior committed tag as the load candidate.
+        writer.commit(extra=dict(
+            global_steps=self.global_steps, num_stages=self.num_stages,
+        ))
         if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as fd:
-                fd.write(str(tag))
+            storage.write_latest(save_dir, tag)
+        storage.rotate(save_dir)
         return True
 
     def _gather_layer_params(self):
@@ -1877,26 +1898,79 @@ class PipelineEngine:
         return True
 
     def load_checkpoint(self, load_dir, tag=None, **kwargs):
-        if tag is None:
-            latest = os.path.join(load_dir, "latest")
-            if not os.path.isfile(latest):
-                return None, {}
-            with open(latest) as fd:
-                tag = fd.read().strip()
+        storage = self.checkpoint_storage
+        candidates = storage.load_candidates(load_dir, tag)
+        if not candidates:
+            logger.warning(
+                f"no checkpoint found under {load_dir} (tag={tag}); starting fresh"
+            )
+            return None, {}
+        failures = []
+        for cand_tag, manifest in candidates:
+            try:
+                meta, layer_params, opt_blob = self._read_pipe_checkpoint(
+                    load_dir, cand_tag, manifest
+                )
+            except CheckpointCorruptionError as e:
+                logger.error(
+                    f"CHECKPOINT CORRUPT: tag '{cand_tag}' failed verification "
+                    f"({e}); falling back to previous committed tag"
+                )
+                failures.append(f"{cand_tag}: {e}")
+                continue
+            return self._apply_pipe_checkpoint(
+                load_dir, cand_tag, meta, layer_params, opt_blob
+            )
+        raise CheckpointCorruptionError(
+            f"no loadable checkpoint under {load_dir}; every candidate failed "
+            f"verification: {'; '.join(failures)}"
+        )
+
+    def _read_pipe_checkpoint(self, load_dir, tag, manifest):
+        """Read + digest-verify + unpickle every blob of one tag BEFORE any
+        engine state is touched, so a corrupt/partial candidate falls back to
+        the previous committed tag instead of leaving a half-loaded engine."""
+        storage = self.checkpoint_storage
+        if manifest is not None and storage.verify_on_load:
+            storage.verify_tag(load_dir, tag, manifest, deep=False)
         path = os.path.join(load_dir, str(tag))
-        with open(os.path.join(path, "module-meta.pt"), "rb") as f:
-            meta = pickle.load(f)
+        entries = (manifest or {}).get("files", {})
+
+        def present(name):
+            if manifest is not None:
+                return name in entries
+            return os.path.exists(os.path.join(path, name))
+
+        def read_pickle(name):
+            data = storage.read_bytes(
+                os.path.join(path, name), entry=entries.get(name), name=name
+            )
+            try:
+                return pickle.loads(data)
+            except Exception as e:
+                raise CheckpointCorruptionError(
+                    f"checkpoint file '{name}' failed to unpickle: {e}"
+                )
+
+        meta = read_pickle("module-meta.pt")
+        if not isinstance(meta, dict) or "num_layers" not in meta:
+            raise CheckpointCorruptionError(
+                f"module-meta.pt of tag '{tag}' is malformed"
+            )
+        layer_params = [
+            read_pickle(name) if present(name) else None
+            for idx in range(meta["num_layers"])
+            for name in [f"layer_{idx:02d}-model_states.pt"]
+        ]
+        opt_blob = read_pickle("optim_states.pt") if present("optim_states.pt") else None
+        return meta, layer_params, opt_blob
+
+    def _apply_pipe_checkpoint(self, load_dir, tag, meta, layer_params, opt_blob):
+        """Mutate engine state from pre-read, pre-verified blobs."""
+        path = os.path.join(load_dir, str(tag))
         assert meta["num_layers"] == self.module._num_layers, (
             f"checkpoint has {meta['num_layers']} layers, module has {self.module._num_layers}"
         )
-        layer_params = []
-        for idx in range(meta["num_layers"]):
-            fname = os.path.join(path, f"layer_{idx:02d}-model_states.pt")
-            if os.path.exists(fname):
-                with open(fname, "rb") as f:
-                    layer_params.append(pickle.load(f))
-            else:
-                layer_params.append(None)
         # Repartition onto current stages: files are per-LAYER, not per-stage,
         # so a different stage count re-slices cleanly (elastic pipeline).
         self.module._params = layer_params
@@ -1924,11 +1998,9 @@ class PipelineEngine:
             self._stage_opt_state = [
                 self._stage_opt[s].init(self._stage_params[s]) for s in range(self.num_stages)
             ]
-        opt_file = os.path.join(path, "optim_states.pt")
-        if os.path.exists(opt_file):
-            with open(opt_file, "rb") as f:
-                if not self._restore_opt_state_per_layer(pickle.load(f)):
-                    logger.warning("could not restore optimizer state; reinitialized")
+        if opt_blob is not None:
+            if not self._restore_opt_state_per_layer(opt_blob):
+                logger.warning("could not restore optimizer state; reinitialized")
         if not self._multi_host:
             self._zero_acc_grads()
         # Loaded per-stage params are now authoritative: a previously built

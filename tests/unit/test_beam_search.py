@@ -87,6 +87,11 @@ def test_beam_validation():
         beam_search(params, cfg, jnp.zeros((1, 2), jnp.int32), 2, num_beams=0)
     with pytest.raises(ValueError, match="max_position"):
         beam_search(params, cfg, jnp.zeros((1, 30), jnp.int32), 10)
+    # zero decode steps would length-normalize by 0 -> NaN scores
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        beam_search(params, cfg, jnp.zeros((1, 2), jnp.int32), 0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        beam_search(params, cfg, jnp.zeros((1, 2), jnp.int32), -3)
 
 
 def test_beam_over_quantized_params():

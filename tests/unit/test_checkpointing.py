@@ -216,3 +216,259 @@ def test_zero_checkpoint_shard_files(tmpdir):
 
     shards = glob.glob(f"{save_dir}/z/zero_pp_rank_*optim_states.pt")
     assert len(shards) == engine.dp_world_size
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection suite: the atomic-commit protocol must survive a crash at
+# EVERY write stage, torn/corrupted shards, and a deleted `latest` pointer
+# (runtime/checkpoint/: storage + manifest + fault_injection).
+# ---------------------------------------------------------------------------
+
+import os
+
+from deepspeed_tpu.runtime.checkpoint import (
+    MANIFEST_NAME,
+    CheckpointCorruptionError,
+    InjectedCrash,
+    read_manifest,
+)
+
+
+def _cfg_ft(**ckpt):
+    """_cfg() + a checkpoint section with an armed-able injector and
+    zero retry backoff (tests should not sleep)."""
+    cfg = _cfg()
+    ckpt.setdefault("retry_backoff_s", 0)
+    ckpt.setdefault("fault_injection", {})
+    cfg["checkpoint"] = ckpt
+    return cfg
+
+
+def _save_good_tag(tmpdir, cfg, tag="one"):
+    save_dir = str(tmpdir.join("ckpt"))
+    engine = make_simple_engine(tmpdir, cfg)
+    _train_steps(engine, 2)
+    engine.save_checkpoint(save_dir, tag=tag)
+    return engine, save_dir, jax.device_get(engine.params), engine.global_steps
+
+
+def _module_states_file(save_dir, tag):
+    """The module-states file of a tag, via its manifest inventory."""
+    manifest = read_manifest(os.path.join(save_dir, tag))
+    (name,) = [n for n in manifest["files"] if "model_states" in n]
+    return os.path.join(save_dir, tag, name)
+
+
+@pytest.mark.parametrize(
+    "point", ["tmp_write", "fsync", "rename", "manifest_write", "manifest_rename"]
+)
+def test_ckpt_crash_at_every_write_stage_falls_back(tmpdir, point):
+    """A simulated preemption at any stage of the save leaves the previous
+    committed tag loadable: manifest.json lands last, so the half-written
+    tag is simply never a candidate."""
+    cfg = _cfg_ft()
+    engine, save_dir, params_one, steps_one = _save_good_tag(tmpdir, cfg)
+    _train_steps(engine, 2)
+    engine.checkpoint_storage.fault_injector.arm(point, mode="crash")
+    with pytest.raises(InjectedCrash):
+        engine.save_checkpoint(save_dir, tag="two")
+    engine.checkpoint_storage.fault_injector.disarm()
+    assert read_manifest(os.path.join(save_dir, "two")) is None  # uncommitted
+
+    engine2 = make_simple_engine(tmpdir, cfg, seed=99)
+    name, _ = engine2.load_checkpoint(save_dir)
+    assert name is not None and "one" in name
+    assert engine2.global_steps == steps_one
+    _tree_equal(engine2.params, params_one)
+
+
+def test_ckpt_torn_tmp_write_falls_back(tmpdir):
+    """Crash after exactly N bytes of a shard reached the .tmp file: the
+    torn prefix never reaches the final name, the tag never commits."""
+    cfg = _cfg_ft()
+    engine, save_dir, params_one, _ = _save_good_tag(tmpdir, cfg)
+    _train_steps(engine, 2)
+    engine.checkpoint_storage.fault_injector.arm("tmp_write", after_bytes=16)
+    with pytest.raises(InjectedCrash):
+        engine.save_checkpoint(save_dir, tag="two")
+    engine.checkpoint_storage.fault_injector.disarm()
+
+    engine2 = make_simple_engine(tmpdir, cfg, seed=99)
+    name, _ = engine2.load_checkpoint(save_dir)
+    assert "one" in name
+    _tree_equal(engine2.params, params_one)
+
+
+def test_ckpt_transient_eio_is_retried(tmpdir):
+    """Transient EIO (flaky mount) heals under bounded retry: the save
+    commits and round-trips; the injector counts the retried hits."""
+    cfg = _cfg_ft(max_retries=3)
+    engine, save_dir, params_one, steps_one = _save_good_tag(tmpdir, cfg)
+    fi = engine.checkpoint_storage.fault_injector
+    fi.arm("tmp_write", mode="transient", times=2)
+    _train_steps(engine, 2)
+    engine.save_checkpoint(save_dir, tag="two")
+    assert fi.fired["tmp_write"] == 2
+
+    engine2 = make_simple_engine(tmpdir, cfg, seed=99)
+    fi2 = engine2.checkpoint_storage.fault_injector
+    fi2.arm("read", mode="transient", times=1)
+    name, _ = engine2.load_checkpoint(save_dir)
+    assert "two" in name
+    assert fi2.fired["read"] == 1
+    _tree_equal(engine2.params, jax.device_get(engine.params))
+
+
+def test_ckpt_truncated_shard_falls_back(tmpdir):
+    """A committed tag whose shard got truncated after the fact (partial
+    replication, disk loss) fails size verification and falls back."""
+    cfg = _cfg_ft()
+    engine, save_dir, params_one, steps_one = _save_good_tag(tmpdir, cfg)
+    _train_steps(engine, 2)
+    engine.save_checkpoint(save_dir, tag="two")
+    path = _module_states_file(save_dir, "two")
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+
+    engine2 = make_simple_engine(tmpdir, cfg, seed=99)
+    name, _ = engine2.load_checkpoint(save_dir)
+    assert "one" in name
+    assert engine2.global_steps == steps_one
+    _tree_equal(engine2.params, params_one)
+
+
+def test_ckpt_corrupt_checksum_falls_back(tmpdir):
+    """Same-size bit rot passes the shallow size check but fails the
+    read-time crc32/sha256 verification — fall back, don't load garbage."""
+    cfg = _cfg_ft()
+    engine, save_dir, params_one, _ = _save_good_tag(tmpdir, cfg)
+    _train_steps(engine, 2)
+    engine.save_checkpoint(save_dir, tag="two")
+    path = _module_states_file(save_dir, "two")
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(blob))
+
+    engine2 = make_simple_engine(tmpdir, cfg, seed=99)
+    name, _ = engine2.load_checkpoint(save_dir)
+    assert "one" in name
+    _tree_equal(engine2.params, params_one)
+
+
+def test_ckpt_deleted_latest_loads_newest_committed(tmpdir):
+    """`latest` is a derived convenience, not a single point of failure:
+    with it deleted, load resolves the newest committed tag by manifest
+    sequence."""
+    cfg = _cfg_ft()
+    engine, save_dir, _, _ = _save_good_tag(tmpdir, cfg)
+    _train_steps(engine, 2)
+    engine.save_checkpoint(save_dir, tag="two")
+    os.remove(os.path.join(save_dir, "latest"))
+
+    engine2 = make_simple_engine(tmpdir, cfg, seed=99)
+    name, _ = engine2.load_checkpoint(save_dir)
+    assert "two" in name
+    _tree_equal(engine2.params, jax.device_get(engine.params))
+
+    # and the manifest is a sane, self-describing commit record
+    manifest = read_manifest(os.path.join(save_dir, "two"))
+    assert manifest["format_version"] == 1
+    assert manifest["sequence"] == 2
+    for entry in manifest["files"].values():
+        assert entry["bytes"] > 0 and entry["crc32"] and entry["sha256"]
+
+
+def test_ckpt_crash_between_commit_and_latest(tmpdir):
+    """A crash AFTER the manifest commit but BEFORE the `latest` update
+    leaves a stale hint — the newest committed tag must still win (load
+    order is derived from manifest sequences, not the hint)."""
+    cfg = _cfg_ft()
+    engine, save_dir, _, _ = _save_good_tag(tmpdir, cfg)
+    _train_steps(engine, 2)
+    engine.checkpoint_storage.fault_injector.arm("latest_write", mode="crash")
+    with pytest.raises(InjectedCrash):
+        engine.save_checkpoint(save_dir, tag="two")
+    engine.checkpoint_storage.fault_injector.disarm()
+    assert open(os.path.join(save_dir, "latest")).read().strip() == "one"  # stale
+
+    engine2 = make_simple_engine(tmpdir, cfg, seed=99)
+    name, _ = engine2.load_checkpoint(save_dir)
+    assert "two" in name
+    _tree_equal(engine2.params, jax.device_get(engine.params))
+
+
+def test_ckpt_all_candidates_corrupt_raises_named_error(tmpdir):
+    """When every candidate fails verification the engine raises the
+    named corruption error instead of a bare unpickling traceback."""
+    cfg = _cfg_ft()
+    engine, save_dir, _, _ = _save_good_tag(tmpdir, cfg)
+    path = _module_states_file(save_dir, "one")
+    with open(path, "wb") as f:
+        f.write(b"not a pickle")
+
+    engine2 = make_simple_engine(tmpdir, cfg, seed=99)
+    with pytest.raises(CheckpointCorruptionError):
+        engine2.load_checkpoint(save_dir)
+
+
+def test_ckpt_rotation_keeps_newest_committed(tmpdir):
+    """keep_last_k=2 across 5 saves leaves exactly the 2 newest committed
+    tags — and a corrupted newest still resumes from the older survivor."""
+    cfg = _cfg_ft(keep_last_k=2)
+    save_dir = str(tmpdir.join("ckpt"))
+    engine = make_simple_engine(tmpdir, cfg)
+    snapshots = {}
+    for i in range(1, 6):
+        _train_steps(engine, 1)
+        engine.save_checkpoint(save_dir, tag=f"t{i}")
+        snapshots[f"t{i}"] = (jax.device_get(engine.params), engine.global_steps)
+
+    tag_dirs = sorted(
+        d for d in os.listdir(save_dir) if os.path.isdir(os.path.join(save_dir, d))
+    )
+    assert tag_dirs == ["t4", "t5"]
+
+    # corrupt the newest -> resume lands on t4, the older committed tag
+    path = _module_states_file(save_dir, "t5")
+    with open(path, "wb") as f:
+        f.write(b"garbage")
+    engine2 = make_simple_engine(tmpdir, cfg, seed=99)
+    name, _ = engine2.load_checkpoint(save_dir)
+    assert "t4" in name
+    params_t4, steps_t4 = snapshots["t4"]
+    assert engine2.global_steps == steps_t4
+    _tree_equal(engine2.params, params_t4)
+
+
+def test_ckpt_rotation_spares_uncommitted_dirs(tmpdir):
+    """Only committed tags rotate: an uncommitted (crashed) save and
+    foreign files in the checkpoint root are never deleted."""
+    cfg = _cfg_ft(keep_last_k=1)
+    engine, save_dir, _, _ = _save_good_tag(tmpdir, cfg, tag="good")
+    engine.checkpoint_storage.fault_injector.arm("manifest_rename", mode="crash")
+    with pytest.raises(InjectedCrash):
+        engine.save_checkpoint(save_dir, tag="crashed")
+    engine.checkpoint_storage.fault_injector.disarm()
+    _train_steps(engine, 1)
+    engine.save_checkpoint(save_dir, tag="good2")  # rotates "good" out
+
+    dirs = {d for d in os.listdir(save_dir) if os.path.isdir(os.path.join(save_dir, d))}
+    assert "good" not in dirs          # rotated (committed, beyond k=1)
+    assert "crashed" in dirs           # uncommitted: never touched
+    assert "good2" in dirs             # newest committed: never deleted
+
+
+def test_ckpt_legacy_tag_without_manifest_loads(tmpdir):
+    """Pre-subsystem checkpoints (no manifest.json) stay loadable through
+    the `latest` hint — no verification, but no regression either."""
+    cfg = _cfg_ft()
+    engine, save_dir, params_one, steps_one = _save_good_tag(tmpdir, cfg)
+    os.remove(os.path.join(save_dir, "one", MANIFEST_NAME))
+
+    engine2 = make_simple_engine(tmpdir, cfg, seed=99)
+    name, _ = engine2.load_checkpoint(save_dir)
+    assert "one" in name
+    assert engine2.global_steps == steps_one
+    _tree_equal(engine2.params, params_one)

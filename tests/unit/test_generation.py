@@ -181,3 +181,26 @@ def test_generate_sampling_knob_validation():
     with pytest.raises(ValueError, match="top_p"):
         generate(params, cfg, p, 2, temperature=1.0,
                  rng=jax.random.PRNGKey(0), top_p=0.0)
+
+
+def test_generate_bf16_params():
+    """Decode must run in the params' compute dtype: a bf16 checkpoint
+    previously crashed at trace time (f32-hardcoded caches/carry vs bf16
+    k/v/logits), and must agree with the bf16 oracle forward."""
+    cfg = _tiny_config()
+    model, params = init_gpt2(cfg, batch_size=2, seq_len=4, seed=0)
+    bf16_params = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+        params)
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 4)), jnp.int32)
+
+    got = generate(bf16_params, cfg, prompt, max_new_tokens=6)
+    assert got.shape == (2, 6)
+    want = _oracle_greedy(model, bf16_params, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # sampling path shares the same carry dtypes
+    s = generate(bf16_params, cfg, prompt, 4, temperature=0.9,
+                 rng=jax.random.PRNGKey(1), top_k=8)
+    assert s.shape == (2, 4)

@@ -125,3 +125,27 @@ def test_close_stops_worker_and_releases():
     with PrefetchLoader(gen(), depth=2) as pf2:
         next(iter(pf2))
     assert not pf2._thread.is_alive()
+
+
+def test_close_timeout_abandons_blocked_source():
+    """A source iterator wedged inside next() cannot be interrupted; close()
+    must still return within its total timeout, abandoning the daemon
+    worker instead of spinning forever."""
+    import threading
+    import time
+
+    release = threading.Event()
+
+    def gen():
+        yield np.ones((2,))
+        release.wait()  # simulates a stalled network read
+        yield np.ones((2,))
+
+    pf = PrefetchLoader(gen(), depth=1)
+    it = iter(pf)
+    next(it)
+    t0 = time.monotonic()
+    pf.close(timeout=0.5)
+    assert time.monotonic() - t0 < 5.0  # bounded, not an unbounded drain
+    release.set()  # let the daemon worker exit for a clean test teardown
+    pf._thread.join(timeout=5.0)
