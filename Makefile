@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast ops bench
+.PHONY: test test-fast test-faults ops bench
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -9,6 +9,12 @@ test:
 
 test-fast:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -x -q -m "not slow"
+
+# Fault-injection suites: checkpoint I/O faults (crash/torn-write/EIO at every
+# protocol point) + step-level resilience (divergence guard, watchdog,
+# rollback recovery). Deterministic on the CPU mesh.
+test-faults:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults
 
 ops:
 	$(MAKE) -C csrc

@@ -359,6 +359,79 @@ def get_checkpoint_config(param_dict):
     )
 
 
+def get_resilience_config(param_dict):
+    """resilience: step-level divergence guard / watchdog / auto-rollback
+    recovery (runtime/resilience/). The block being present enables the
+    subsystem (unless it sets "enabled": false); absent means disabled and
+    the engines' train_batch path is untouched."""
+    from deepspeed_tpu.runtime.resilience import ResilienceConfig
+
+    section = param_dict.get(RESILIENCE, None)
+    params = section or {}
+    enabled = bool(get_scalar_param(params, RESILIENCE_ENABLED, section is not None))
+    spike_window = get_scalar_param(
+        params, RESILIENCE_SPIKE_WINDOW, RESILIENCE_SPIKE_WINDOW_DEFAULT
+    )
+    if not isinstance(spike_window, int) or spike_window < 0:
+        raise ValueError(
+            f"resilience.{RESILIENCE_SPIKE_WINDOW} must be an int >= 0 "
+            f"(0 disables spike detection), got {spike_window!r}"
+        )
+    spike_threshold = get_scalar_param(
+        params, RESILIENCE_SPIKE_THRESHOLD, RESILIENCE_SPIKE_THRESHOLD_DEFAULT
+    )
+    if not spike_threshold > 1.0:
+        raise ValueError(
+            f"resilience.{RESILIENCE_SPIKE_THRESHOLD} must be > 1.0 (a multiple "
+            f"of the rolling median), got {spike_threshold!r}"
+        )
+    max_recoveries = get_scalar_param(
+        params, RESILIENCE_MAX_RECOVERIES, RESILIENCE_MAX_RECOVERIES_DEFAULT
+    )
+    if not isinstance(max_recoveries, int) or max_recoveries < 0:
+        raise ValueError(
+            f"resilience.{RESILIENCE_MAX_RECOVERIES} must be an int >= 0, "
+            f"got {max_recoveries!r}"
+        )
+    recovery_backoff_s = get_scalar_param(
+        params, RESILIENCE_RECOVERY_BACKOFF, RESILIENCE_RECOVERY_BACKOFF_DEFAULT
+    )
+    if recovery_backoff_s < 0:
+        raise ValueError(
+            f"resilience.{RESILIENCE_RECOVERY_BACKOFF} must be >= 0, "
+            f"got {recovery_backoff_s!r}"
+        )
+    step_timeout_s = get_scalar_param(
+        params, RESILIENCE_STEP_TIMEOUT, RESILIENCE_STEP_TIMEOUT_DEFAULT
+    )
+    if step_timeout_s < 0:
+        raise ValueError(
+            f"resilience.{RESILIENCE_STEP_TIMEOUT} must be >= 0 "
+            f"(0 disables the watchdog), got {step_timeout_s!r}"
+        )
+    fault_injection = params.get(RESILIENCE_FAULT_INJECTION, None)
+    if fault_injection is not None and not isinstance(fault_injection, dict):
+        raise ValueError(
+            f"resilience.{RESILIENCE_FAULT_INJECTION} must be a dict of "
+            f"fault-point specs, got {type(fault_injection).__name__}"
+        )
+    return ResilienceConfig(
+        enabled=enabled,
+        divergence_check=bool(get_scalar_param(
+            params, RESILIENCE_DIVERGENCE_CHECK, RESILIENCE_DIVERGENCE_CHECK_DEFAULT
+        )),
+        spike_window=spike_window,
+        spike_threshold=float(spike_threshold),
+        max_recoveries=max_recoveries,
+        recovery_backoff_s=float(recovery_backoff_s),
+        skip_poisoned_batches=bool(get_scalar_param(
+            params, RESILIENCE_SKIP_POISONED_BATCHES, RESILIENCE_SKIP_POISONED_BATCHES_DEFAULT
+        )),
+        step_timeout_s=float(step_timeout_s),
+        fault_injection=fault_injection,
+    )
+
+
 def get_progressive_layer_drop(param_dict):
     pld_dict = param_dict.get(PROGRESSIVE_LAYER_DROP, {})
     enabled = get_scalar_param(pld_dict, PLD_ENABLED, PLD_ENABLED_DEFAULT)
@@ -520,6 +593,7 @@ class DeepSpeedConfig:
         self.checkpoint_tag_validation_enabled = mode != CHECKPOINT_TAG_VALIDATION_IGNORE
         self.checkpoint_tag_validation_fail = mode == CHECKPOINT_TAG_VALIDATION_FAIL
         self.checkpoint_config = get_checkpoint_config(param_dict)
+        self.resilience_config = get_resilience_config(param_dict)
 
         (
             self.pld_enabled,

@@ -167,6 +167,29 @@ CHECKPOINT_VERIFY_ON_LOAD_DEFAULT = True
 CHECKPOINT_FAULT_INJECTION = "fault_injection"
 
 #############################################
+# Resilience (runtime/resilience/ subsystem: divergence guard, hung-step
+# watchdog, auto-rollback recovery). Opt-in: the block being present in the
+# config enables it; absent means the engines run exactly as before.
+#############################################
+RESILIENCE = "resilience"
+RESILIENCE_ENABLED = "enabled"
+RESILIENCE_DIVERGENCE_CHECK = "divergence_check"
+RESILIENCE_DIVERGENCE_CHECK_DEFAULT = True
+RESILIENCE_SPIKE_WINDOW = "spike_window"
+RESILIENCE_SPIKE_WINDOW_DEFAULT = 0  # 0 = no spike detection
+RESILIENCE_SPIKE_THRESHOLD = "spike_threshold"
+RESILIENCE_SPIKE_THRESHOLD_DEFAULT = 10.0  # x rolling median
+RESILIENCE_MAX_RECOVERIES = "max_recoveries"
+RESILIENCE_MAX_RECOVERIES_DEFAULT = 2
+RESILIENCE_RECOVERY_BACKOFF = "recovery_backoff_s"
+RESILIENCE_RECOVERY_BACKOFF_DEFAULT = 0.05
+RESILIENCE_SKIP_POISONED_BATCHES = "skip_poisoned_batches"
+RESILIENCE_SKIP_POISONED_BATCHES_DEFAULT = True
+RESILIENCE_STEP_TIMEOUT = "step_timeout_s"
+RESILIENCE_STEP_TIMEOUT_DEFAULT = 0.0  # 0 = watchdog off
+RESILIENCE_FAULT_INJECTION = "fault_injection"
+
+#############################################
 # Sparse attention
 #############################################
 SPARSE_ATTENTION = "sparse_attention"

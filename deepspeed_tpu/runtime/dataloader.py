@@ -151,7 +151,10 @@ class PrefetchLoader:
                 if self._closed:
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised on the consumer side
-            self._queue.put(("err", e))
+            # Ship the exception WITH the traceback captured here on the
+            # worker, so the consumer-side re-raise names the real cause
+            # (the frame inside the source iterator), not this wrapper.
+            self._queue.put(("err", e.with_traceback(e.__traceback__)))
             return
         self._queue.put(("end", None))
 
@@ -173,7 +176,11 @@ class PrefetchLoader:
             return payload
         self._done = True
         if kind == "err":
-            raise payload
+            # Re-raise the ORIGINAL exception object on the consumer thread,
+            # explicitly carrying the worker-side traceback and the original
+            # cause chain (`raise ... from`): poisoned-batch diagnostics must
+            # point at the source iterator's frame, not at this queue pop.
+            raise payload.with_traceback(payload.__traceback__) from payload.__cause__
         raise StopIteration
 
     def close(self, timeout=5.0):

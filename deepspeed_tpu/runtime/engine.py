@@ -326,6 +326,12 @@ class DeepSpeedEngine:
 
         self.monitor = monitor_from_config(self._config, self.global_rank)
 
+        # step-level resilience: divergence guard + watchdog + auto-rollback
+        # recovery (None unless the config has a `resilience` block)
+        from deepspeed_tpu.runtime.resilience import ResilienceSupervisor
+
+        self.resilience = ResilienceSupervisor.from_ds_config(self._config, self)
+
         if self.global_rank == 0:
             self._config.print("DeepSpeedEngine configuration")
 
@@ -1459,17 +1465,28 @@ class DeepSpeedEngine:
     def train_batch(self, data_iter=None):
         """Convenience: run gas micro-steps + optimizer step, return mean loss.
         Uses the fused scanned program when the config allows; falls back to
-        the 3-call micro loop (1-bit / offload / profiling)."""
+        the 3-call micro loop (1-bit / offload / profiling). With a
+        `resilience` config block the step runs supervised: watchdog-bounded
+        fetch, post-step divergence guard, and rollback recovery
+        (runtime/resilience/, see docs/resilience.md)."""
         if data_iter is None:
             assert self.training_dataloader is not None
             data_iter = iter(self.training_dataloader)
         gas = self.gradient_accumulation_steps()
+        if self.resilience is not None:
+            return self.resilience.train_batch(data_iter, self._train_batch_now, gas)
+        micro = [next(data_iter) for _ in range(gas)]
+        return self._train_batch_now(micro)
+
+    def _train_batch_now(self, micro):
+        """One full optimizer step over already-fetched microbatches (the
+        un-supervised core of train_batch); returns the mean loss as a host
+        float. This is the callable the resilience supervisor retries and
+        replays — it must consume ONLY its arguments and engine state."""
         if self._can_fuse_train_step():
-            micro = [next(data_iter) for _ in range(gas)]
             return float(jax.device_get(self.train_step(micro)))
         losses = []
-        for _ in range(gas):
-            batch = next(data_iter)
+        for batch in micro:
             if not isinstance(batch, (tuple, list)):
                 batch = (batch,)
             loss = self.forward(*batch)
@@ -1558,6 +1575,10 @@ class DeepSpeedEngine:
                 optimizer=None if self.zero_optimization() else self.optimizer_state_dict(),
                 lr_scheduler=self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
                 scaler=jax.device_get(self.scaler_state),
+                # rng stream position: restoring it makes a resumed (or
+                # rolled-back-and-replayed) run reproduce the original
+                # trajectory exactly even for modules that draw rng per step
+                step_rng=jax.device_get(self._step_rng),
                 csr_tensor_module_names=self.csr_tensor_module_names,
                 skipped_steps=self.skipped_steps,
                 global_steps=self.global_steps,
@@ -1587,6 +1608,10 @@ class DeepSpeedEngine:
             if save_latest:
                 storage.write_latest(save_dir, tag)
             storage.rotate(save_dir)
+        if self.resilience is not None:
+            # the committed tag is the new rollback target; the replay
+            # buffer restarts from here
+            self.resilience.note_checkpoint(save_dir, tag)
         if self.monitor is not None:
             self.monitor.flush()
         return True
@@ -1711,15 +1736,19 @@ class DeepSpeedEngine:
         self.global_steps = checkpoint.get("global_steps", 0)
         self.global_samples = checkpoint.get("global_samples", self.global_steps * self.train_batch_size())
         self.skipped_steps = checkpoint.get("skipped_steps", 0)
+        if checkpoint.get("step_rng") is not None:
+            self._step_rng = jnp.asarray(checkpoint["step_rng"])
         if self.curriculum_scheduler is not None:
             # difficulty is a pure function of the step — recompute, don't store
             self.curriculum_scheduler.update_difficulty(self.global_steps)
 
         deepspeed_states = [
-            "module", "optimizer", "lr_scheduler", "scaler", "csr_tensor_module_names",
+            "module", "optimizer", "lr_scheduler", "scaler", "step_rng", "csr_tensor_module_names",
             "skipped_steps", "global_steps", "global_samples", "dp_world_size", "mp_world_size",
         ]
         client_state = {k: v for k, v in checkpoint.items() if k not in deepspeed_states}
+        if self.resilience is not None:
+            self.resilience.note_restore(load_dir, tag)
         log_dist(f"Loaded checkpoint {ckpt_name} at global step {self.global_steps}", ranks=[0])
         return ckpt_name, client_state
 

@@ -62,9 +62,15 @@ def update_scaler(state: DynamicScalerState, overflow, *, scale_factor=2.0, scal
     # Clean path.
     window_elapsed = ((state.cur_iter - state.last_overflow_iter) % scale_window) == 0
     ok_scale = jnp.where(window_elapsed, state.cur_scale * scale_factor, state.cur_scale)
-    ok_hysteresis = jnp.where(
-        window_elapsed & (not consecutive_hysteresis), jnp.asarray(delayed_shift, jnp.int32), state.cur_hysteresis
-    )
+    if consecutive_hysteresis:
+        # Reference DynamicLossScaler.update_scale resets the hysteresis
+        # budget on EVERY clean step in this mode (only consecutive
+        # overflows draw it down), not just at window boundaries.
+        ok_hysteresis = jnp.full_like(state.cur_hysteresis, delayed_shift)
+    else:
+        ok_hysteresis = jnp.where(
+            window_elapsed, jnp.asarray(delayed_shift, jnp.int32), state.cur_hysteresis
+        )
 
     return DynamicScalerState(
         cur_scale=jnp.where(overflow, of_scale, ok_scale),

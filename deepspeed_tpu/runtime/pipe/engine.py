@@ -218,6 +218,13 @@ class PipelineEngine:
 
         self.monitor = monitor_from_config(self._config, dist.get_rank())
 
+        # step-level resilience: divergence guard + watchdog + auto-rollback
+        # recovery, shared with DeepSpeedEngine (None unless the config has a
+        # `resilience` block)
+        from deepspeed_tpu.runtime.resilience import ResilienceSupervisor
+
+        self.resilience = ResilienceSupervisor.from_ds_config(self._config, self)
+
         # curriculum learning (beyond the v0.3.10 reference) — same wiring
         # as DeepSpeedEngine so the config section works under pipelines too
         self.curriculum_scheduler = None
@@ -1253,9 +1260,21 @@ class PipelineEngine:
         if data_iter is None:
             assert self.training_dataloader is not None, "no training data"
             data_iter = iter(self.training_dataloader)
-
-        self.tput_timer.start()
+        if self.resilience is not None:
+            # supervised path: watchdog-bounded fetch + divergence guard +
+            # rollback recovery (runtime/resilience/, see docs/resilience.md)
+            return self.resilience.train_batch(
+                data_iter, self._train_batch_now, self.micro_batches,
+                transform=self._split_batch,
+            )
         micro = [self._split_batch(next(data_iter)) for _ in range(self.micro_batches)]
+        return self._train_batch_now(micro)
+
+    def _train_batch_now(self, micro):
+        """One full pipeline step over already-split microbatches (the
+        un-supervised core of train_batch); returns agg_train_loss as a host
+        float. The resilience supervisor retries/replays this callable."""
+        self.tput_timer.start()
         self._ensure_params(micro[0][0])
 
         mode = (
@@ -1723,6 +1742,10 @@ class PipelineEngine:
         write = dist.get_rank() == 0
         layer_params = self._gather_layer_params()
         if not write:
+            if self.resilience is not None:
+                # rank 0 commits the tag; every rank's supervisor must agree
+                # on the rollback target and restart its replay buffer
+                self.resilience.note_checkpoint(save_dir, tag)
             return True
         storage = self.checkpoint_storage
         writer = storage.tag_writer(save_dir, tag)
@@ -1763,6 +1786,8 @@ class PipelineEngine:
         if save_latest:
             storage.write_latest(save_dir, tag)
         storage.rotate(save_dir)
+        if self.resilience is not None:
+            self.resilience.note_checkpoint(save_dir, tag)
         return True
 
     def _gather_layer_params(self):
@@ -2023,6 +2048,8 @@ class PipelineEngine:
         self.skipped_steps = meta.get("skipped_steps", self.skipped_steps)
         if self.lr_scheduler is not None and meta.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        if self.resilience is not None:
+            self.resilience.note_restore(load_dir, tag)
         return path, meta.get("client_state", {})
 
 

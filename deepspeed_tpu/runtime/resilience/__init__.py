@@ -1,0 +1,24 @@
+"""Step-level training resilience: divergence guard, hung-step watchdog,
+and auto-rollback recovery on top of the fault-tolerant checkpoint layer.
+
+See docs/resilience.md for the protocol and the ``resilience`` config block.
+"""
+
+from deepspeed_tpu.runtime.resilience.config import ResilienceConfig
+from deepspeed_tpu.runtime.resilience.errors import StepTimeoutError, TrainingDivergenceError
+from deepspeed_tpu.runtime.resilience.fault_injection import InjectedLoaderError, StepFaultInjector
+from deepspeed_tpu.runtime.resilience.guard import DivergenceGuard
+from deepspeed_tpu.runtime.resilience.supervisor import ResilienceSupervisor
+from deepspeed_tpu.runtime.resilience.watchdog import TimedFetcher, timed_call
+
+__all__ = [
+    "DivergenceGuard",
+    "InjectedLoaderError",
+    "ResilienceConfig",
+    "ResilienceSupervisor",
+    "StepFaultInjector",
+    "StepTimeoutError",
+    "TimedFetcher",
+    "TrainingDivergenceError",
+    "timed_call",
+]

@@ -1,0 +1,39 @@
+"""Typed view of the ``resilience`` config block.
+
+Parsed and validated by ``runtime/config.py::get_resilience_config`` (key
+strings and defaults live in ``runtime/constants.py`` next to the checkpoint
+block). The subsystem is opt-in: with no ``resilience`` section in the config
+the engines behave exactly as before — no guard, no watchdog, no recovery.
+"""
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ResilienceConfig:
+    # Master switch: defaults to True once a `resilience` section exists,
+    # False when the section is absent (see get_resilience_config).
+    enabled: bool = False
+    # Check post-step loss for non-finite values (NaN/inf) every step.
+    divergence_check: bool = True
+    # Rolling-median spike detection over the last `spike_window` clean
+    # losses; 0 disables spike detection (non-finite checks still apply).
+    spike_window: int = 0
+    # A step diverges when loss > median + (spike_threshold - 1) * |median|
+    # (i.e. spike_threshold x the rolling median for the usual positive
+    # losses). Must be > 1.
+    spike_threshold: float = 10.0
+    # Bounded recovery attempts per failing step before surfacing
+    # TrainingDivergenceError.
+    max_recoveries: int = 2
+    # Base backoff between recovery attempts (doubles per attempt).
+    recovery_backoff_s: float = 0.05
+    # After one failed retry of the same batch window, quarantine it and
+    # move on instead of burning the remaining attempts on poisoned data.
+    skip_poisoned_batches: bool = True
+    # Wall-time bound per train step / per data fetch; 0 disables the
+    # watchdog.
+    step_timeout_s: float = 0.0
+    # Step-level fault-injection spec (tests only): see
+    # resilience/fault_injection.py for the accepted points.
+    fault_injection: dict = field(default=None)

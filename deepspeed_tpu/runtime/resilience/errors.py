@@ -1,0 +1,40 @@
+"""Named errors for the step-level resilience subsystem.
+
+``TrainingDivergenceError`` is the terminal surface of the recovery policy:
+it carries everything an operator (or an outer restart loop) needs to act —
+the failing step, how many recoveries were attempted, and which committed
+checkpoint tag the rollbacks used.
+
+``StepTimeoutError`` is the recoverable form of a wedged iterator or host
+callback: the watchdog raises it instead of hanging forever, and the
+supervisor treats it exactly like a divergence (rollback + replay + retry).
+"""
+
+
+class TrainingDivergenceError(RuntimeError):
+    """Training diverged and the recovery policy is out of options."""
+
+    def __init__(self, step, attempts, checkpoint_tag, reason):
+        self.step = step
+        self.attempts = attempts
+        self.checkpoint_tag = checkpoint_tag
+        self.reason = reason
+        super().__init__(
+            f"training diverged at step {step} after {attempts} recovery "
+            f"attempt(s) (checkpoint tag used: {checkpoint_tag!r}): {reason}"
+        )
+
+
+class StepTimeoutError(TimeoutError):
+    """A train step or data fetch exceeded ``resilience.step_timeout_s``.
+
+    ``thread`` (when set) is the abandoned worker still executing the wedged
+    call; the recovery path joins it (bounded) before mutating engine state
+    so a late completion cannot race a rollback.
+    """
+
+    def __init__(self, what, timeout_s, thread=None):
+        self.what = what
+        self.timeout_s = timeout_s
+        self.thread = thread
+        super().__init__(f"{what} exceeded the {timeout_s}s watchdog timeout")

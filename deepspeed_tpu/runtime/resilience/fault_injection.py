@@ -1,0 +1,155 @@
+"""Deterministic step-level fault injection for the resilience subsystem.
+
+Extends the PR 1 checkpoint ``FaultInjector`` (I/O faults: crash/transient/
+torn-write at storage protocol points) with *training-step* faults so every
+recovery path is testable on CPU without a real divergence:
+
+    nan_loss      replace the observed step loss with NaN (or inf) at step N
+    spike_loss    multiply the observed step loss by ``factor`` at step N
+    poison_batch  NaN-fill the float leaves of the step's batch window at
+                  step N — corrupts gradients and therefore params, the
+                  "truly poisoned data" scenario (persistent by default)
+    hang_fetch    sleep ``seconds`` inside the loader's next() at step N
+                  (exercises the data-fetch watchdog)
+    hang_step     sleep ``seconds`` before the train step at step N
+                  (exercises the whole-step watchdog)
+    fail_fetch    raise InjectedLoaderError from the data fetch ``times``
+                  times, then succeed (fail-K-then-succeed)
+
+Each arm takes ``at_step`` (int, or None for every step) and ``times``
+(int, or None for "every time it matches" — e.g. a persistently poisoned
+batch that fails every retry). ``fired`` counts per point, inherited from
+the base class, for test assertions. Because the class subclasses the
+checkpoint injector, one spec may combine step faults with I/O faults::
+
+    {"nan_loss": {"at_step": 3},
+     "fail_fetch": {"at_step": 1, "times": 2},
+     "rename": {"mode": "crash"}}         # checkpoint-level, via the base
+
+Programmatically::
+
+    fi = StepFaultInjector()
+    fi.arm_step("nan_loss", at_step=3)
+    fi.arm_step("poison_batch", at_step=4, times=None)
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.checkpoint.fault_injection import FaultInjector
+
+STEP_POINTS = (
+    "nan_loss",
+    "spike_loss",
+    "poison_batch",
+    "hang_fetch",
+    "hang_step",
+    "fail_fetch",
+)
+
+
+class InjectedLoaderError(RuntimeError):
+    """Simulated data-loader failure (fail-K-then-succeed arm)."""
+
+
+class _StepArm:
+    __slots__ = ("at_step", "times", "factor", "seconds", "value")
+
+    def __init__(self, at_step=None, times=1, factor=100.0, seconds=0.25, value="nan"):
+        self.at_step = None if at_step is None else int(at_step)
+        self.times = None if times is None else int(times)
+        self.factor = float(factor)
+        self.seconds = float(seconds)
+        if value not in ("nan", "inf"):
+            raise ValueError(f"nan_loss value must be 'nan' or 'inf', got {value!r}")
+        self.value = value
+
+
+class StepFaultInjector(FaultInjector):
+    """Checkpoint-I/O fault injector + step-level training faults."""
+
+    def __init__(self, spec=None):
+        spec = dict(spec or {})
+        step_spec = {p: spec.pop(p) for p in list(spec) if p in STEP_POINTS}
+        super().__init__(spec)  # remaining points are checkpoint I/O arms
+        self._step_arms = {}
+        for point, cfg in step_spec.items():
+            self.arm_step(point, **dict(cfg or {}))
+
+    def arm_step(self, point, **kwargs):
+        if point not in STEP_POINTS:
+            raise ValueError(
+                f"unknown step fault point '{point}' (known: {', '.join(STEP_POINTS)})"
+            )
+        self._step_arms[point] = _StepArm(**kwargs)
+        return self
+
+    def disarm_step(self, point=None):
+        if point is None:
+            self._step_arms.clear()
+        else:
+            self._step_arms.pop(point, None)
+
+    def _take(self, point, step):
+        """True (and consume one firing) when ``point`` is armed for ``step``."""
+        arm = self._step_arms.get(point)
+        if arm is None:
+            return None
+        if arm.at_step is not None and step != arm.at_step:
+            return None
+        if arm.times is not None:
+            if arm.times <= 0:
+                return None
+            arm.times -= 1
+        self._fire(point)
+        return arm
+
+    # -- hooks the supervisor calls ------------------------------------
+    def corrupt_loss(self, step, loss):
+        """Apply nan_loss / spike_loss arms to the observed host loss."""
+        arm = self._take("nan_loss", step)
+        if arm is not None:
+            return float("nan") if arm.value == "nan" else float("inf")
+        arm = self._take("spike_loss", step)
+        if arm is not None:
+            return float(loss) * arm.factor
+        return loss
+
+    def corrupt_batches(self, step, microbatches):
+        """Apply the poison_batch arm: NaN-fill every float leaf of the
+        step's microbatches (ints — labels, masks — stay intact). The
+        caller keeps the CLEAN batches in its replay buffer; corruption is
+        per-execution, so ``times`` bounds how many retries stay poisoned."""
+        arm = self._take("poison_batch", step)
+        if arm is None:
+            return microbatches
+
+        def poison(x):
+            x = jnp.asarray(x)
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return jnp.full_like(x, jnp.nan)
+            return x
+
+        return [jax.tree_util.tree_map(poison, mb) for mb in microbatches]
+
+    def maybe_hang_fetch(self, step):
+        arm = self._take("hang_fetch", step)
+        if arm is not None:
+            time.sleep(arm.seconds)
+
+    def maybe_hang_step(self, step):
+        arm = self._take("hang_step", step)
+        if arm is not None:
+            time.sleep(arm.seconds)
+
+    def check_fetch(self, step):
+        """Raise InjectedLoaderError while the fail_fetch arm has firings
+        left (fail K times, then succeed)."""
+        arm = self._take("fail_fetch", step)
+        if arm is not None:
+            raise InjectedLoaderError(
+                f"injected loader failure at step {step} "
+                f"({self.fired.get('fail_fetch', 0)} so far)"
+            )

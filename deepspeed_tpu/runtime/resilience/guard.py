@@ -1,0 +1,72 @@
+"""Divergence guard: post-step anomaly detection on the host loss.
+
+Two detectors, both cheap (the engines already sync the step loss to the
+host before returning it from ``train_batch``):
+
+- **non-finite**: NaN/inf loss. Crucially this is NOT the same event as an
+  fp16 loss-scale overflow — overflow means the *gradients* went non-finite
+  at the current scale, the scaler already skipped the update on device
+  (``fp16/loss_scaler.py``), and the step is recoverable by backoff of the
+  scale alone. The guard therefore ignores steps the engine flagged as
+  overflow-skipped and only treats a non-finite *loss* (or a non-finite
+  loss on a non-overflow step) as true divergence.
+
+- **spike**: rolling median over the last ``spike_window`` clean losses;
+  a step whose loss exceeds ``median + (spike_threshold - 1) * |median|``
+  (i.e. ``spike_threshold`` x the median for ordinary positive losses) is
+  flagged. The window only accumulates clean, non-overflow steps, so a
+  quarantined batch never pollutes the baseline.
+
+``check`` returns ``None`` for a clean step or a human-readable reason
+string for a diverged one; the supervisor turns reasons into recoveries.
+"""
+
+import math
+import statistics
+from collections import deque
+
+
+class DivergenceGuard:
+    def __init__(self, divergence_check=True, spike_window=0, spike_threshold=10.0):
+        self.divergence_check = divergence_check
+        self.spike_window = int(spike_window)
+        self.spike_threshold = float(spike_threshold)
+        self._window = deque(maxlen=self.spike_window or 1)
+
+    def reset(self):
+        """Forget the loss history (called after a rollback: the replayed
+        trajectory repopulates the window from known-clean steps)."""
+        self._window.clear()
+
+    def check(self, step, loss, overflow=False, grad_norm=None):
+        """Verdict for one completed step. ``loss`` is a host float;
+        ``overflow`` is the engine's loss-scaler verdict for the step;
+        ``grad_norm`` (optional, host float) is checked for non-finite
+        values the same way the loss is. Clean steps are recorded into
+        the spike window; anomalies are not."""
+        if not self.divergence_check:
+            return None
+        if overflow:
+            # Loss-scale overflow: the scaler skipped the update and backed
+            # the scale off — already handled, not a divergence. Don't let
+            # the (possibly inf) loss of a skipped step into the window.
+            return None
+        loss = float(loss)
+        if not math.isfinite(loss):
+            return f"non-finite loss {loss!r} at step {step}"
+        if grad_norm is not None:
+            gn = float(grad_norm)
+            if not math.isfinite(gn):
+                return f"non-finite grad norm {gn!r} at step {step} (loss {loss:.6g})"
+        if self.spike_window > 0 and len(self._window) >= self.spike_window:
+            median = statistics.median(self._window)
+            limit = median + (self.spike_threshold - 1.0) * max(abs(median), 1e-6)
+            if loss > limit:
+                return (
+                    f"loss spike at step {step}: {loss:.6g} > {limit:.6g} "
+                    f"(rolling median {median:.6g} over {len(self._window)} steps, "
+                    f"threshold x{self.spike_threshold:g})"
+                )
+        if self.spike_window > 0:
+            self._window.append(loss)
+        return None

@@ -1,0 +1,292 @@
+"""Step-level resilience supervisor: guard + watchdog + rollback recovery.
+
+Wired into ``DeepSpeedEngine.train_batch`` and ``PipelineEngine.train_batch``
+(both delegate here when a ``resilience`` config block is present). One
+supervised ``train_batch`` does:
+
+1. **fetch** the step's batch window through the watchdog (bounded wall-time
+   per ``next()``; injected loader failures retried with backoff),
+2. **execute** the engine's raw step on those batches (optionally bounded
+   by the watchdog as a whole),
+3. **check** the host loss with the ``DivergenceGuard`` — fp16 loss-scale
+   overflows are *not* divergence (the scaler already skipped the update
+   on device); non-finite losses and rolling-median spikes are,
+4. on divergence/timeout, **recover**: back off, roll back to the newest
+   committed checkpoint (PR 1 ``runtime/checkpoint/`` subsystem), replay
+   the buffered batch windows since that checkpoint to fast-forward the
+   trajectory deterministically to the failing step, then retry the batch
+   — or, from the second attempt with ``skip_poisoned_batches``, quarantine
+   the window and move on to the next one,
+5. after ``max_recoveries`` failed attempts, surface a named
+   ``TrainingDivergenceError`` carrying the step, attempt count and the
+   checkpoint tag the rollbacks used.
+
+The replay buffer holds every batch window executed since the last committed
+checkpoint (cleared on each ``save_checkpoint``), which is what makes the
+fast-forward exact: same batches, same order, same restored optimizer/scaler
+/rng state. Checkpoint periodically — the buffer (and the recovery's replay
+cost) grows with the distance to the last commit.
+"""
+
+from deepspeed_tpu.runtime.resilience.errors import StepTimeoutError, TrainingDivergenceError
+from deepspeed_tpu.runtime.resilience.guard import DivergenceGuard
+from deepspeed_tpu.runtime.resilience.watchdog import TimedFetcher, timed_call
+from deepspeed_tpu.utils.logging import logger
+
+_HISTORY_WARN_LEN = 1024
+
+
+class ResilienceSupervisor:
+    def __init__(self, config, engine):
+        self.config = config
+        self.engine = engine
+        self.guard = DivergenceGuard(
+            divergence_check=config.divergence_check,
+            spike_window=config.spike_window,
+            spike_threshold=config.spike_threshold,
+        )
+        self.injector = None
+        if config.fault_injection:
+            from deepspeed_tpu.runtime.resilience.fault_injection import StepFaultInjector
+
+            self.injector = StepFaultInjector(config.fault_injection)
+        # Batch windows executed since the last committed checkpoint:
+        # [(global_step, microbatches), ...] — the deterministic fast-forward
+        # source for rollback recovery.
+        self._history = []
+        self._history_warned = False
+        self._ckpt_dir = None
+        self._ckpt_tag = None
+        self._in_recovery = False
+        self._fetch_src = None
+        self._fetcher = None
+        self._consecutive_quarantines = 0
+        self._steps_seen = 0
+        # Stats for tests/operators.
+        self.total_recoveries = 0
+        self.quarantined_steps = []
+
+    @classmethod
+    def from_ds_config(cls, ds_config, engine):
+        """Supervisor when the config enables resilience, else None."""
+        rc = getattr(ds_config, "resilience_config", None)
+        if rc is None or not rc.enabled:
+            return None
+        return cls(rc, engine)
+
+    # ------------------------------------------------------------------
+    # checkpoint bookkeeping (engines call these from save/load_checkpoint)
+    # ------------------------------------------------------------------
+    def note_checkpoint(self, save_dir, tag):
+        """A tag just committed: it becomes the rollback target and the
+        replay buffer restarts from here."""
+        self._ckpt_dir, self._ckpt_tag = save_dir, str(tag)
+        self._history.clear()
+        self._history_warned = False
+
+    def note_restore(self, load_dir, tag):
+        """A user-initiated restore invalidates the replay buffer (the
+        trajectory changed under us). Rollbacks the supervisor itself
+        performs do NOT pass through here — they need the buffer intact."""
+        if self._in_recovery:
+            return
+        self._ckpt_dir, self._ckpt_tag = load_dir, str(tag)
+        self._history.clear()
+        self._history_warned = False
+        self.guard.reset()
+
+    # ------------------------------------------------------------------
+    # supervised train_batch
+    # ------------------------------------------------------------------
+    def train_batch(self, data_iter, raw_step, n_micro, transform=None):
+        """Run one full (guarded, recoverable) optimizer step. ``raw_step``
+        is the engine's un-supervised step over a list of ``n_micro``
+        already-fetched microbatches, returning the host-float loss;
+        ``transform`` is applied per fetched batch (pipeline batch split)."""
+        while True:
+            micro = self._fetch_window(data_iter, n_micro, transform)
+            loss = self._step_with_recovery(micro, raw_step)
+            if loss is not None:
+                self._consecutive_quarantines = 0
+                return loss
+            # window quarantined: fetch the next one and try again
+
+    # ------------------------------------------------------------------
+    # data fetch (watchdog-bounded, injectable, retried)
+    # ------------------------------------------------------------------
+    def _fetcher_for(self, data_iter):
+        if self._fetch_src is not data_iter:
+            self._fetch_src = data_iter
+            self._fetcher = TimedFetcher(
+                data_iter,
+                hook=lambda: (
+                    self.injector.maybe_hang_fetch(self.engine.global_steps)
+                    if self.injector is not None else None
+                ),
+            )
+        return self._fetcher
+
+    def _fetch_window(self, data_iter, n, transform):
+        return [self._fetch_one(data_iter, transform) for _ in range(n)]
+
+    def _fetch_one(self, data_iter, transform):
+        step = self.engine.global_steps
+        fetcher = self._fetcher_for(data_iter)
+        failures = 0
+        while True:
+            try:
+                if self.injector is not None:
+                    self.injector.check_fetch(step)
+                batch = fetcher.next(self.config.step_timeout_s)
+                return batch if transform is None else transform(batch)
+            except StopIteration:
+                raise  # end of data is not a fault
+            except Exception as e:  # noqa: BLE001 — incl. StepTimeoutError
+                failures += 1
+                if failures > self.config.max_recoveries:
+                    raise
+                what = "timed out" if isinstance(e, StepTimeoutError) else f"failed ({e})"
+                logger.warning(
+                    f"[resilience] data fetch at step {step} {what}; "
+                    f"retry {failures}/{self.config.max_recoveries}"
+                )
+                self._sleep_backoff(failures)
+
+    # ------------------------------------------------------------------
+    # guarded step + recovery policy
+    # ------------------------------------------------------------------
+    def _execute(self, raw_step, micro, step):
+        run_micro = micro
+        if self.injector is not None:
+            run_micro = self.injector.corrupt_batches(step, micro)
+
+        def run():
+            if self.injector is not None:
+                self.injector.maybe_hang_step(step)
+            return raw_step(run_micro)
+
+        # The very first step traces + compiles the jitted program, which
+        # dwarfs a steady-state step's wall time — exempt it from the step
+        # bound (the data-fetch bound still applies from the start).
+        step_timeout = self.config.step_timeout_s if self._steps_seen > 0 else 0
+        loss = timed_call(run, step_timeout, what=f"train step {step}")
+        if self.injector is not None:
+            loss = self.injector.corrupt_loss(step, loss)
+        return loss
+
+    def _step_with_recovery(self, micro, raw_step):
+        eng = self.engine
+        step = eng.global_steps
+        attempts = 0
+        while True:
+            reason, zombie, loss = None, None, None
+            try:
+                loss = self._execute(raw_step, micro, step)
+                reason = self.guard.check(
+                    step, loss, overflow=bool(getattr(eng, "_last_overflow", False))
+                )
+            except StepTimeoutError as e:
+                reason, zombie = str(e), e.thread
+            if reason is None:
+                self._record(step, micro)
+                return loss
+            self.guard.reset()
+            if attempts >= self.config.max_recoveries:
+                raise TrainingDivergenceError(
+                    step=step, attempts=attempts,
+                    checkpoint_tag=self._ckpt_tag, reason=reason,
+                )
+            attempts += 1
+            self.total_recoveries += 1
+            logger.error(
+                f"[resilience] step {step}: {reason} — recovery "
+                f"{attempts}/{self.config.max_recoveries}"
+            )
+            self._sleep_backoff(attempts)
+            self._join_zombie(zombie, step, attempts, reason)
+            self._rollback(step, attempts, reason, raw_step)
+            if self.config.skip_poisoned_batches and attempts >= 2:
+                # The same window failed twice across a rollback: treat the
+                # data as poisoned, quarantine it, and let the caller move on.
+                self.quarantined_steps.append(step)
+                self._consecutive_quarantines += 1
+                if self._consecutive_quarantines > self.config.max_recoveries:
+                    raise TrainingDivergenceError(
+                        step=step, attempts=attempts, checkpoint_tag=self._ckpt_tag,
+                        reason=(
+                            f"{reason}; {self._consecutive_quarantines} consecutive "
+                            "batch windows quarantined — divergence does not "
+                            "follow the data"
+                        ),
+                    )
+                logger.error(
+                    f"[resilience] quarantined the batch window of step {step} "
+                    f"after {attempts} attempts; skipping it"
+                )
+                return None
+
+    def _record(self, step, micro):
+        self._steps_seen += 1
+        self._history.append((step, micro))
+        if len(self._history) >= _HISTORY_WARN_LEN and not self._history_warned:
+            self._history_warned = True
+            logger.warning(
+                f"[resilience] {len(self._history)} batch windows buffered since "
+                "the last committed checkpoint — recovery replay (and host "
+                "memory) grows with this; call save_checkpoint more often"
+            )
+
+    def _rollback(self, failing_step, attempt, reason, raw_step):
+        """Restore the newest committed tag, then deterministically replay
+        the buffered batch windows up to (excluding) the failing step."""
+        eng = self.engine
+        if self._ckpt_dir is None:
+            raise TrainingDivergenceError(
+                step=failing_step, attempts=attempt, checkpoint_tag=None,
+                reason=f"{reason}; cannot roll back — no checkpoint has been "
+                       "saved this run",
+            )
+        self._in_recovery = True
+        try:
+            name, _ = eng.load_checkpoint(self._ckpt_dir, tag=self._ckpt_tag)
+            if name is None:
+                raise TrainingDivergenceError(
+                    step=failing_step, attempts=attempt, checkpoint_tag=self._ckpt_tag,
+                    reason=f"{reason}; rollback found no committed checkpoint "
+                           f"under {self._ckpt_dir}",
+                )
+            replay = [
+                (s, b) for (s, b) in self._history
+                if eng.global_steps <= s < failing_step
+            ]
+            logger.info(
+                f"[resilience] rolled back to tag '{self._ckpt_tag}' "
+                f"(step {eng.global_steps}); replaying {len(replay)} buffered "
+                f"batch window(s) to fast-forward to step {failing_step}"
+            )
+            for _s, batches in replay:
+                raw_step(batches)
+        finally:
+            self._in_recovery = False
+
+    def _join_zombie(self, thread, step, attempt, reason):
+        """A timed-out step's worker may still be executing (and mutating
+        engine state). Join it — bounded — before rolling back; recovery on
+        top of a still-running step would race the restore."""
+        if thread is None or not thread.is_alive():
+            return
+        grace = max(1.0, 4.0 * self.config.step_timeout_s)
+        thread.join(timeout=grace)
+        if thread.is_alive():
+            raise TrainingDivergenceError(
+                step=step, attempts=attempt, checkpoint_tag=self._ckpt_tag,
+                reason=f"{reason}; the hung step did not terminate within "
+                       f"{grace:.1f}s — engine state cannot be rolled back safely",
+            )
+
+    def _sleep_backoff(self, attempt):
+        base = self.config.recovery_backoff_s
+        if base > 0:
+            import time
+
+            time.sleep(base * (2 ** (attempt - 1)))

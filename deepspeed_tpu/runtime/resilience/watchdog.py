@@ -1,0 +1,94 @@
+"""Hung-step watchdog: bounded wall-time for data fetches and train steps.
+
+Python cannot interrupt a thread wedged inside ``next()`` or a host
+callback, so the watchdog inverts control: the blocking call runs on a
+daemon worker and the caller waits on a result queue with a timeout. On
+timeout the caller gets a recoverable ``StepTimeoutError`` instead of an
+eternal hang; the worker is left to finish (or not) on its own.
+
+Two subtleties make this safe:
+
+- **No lost batches.** ``TimedFetcher`` keeps the abandoned worker's queue
+  as *pending* state per iterator: a retry waits on the same queue, so a
+  batch that arrives late (loader wedged transiently) is delivered on the
+  next attempt rather than silently dropped — the data stream stays
+  deterministic. It also never calls ``next()`` on an iterator that still
+  has a fetch in flight (re-entering a running generator raises).
+
+- **No state races.** ``timed_call`` (used for whole train steps) returns
+  the abandoned thread inside the ``StepTimeoutError`` so the recovery
+  path can join it (bounded) before rolling engine state back; a zombie
+  step that completes mid-rollback would otherwise clobber the restore.
+"""
+
+import queue
+import threading
+
+from deepspeed_tpu.runtime.resilience.errors import StepTimeoutError
+
+
+def timed_call(fn, timeout_s, what="call"):
+    """Run ``fn()`` with a wall-time bound. Returns its result, re-raises
+    its exception, or raises ``StepTimeoutError`` (carrying the abandoned
+    worker thread) after ``timeout_s`` seconds."""
+    if timeout_s is None or timeout_s <= 0:
+        return fn()
+    out = queue.Queue(maxsize=1)
+
+    def run():
+        try:
+            out.put(("ok", fn()))
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller side
+            out.put(("err", e))
+
+    t = threading.Thread(target=run, daemon=True, name=f"watchdog:{what}")
+    t.start()
+    try:
+        kind, val = out.get(timeout=timeout_s)
+    except queue.Empty:
+        raise StepTimeoutError(what=what, timeout_s=timeout_s, thread=t) from None
+    if kind == "err":
+        raise val
+    return val
+
+
+class TimedFetcher:
+    """Watchdog-bounded ``next()`` over one source iterator."""
+
+    def __init__(self, source, hook=None):
+        self.source = source
+        self.hook = hook  # e.g. fault-injection hang, runs on the worker
+        self._pending = None  # queue of an abandoned (timed-out) fetch
+
+    def _spawn(self):
+        out = queue.Queue(maxsize=1)
+
+        def run():
+            try:
+                if self.hook is not None:
+                    self.hook()
+                out.put(("ok", next(self.source)))
+            except BaseException as e:  # noqa: BLE001 — incl. StopIteration
+                out.put(("err", e))
+
+        threading.Thread(target=run, daemon=True, name="watchdog:fetch").start()
+        return out
+
+    def next(self, timeout_s):
+        """One batch, or ``StepTimeoutError`` after ``timeout_s``. A timed-out
+        fetch stays pending: the next call waits for ITS result first, so no
+        batch is lost and the worker's generator is never re-entered."""
+        if timeout_s is None or timeout_s <= 0:
+            if self.hook is not None:
+                self.hook()
+            return next(self.source)
+        out = self._pending if self._pending is not None else self._spawn()
+        self._pending = None
+        try:
+            kind, val = out.get(timeout=timeout_s)
+        except queue.Empty:
+            self._pending = out
+            raise StepTimeoutError(what="data fetch", timeout_s=timeout_s) from None
+        if kind == "err":
+            raise val
+        return val

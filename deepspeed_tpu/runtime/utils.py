@@ -91,9 +91,19 @@ def get_weight_norm(params, mpu=None, norm_type=2):
 def clip_grad_norm_(grads, max_norm, global_grad_norm=None):
     """Scale grads so their global norm is at most ``max_norm``. Returns
     (clipped_grads, total_norm). Pure/functional (jit-safe); mirrors the
-    combined get_grad_norm + clip_coef application in the reference step path."""
+    combined get_grad_norm + clip_coef application in the reference step path.
+
+    A non-finite ``total_norm`` (NaN/inf gradients that slipped past the
+    overflow check — always, under pure fp32/bf16) must NOT reach the clip
+    coefficient: NaN * g poisons every gradient leaf, including finite ones.
+    The grads pass through unclipped instead, and the raw norm is returned
+    so the caller (engine / divergence guard) can see the anomaly and act."""
     total_norm = global_grad_norm if global_grad_norm is not None else global_norm(grads)
-    clip_coef = jnp.minimum(1.0, max_norm / (total_norm + 1e-6))
+    clip_coef = jnp.where(
+        jnp.isfinite(total_norm),
+        jnp.minimum(1.0, max_norm / (total_norm + 1e-6)),
+        1.0,
+    )
     clipped = jax.tree_util.tree_map(lambda g: (g * clip_coef).astype(g.dtype), grads)
     return clipped, total_norm
 

@@ -262,6 +262,7 @@ def _module_states_file(save_dir, tag):
 @pytest.mark.parametrize(
     "point", ["tmp_write", "fsync", "rename", "manifest_write", "manifest_rename"]
 )
+@pytest.mark.faults
 def test_ckpt_crash_at_every_write_stage_falls_back(tmpdir, point):
     """A simulated preemption at any stage of the save leaves the previous
     committed tag loadable: manifest.json lands last, so the half-written
@@ -282,6 +283,7 @@ def test_ckpt_crash_at_every_write_stage_falls_back(tmpdir, point):
     _tree_equal(engine2.params, params_one)
 
 
+@pytest.mark.faults
 def test_ckpt_torn_tmp_write_falls_back(tmpdir):
     """Crash after exactly N bytes of a shard reached the .tmp file: the
     torn prefix never reaches the final name, the tag never commits."""
@@ -299,6 +301,7 @@ def test_ckpt_torn_tmp_write_falls_back(tmpdir):
     _tree_equal(engine2.params, params_one)
 
 
+@pytest.mark.faults
 def test_ckpt_transient_eio_is_retried(tmpdir):
     """Transient EIO (flaky mount) heals under bounded retry: the save
     commits and round-trips; the injector counts the retried hits."""
@@ -319,6 +322,7 @@ def test_ckpt_transient_eio_is_retried(tmpdir):
     _tree_equal(engine2.params, jax.device_get(engine.params))
 
 
+@pytest.mark.faults
 def test_ckpt_truncated_shard_falls_back(tmpdir):
     """A committed tag whose shard got truncated after the fact (partial
     replication, disk loss) fails size verification and falls back."""
@@ -338,6 +342,7 @@ def test_ckpt_truncated_shard_falls_back(tmpdir):
     _tree_equal(engine2.params, params_one)
 
 
+@pytest.mark.faults
 def test_ckpt_corrupt_checksum_falls_back(tmpdir):
     """Same-size bit rot passes the shallow size check but fails the
     read-time crc32/sha256 verification — fall back, don't load garbage."""
@@ -357,6 +362,7 @@ def test_ckpt_corrupt_checksum_falls_back(tmpdir):
     _tree_equal(engine2.params, params_one)
 
 
+@pytest.mark.faults
 def test_ckpt_deleted_latest_loads_newest_committed(tmpdir):
     """`latest` is a derived convenience, not a single point of failure:
     with it deleted, load resolves the newest committed tag by manifest
@@ -380,6 +386,7 @@ def test_ckpt_deleted_latest_loads_newest_committed(tmpdir):
         assert entry["bytes"] > 0 and entry["crc32"] and entry["sha256"]
 
 
+@pytest.mark.faults
 def test_ckpt_crash_between_commit_and_latest(tmpdir):
     """A crash AFTER the manifest commit but BEFORE the `latest` update
     leaves a stale hint — the newest committed tag must still win (load
@@ -399,6 +406,7 @@ def test_ckpt_crash_between_commit_and_latest(tmpdir):
     _tree_equal(engine2.params, jax.device_get(engine.params))
 
 
+@pytest.mark.faults
 def test_ckpt_all_candidates_corrupt_raises_named_error(tmpdir):
     """When every candidate fails verification the engine raises the
     named corruption error instead of a bare unpickling traceback."""
@@ -413,6 +421,7 @@ def test_ckpt_all_candidates_corrupt_raises_named_error(tmpdir):
         engine2.load_checkpoint(save_dir)
 
 
+@pytest.mark.faults
 def test_ckpt_rotation_keeps_newest_committed(tmpdir):
     """keep_last_k=2 across 5 saves leaves exactly the 2 newest committed
     tags — and a corrupted newest still resumes from the older survivor."""
@@ -442,6 +451,7 @@ def test_ckpt_rotation_keeps_newest_committed(tmpdir):
     _tree_equal(engine2.params, params_t4)
 
 
+@pytest.mark.faults
 def test_ckpt_rotation_spares_uncommitted_dirs(tmpdir):
     """Only committed tags rotate: an uncommitted (crashed) save and
     foreign files in the checkpoint root are never deleted."""
@@ -460,6 +470,7 @@ def test_ckpt_rotation_spares_uncommitted_dirs(tmpdir):
     assert "good2" in dirs             # newest committed: never deleted
 
 
+@pytest.mark.faults
 def test_ckpt_legacy_tag_without_manifest_loads(tmpdir):
     """Pre-subsystem checkpoints (no manifest.json) stay loadable through
     the `latest` hint — no verification, but no regression either."""

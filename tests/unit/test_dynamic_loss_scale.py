@@ -73,3 +73,85 @@ def test_functional_matches_host_class():
         assert float(dev.cur_scale) == host.cur_scale, (
             f"diverged at iter {host.cur_iter}: dev={float(dev.cur_scale)} host={host.cur_scale}"
         )
+
+
+# ---------------------------------------------------------------------------
+# Functional-vs-class parity: pin ALL FOUR state fields at every step, not
+# just cur_scale — hysteresis and window-restart drift hides in the others.
+# ---------------------------------------------------------------------------
+
+def _run_parity(overflow_seq, init_scale=2**16, scale_window=4, min_scale=1,
+                delayed_shift=1, consecutive_hysteresis=False):
+    host = DynamicLossScaler(
+        init_scale=init_scale, scale_window=scale_window, min_scale=min_scale,
+        delayed_shift=delayed_shift, consecutive_hysteresis=consecutive_hysteresis,
+    )
+    dev = init_dynamic_scaler_state(init_scale=init_scale, delayed_shift=delayed_shift)
+    for i, of in enumerate(overflow_seq):
+        host.update_scale(bool(of))
+        dev = update_scaler(
+            dev, bool(of), scale_window=scale_window, min_scale=min_scale,
+            delayed_shift=delayed_shift, consecutive_hysteresis=consecutive_hysteresis,
+        )
+        state = dict(
+            cur_scale=float(dev.cur_scale), cur_iter=int(dev.cur_iter),
+            last_overflow_iter=int(dev.last_overflow_iter),
+            cur_hysteresis=int(dev.cur_hysteresis),
+        )
+        expected = dict(
+            cur_scale=float(host.cur_scale), cur_iter=host.cur_iter,
+            last_overflow_iter=host.last_overflow_iter,
+            cur_hysteresis=host.cur_hysteresis,
+        )
+        assert state == expected, f"diverged at step {i} (overflow={of}): {state} != {expected}"
+    return host
+
+
+def test_parity_growth_only():
+    _run_parity([False] * 12, scale_window=3)
+
+
+def test_parity_isolated_and_leading_overflows():
+    _run_parity([True] + [False] * 6 + [True] + [False] * 6, scale_window=3)
+
+
+def test_parity_consecutive_overflows_exactly_scale_window_apart():
+    """Overflows at iters 0, 4, 8 with scale_window=4: each overflow resets
+    the window base, so NO growth may happen in between — the modulo form of
+    the window test is where this historically drifts."""
+    seq = []
+    for _ in range(3):
+        seq.append(True)
+        seq.extend([False] * 3)
+    _run_parity(seq, scale_window=4)
+
+
+def test_parity_hysteresis_delayed_shift():
+    # draw the hysteresis budget down across overflow bursts, let the window
+    # refill it, then burst again
+    seq = [True, True, False, False, False, False, True, True, True, False]
+    _run_parity(seq, scale_window=4, delayed_shift=3)
+
+
+def test_parity_consecutive_hysteresis_mode():
+    """consecutive_hysteresis=True refills the budget on EVERY clean step
+    (only back-to-back overflows may exhaust it)."""
+    seq = [True, False, True, False, True, True, True, False, False]
+    host = _run_parity(seq, scale_window=4, delayed_shift=2, consecutive_hysteresis=True)
+    # interleaved singles never drained the budget below delayed_shift - 1
+    assert host.cur_scale >= 2**14
+
+
+def test_parity_min_scale_floor():
+    _run_parity([True] * 8, init_scale=8, min_scale=2, scale_window=2)
+
+
+def test_parity_long_random_sequence_all_fields():
+    rng = np.random.default_rng(7)
+    _run_parity(rng.random(300) < 0.15, scale_window=5, delayed_shift=2)
+
+
+def test_parity_random_sequence_consecutive_hysteresis():
+    rng = np.random.default_rng(11)
+    _run_parity(rng.random(200) < 0.2, scale_window=7, delayed_shift=3,
+                consecutive_hysteresis=True)

@@ -149,3 +149,39 @@ def test_close_timeout_abandons_blocked_source():
     assert time.monotonic() - t0 < 5.0  # bounded, not an unbounded drain
     release.set()  # let the daemon worker exit for a clean test teardown
     pf._thread.join(timeout=5.0)
+
+
+def test_source_exception_keeps_original_traceback():
+    """The re-raise on the consumer thread must point at the SOURCE
+    iterator's frame (raise ... with worker traceback), not at the
+    queue pop inside PrefetchLoader.__next__."""
+    import traceback
+
+    def exploding_source():
+        yield 0
+        raise RuntimeError("boom at batch 1")
+
+    pf = PrefetchLoader(exploding_source(), depth=2)
+    it = iter(pf)
+    assert next(it) == 0
+    with pytest.raises(RuntimeError, match="boom at batch 1") as ei:
+        next(it)
+    frames = [f.name for f in traceback.extract_tb(ei.value.__traceback__)]
+    assert "exploding_source" in frames, frames
+
+
+def test_source_exception_preserves_cause_chain():
+    """`raise X from Y` inside the source survives the thread hop."""
+    def source_with_cause():
+        yield 0
+        try:
+            raise KeyError("missing-key")
+        except KeyError as e:
+            raise RuntimeError("wrapped") from e
+
+    pf = PrefetchLoader(source_with_cause(), depth=2)
+    it = iter(pf)
+    next(it)
+    with pytest.raises(RuntimeError, match="wrapped") as ei:
+        next(it)
+    assert isinstance(ei.value.__cause__, KeyError)

@@ -1,0 +1,539 @@
+"""Step-level resilience tests: divergence guard, watchdog, rollback recovery.
+
+Every recovery path is driven deterministically on CPU through the
+``StepFaultInjector`` (runtime/resilience/fault_injection.py) — no real
+divergence or wedged loader needed. The strongest oracle used throughout:
+after fault injection + recovery, the final parameters must EXACTLY equal
+those of an uninterrupted run on clean data (bitwise, not approximately) —
+rollback + deterministic replay must reproduce the clean trajectory.
+"""
+
+import math
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.runtime.resilience import (
+    DivergenceGuard,
+    InjectedLoaderError,
+    ResilienceConfig,
+    ResilienceSupervisor,
+    StepFaultInjector,
+    StepTimeoutError,
+    TimedFetcher,
+    TrainingDivergenceError,
+    timed_call,
+)
+from deepspeed_tpu.runtime.checkpoint.fault_injection import InjectedCrash
+from deepspeed_tpu.runtime.config import get_resilience_config
+
+from simple_model import make_simple_engine, random_dataloader
+
+pytestmark = pytest.mark.faults
+
+HIDDEN = 16
+
+
+def _base_cfg(**resilience):
+    cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+    }
+    if resilience:
+        res = {"max_recoveries": 2, "recovery_backoff_s": 0}
+        res.update(resilience.pop("overrides", {}))
+        cfg["resilience"] = res
+    return cfg
+
+
+def _res_cfg(**overrides):
+    return _base_cfg(overrides=overrides)
+
+
+def _batches(n, seed=0):
+    """Explicit (x, y) batches so tests control exactly which data each
+    engine sees (batch of 8 = micro 1 x 8 virtual devices)."""
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            rng.standard_normal((8, HIDDEN)).astype(np.float32),
+            rng.standard_normal((8, HIDDEN)).astype(np.float32),
+        )
+        for _ in range(n)
+    ]
+
+
+def _params_equal(e1, e2):
+    l1 = jax.tree_util.tree_leaves(jax.device_get(e1.params))
+    l2 = jax.tree_util.tree_leaves(jax.device_get(e2.params))
+    return len(l1) == len(l2) and all(np.array_equal(a, b) for a, b in zip(l1, l2))
+
+
+def _train(engine, batches, ckpt_dir=None, ckpt_at=None, tag=None, steps=None):
+    it = iter(batches)
+    losses = []
+    for _ in range(steps if steps is not None else len(batches)):
+        losses.append(engine.train_batch(it))
+        if ckpt_at is not None and engine.global_steps == ckpt_at and ckpt_dir:
+            engine.save_checkpoint(str(ckpt_dir), tag=tag)
+            ckpt_at = None  # save once
+    return losses
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: recovery on the real engine
+# ---------------------------------------------------------------------------
+
+def test_nan_loss_transient_recovery_matches_clean_run(tmpdir):
+    """NaN loss injected at step 3 -> rollback to the committed checkpoint,
+    replay, retry clean; final params EXACTLY equal an uninterrupted run."""
+    ck = tmpdir.mkdir("ck")
+    data = _batches(6)
+
+    eng = make_simple_engine(
+        tmpdir.mkdir("a"),
+        _res_cfg(fault_injection={"nan_loss": {"at_step": 3}}),
+    )
+    losses = _train(eng, data, ckpt_dir=ck, ckpt_at=2)
+
+    clean = make_simple_engine(tmpdir.mkdir("b"), _base_cfg())
+    assert clean.resilience is None  # no `resilience` block -> no supervisor
+    clean_losses = _train(clean, data)
+
+    assert eng.resilience.total_recoveries == 1
+    assert eng.resilience.injector.fired.get("nan_loss") == 1
+    assert eng.global_steps == 6
+    assert all(math.isfinite(l) for l in losses)
+    np.testing.assert_allclose(losses, clean_losses, rtol=1e-6)
+    assert _params_equal(eng, clean)
+
+
+def test_poisoned_batch_is_quarantined_and_skipped(tmpdir):
+    """A batch that fails twice across a rollback is quarantined; training
+    continues on the next window and matches a clean run without that batch."""
+    ck = tmpdir.mkdir("ck")
+    data = _batches(6)
+
+    # poison fires on the first try AND the post-rollback retry, then the
+    # replacement window runs clean at the same global step
+    eng = make_simple_engine(
+        tmpdir.mkdir("a"),
+        _res_cfg(fault_injection={"poison_batch": {"at_step": 3, "times": 2}}),
+    )
+    _train(eng, data, ckpt_dir=ck, ckpt_at=3, steps=5)
+
+    clean = make_simple_engine(tmpdir.mkdir("b"), _base_cfg())
+    _train(clean, [data[0], data[1], data[2], data[4], data[5]])
+
+    assert eng.resilience.quarantined_steps == [3]
+    assert eng.resilience.total_recoveries == 2
+    assert eng.resilience.injector.fired.get("poison_batch") == 2
+    assert eng.global_steps == 5
+    assert _params_equal(eng, clean)
+
+
+def test_exhausted_recoveries_raise_named_error(tmpdir):
+    """Persistently failing step with quarantine disabled: after
+    max_recoveries attempts a TrainingDivergenceError surfaces carrying the
+    step, the attempt count, and the checkpoint tag the rollbacks used."""
+    ck = tmpdir.mkdir("ck")
+    eng = make_simple_engine(
+        tmpdir.mkdir("a"),
+        _res_cfg(
+            skip_poisoned_batches=False,
+            fault_injection={"poison_batch": {"at_step": 3, "times": None}},
+        ),
+    )
+    with pytest.raises(TrainingDivergenceError) as ei:
+        _train(eng, _batches(6), ckpt_dir=ck, ckpt_at=3, tag="stable")
+    err = ei.value
+    assert err.step == 3
+    assert err.attempts == 2
+    assert err.checkpoint_tag == "stable"
+    assert "stable" in str(err) and "step 3" in str(err)
+    # 2 recoveries ran (rollback + retry), the 3rd failure surfaced
+    assert eng.resilience.injector.fired.get("poison_batch") == 3
+
+
+def test_divergence_without_checkpoint_raises(tmpdir):
+    """No committed checkpoint -> recovery is impossible; the named error
+    says so instead of looping."""
+    eng = make_simple_engine(
+        tmpdir.mkdir("a"),
+        _res_cfg(fault_injection={"nan_loss": {"at_step": 1}}),
+    )
+    with pytest.raises(TrainingDivergenceError) as ei:
+        _train(eng, _batches(3))
+    assert ei.value.checkpoint_tag is None
+    assert "no checkpoint" in str(ei.value)
+
+
+def test_hang_fetch_watchdog_recovers_without_losing_the_batch(tmpdir):
+    """A transiently wedged loader trips the fetch watchdog; the late batch
+    is delivered on retry (not dropped), so params still match a clean run."""
+    data = _batches(4)
+    eng = make_simple_engine(
+        tmpdir.mkdir("a"),
+        _res_cfg(
+            step_timeout_s=2.0,
+            max_recoveries=3,
+            fault_injection={"hang_fetch": {"at_step": 1, "seconds": 5.0}},
+        ),
+    )
+    _train(eng, data)
+
+    clean = make_simple_engine(tmpdir.mkdir("b"), _base_cfg())
+    _train(clean, data)
+
+    assert eng.resilience.injector.fired.get("hang_fetch") == 1
+    assert eng.resilience.total_recoveries == 0  # fetch retry, no rollback
+    assert eng.global_steps == 4
+    assert _params_equal(eng, clean)
+
+
+def test_hang_step_watchdog_recovers(tmpdir):
+    """A wedged train step times out; the zombie worker is joined, state is
+    rolled back, and the retry reproduces the clean trajectory exactly."""
+    ck = tmpdir.mkdir("ck")
+    data = _batches(3)
+    eng = make_simple_engine(
+        tmpdir.mkdir("a"),
+        _res_cfg(
+            step_timeout_s=2.0,
+            fault_injection={"hang_step": {"at_step": 1, "seconds": 5.0}},
+        ),
+    )
+    _train(eng, data, ckpt_dir=ck, ckpt_at=1)
+
+    clean = make_simple_engine(tmpdir.mkdir("b"), _base_cfg())
+    _train(clean, data)
+
+    assert eng.resilience.injector.fired.get("hang_step") == 1
+    assert eng.resilience.total_recoveries == 1
+    assert eng.global_steps == 3
+    assert _params_equal(eng, clean)
+
+
+def test_fail_fetch_retried_then_succeeds(tmpdir):
+    """Loader raises K times then heals: the fetch retry loop absorbs it."""
+    data = _batches(4)
+    eng = make_simple_engine(
+        tmpdir.mkdir("a"),
+        _res_cfg(max_recoveries=3,
+                 fault_injection={"fail_fetch": {"at_step": 1, "times": 2}}),
+    )
+    _train(eng, data)
+    assert eng.resilience.injector.fired.get("fail_fetch") == 2
+    assert eng.global_steps == 4
+
+
+def test_loss_spike_triggers_recovery(tmpdir):
+    """A 50x loss spike against the rolling median is divergence; recovery
+    replays to the failing step and the retried step observes a clean loss."""
+    ck = tmpdir.mkdir("ck")
+    data = _batches(6)
+    eng = make_simple_engine(
+        tmpdir.mkdir("a"),
+        _res_cfg(
+            spike_window=3,
+            spike_threshold=3.0,
+            fault_injection={"spike_loss": {"at_step": 4, "factor": 50.0}},
+        ),
+    )
+    losses = _train(eng, data, ckpt_dir=ck, ckpt_at=3)
+
+    clean = make_simple_engine(tmpdir.mkdir("b"), _base_cfg())
+    clean_losses = _train(clean, data)
+
+    assert eng.resilience.total_recoveries == 1
+    assert eng.resilience.injector.fired.get("spike_loss") == 1
+    np.testing.assert_allclose(losses, clean_losses, rtol=1e-6)
+    assert _params_equal(eng, clean)
+
+
+def test_pipeline_engine_nan_loss_recovery(tmpdir):
+    """The pipeline engine shares the supervisor: injected NaN at step 2
+    rolls back to the committed pipeline checkpoint and the losses match an
+    uninterrupted pipeline run."""
+    import deepspeed_tpu
+    from test_pipe import make_module, make_data, ds_config
+
+    def run(resilience):
+        cfg = ds_config(mb=8, gas=2, dp=4)
+        # the interpreter executor: the compiled shard_map executors do not
+        # run under this environment's JAX (pre-existing, see test_pipe)
+        cfg["pipeline"] = {"executor": "interpreted"}
+        if resilience:
+            cfg["resilience"] = {
+                "max_recoveries": 2,
+                "recovery_backoff_s": 0,
+                "fault_injection": {"nan_loss": {"at_step": 2}},
+            }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=make_module(num_stages=2), config_params=cfg
+        )
+        data = make_data(4 * 2, 32)
+        it = iter(data)
+        losses = []
+        for _ in range(4):
+            losses.append(engine.train_batch(it))
+            if resilience and engine.global_steps == 2:
+                engine.save_checkpoint(str(tmpdir.mkdir("pipe_ck")))
+        return engine, losses
+
+    eng, losses = run(resilience=True)
+    clean, clean_losses = run(resilience=False)
+
+    assert eng.resilience.total_recoveries == 1
+    assert eng.resilience.injector.fired.get("nan_loss") == 1
+    assert all(math.isfinite(l) for l in losses)
+    np.testing.assert_allclose(losses, clean_losses, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# supervisor policy units (fake engine: no jax compile cost)
+# ---------------------------------------------------------------------------
+
+class _FakeEngine:
+    def __init__(self, ckpt_step=0):
+        self.global_steps = 0
+        self._last_overflow = False
+        self.ckpt_step = ckpt_step
+        self.loads = 0
+
+    def load_checkpoint(self, load_dir, tag=None):
+        self.loads += 1
+        self.global_steps = self.ckpt_step
+        return tag, {}
+
+
+def _sup(engine, **overrides):
+    kw = dict(enabled=True, max_recoveries=2, recovery_backoff_s=0.0)
+    kw.update(overrides)
+    return ResilienceSupervisor(ResilienceConfig(**kw), engine)
+
+
+def test_fp16_overflow_is_not_divergence():
+    """An overflow-skipped step (scaler already handled it on device) must
+    not trigger recovery even though its loss can be non-finite."""
+    eng = _FakeEngine()
+    sup = _sup(eng)
+    eng._last_overflow = True
+
+    def raw_step(micro):
+        eng.global_steps += 1
+        return float("inf")
+
+    loss = sup.train_batch(iter([("b0",)]), raw_step, 1)
+    assert math.isinf(loss)
+    assert sup.total_recoveries == 0 and eng.loads == 0
+
+
+def test_consecutive_quarantines_bound_raises():
+    """Divergence that does NOT follow the data (every window fails) must
+    not silently skip unbounded amounts of data: after max_recoveries + 1
+    consecutive quarantines the named error surfaces."""
+    eng = _FakeEngine()
+    sup = _sup(eng, skip_poisoned_batches=True)
+    sup.note_checkpoint("/nonexistent", "t0")
+    eng.load_checkpoint = lambda d, tag=None: (tag, {})
+
+    with pytest.raises(TrainingDivergenceError) as ei:
+        sup.train_batch(iter([("b",)] * 10), lambda micro: float("nan"), 1)
+    assert "consecutive" in str(ei.value)
+    assert len(sup.quarantined_steps) == sup.config.max_recoveries + 1
+
+
+def test_user_restore_invalidates_replay_buffer():
+    eng = _FakeEngine()
+    sup = _sup(eng)
+    sup.note_checkpoint("/ck", "t1")
+    sup._record(0, [("b0",)])
+    assert len(sup._history) == 1
+    sup.note_restore("/ck", "t0")  # user-initiated: trajectory changed
+    assert sup._history == [] and sup._ckpt_tag == "t0"
+    # ...but the supervisor's own rollback must keep the buffer
+    sup._record(0, [("b0",)])
+    sup._in_recovery = True
+    sup.note_restore("/ck", "t0")
+    assert len(sup._history) == 1
+
+
+# ---------------------------------------------------------------------------
+# divergence guard units
+# ---------------------------------------------------------------------------
+
+def test_guard_flags_nonfinite_loss_and_grad_norm():
+    g = DivergenceGuard()
+    assert g.check(0, 1.0) is None
+    assert "non-finite loss" in g.check(1, float("nan"))
+    assert "non-finite loss" in g.check(1, float("inf"))
+    assert "non-finite grad norm" in g.check(2, 1.0, grad_norm=float("nan"))
+    assert g.check(3, 1.0, grad_norm=2.5) is None
+
+
+def test_guard_overflow_step_is_exempt():
+    g = DivergenceGuard(spike_window=2)
+    assert g.check(0, float("inf"), overflow=True) is None
+    assert g.check(1, float("nan"), overflow=True) is None
+    # overflow steps never pollute the spike window
+    assert len(g._window) == 0
+
+
+def test_guard_disabled_passes_everything():
+    g = DivergenceGuard(divergence_check=False)
+    assert g.check(0, float("nan")) is None
+
+
+def test_guard_spike_detection_and_reset():
+    g = DivergenceGuard(spike_window=3, spike_threshold=2.0)
+    for i, l in enumerate([1.0, 1.0, 1.0]):
+        assert g.check(i, l) is None
+    assert g.check(3, 1.9) is None          # under 2x median: clean, recorded
+    reason = g.check(4, 2.5)                # over 2x median of [1,1,1.9]
+    assert reason and "spike" in reason
+    g.reset()
+    assert g.check(5, 100.0) is None        # window empty again: no baseline
+
+
+# ---------------------------------------------------------------------------
+# watchdog units
+# ---------------------------------------------------------------------------
+
+def test_timed_call_passthrough_and_errors():
+    assert timed_call(lambda: 42, 0) == 42        # <=0: no thread at all
+    assert timed_call(lambda: 42, 5.0) == 42
+    with pytest.raises(KeyError):
+        timed_call(lambda: (_ for _ in ()).throw(KeyError("k")), 5.0)
+
+
+def test_timed_call_timeout_carries_thread():
+    with pytest.raises(StepTimeoutError) as ei:
+        timed_call(lambda: time.sleep(1.0), 0.1, what="wedged step")
+    err = ei.value
+    assert err.timeout_s == 0.1 and "wedged step" in str(err)
+    assert err.thread is not None
+    err.thread.join(timeout=2.0)
+
+
+def test_timed_fetcher_delivers_late_batch_on_retry():
+    """A timed-out fetch is NOT lost: the retry waits on the same in-flight
+    fetch, so the stream stays deterministic and in order."""
+    def slow_gen():
+        yield 1
+        time.sleep(0.4)
+        yield 2
+        yield 3
+
+    f = TimedFetcher(slow_gen())
+    assert f.next(2.0) == 1
+    with pytest.raises(StepTimeoutError):
+        f.next(0.1)              # wedged mid-fetch
+    assert f.next(2.0) == 2      # late batch delivered, generator not re-entered
+    assert f.next(2.0) == 3
+    with pytest.raises(StopIteration):
+        f.next(2.0)
+
+
+def test_timed_fetcher_unbounded_mode():
+    f = TimedFetcher(iter([7]))
+    assert f.next(0) == 7
+    with pytest.raises(StopIteration):
+        f.next(0)
+
+
+# ---------------------------------------------------------------------------
+# step fault injector units
+# ---------------------------------------------------------------------------
+
+def test_injector_rejects_unknown_step_point():
+    # (constructor specs pass unknown names through to the base checkpoint
+    # injector, whose fault points are free-form protocol-site strings)
+    with pytest.raises(ValueError):
+        StepFaultInjector().arm_step("melt_cpu")
+
+
+def test_injector_nan_loss_fires_once_by_default():
+    fi = StepFaultInjector({"nan_loss": {"at_step": 3}})
+    assert fi.corrupt_loss(2, 1.0) == 1.0      # wrong step: untouched
+    assert math.isnan(fi.corrupt_loss(3, 1.0))
+    assert fi.corrupt_loss(3, 1.0) == 1.0      # times=1: consumed
+    assert fi.fired == {"nan_loss": 1}
+
+
+def test_injector_inf_and_spike_values():
+    fi = StepFaultInjector({"nan_loss": {"at_step": 0, "value": "inf"}})
+    assert math.isinf(fi.corrupt_loss(0, 1.0))
+    fi = StepFaultInjector({"spike_loss": {"at_step": 1, "factor": 7.0}})
+    assert fi.corrupt_loss(1, 2.0) == 14.0
+    with pytest.raises(ValueError):
+        StepFaultInjector({"nan_loss": {"value": "zero"}})
+
+
+def test_injector_persistent_arm_fires_every_match():
+    fi = StepFaultInjector({"nan_loss": {"at_step": 2, "times": None}})
+    for _ in range(5):
+        assert math.isnan(fi.corrupt_loss(2, 1.0))
+    assert fi.fired["nan_loss"] == 5
+
+
+def test_injector_poison_batch_nans_floats_only():
+    fi = StepFaultInjector({"poison_batch": {"at_step": 0}})
+    micro = [{"x": np.ones((2, 2), np.float32), "ids": np.arange(2)}]
+    out = fi.corrupt_batches(0, micro)
+    assert np.isnan(np.asarray(out[0]["x"])).all()
+    assert np.array_equal(np.asarray(out[0]["ids"]), np.arange(2))
+    # clean input object untouched (the replay buffer keeps clean batches)
+    assert not np.isnan(micro[0]["x"]).any()
+
+
+def test_injector_fail_fetch_k_then_succeed():
+    fi = StepFaultInjector({"fail_fetch": {"times": 2}})
+    for _ in range(2):
+        with pytest.raises(InjectedLoaderError):
+            fi.check_fetch(0)
+    fi.check_fetch(0)  # healed
+    assert fi.fired["fail_fetch"] == 2
+
+
+def test_injector_combines_step_and_checkpoint_arms():
+    """One spec drives both layers: step faults here, I/O faults via the
+    inherited PR 1 checkpoint injector."""
+    fi = StepFaultInjector({"nan_loss": {"at_step": 1}, "rename": {"mode": "crash"}})
+    assert math.isnan(fi.corrupt_loss(1, 1.0))
+    with pytest.raises(InjectedCrash):
+        fi.check("rename")
+    assert fi.fired == {"nan_loss": 1, "rename": 1}
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+def test_resilience_config_defaults_and_enable_rules():
+    rc = get_resilience_config({})
+    assert rc.enabled is False
+    rc = get_resilience_config({"resilience": {}})  # presence enables
+    assert rc.enabled is True
+    assert rc.max_recoveries == 2 and rc.spike_window == 0
+    assert rc.step_timeout_s == 0.0 and rc.skip_poisoned_batches is True
+    rc = get_resilience_config({"resilience": {"enabled": False}})
+    assert rc.enabled is False
+
+
+@pytest.mark.parametrize("bad", [
+    {"spike_window": -1},
+    {"spike_window": 2.5},
+    {"spike_threshold": 1.0},
+    {"max_recoveries": -1},
+    {"recovery_backoff_s": -0.1},
+    {"step_timeout_s": -1},
+    {"fault_injection": "nan_loss"},
+])
+def test_resilience_config_rejects_bad_values(bad):
+    with pytest.raises(ValueError):
+        get_resilience_config({"resilience": bad})

@@ -120,3 +120,23 @@ def test_partitioned_tensor_collective():
 
     out = jax.shard_map(f, mesh=mesh, in_specs=(P("data"),), out_specs=P(), check_vma=False)(jnp.zeros((4,)))
     np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_clip_grad_norm_nonfinite_norm_passes_grads_through():
+    """NaN/inf total norm must NOT poison the clip coefficient: the grads
+    pass through UNCLIPPED (bitwise) and the raw norm is surfaced so the
+    caller (engine overflow check / divergence guard) can act on it."""
+    for poison in (jnp.nan, jnp.inf):
+        grads = {"a": jnp.asarray([1.0, 2.0]), "b": jnp.asarray([3.0, poison])}
+        clipped, norm = clip_grad_norm_(grads, 1.0)
+        assert not bool(jnp.isfinite(norm))
+        np.testing.assert_array_equal(np.asarray(clipped["a"]), np.asarray(grads["a"]))
+        # the poisoned leaf keeps its own values (incl. the non-finite one) —
+        # crucially the FINITE leaf was not multiplied by a NaN coefficient
+        assert np.isfinite(np.asarray(clipped["a"])).all()
+
+    # and the guard stays jit-compatible (jnp.where, no host branching)
+    jitted = jax.jit(lambda g: clip_grad_norm_(g, 1.0))
+    clipped, norm = jitted({"w": jnp.asarray([jnp.nan, 1.0])})
+    assert not bool(jnp.isfinite(norm))
+    assert np.isfinite(np.asarray(clipped["w"])[1])
