@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults ops bench
+.PHONY: test test-fast test-faults test-cluster ops bench
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -12,9 +12,15 @@ test-fast:
 
 # Fault-injection suites: checkpoint I/O faults (crash/torn-write/EIO at every
 # protocol point) + step-level resilience (divergence guard, watchdog,
-# rollback recovery). Deterministic on the CPU mesh.
+# rollback recovery) + cluster fault tolerance (supervised kill/preempt with
+# subprocess workers, comm deadlines, gossip). Deterministic on the CPU mesh.
 test-faults:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults
+
+# Just the job-level (cluster) suite: worker supervision, preemption,
+# comm deadlines, health gossip, elastic resume.
+test-cluster:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_cluster_resilience.py -q
 
 ops:
 	$(MAKE) -C csrc

@@ -5,5 +5,8 @@ from deepspeed_tpu.comm.comm import (
     broadcast,
     ppermute_send_recv,
     barrier,
+    host_allreduce_scalar,
     ReduceOp,
 )
+from deepspeed_tpu.comm.errors import CommError, CommTimeoutError, DeadPeerError
+from deepspeed_tpu.comm.health import HealthGossip

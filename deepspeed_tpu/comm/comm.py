@@ -13,10 +13,14 @@ API (all_reduce / all_gather / reduce_scatter / broadcast / p2p) for the engine,
 ZeRO, 1-bit Adam, and pipeline code.
 """
 
+import queue
+import threading
 from enum import Enum
 
 import jax
 import jax.numpy as jnp
+
+from deepspeed_tpu.comm.errors import CommError, CommTimeoutError, DeadPeerError  # noqa: F401 — re-exported
 
 
 class ReduceOp(Enum):
@@ -38,7 +42,21 @@ def all_reduce(x, axis_name, op=ReduceOp.SUM):
         return jax.lax.pmax(x, axis_name)
     if op == ReduceOp.MIN:
         return jax.lax.pmin(x, axis_name)
-    raise NotImplementedError(op)
+    if op == ReduceOp.PRODUCT:
+        # no pprod primitive: gather the per-rank values and reduce locally
+        # (XLA fuses this; fine for the scalar/flag uses PRODUCT serves)
+        return jnp.prod(jax.lax.all_gather(x, axis_name, axis=0, tiled=False), axis=0)
+    raise NotImplementedError(
+        f"all_reduce op {op!r} is not supported "
+        f"(supported: {', '.join(o.name for o in ReduceOp)})"
+    )
+
+
+def _axis_size(axis_name):
+    """Static (python int) size of a named mesh axis at trace time.
+    ``psum`` of the literal 1 is constant-folded to the axis size —
+    ``jax.lax.axis_size`` does not exist on the pinned jax version."""
+    return jax.lax.psum(1, axis_name)
 
 
 def all_gather(x, axis_name, axis=0, tiled=True):
@@ -54,7 +72,18 @@ def reduce_scatter(x, axis_name, scatter_dimension=0):
 
 def broadcast(x, axis_name, root=0):
     """Everyone takes root's value: implemented as a select + psum (cheap on
-    ICI; XLA pattern-matches this to a broadcast)."""
+    ICI; XLA pattern-matches this to a broadcast).
+
+    ``root`` must be a valid index on ``axis_name`` (``0 <= root < axis
+    size``): the mask below is simply false everywhere for an out-of-range
+    root, which would silently broadcast zeros. The axis size is static at
+    trace time, so this is checked eagerly."""
+    n = _axis_size(axis_name)
+    if not 0 <= int(root) < n:
+        raise ValueError(
+            f"broadcast root {root} is not a valid index on axis "
+            f"'{axis_name}' (size {n})"
+        )
     idx = jax.lax.axis_index(axis_name)
     masked = jnp.where(idx == root, x, jnp.zeros_like(x))
     return jax.lax.psum(masked, axis_name)
@@ -64,29 +93,83 @@ def ppermute_send_recv(x, axis_name, shift=1):
     """Ring shift: rank i's value goes to rank i+shift (mod size). The pipeline
     engine's activation/grad exchange (replacing pipe/p2p.py's broadcast-pair
     trick with the native ICI collective-permute)."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis_name, perm)
 
 
-def barrier(name="dstpu_barrier"):
-    """Cross-process barrier (reference dist.barrier). Single-process: just
-    drain local async dispatch; multi-process: sync all global devices."""
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+def _deadline_call(fn, timeout_s, what):
+    """Run a blocking host-level call with a wall-clock deadline. The
+    native collective cannot be cancelled, so the call runs on a daemon
+    worker and the caller waits on a result queue: on expiry the worker is
+    abandoned and a named ``CommTimeoutError`` surfaces instead of an
+    eternal hang (same inversion as the resilience watchdog)."""
+    if timeout_s is None or timeout_s <= 0:
+        return fn()
+    out = queue.Queue(maxsize=1)
 
-        multihost_utils.sync_global_devices(name)
-    else:
-        jax.block_until_ready(jax.device_put(0))
+    def run():
+        try:
+            out.put(("ok", fn()))
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller side
+            out.put(("err", e))
+
+    threading.Thread(target=run, daemon=True, name=f"comm-deadline:{what}").start()
+    try:
+        kind, val = out.get(timeout=timeout_s)
+    except queue.Empty:
+        raise CommTimeoutError(what=what, timeout_s=timeout_s) from None
+    if kind == "err":
+        raise val
+    return val
+
+
+def _injected_hang():
+    """Cluster fault-injection seam (hang_barrier arm); no-op outside
+    fault-injection runs. Imported lazily — comm must not depend on the
+    runtime package at import time."""
+    from deepspeed_tpu.runtime.resilience.cluster_faults import get_active_injector
+
+    inj = get_active_injector()
+    if inj is not None:
+        inj.maybe_hang_barrier()
+
+
+def barrier(name="dstpu_barrier", timeout_s=None):
+    """Cross-process barrier (reference dist.barrier). Single-process: just
+    drain local async dispatch; multi-process: sync all global devices.
+
+    ``timeout_s`` bounds the wait: a barrier a dead/wedged peer never
+    joins raises ``CommTimeoutError`` within the deadline instead of
+    hanging every surviving host forever. None/0 keeps the old unbounded
+    behavior."""
+
+    def sync():
+        _injected_hang()
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(name)
+        else:
+            jax.block_until_ready(jax.device_put(0))
+
+    return _deadline_call(sync, timeout_s, what=f"barrier '{name}'")
 
 
 # Host-side helpers used outside jit ---------------------------------------
 
-def host_allreduce_scalar(value):
-    """Cross-process scalar sum using jax.distributed-backed collectives."""
-    if jax.process_count() == 1:
-        return value
-    arr = jnp.asarray([value], jnp.float32)
-    from jax.experimental import multihost_utils
+def host_allreduce_scalar(value, timeout_s=None):
+    """Cross-process scalar sum using jax.distributed-backed collectives.
+    ``timeout_s`` bounds the wait (``CommTimeoutError``), as in
+    ``barrier``."""
 
-    return float(multihost_utils.process_allgather(arr).sum())
+    def reduce():
+        _injected_hang()
+        if jax.process_count() == 1:
+            return value
+        arr = jnp.asarray([value], jnp.float32)
+        from jax.experimental import multihost_utils
+
+        return float(multihost_utils.process_allgather(arr).sum())
+
+    return _deadline_call(reduce, timeout_s, what="host_allreduce_scalar")

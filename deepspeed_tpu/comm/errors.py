@@ -1,0 +1,42 @@
+"""Named errors for cross-host communication.
+
+The blocking host-level collectives (``barrier``, ``host_allreduce_scalar``)
+sit on ``jax.distributed`` primitives that wait forever when a peer is gone
+— on a preempted pod that turns one dead host into N hung ones. These
+errors are the bounded alternative: a deadline produces a
+``CommTimeoutError``, health gossip produces a ``DeadPeerError``, and
+either one unwinds the step so the job-level supervisor can restart the
+worker (see docs/cluster_resilience.md).
+"""
+
+
+class CommError(RuntimeError):
+    """Base class for named communication failures."""
+
+
+class CommTimeoutError(CommError, TimeoutError):
+    """A host-level collective exceeded its deadline (a peer is likely
+    dead or wedged). The underlying native call cannot be cancelled; its
+    worker thread is abandoned (daemon) and the process is expected to
+    exit for a supervised restart."""
+
+    def __init__(self, what, timeout_s):
+        self.what = what
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"{what} did not complete within the {timeout_s}s deadline "
+            "(peer dead or wedged?)"
+        )
+
+
+class DeadPeerError(CommError):
+    """Health gossip declared a peer host dead (stale heartbeat)."""
+
+    def __init__(self, rank, stale_s, timeout_s):
+        self.rank = rank
+        self.stale_s = stale_s
+        self.timeout_s = timeout_s
+        super().__init__(
+            f"peer rank {rank} has been silent for {stale_s:.1f}s "
+            f"(> {timeout_s}s peer timeout) — escalating to coordinated restart"
+        )

@@ -1,0 +1,74 @@
+"""Cross-host health gossip over a shared directory.
+
+Each rank periodically touches its own heartbeat file
+(``hb_<rank>``) in a directory every host can see (NFS/GCS-fuse mount —
+the same class of storage checkpoints already use); ``check_peers``
+reads the *other* ranks' mtimes and raises a named ``DeadPeerError``
+once one goes stale. File mtimes instead of a network protocol keeps the
+mechanism dead-simple, dependency-free, and — crucially for tests —
+fully deterministic on a single CPU host: N processes sharing a tmpdir
+gossip exactly like N hosts sharing a mount.
+
+The engine drives this from its step boundary (beat + check once per
+optimizer step) when the ``resilience`` config sets ``gossip_dir`` and
+``peer_timeout_s``. A raised ``DeadPeerError`` unwinds ``train_batch`` on
+every *surviving* host within one peer timeout — that is the coordinated
+restart: each worker exits nonzero, each node's supervisor restarts it,
+and the restarted job resumes from the last committed checkpoint tag.
+"""
+
+import os
+import time
+
+from deepspeed_tpu.comm.errors import DeadPeerError
+
+
+class HealthGossip:
+    def __init__(self, gossip_dir, rank, world_size, peer_timeout_s):
+        self.gossip_dir = gossip_dir
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.peer_timeout_s = float(peer_timeout_s)
+        os.makedirs(gossip_dir, exist_ok=True)
+        self._path = os.path.join(gossip_dir, f"hb_{self.rank}")
+        # A peer that has not written its first beat yet is measured from
+        # our start, so startup skew cannot declare a booting host dead.
+        self._started = time.time()
+        self.beat()
+
+    def _peer_path(self, rank):
+        return os.path.join(self.gossip_dir, f"hb_{rank}")
+
+    def beat(self):
+        now = time.time()
+        try:
+            os.utime(self._path, (now, now))
+        except OSError:
+            with open(self._path, "a"):
+                pass
+
+    def last_seen(self, rank):
+        """Seconds since ``rank`` last beat (from our start, if never)."""
+        try:
+            mtime = os.path.getmtime(self._peer_path(rank))
+        except OSError:
+            mtime = self._started
+        return max(0.0, time.time() - mtime)
+
+    def stale_peers(self):
+        """[(rank, stale_s)] for every peer past the timeout."""
+        out = []
+        for rank in range(self.world_size):
+            if rank == self.rank:
+                continue
+            stale = self.last_seen(rank)
+            if stale > self.peer_timeout_s:
+                out.append((rank, stale))
+        return out
+
+    def check_peers(self):
+        """Raise ``DeadPeerError`` for the stalest dead peer, if any."""
+        stale = self.stale_peers()
+        if stale:
+            rank, stale_s = max(stale, key=lambda rs: rs[1])
+            raise DeadPeerError(rank=rank, stale_s=stale_s, timeout_s=self.peer_timeout_s)

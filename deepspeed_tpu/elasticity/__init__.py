@@ -8,6 +8,7 @@ from deepspeed_tpu.elasticity.elasticity import (
     _get_compatible_gpus_v01,
     HCN_LIST,
 )
+from deepspeed_tpu.elasticity.resume import compute_elastic_resume
 from deepspeed_tpu.elasticity.config import (
     ElasticityConfig,
     ElasticityError,

@@ -1,0 +1,69 @@
+"""Elastic resume: re-split the batch when a job restarts at a new world size.
+
+The HCN algebra in ``elasticity.py`` picks one *global* train batch size
+valid across many accelerator counts. That makes restart-at-a-different-
+world-size loss-trajectory-preserving **iff** the restarted run keeps that
+global batch and only re-splits it into micro-batch x grad-accumulation x
+world. ``compute_elastic_resume`` is that re-split: it validates the new
+world size (raising the named ``ElasticityIncompatibleWorldSize``) and
+returns the new splits, asserting the global batch did not move.
+
+Pure math, no device code — shared by ``DeepSpeedEngine``'s checkpoint
+restore path (see ``_maybe_elastic_resume``) and tests.
+"""
+
+from deepspeed_tpu.elasticity.config import ElasticityConfigError
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.utils.logging import logger
+
+
+def compute_elastic_resume(ds_config, target_deepspeed_version,
+                           prev_world_size, new_world_size,
+                           saved_train_batch_size=None):
+    """Splits for resuming an elastic job at ``new_world_size``.
+
+    Args:
+        ds_config: full config dict with an ``elasticity`` section.
+        target_deepspeed_version: this library's version (compat check).
+        prev_world_size: data-parallel world size the checkpoint was saved
+            at (0/None when unknown — validation of the new size still runs).
+        new_world_size: data-parallel world size of the restarted job.
+        saved_train_batch_size: the global batch recorded in the
+            checkpoint, when available; a mismatch against the recomputed
+            batch means the elastic config changed between runs and the
+            loss trajectory would silently break — that raises
+            ``ElasticityConfigError``.
+
+    Returns:
+        dict with ``train_batch_size``, ``micro_batch_size``,
+        ``gradient_accumulation_steps``, ``valid_gpus``.
+
+    Raises:
+        ElasticityIncompatibleWorldSize: ``new_world_size`` cannot consume
+            the elastic global batch evenly.
+        ElasticityConfigError: the recomputed global batch differs from the
+            one the checkpoint was trained with.
+    """
+    final_batch, valid_gpus, micro_batch = compute_elastic_config(
+        ds_config, target_deepspeed_version, world_size=new_world_size
+    )
+    if saved_train_batch_size is not None and int(saved_train_batch_size) != final_batch:
+        raise ElasticityConfigError(
+            f"elastic resume would change the global batch: checkpoint was "
+            f"trained with train_batch_size={saved_train_batch_size} but the "
+            f"current elastic config computes {final_batch} — the elasticity "
+            "section changed between runs"
+        )
+    gas = final_batch // (micro_batch * new_world_size)
+    if prev_world_size and prev_world_size != new_world_size:
+        logger.info(
+            f"[elasticity] resuming at world size {new_world_size} (was "
+            f"{prev_world_size}): global batch {final_batch} preserved as "
+            f"{micro_batch} micro x {gas} accumulation x {new_world_size} ranks"
+        )
+    return {
+        "train_batch_size": final_batch,
+        "micro_batch_size": micro_batch,
+        "gradient_accumulation_steps": gas,
+        "valid_gpus": valid_gpus,
+    }

@@ -8,15 +8,21 @@ host drives all local chips (jax single-controller-per-host), so this sets
 ``RANK`` = node rank, ``WORLD_SIZE`` = number of hosts, exports
 ``MASTER_ADDR/PORT`` for ``jax.distributed``, restricts visible chips when a
 slot subset was requested, and execs the user script.
+
+The child runs under ``WorkerSupervisor`` (launcher/supervisor.py): SIGTERM
+*and* SIGINT are forwarded with terminate→wait→kill escalation, the child's
+actual exit code is propagated, and — with ``--max_restarts`` — preempted or
+crashed workers are restarted with heartbeat liveness monitoring and
+exponential backoff (see docs/cluster_resilience.md for the exit-code
+contract).
 """
 
 import argparse
 import os
-import signal
-import subprocess
 import sys
 
 from deepspeed_tpu.launcher.runner import decode_world_info
+from deepspeed_tpu.launcher.supervisor import WorkerSupervisor
 from deepspeed_tpu.utils.logging import logger
 
 
@@ -29,6 +35,15 @@ def parse_args():
                              "from the MPI launcher env (OpenMPI/MVAPICH2/PMI)")
     parser.add_argument("--master_addr", default="127.0.0.1", type=str)
     parser.add_argument("--master_port", default=29500, type=int)
+    parser.add_argument("--max_restarts", default=0, type=int,
+                        help="Restart budget for crashed/preempted/hung workers "
+                             "(0 = run once, the old behavior)")
+    parser.add_argument("--restart_backoff_s", default=1.0, type=float,
+                        help="Base of the exponential backoff between crash restarts")
+    parser.add_argument("--heartbeat_timeout_s", default=0.0, type=float,
+                        help="Kill and restart a worker whose step heartbeat goes "
+                             "stale for this long (0 = no liveness monitoring; must "
+                             "exceed first-step compile time)")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return parser.parse_args()
@@ -56,6 +71,13 @@ def main():
 
     hosts = list(world_info.keys())
     num_nodes = len(hosts)
+    if not 0 <= node_rank < num_nodes:
+        logger.error(
+            f"launch: node_rank {node_rank} is out of range for this world "
+            f"layout ({num_nodes} host(s): {hosts}) — check --node_rank / the "
+            "MPI rank env against the hostfile"
+        )
+        sys.exit(2)
     this_host = hosts[node_rank]
     local_slots = world_info[this_host]
 
@@ -73,20 +95,19 @@ def main():
 
     logger.info(
         f"launch: node_rank={node_rank}/{num_nodes} host={this_host} "
-        f"slots={local_slots} master={args.master_addr}:{args.master_port}"
+        f"slots={local_slots} master={args.master_addr}:{args.master_port} "
+        f"max_restarts={args.max_restarts}"
     )
 
     cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
-    process = subprocess.Popen(cmd, env=current_env)
-
-    def sig_handler(signum, frame):
-        process.terminate()
-        sys.exit(1)
-
-    signal.signal(signal.SIGTERM, sig_handler)
-    process.wait()
-    if process.returncode != 0:
-        raise subprocess.CalledProcessError(returncode=process.returncode, cmd=cmd)
+    supervisor = WorkerSupervisor(
+        cmd, env=current_env,
+        max_restarts=args.max_restarts,
+        backoff_s=args.restart_backoff_s,
+        heartbeat_timeout_s=args.heartbeat_timeout_s,
+        log=lambda msg: logger.warning(f"launch[{node_rank}]: {msg}"),
+    )
+    sys.exit(supervisor.run())
 
 
 if __name__ == "__main__":

@@ -37,6 +37,11 @@ class MultiNodeRunner(ABC):
     def export_string(self):
         return " ".join(f"export {k}={quote(v)};" for k, v in sorted(self.exports.items()))
 
+    def cleanup(self):
+        """Remove anything ``get_cmd`` materialized on disk (temp hostfiles
+        etc.). Called by ``runner.main`` after the launch finishes; the base
+        implementation has nothing to clean."""
+
 
 class PDSHRunner(MultiNodeRunner):
     def backend_exists(self):
@@ -78,8 +83,17 @@ class SSHRunner(MultiNodeRunner):
                 f"{self.user_script} {' '.join(map(quote, self.user_arguments))}"
             )
             cmds.append(f"ssh {host} {quote(payload)}")
-        # run all nodes concurrently, wait for all
-        script = " & ".join(cmds) + " & wait"
+        # Run all nodes concurrently and propagate the FIRST nonzero exit
+        # status: a bare `wait` always returns 0, which silently swallowed
+        # per-node failures. Collect each background pid and wait on them
+        # individually instead.
+        script = (
+            "pids=(); "
+            + " ".join(f"{c} & pids+=($!);" for c in cmds)
+            + ' rc=0; for p in "${pids[@]}"; do'
+            + ' wait "$p"; s=$?; if [ "$rc" -eq 0 ]; then rc=$s; fi;'
+            + ' done; exit "$rc"'
+        )
         return ["bash", "-c", script]
 
 
@@ -134,11 +148,14 @@ class MVAPICHRunner(MultiNodeRunner):
             return False
         return "MVAPICH" in out
 
+    _hostfile = None
+
     def get_cmd(self):
         world = decode_world_info(self.world_info_base64)
         # fresh temp hostfile per invocation: a fixed /tmp path would clobber
         # between concurrent jobs and follow planted symlinks
         fd, hostfile = tempfile.mkstemp(prefix="dstpu_mvapich_hosts_", text=True)
+        self._hostfile = hostfile
         with os.fdopen(fd, "w") as f:
             for host in world.keys():
                 f.write(f"{host}\n")
@@ -158,3 +175,13 @@ class MVAPICHRunner(MultiNodeRunner):
                        f"--master_addr={self.master_addr}",
                        f"--master_port={self.args.master_port}"]
         return mpirun_cmd + export_cmd + python_exec + [self.user_script] + list(self.user_arguments)
+
+    def cleanup(self):
+        """Remove the generated temp hostfile once the launch is done
+        (tolerates an already-removed file)."""
+        if self._hostfile is not None:
+            try:
+                os.unlink(self._hostfile)
+            except OSError:
+                pass
+            self._hostfile = None

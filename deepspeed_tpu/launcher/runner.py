@@ -252,8 +252,11 @@ def main(args=None):
         raise RuntimeError(f"launcher backend '{args.launcher}' not installed")
     cmd = runner.get_cmd()
     logger.info(f"cmd = {' '.join(cmd)}")
-    result = subprocess.Popen(cmd, env=os.environ.copy())
-    result.wait()
+    try:
+        result = subprocess.Popen(cmd, env=os.environ.copy())
+        result.wait()
+    finally:
+        runner.cleanup()  # e.g. the MVAPICH temp hostfile
     return result.returncode
 
 

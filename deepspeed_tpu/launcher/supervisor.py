@@ -1,0 +1,230 @@
+"""Worker supervision for the per-node launcher.
+
+``launch.py`` used to spawn the training process exactly once and raise on
+any nonzero exit — on preemptible TPU pods that turns every SIGTERM into a
+dead job. ``WorkerSupervisor`` wraps the child with:
+
+- **liveness monitoring** via a heartbeat file the engine touches at every
+  optimizer-step boundary (``DSTPU_HEARTBEAT_FILE``): a stale heartbeat
+  means the worker is wedged (not just slow — the engine beats even while
+  recovering), so the supervisor kills and restarts it;
+- **bounded restart with exponential backoff**: crashes restart up to
+  ``max_restarts`` times with ``backoff_s * 2^(n-1)`` sleeps (capped);
+  a preempted-resumable exit restarts promptly, without backoff;
+- **distinct exit classes** (the exit-code contract below): clean exits and
+  poisoned-fatal exits never restart; preempted-resumable and crash/hang
+  exits do, while the restart budget lasts;
+- **signal forwarding**: SIGTERM *and* SIGINT are forwarded to the child
+  (so the engine's ``PreemptionHandler`` can commit an emergency
+  checkpoint), escalating terminate → ``wait(grace)`` → kill. A signal
+  received by the supervisor itself means the *job* is being torn down:
+  the child's exit code is propagated and no restart happens.
+
+Exit-code contract (shared with ``runtime/resilience/preemption.py``):
+
+=================  ====  =============================================
+``EXIT_CLEAN``     0     training finished; do not restart
+``EXIT_POISONED``  98    poisoned/fatal (e.g. unrecoverable divergence);
+                         restarting would fail the same way — do not
+``EXIT_PREEMPTED`` 99    preemption checkpoint committed; resumable —
+                         restart without backoff
+other nonzero / signal   crash; restart with exponential backoff
+=================  ====  =============================================
+
+This module is stdlib-only on purpose: the supervisor must stay importable
+(and restart workers) even when the training stack itself is the thing
+crashing.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+EXIT_CLEAN = 0
+EXIT_POISONED = 98
+EXIT_PREEMPTED = 99
+
+# Env contract between the supervisor and the engine it supervises.
+HEARTBEAT_FILE_ENV = "DSTPU_HEARTBEAT_FILE"
+PREEMPTION_ENV = "DSTPU_PREEMPTION"
+PREEMPT_SAVE_DIR_ENV = "DSTPU_PREEMPT_SAVE_DIR"
+
+# Exit classes (WorkerSupervisor.exit_history entries).
+CLASS_CLEAN = "clean"
+CLASS_PREEMPTED = "preempted"
+CLASS_FATAL = "fatal"
+CLASS_CRASH = "crash"
+CLASS_HUNG = "hung"
+
+
+def classify_exit(returncode, fatal_exit_codes=(EXIT_POISONED,)):
+    """Map a child exit code to its supervision class. Signal deaths come
+    through as negative returncodes and classify as crashes."""
+    if returncode == EXIT_CLEAN:
+        return CLASS_CLEAN
+    if returncode == EXIT_PREEMPTED:
+        return CLASS_PREEMPTED
+    if returncode in fatal_exit_codes:
+        return CLASS_FATAL
+    return CLASS_CRASH
+
+
+class WorkerSupervisor:
+    """Run one worker command under restart supervision.
+
+    ``run()`` blocks until the worker exits in a non-restartable way (or
+    the restart budget is exhausted) and returns the exit code the caller
+    should propagate.
+    """
+
+    def __init__(self, cmd, env=None, max_restarts=0, backoff_s=1.0,
+                 max_backoff_s=30.0, heartbeat_timeout_s=0.0,
+                 heartbeat_file=None, poll_interval_s=0.05, term_grace_s=5.0,
+                 fatal_exit_codes=(EXIT_POISONED,), log=None):
+        self.cmd = list(cmd)
+        self.env = dict(env if env is not None else os.environ)
+        self.max_restarts = int(max_restarts)
+        self.backoff_s = float(backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.term_grace_s = float(term_grace_s)
+        self.fatal_exit_codes = tuple(fatal_exit_codes)
+        self._log = log or (lambda msg: print(f"[supervisor] {msg}", file=sys.stderr, flush=True))
+
+        if self.heartbeat_timeout_s > 0 and heartbeat_file is None:
+            fd, heartbeat_file = tempfile.mkstemp(prefix="dstpu_heartbeat_")
+            os.close(fd)
+        self.heartbeat_file = heartbeat_file
+        if self.heartbeat_file is not None:
+            self.env[HEARTBEAT_FILE_ENV] = self.heartbeat_file
+        # children auto-install the engine PreemptionHandler under a supervisor
+        self.env.setdefault(PREEMPTION_ENV, "1")
+
+        self.child = None
+        self.restarts = 0
+        self.exit_history = []  # [(exit_class, returncode), ...]
+        self._shutdown_signal = None
+        self._spawned_at = 0.0
+
+    # -- lifecycle -----------------------------------------------------
+    def run(self):
+        prev = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev[sig] = signal.signal(sig, self._on_signal)
+            except ValueError:  # not the main thread (tests): no forwarding
+                pass
+        try:
+            return self._supervise()
+        finally:
+            for sig, handler in prev.items():
+                signal.signal(sig, handler)
+
+    def _supervise(self):
+        while True:
+            self._spawn()
+            returncode, hung = self._wait()
+            if self._shutdown_signal is not None:
+                # the supervisor itself was told to stop: propagate the
+                # child's verdict (EXIT_PREEMPTED when it checkpointed)
+                self._log(
+                    f"shutting down on signal {self._shutdown_signal}; "
+                    f"worker exited {returncode}"
+                )
+                return returncode
+            cls = CLASS_HUNG if hung else classify_exit(returncode, self.fatal_exit_codes)
+            self.exit_history.append((cls, returncode))
+            if cls == CLASS_CLEAN:
+                return EXIT_CLEAN
+            if cls == CLASS_FATAL:
+                self._log(f"worker exit {returncode} is fatal (poisoned); not restarting")
+                return returncode
+            if self.restarts >= self.max_restarts:
+                self._log(
+                    f"worker {cls} (exit {returncode}); restart budget "
+                    f"exhausted ({self.restarts}/{self.max_restarts})"
+                )
+                return returncode if returncode != 0 else 1
+            self.restarts += 1
+            if cls == CLASS_PREEMPTED:
+                delay = 0.0  # resumable checkpoint committed: come back fast
+            else:
+                delay = min(self.backoff_s * (2 ** (self.restarts - 1)), self.max_backoff_s)
+            self._log(
+                f"worker {cls} (exit {returncode}); restart "
+                f"{self.restarts}/{self.max_restarts} in {delay:.1f}s"
+            )
+            if delay > 0:
+                time.sleep(delay)
+
+    def _spawn(self):
+        self.child = subprocess.Popen(self.cmd, env=self.env)
+        self._spawned_at = time.monotonic()
+
+    def _wait(self):
+        """Poll the child until it exits. Returns (returncode, hung) where
+        ``hung`` means the heartbeat went stale and the child was killed."""
+        term_deadline = kill_deadline = None
+        while True:
+            rc = self.child.poll()
+            if rc is not None:
+                return rc, False
+            now = time.monotonic()
+            if self._shutdown_signal is not None:
+                if term_deadline is None:
+                    term_deadline = now + self.term_grace_s
+                elif now >= term_deadline and kill_deadline is None:
+                    self._log("worker ignored the forwarded signal; terminating")
+                    self.child.terminate()
+                    kill_deadline = now + self.term_grace_s
+                elif kill_deadline is not None and now >= kill_deadline:
+                    self._log("worker ignored terminate; killing")
+                    self.child.kill()
+                    return self.child.wait(), False
+            elif self._heartbeat_stale(now):
+                age = now - self._last_beat(now)
+                self._log(
+                    f"heartbeat stale ({age:.1f}s > {self.heartbeat_timeout_s}s): "
+                    "worker is wedged; killing it"
+                )
+                self._stop_child()
+                return self.child.returncode, True
+            time.sleep(self.poll_interval_s)
+
+    def _heartbeat_stale(self, now):
+        if self.heartbeat_timeout_s <= 0 or self.heartbeat_file is None:
+            return False
+        return now - self._last_beat(now) > self.heartbeat_timeout_s
+
+    def _last_beat(self, now):
+        """Monotonic time of the newest sign of life: spawn counts as one (a
+        worker gets a full timeout to produce its first step)."""
+        try:
+            mtime = os.path.getmtime(self.heartbeat_file)
+        except OSError:
+            return self._spawned_at
+        # mtime is wall-clock; convert its age into the monotonic domain
+        return max(self._spawned_at, now - max(0.0, time.time() - mtime))
+
+    def _stop_child(self):
+        """terminate → wait(grace) → kill escalation."""
+        if self.child.poll() is not None:
+            return
+        self.child.terminate()
+        try:
+            self.child.wait(timeout=self.term_grace_s)
+        except subprocess.TimeoutExpired:
+            self.child.kill()
+            self.child.wait()
+
+    def _on_signal(self, signum, frame):
+        self._shutdown_signal = signum
+        if self.child is not None and self.child.poll() is None:
+            try:
+                self.child.send_signal(signum)
+            except OSError:
+                pass

@@ -415,6 +415,38 @@ def get_resilience_config(param_dict):
             f"resilience.{RESILIENCE_FAULT_INJECTION} must be a dict of "
             f"fault-point specs, got {type(fault_injection).__name__}"
         )
+    peer_timeout_s = get_scalar_param(
+        params, RESILIENCE_PEER_TIMEOUT, RESILIENCE_PEER_TIMEOUT_DEFAULT
+    )
+    if peer_timeout_s < 0:
+        raise ValueError(
+            f"resilience.{RESILIENCE_PEER_TIMEOUT} must be >= 0 "
+            f"(0 disables health gossip), got {peer_timeout_s!r}"
+        )
+    comm_timeout_s = get_scalar_param(
+        params, RESILIENCE_COMM_TIMEOUT, RESILIENCE_COMM_TIMEOUT_DEFAULT
+    )
+    if comm_timeout_s < 0:
+        raise ValueError(
+            f"resilience.{RESILIENCE_COMM_TIMEOUT} must be >= 0 "
+            f"(0 leaves host collectives unbounded), got {comm_timeout_s!r}"
+        )
+    gossip_dir = get_scalar_param(
+        params, RESILIENCE_GOSSIP_DIR, RESILIENCE_GOSSIP_DIR_DEFAULT
+    )
+    if gossip_dir is not None and not isinstance(gossip_dir, str):
+        raise ValueError(
+            f"resilience.{RESILIENCE_GOSSIP_DIR} must be a path string, "
+            f"got {type(gossip_dir).__name__}"
+        )
+    preemption_save_dir = get_scalar_param(
+        params, RESILIENCE_PREEMPTION_SAVE_DIR, RESILIENCE_PREEMPTION_SAVE_DIR_DEFAULT
+    )
+    if preemption_save_dir is not None and not isinstance(preemption_save_dir, str):
+        raise ValueError(
+            f"resilience.{RESILIENCE_PREEMPTION_SAVE_DIR} must be a path "
+            f"string, got {type(preemption_save_dir).__name__}"
+        )
     return ResilienceConfig(
         enabled=enabled,
         divergence_check=bool(get_scalar_param(
@@ -429,6 +461,13 @@ def get_resilience_config(param_dict):
         )),
         step_timeout_s=float(step_timeout_s),
         fault_injection=fault_injection,
+        handle_preemption=bool(get_scalar_param(
+            params, RESILIENCE_HANDLE_PREEMPTION, RESILIENCE_HANDLE_PREEMPTION_DEFAULT
+        )),
+        preemption_save_dir=preemption_save_dir,
+        gossip_dir=gossip_dir,
+        peer_timeout_s=float(peer_timeout_s),
+        comm_timeout_s=float(comm_timeout_s),
     )
 
 

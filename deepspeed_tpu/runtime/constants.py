@@ -188,6 +188,18 @@ RESILIENCE_SKIP_POISONED_BATCHES_DEFAULT = True
 RESILIENCE_STEP_TIMEOUT = "step_timeout_s"
 RESILIENCE_STEP_TIMEOUT_DEFAULT = 0.0  # 0 = watchdog off
 RESILIENCE_FAULT_INJECTION = "fault_injection"
+# Job-level (cluster) resilience: preemption-safe shutdown + host health
+# gossip (runtime/resilience/preemption.py, comm/health.py).
+RESILIENCE_HANDLE_PREEMPTION = "handle_preemption"
+RESILIENCE_HANDLE_PREEMPTION_DEFAULT = False
+RESILIENCE_PREEMPTION_SAVE_DIR = "preemption_save_dir"
+RESILIENCE_PREEMPTION_SAVE_DIR_DEFAULT = None
+RESILIENCE_GOSSIP_DIR = "gossip_dir"
+RESILIENCE_GOSSIP_DIR_DEFAULT = None
+RESILIENCE_PEER_TIMEOUT = "peer_timeout_s"
+RESILIENCE_PEER_TIMEOUT_DEFAULT = 0.0  # 0 = gossip off
+RESILIENCE_COMM_TIMEOUT = "comm_timeout_s"
+RESILIENCE_COMM_TIMEOUT_DEFAULT = 0.0  # 0 = unbounded comm waits
 
 #############################################
 # Sparse attention

@@ -328,9 +328,13 @@ class DeepSpeedEngine:
 
         # step-level resilience: divergence guard + watchdog + auto-rollback
         # recovery (None unless the config has a `resilience` block)
-        from deepspeed_tpu.runtime.resilience import ResilienceSupervisor
+        from deepspeed_tpu.runtime.resilience import ClusterHooks, ResilienceSupervisor
 
         self.resilience = ResilienceSupervisor.from_ds_config(self._config, self)
+        # job-level resilience hooks run at every step boundary: supervisor
+        # heartbeat, preemption-safe shutdown, host health gossip, cluster
+        # fault arms (no-op unless configured / running under a supervisor)
+        self._cluster = ClusterHooks(self)
 
         if self.global_rank == 0:
             self._config.print("DeepSpeedEngine configuration")
@@ -1472,6 +1476,9 @@ class DeepSpeedEngine:
         if data_iter is None:
             assert self.training_dataloader is not None
             data_iter = iter(self.training_dataloader)
+        # job-level hooks first: heartbeat/preemption/gossip/cluster faults
+        # run where params+optimizer state are consistent (step boundary)
+        self._cluster.step_boundary()
         gas = self.gradient_accumulation_steps()
         if self.resilience is not None:
             return self.resilience.train_batch(data_iter, self._train_batch_now, gas)
@@ -1585,6 +1592,9 @@ class DeepSpeedEngine:
                 global_samples=self.global_samples,
                 dp_world_size=self.dp_world_size,
                 mp_world_size=self.mp_world_size,
+                # the global batch the trajectory was trained with: elastic
+                # resume must preserve it across a world-size change
+                train_batch_size=self.train_batch_size(),
             )
             state.update(client_state)
             writer.write_file(
@@ -1608,6 +1618,7 @@ class DeepSpeedEngine:
             if save_latest:
                 storage.write_latest(save_dir, tag)
             storage.rotate(save_dir)
+        self._ckpt_commit_barrier(tag)
         if self.resilience is not None:
             # the committed tag is the new rollback target; the replay
             # buffer restarts from here
@@ -1615,6 +1626,21 @@ class DeepSpeedEngine:
         if self.monitor is not None:
             self.monitor.flush()
         return True
+
+    def _ckpt_commit_barrier(self, tag):
+        """Deadline-bounded rendezvous at the checkpoint commit point.
+        Checkpoint saves are where multi-host jobs classically wedge: a peer
+        that died mid-save leaves every survivor blocked in the next
+        collective forever. With ``resilience.comm_timeout_s`` set, a named
+        ``CommTimeoutError`` surfaces within the deadline instead; 0/unset
+        keeps the wait unbounded. Single-process runs skip the barrier
+        entirely unless a deadline is configured (no behavior change)."""
+        rc = getattr(self._config, "resilience_config", None)
+        timeout_s = getattr(rc, "comm_timeout_s", 0.0) or 0.0
+        if dist.get_world_size() > 1 or timeout_s > 0:
+            import deepspeed_tpu.comm as dscomm
+
+            dscomm.barrier(f"ckpt_commit:{tag}", timeout_s=timeout_s or None)
 
     def _save_zero_checkpoint(self, save_path, tag, writer):
         """Every dp shard gets its own optim-states file (reference engine.py:1557)."""
@@ -1715,6 +1741,9 @@ class DeepSpeedEngine:
         self.load_module_state_dict(checkpoint["module"], strict=load_module_strict)
         # set before _load_zero_shards so its log reports the true saved dp
         self.loaded_checkpoint_dp_world_size = checkpoint.get("dp_world_size", None)
+        # elastic resume: a changed dp world size re-splits the (preserved)
+        # global batch, or raises ElasticityIncompatibleWorldSize
+        self._maybe_elastic_resume(checkpoint)
 
         if load_optimizer_states:
             if self.zero_optimization():
@@ -1745,12 +1774,56 @@ class DeepSpeedEngine:
         deepspeed_states = [
             "module", "optimizer", "lr_scheduler", "scaler", "step_rng", "csr_tensor_module_names",
             "skipped_steps", "global_steps", "global_samples", "dp_world_size", "mp_world_size",
+            "train_batch_size",
         ]
         client_state = {k: v for k, v in checkpoint.items() if k not in deepspeed_states}
         if self.resilience is not None:
             self.resilience.note_restore(load_dir, tag)
         log_dist(f"Loaded checkpoint {ckpt_name} at global step {self.global_steps}", ranks=[0])
         return ckpt_name, client_state
+
+    def _maybe_elastic_resume(self, checkpoint):
+        """Job restarted at a different dp world size than the checkpoint
+        was saved at. With elasticity enabled, validate the new size against
+        the HCN algebra (``ElasticityIncompatibleWorldSize`` when it cannot
+        consume the elastic global batch) and re-split the *preserved*
+        global batch into micro x accumulation x world for this run; jitted
+        programs bake the old splits, so the jit cache is dropped. Without
+        elasticity, a changed world size silently changes the global batch
+        — warn loudly and continue (the reference behavior)."""
+        saved_dp = checkpoint.get("dp_world_size", None)
+        if not saved_dp or saved_dp == self.dp_world_size:
+            return
+        if not self.elasticity_enabled():
+            logger.warning(
+                f"[elasticity] checkpoint was saved at dp world size "
+                f"{saved_dp} but this run has {self.dp_world_size} and "
+                "elasticity is not enabled: the global batch (and the loss "
+                "trajectory) will change. Enable the `elasticity` config "
+                "block to preserve it across world-size changes."
+            )
+            return
+        from deepspeed_tpu.elasticity import compute_elastic_resume
+        from deepspeed_tpu.version import __version__
+
+        plan = compute_elastic_resume(
+            self._config._param_dict, __version__,
+            prev_world_size=saved_dp, new_world_size=self.dp_world_size,
+            saved_train_batch_size=checkpoint.get("train_batch_size"),
+        )
+        cfg = self._config
+        changed = (
+            cfg.train_micro_batch_size_per_gpu != plan["micro_batch_size"]
+            or cfg.gradient_accumulation_steps != plan["gradient_accumulation_steps"]
+        )
+        cfg.train_batch_size = plan["train_batch_size"]
+        cfg.train_micro_batch_size_per_gpu = plan["micro_batch_size"]
+        cfg.gradient_accumulation_steps = plan["gradient_accumulation_steps"]
+        if changed:
+            # gas/micro are baked into the fused train_step programs
+            self._jit_cache.clear()
+            self._cached_grads = None
+            self._acc_grads = None
 
     def _load_zero_shards(self, load_dir, tag, shards):
         """Re-partition the saved dp shards (already read + verified) for

@@ -221,9 +221,12 @@ class PipelineEngine:
         # step-level resilience: divergence guard + watchdog + auto-rollback
         # recovery, shared with DeepSpeedEngine (None unless the config has a
         # `resilience` block)
-        from deepspeed_tpu.runtime.resilience import ResilienceSupervisor
+        from deepspeed_tpu.runtime.resilience import ClusterHooks, ResilienceSupervisor
 
         self.resilience = ResilienceSupervisor.from_ds_config(self._config, self)
+        # job-level resilience hooks (heartbeat, preemption-safe shutdown,
+        # health gossip, cluster fault arms), shared with DeepSpeedEngine
+        self._cluster = ClusterHooks(self)
 
         # curriculum learning (beyond the v0.3.10 reference) — same wiring
         # as DeepSpeedEngine so the config section works under pipelines too
@@ -1260,6 +1263,9 @@ class PipelineEngine:
         if data_iter is None:
             assert self.training_dataloader is not None, "no training data"
             data_iter = iter(self.training_dataloader)
+        # job-level hooks first (step boundary = consistent state):
+        # heartbeat, preemption, gossip, cluster fault arms
+        self._cluster.step_boundary()
         if self.resilience is not None:
             # supervised path: watchdog-bounded fetch + divergence guard +
             # rollback recovery (runtime/resilience/, see docs/resilience.md)
@@ -1742,6 +1748,7 @@ class PipelineEngine:
         write = dist.get_rank() == 0
         layer_params = self._gather_layer_params()
         if not write:
+            self._ckpt_commit_barrier(tag)
             if self.resilience is not None:
                 # rank 0 commits the tag; every rank's supervisor must agree
                 # on the rollback target and restart its replay buffer
@@ -1786,9 +1793,23 @@ class PipelineEngine:
         if save_latest:
             storage.write_latest(save_dir, tag)
         storage.rotate(save_dir)
+        self._ckpt_commit_barrier(tag)
         if self.resilience is not None:
             self.resilience.note_checkpoint(save_dir, tag)
         return True
+
+    def _ckpt_commit_barrier(self, tag):
+        """Deadline-bounded rendezvous at the checkpoint commit point (same
+        contract as ``DeepSpeedEngine._ckpt_commit_barrier``): with
+        ``resilience.comm_timeout_s`` set, a peer dead mid-save raises
+        ``CommTimeoutError`` within the deadline instead of wedging the
+        survivors; single-process runs without a deadline skip it."""
+        rc = getattr(self._config, "resilience_config", None)
+        timeout_s = getattr(rc, "comm_timeout_s", 0.0) or 0.0
+        if dist.get_world_size() > 1 or timeout_s > 0:
+            import deepspeed_tpu.comm as dscomm
+
+            dscomm.barrier(f"ckpt_commit:{tag}", timeout_s=timeout_s or None)
 
     def _gather_layer_params(self):
         out = [None] * self.module._num_layers

@@ -1,24 +1,35 @@
 """Step-level training resilience: divergence guard, hung-step watchdog,
-and auto-rollback recovery on top of the fault-tolerant checkpoint layer.
+and auto-rollback recovery on top of the fault-tolerant checkpoint layer —
+plus the job-level pieces (preemption-safe shutdown, cluster fault
+injection) the worker supervisor builds on.
 
-See docs/resilience.md for the protocol and the ``resilience`` config block.
+See docs/resilience.md (step level) and docs/cluster_resilience.md (job
+level) for the protocols and the ``resilience`` config block.
 """
 
+from deepspeed_tpu.runtime.resilience.cluster_faults import ClusterFaultInjector, get_active_injector, set_active_injector
 from deepspeed_tpu.runtime.resilience.config import ResilienceConfig
 from deepspeed_tpu.runtime.resilience.errors import StepTimeoutError, TrainingDivergenceError
 from deepspeed_tpu.runtime.resilience.fault_injection import InjectedLoaderError, StepFaultInjector
 from deepspeed_tpu.runtime.resilience.guard import DivergenceGuard
+from deepspeed_tpu.runtime.resilience.preemption import ClusterHooks, PreemptionHandler, StepHeartbeat
 from deepspeed_tpu.runtime.resilience.supervisor import ResilienceSupervisor
 from deepspeed_tpu.runtime.resilience.watchdog import TimedFetcher, timed_call
 
 __all__ = [
+    "ClusterFaultInjector",
+    "ClusterHooks",
     "DivergenceGuard",
     "InjectedLoaderError",
+    "PreemptionHandler",
     "ResilienceConfig",
     "ResilienceSupervisor",
     "StepFaultInjector",
+    "StepHeartbeat",
     "StepTimeoutError",
     "TimedFetcher",
     "TrainingDivergenceError",
+    "get_active_injector",
+    "set_active_injector",
     "timed_call",
 ]

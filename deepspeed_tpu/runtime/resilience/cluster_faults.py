@@ -1,0 +1,155 @@
+"""Cluster-level fault injection: worker death, preemption, comm wedges.
+
+``ClusterFaultInjector`` extends the step-level injector with the fault
+arms a *job-level* recovery loop must survive, so the supervisor /
+preemption / comm-deadline paths are all exercised deterministically on
+CPU with real subprocess workers:
+
+    preempt_signal  send SIGTERM to this process at step N (the TPU-pod
+                    preemption signal; exercises PreemptionHandler +
+                    emergency checkpoint + EXIT_PREEMPTED)
+    kill_worker     SIGKILL this process at step N — hard death, no
+                    cleanup, no atexit (exercises supervisor restart +
+                    resume from the last committed tag)
+    hang_barrier    sleep ``seconds`` inside comm.barrier()/
+                    host_allreduce_scalar() (exercises the comm deadline:
+                    ``CommTimeoutError`` instead of an eternal hang)
+    dead_peer       stop emitting heartbeats/health gossip from step N on,
+                    so *other* hosts see this one as dead (exercises
+                    ``DeadPeerError`` escalation)
+
+Arms take the step-injector fields (``at_step``, ``times``, ``seconds``)
+plus ``marker``: a sentinel-file path giving **one-shot semantics that
+survive process restarts**. A ``kill_worker`` arm without a marker would
+fire again on every supervised restart (the config is re-read) and the job
+would never finish; with a marker the arm fires only in the process that
+wins the atomic marker-file creation, and never again.
+
+``hang_barrier`` is matched on every call (``times`` bounds firings;
+``at_step`` is ignored) because comm calls have no step identity.
+
+The constructor registers the instance as the process-global active
+injector so ``comm/`` — which has no engine handle — can consult the
+``hang_barrier`` arm.
+"""
+
+import os
+import signal
+import time
+
+from deepspeed_tpu.runtime.resilience.fault_injection import StepFaultInjector
+from deepspeed_tpu.utils.logging import logger
+
+CLUSTER_POINTS = (
+    "preempt_signal",
+    "kill_worker",
+    "hang_barrier",
+    "dead_peer",
+)
+
+_ACTIVE = None
+
+
+def get_active_injector():
+    """The process-global cluster injector, for code (comm/) without an
+    engine handle. None outside fault-injection runs."""
+    return _ACTIVE
+
+
+def set_active_injector(injector):
+    global _ACTIVE
+    _ACTIVE = injector
+
+
+class _ClusterArm:
+    __slots__ = ("at_step", "times", "seconds", "marker")
+
+    def __init__(self, at_step=None, times=1, seconds=30.0, marker=None):
+        self.at_step = None if at_step is None else int(at_step)
+        self.times = None if times is None else int(times)
+        self.seconds = float(seconds)
+        self.marker = marker
+
+
+class ClusterFaultInjector(StepFaultInjector):
+    """Step + checkpoint-I/O injector, extended with cluster fault arms."""
+
+    def __init__(self, spec=None):
+        spec = dict(spec or {})
+        cluster_spec = {p: spec.pop(p) for p in list(spec) if p in CLUSTER_POINTS}
+        super().__init__(spec)  # step + checkpoint I/O arms
+        self._cluster_arms = {}
+        self._dead = False
+        for point, cfg in cluster_spec.items():
+            self.arm_cluster(point, **dict(cfg or {}))
+        set_active_injector(self)
+
+    def arm_cluster(self, point, **kwargs):
+        if point not in CLUSTER_POINTS:
+            raise ValueError(
+                f"unknown cluster fault point '{point}' (known: {', '.join(CLUSTER_POINTS)})"
+            )
+        self._cluster_arms[point] = _ClusterArm(**kwargs)
+        return self
+
+    def disarm_cluster(self, point=None):
+        if point is None:
+            self._cluster_arms.clear()
+        else:
+            self._cluster_arms.pop(point, None)
+
+    def _take_cluster(self, point, step):
+        """Like ``_take`` but with restart-surviving one-shot semantics:
+        an arm with a ``marker`` fires only if this process wins the atomic
+        creation of the marker file."""
+        arm = self._cluster_arms.get(point)
+        if arm is None:
+            return None
+        if arm.at_step is not None and step is not None and step != arm.at_step:
+            return None
+        if arm.times is not None:
+            if arm.times <= 0:
+                return None
+        if arm.marker is not None and not self._claim_marker(arm.marker):
+            return None
+        if arm.times is not None:
+            arm.times -= 1
+        self._fire(point)
+        return arm
+
+    @staticmethod
+    def _claim_marker(path):
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False  # already fired (possibly in a previous process)
+        os.close(fd)
+        return True
+
+    # -- hooks (ClusterHooks.step_boundary / comm) ---------------------
+    def maybe_preempt(self, step):
+        arm = self._take_cluster("preempt_signal", step)
+        if arm is not None:
+            logger.warning(f"[fault-injection] sending SIGTERM to self at step {step}")
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_kill_worker(self, step):
+        arm = self._take_cluster("kill_worker", step)
+        if arm is not None:
+            logger.warning(f"[fault-injection] SIGKILL self at step {step} (hard death)")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def maybe_hang_barrier(self):
+        # no step identity inside comm: matched on every call, `times` bounds it
+        arm = self._take_cluster("hang_barrier", None)
+        if arm is not None:
+            time.sleep(arm.seconds)
+
+    def heartbeat_suppressed(self, step):
+        """True from the step the ``dead_peer`` arm fires onward: this host
+        goes silent so its peers' gossip declares it dead."""
+        if self._dead:
+            return True
+        if self._take_cluster("dead_peer", step) is not None:
+            self._dead = True
+        return self._dead

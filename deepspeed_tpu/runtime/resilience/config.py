@@ -34,6 +34,25 @@ class ResilienceConfig:
     # Wall-time bound per train step / per data fetch; 0 disables the
     # watchdog.
     step_timeout_s: float = 0.0
-    # Step-level fault-injection spec (tests only): see
-    # resilience/fault_injection.py for the accepted points.
+    # Step-level + cluster fault-injection spec (tests only): see
+    # resilience/fault_injection.py and resilience/cluster_faults.py for
+    # the accepted points.
     fault_injection: dict = field(default=None)
+    # --- job-level (cluster) resilience -------------------------------
+    # Catch SIGTERM/SIGINT, commit an emergency checkpoint at the next
+    # step boundary, exit with the resumable code the worker supervisor
+    # recognizes (launcher/supervisor.py). Also enabled by the
+    # DSTPU_PREEMPTION=1 env the supervisor sets.
+    handle_preemption: bool = False
+    # Where the emergency checkpoint goes; None falls back to
+    # DSTPU_PREEMPT_SAVE_DIR, then to the last save_checkpoint directory.
+    preemption_save_dir: str = None
+    # Shared directory for cross-host health gossip (comm/health.py);
+    # None disables gossip.
+    gossip_dir: str = None
+    # A peer silent for longer than this is declared dead (DeadPeerError
+    # at the step boundary -> coordinated restart); 0 disables gossip.
+    peer_timeout_s: float = 0.0
+    # Deadline for host-level collectives the engine issues (barrier /
+    # host_allreduce_scalar); 0 keeps them unbounded.
+    comm_timeout_s: float = 0.0

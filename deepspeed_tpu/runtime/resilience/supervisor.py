@@ -47,9 +47,11 @@ class ResilienceSupervisor:
         )
         self.injector = None
         if config.fault_injection:
-            from deepspeed_tpu.runtime.resilience.fault_injection import StepFaultInjector
+            # the cluster injector is a superset (checkpoint I/O + step +
+            # cluster arms), so one fault_injection spec drives everything
+            from deepspeed_tpu.runtime.resilience.cluster_faults import ClusterFaultInjector
 
-            self.injector = StepFaultInjector(config.fault_injection)
+            self.injector = ClusterFaultInjector(config.fault_injection)
         # Batch windows executed since the last committed checkpoint:
         # [(global_step, microbatches), ...] — the deterministic fast-forward
         # source for rollback recovery.

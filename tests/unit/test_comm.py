@@ -34,6 +34,33 @@ def test_all_reduce_ops(mesh):
     np.testing.assert_allclose(run(comm.ReduceOp.MIN), np.zeros(n))
 
 
+def test_all_reduce_product(mesh):
+    """PRODUCT has no psum-style primitive; the gather+local-prod path must
+    still produce the cross-rank product on every rank."""
+    n = len(jax.devices())
+    x = jnp.arange(1.0, float(n) + 1.0)  # 1..n so the product is n!
+    out = np.asarray(_per_rank(
+        mesh, lambda v: comm.all_reduce(v, "data", op=comm.ReduceOp.PRODUCT), x,
+        out_spec=P("data")))
+    np.testing.assert_allclose(out, np.full(n, np.prod(np.arange(1.0, n + 1.0))))
+
+
+def test_all_reduce_unsupported_op_names_supported_set():
+    with pytest.raises(NotImplementedError, match="SUM.*PRODUCT"):
+        comm.all_reduce(jnp.zeros(()), "data", op="bitwise_and")
+
+
+def test_broadcast_rejects_out_of_range_root(mesh):
+    """An out-of-range root would silently broadcast zeros (the select mask
+    is false everywhere); it must raise eagerly at trace time instead."""
+    n = len(jax.devices())
+    x = jnp.arange(float(n))
+    for bad in (n, -1, 99):
+        with pytest.raises(ValueError, match="root"):
+            _per_rank(mesh, lambda v: comm.broadcast(v, "data", root=bad), x,
+                      P("data"))
+
+
 def test_all_gather_and_reduce_scatter(mesh):
     n = len(jax.devices())
     x = jnp.arange(float(n))
