@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster ops bench
+.PHONY: test test-fast test-faults test-cluster test-serving ops bench bench-serving
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -22,8 +22,18 @@ test-faults:
 test-cluster:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_cluster_resilience.py -q
 
+# Continuous-batching serving engine: bitwise oracle vs generate(),
+# recompile pins, backpressure/deadline/fault-injection recovery.
+test-serving:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_serving.py -q
+
 ops:
 	$(MAKE) -C csrc
+
+# Continuous-batching serving throughput + TTFT on the CPU backend;
+# writes SERVING_BENCH_CPU.json (see docs/serving.md).
+bench-serving:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=serving python bench.py --child
 
 # Benchmark on the real TPU chip (default platform).
 bench:

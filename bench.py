@@ -130,6 +130,8 @@ def _perf_fields(dt, steps, cfg, n_params, global_batch, seq_len, n_dev, dev, on
 def child_main():
     if os.environ.get("BENCH_MODEL", "bert") == "gpt2":
         return gpt2_child_main()
+    if os.environ.get("BENCH_MODEL", "bert") == "serving":
+        return serving_child_main()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -268,6 +270,93 @@ def gpt2_child_main():
         "scan_unroll": cfg.scan_unroll,
         "attn_impl": _attn_impl_label(on_tpu),
         "final_loss": round(final_loss, 3),
+    }))
+    return 0
+
+
+def serving_child_main():
+    """Serving leg: continuous-batching aggregate tokens/sec + TTFT.
+
+    Same tiny GPT-2 shape as tests/perf/decode_bench.py, so the aggregate
+    number reads directly against that artifact's single-stream
+    ``kv_cache_tok_per_s`` rows — the delta IS the continuous-batching
+    win. Writes SERVING_BENCH[_CPU].json next to DECODE_BENCH[_CPU].json
+    and prints the usual one JSON line. Knobs: BENCH_SERVE_REQUESTS /
+    BENCH_SERVE_SLOTS / BENCH_SERVE_NEW_TOKENS."""
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference import generate
+    from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "16"))
+    max_slots = int(os.environ.get("BENCH_SERVE_SLOTS", "8"))
+    max_new = int(os.environ.get("BENCH_SERVE_NEW_TOKENS", "32"))
+
+    cfg = GPT2Config(
+        vocab_size=512, hidden_size=128, num_hidden_layers=4,
+        num_attention_heads=4, max_position_embeddings=1024,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    _, params = init_gpt2(cfg, batch_size=1, seq_len=8, seed=0)
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (int(n),)).tolist()
+               for n in rng.randint(4, 17, size=n_requests)]
+
+    def make_engine():
+        return ServingEngine(params, cfg, ServingConfig(
+            max_slots=max_slots, max_queue=max(n_requests, 1),
+            max_seq_len=64, prompt_buckets=(8, 16)))
+
+    # warmup engine: pays every compile (per-bucket prefill + the one
+    # decode program) and anchors correctness against one-shot generate()
+    warm = make_engine()
+    w0, w1 = warm.submit(prompts[0], max_new_tokens=max_new), \
+        warm.submit(prompts[1], max_new_tokens=max_new)
+    warm.drain(max_steps=10 * max_new)
+    want = np.asarray(generate(
+        params, cfg, np.asarray([prompts[0]], np.int32), max_new))[0].tolist()
+    assert w0.result(timeout=5) == want, "serving diverged from generate()"
+    w1.result(timeout=5)
+
+    eng = make_engine()
+    t0 = time.perf_counter()
+    futs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    eng.drain(max_steps=100 * max_new * max(1, n_requests // max_slots))
+    tokens = sum(len(f.result(timeout=5)) for f in futs)
+    wall_s = time.perf_counter() - t0
+    snap = eng.metrics.snapshot()
+
+    result = {
+        "platform": platform,
+        "model": "gpt2-tiny(L4,H128)",
+        "requests": n_requests,
+        "max_slots": max_slots,
+        "max_new_tokens": max_new,
+        "tokens_per_sec": round(tokens / wall_s, 1),
+        "decode_tokens_per_sec": round(snap["tokens_per_sec"] or 0.0, 1),
+        "avg_ttft_s": round(snap["avg_ttft_s"], 4),
+        "max_ttft_s": round(snap["max_ttft_s"], 4),
+        "decode_steps": snap["decode_steps"],
+        "complete": True,
+    }
+    suffix = "" if platform == "tpu" else f"_{platform.upper()}"
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       f"SERVING_BENCH{suffix}.json")
+    with open(out, "w") as f:
+        f.write(json.dumps(result, indent=1) + "\n")
+
+    print(json.dumps({
+        "metric": f"continuous-batching serving tokens/sec ({platform})",
+        "value": result["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        **{k: result[k] for k in ("avg_ttft_s", "max_ttft_s", "requests",
+                                  "max_slots", "max_new_tokens",
+                                  "decode_tokens_per_sec")},
     }))
     return 0
 
@@ -459,6 +548,10 @@ def main():
     if os.environ.get("BENCH_MODEL", "bert") == "gpt2":
         label = f"gpt2-{os.environ.get('BENCH_GPT2_SIZE', 'medium')} pretrain tokens/sec/chip"
         seq = os.environ.get("BENCH_SEQ", "1024")
+        unit = "tokens/sec"
+    elif os.environ.get("BENCH_MODEL", "bert") == "serving":
+        label = "continuous-batching serving tokens/sec"
+        seq = os.environ.get("BENCH_SERVE_NEW_TOKENS", "32")
         unit = "tokens/sec"
     else:
         label = "bert-large pretrain samples/sec/chip"

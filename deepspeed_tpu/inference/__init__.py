@@ -16,7 +16,15 @@ from deepspeed_tpu.inference.quantization import (  # noqa: F401
     quantize_for_decode,
     quantize_tensor,
 )
+from deepspeed_tpu.inference.serving import (  # noqa: F401
+    QueueFullError,
+    RequestTimeoutError,
+    ServingConfig,
+    ServingEngine,
+)
 
 __all__ = ["generate", "greedy_generate", "beam_search",
            "quantize_for_decode", "quantize_tensor", "dequantize_tensor",
-           "pipe_layers_to_lm_params", "lm_params_from_pipeline_checkpoint"]
+           "pipe_layers_to_lm_params", "lm_params_from_pipeline_checkpoint",
+           "ServingEngine", "ServingConfig", "QueueFullError",
+           "RequestTimeoutError"]

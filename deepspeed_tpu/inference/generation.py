@@ -223,6 +223,11 @@ def generate(params, config, prompt_ids, max_new_tokens, temperature=0.0,
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if max_new_tokens < 1:
+        # beam_search already rejects this; here a zero/negative count
+        # would silently scan nothing and return an empty [B, 0] array
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
     prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
     total = prompt_ids.shape[1] + int(max_new_tokens)
     if total > config.max_position_embeddings:

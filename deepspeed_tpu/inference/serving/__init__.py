@@ -1,0 +1,40 @@
+"""Continuous-batching inference serving (beyond the v0.3.10 reference;
+DeepSpeed grew this as DeepSpeed-Inference later).
+
+The one-shot ``generate()`` path answers a fixed batch; this subsystem
+answers *traffic*: a bounded admission queue feeds a slot-based KV-cache
+pool, and a single compiled masked batched decode step serves every
+in-flight request — new requests join whenever a slot frees, finished
+ones retire per sequence, and none of that churn recompiles. Greedy
+outputs are bitwise identical to per-request ``generate()`` regardless
+of arrival order (the oracle in tests/unit/test_serving.py).
+
+Layering: kv_pool (device state) <- engine (compiled step + loop) <-
+scheduler (host policy: queue/buckets/retirement) <- metrics (monitor).
+"""
+
+from deepspeed_tpu.inference.serving.config import ServingConfig  # noqa: F401
+from deepspeed_tpu.inference.serving.engine import ServingEngine  # noqa: F401
+from deepspeed_tpu.inference.serving.fault_injection import (  # noqa: F401
+    ServingFaultInjector,
+)
+from deepspeed_tpu.inference.serving.kv_pool import (  # noqa: F401
+    KVCachePool,
+    PoolExhaustedError,
+)
+from deepspeed_tpu.inference.serving.metrics import ServingMetrics  # noqa: F401
+from deepspeed_tpu.inference.serving.scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler,
+    QueueFullError,
+    RequestTimeoutError,
+    ServingFuture,
+    bucket_for,
+    default_buckets,
+)
+
+__all__ = [
+    "ServingEngine", "ServingConfig", "ServingMetrics", "ServingFuture",
+    "KVCachePool", "PoolExhaustedError", "ContinuousBatchingScheduler",
+    "QueueFullError", "RequestTimeoutError", "ServingFaultInjector",
+    "bucket_for", "default_buckets",
+]

@@ -1,0 +1,37 @@
+"""Typed view of the ``serving`` config block.
+
+Parsed and validated by ``runtime/config.py::get_serving_config`` (key
+strings and defaults live in ``runtime/constants.py`` next to the
+checkpoint/resilience blocks). Import-light on purpose: the config layer
+must not drag jax in; device work lives in engine.py/kv_pool.py.
+"""
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ServingConfig:
+    # Master switch: True once a `serving` section exists, False when the
+    # section is absent (see get_serving_config).
+    enabled: bool = False
+    # KV-cache slots = max concurrent requests mid-decode. STATIC: fixes
+    # the decode program's batch dimension, so slot churn never
+    # recompiles. Sized to HBM: pool bytes = 2·L·max_slots·nh·S·hd·dtype.
+    max_slots: int = 8
+    # Bounded admission queue; submit() past this raises QueueFullError.
+    max_queue: int = 64
+    # KV-cache length per slot (prompt + generated). None = the model's
+    # max_position_embeddings.
+    max_seq_len: int = None
+    # Ascending prompt-length bucket ladder; a prompt is padded up to its
+    # bucket so XLA compiles at most len(buckets) prefill programs.
+    # None = powers of two up to max_seq_len - 1.
+    prompt_buckets: tuple = None
+    # max_new_tokens for submit() calls that don't specify one.
+    default_max_new_tokens: int = 64
+    # Default per-request deadline (queued + decoding); 0 = none. A
+    # request past it is retired with RequestTimeoutError.
+    request_timeout_s: float = 0.0
+    # Serving/step/I-O fault-injection spec (tests only): see
+    # serving/fault_injection.py for the accepted points.
+    fault_injection: dict = field(default=None)

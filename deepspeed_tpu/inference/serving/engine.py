@@ -1,0 +1,378 @@
+"""Continuous-batching serving engine over the KV-cache decode path.
+
+The decode loop is ONE jitted program for the life of the server: a
+masked batched step over the pool's ``MaxSlots`` lanes, each lane
+running the SAME per-token ``_step`` the one-shot ``generate()`` path
+uses (vmapped with a per-lane position counter). ``MaxSlots`` is static,
+the lane-active mask and positions are traced operands — so requests
+joining, retiring, or swapping slots NEVER recompile. Prompt prefill is
+per-request at a bucketed length (one compile per bucket, bounded by the
+bucket ladder) and is copied into the request's slot with a traced-slot
+install (one compile total).
+
+Correctness oracle (tests/unit/test_serving.py): continuous-batched
+greedy output is BITWISE equal to per-request ``generate()`` output for
+any arrival order. Why it holds:
+
+- prefill pads the prompt up to its bucket but *selects* the logits at
+  the true last prompt position; positions < prompt_len only ever see
+  true prompt tokens, so the selected logits match the unpadded scan;
+- pad/stale cache beyond a lane's position is either overwritten before
+  it is reachable (decode writes position p before attending to it) or
+  hidden by the causal mask, whose -1e30 scores underflow to exactly 0
+  probability — extra masked cache length is numerically invisible;
+- lanes are vmapped, hence computed independently: a neighbor admitting,
+  retiring, or holding garbage cannot perturb another lane's values
+  (the batch-independence property test_generation.py already pins).
+
+Greedy only: serving argmax-decodes (temperature-0), the mode with a
+bitwise oracle. Sampling needs per-request RNG streams and is future
+work.
+"""
+
+import threading
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.generation import _step
+from deepspeed_tpu.inference.serving.config import ServingConfig
+from deepspeed_tpu.inference.serving.fault_injection import ServingFaultInjector
+from deepspeed_tpu.inference.serving.kv_pool import KVCachePool
+from deepspeed_tpu.inference.serving.metrics import ServingMetrics
+from deepspeed_tpu.inference.serving.scheduler import (
+    ContinuousBatchingScheduler,
+    RequestTimeoutError,
+    bucket_for,
+    default_buckets,
+)
+
+
+@partial(jax.jit, static_argnames=("n_layers", "n_heads", "head_dim", "total"))
+def _prefill_request_jit(params, padded_ids, true_len, *, n_layers, n_heads,
+                         head_dim, total):
+    """Prefill ONE request at its bucketed length into a fresh
+    ``total``-long cache; return (k, v, first greedy token).
+
+    ``padded_ids`` is [1, Sb] (prompt right-padded to its bucket);
+    ``true_len`` is traced, so every prompt length inside a bucket shares
+    the bucket's one compiled program. The scan runs the same ``_step``
+    as ``_prefill``; the carried logits are *selected* at the true last
+    prompt position instead of taken from the scan's end, which makes
+    the padding invisible to the emitted token."""
+    B, Sb = padded_ids.shape
+    tr = params["params"]["transformer"]
+    emb_dtype = (jnp.float32 if "kernel_q" in tr["wte"]
+                 else tr["wte"]["embedding"].dtype)
+    dtype = jnp.result_type(emb_dtype, tr["wpe"]["embedding"].dtype)
+    shape = (n_layers, B, n_heads, total, head_dim)
+    caches = (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    from deepspeed_tpu.inference.quantization import vocab_size
+
+    V = vocab_size(tr["wte"])
+
+    def body(carry, pos):
+        caches, sel = carry
+        logits, caches = _step(params, n_heads, caches, padded_ids[:, pos], pos)
+        sel = jnp.where(pos == true_len - 1, logits, sel)
+        return (caches, sel), None
+
+    (caches, sel), _ = jax.lax.scan(
+        body, (caches, jnp.zeros((B, V), dtype)), jnp.arange(Sb))
+    first = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+    return caches[0], caches[1], first
+
+
+@partial(jax.jit, static_argnames=("n_heads",), donate_argnums=(1, 2))
+def _decode_step_jit(params, pool_k, pool_v, tokens, positions, active, *,
+                     n_heads):
+    """One masked batched decode step over every pool lane.
+
+    Each lane feeds its last token at its own position through the
+    one-shot path's ``_step`` (vmapped as a B=1 lane). Inactive lanes
+    compute garbage into their own (dead) lane and keep their token via
+    the ``active`` mask; the pool buffers are donated — the step is an
+    in-place update of the serving state."""
+
+    def lane(ck, cv, tok, pos):
+        logits, (ck2, cv2) = _step(params, n_heads, (ck[:, None], cv[:, None]),
+                                   tok[None], pos)
+        return logits[0], ck2[:, 0], cv2[:, 0]
+
+    logits, pool_k, pool_v = jax.vmap(
+        lane, in_axes=(1, 1, 0, 0), out_axes=(0, 1, 1))(
+        pool_k, pool_v, tokens, positions)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.where(active, nxt, tokens), pool_k, pool_v
+
+
+class ServingEngine:
+    """Request queue + KV pool + the single compiled decode loop.
+
+    Drive it synchronously (``step()`` / ``drain()`` — deterministic, what
+    the tests do) or as a background thread (``start()`` / ``stop()``)
+    with ``submit()`` from any thread."""
+
+    def __init__(self, params, model_config, serving_config=None,
+                 monitor=None, injector=None):
+        cfg = serving_config or ServingConfig()
+        self.params = params
+        self.model_config = model_config
+        self.config = cfg
+        self.n_layers = model_config.num_hidden_layers
+        self.n_heads = model_config.num_attention_heads
+        self.head_dim = model_config.hidden_size // self.n_heads
+
+        mpe = model_config.max_position_embeddings
+        self.max_seq_len = cfg.max_seq_len or mpe
+        if self.max_seq_len > mpe:
+            raise ValueError(
+                f"serving.max_seq_len={self.max_seq_len} exceeds "
+                f"max_position_embeddings={mpe}")
+        buckets = cfg.prompt_buckets or default_buckets(self.max_seq_len - 1)
+        if buckets[-1] > self.max_seq_len - 1:
+            raise ValueError(
+                f"largest prompt bucket ({buckets[-1]}) must leave room for "
+                f"one generated token (max_seq_len={self.max_seq_len})")
+
+        tr = params["params"]["transformer"]
+        emb_dtype = (jnp.float32 if "kernel_q" in tr["wte"]
+                     else tr["wte"]["embedding"].dtype)
+        dtype = jnp.result_type(emb_dtype, tr["wpe"]["embedding"].dtype)
+        self.pool = KVCachePool(self.n_layers, cfg.max_slots, self.n_heads,
+                                self.max_seq_len, self.head_dim, dtype=dtype)
+        self.scheduler = ContinuousBatchingScheduler(
+            max_queue=cfg.max_queue, buckets=buckets,
+            default_max_new_tokens=cfg.default_max_new_tokens,
+            request_timeout_s=cfg.request_timeout_s)
+        self.metrics = ServingMetrics(monitor)
+        if injector is None and cfg.fault_injection:
+            injector = ServingFaultInjector(cfg.fault_injection)
+        self.injector = injector
+
+        self._active = {}                                   # slot -> Request
+        self._lane_tokens = np.zeros(cfg.max_slots, np.int32)
+        self._lane_active = np.zeros(cfg.max_slots, bool)
+        self._step_count = 0
+        self._loop_thread = None
+        self._stop = threading.Event()
+
+    @classmethod
+    def from_config(cls, params, model_config, ds_config, rank=0,
+                    injector=None):
+        """Build from a ds_config (dict or DeepSpeedConfig): the validated
+        ``serving`` block plus the shared monitor construction path."""
+        from deepspeed_tpu.monitor import monitor_from_config
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        if isinstance(ds_config, dict):
+            ds_config = DeepSpeedConfig(ds_config, world_size=1)
+        return cls(params, model_config,
+                   serving_config=ds_config.serving_config,
+                   monitor=monitor_from_config(ds_config, rank),
+                   injector=injector)
+
+    # -- request intake -------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens=None, eos_token_id=None,
+               timeout_s=None, stream_cb=None):
+        """Queue one request; returns its ``ServingFuture``.
+
+        ``prompt_ids`` is a 1-D token sequence. Raises ``QueueFullError``
+        when the admission queue is at capacity (backpressure) and
+        ``ValueError`` for requests that can never fit. ``stream_cb``
+        (optional) is called as ``stream_cb(request_id, token)`` for every
+        generated token, including the first."""
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if len(prompt) < 1:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens is None:
+            max_new_tokens = self.config.default_max_new_tokens
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        bucket_for(len(prompt), self.scheduler.buckets)  # raises if too long
+        total = len(prompt) + int(max_new_tokens)
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"= {total} exceeds serving max_seq_len={self.max_seq_len}")
+        if eos_token_id is not None and not (
+                0 <= int(eos_token_id) < self.model_config.vocab_size):
+            raise ValueError(
+                f"eos_token_id={eos_token_id} outside vocab "
+                f"[0, {self.model_config.vocab_size})")
+        req = self.scheduler.submit(
+            prompt, max_new_tokens=int(max_new_tokens),
+            eos_token_id=None if eos_token_id is None else int(eos_token_id),
+            timeout_s=timeout_s, stream_cb=stream_cb)
+        return req.future
+
+    # -- the serving loop ----------------------------------------------
+    def step(self):
+        """One scheduler iteration: expire, admit, one batched decode
+        step, retire. Returns an activity dict (all zeros = idle)."""
+        now = time.monotonic()
+        stats = {"admitted": 0, "decoded": 0, "retired": 0}
+
+        for req in self.scheduler.pop_expired(now):
+            self._finish_timeout(req, phase="queued")
+            stats["retired"] += 1
+
+        # join-at-free-slot admission: fill every free lane from the queue
+        while self.pool.free_slots > 0:
+            req = self.scheduler.pop_next()
+            if req is None:
+                break
+            retired = self._admit(req)
+            stats["admitted"] += 1
+            stats["retired"] += retired
+
+        if self._active:
+            if self.injector is not None:
+                self.injector.maybe_slow_decode(self._step_count)
+            t0 = time.monotonic()
+            tokens, self.pool.k, self.pool.v = _decode_step_jit(
+                self.params, self.pool.k, self.pool.v,
+                jnp.asarray(self._lane_tokens),
+                jnp.asarray(self.pool.positions),
+                jnp.asarray(self._lane_active),
+                n_heads=self.n_heads)
+            host_tokens = np.asarray(tokens)       # sync point: EOS checks
+            step_s = time.monotonic() - t0
+            self._lane_tokens = host_tokens.copy()
+            now = time.monotonic()
+            n_active = len(self._active)
+            for slot in list(self._active):
+                req = self._active[slot]
+                self.pool.advance(slot)
+                self._emit(req, int(host_tokens[slot]))
+                stats["decoded"] += 1
+                stats["retired"] += self._maybe_retire(req, int(host_tokens[slot]), now)
+            self.metrics.record_step(
+                queue_depth=self.scheduler.queue_depth(),
+                active_slots=n_active, max_slots=self.pool.max_slots,
+                tokens_this_step=n_active, step_s=step_s)
+        self._step_count += 1
+        return stats
+
+    def drain(self, max_steps=None):
+        """Step until no request is queued or in flight. ``max_steps``
+        bounds the loop (a deadline-less stuck request would otherwise
+        spin forever under fault injection)."""
+        steps = 0
+        while self._active or self.scheduler.queue_depth() > 0:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return steps
+
+    # -- background mode ------------------------------------------------
+    def start(self, idle_sleep_s=0.001):
+        """Run the serving loop on a daemon thread until ``stop()``."""
+        if self._loop_thread is not None:
+            raise RuntimeError("serving loop already running")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                busy = self.step()
+                if not any(busy.values()) and not self._active:
+                    time.sleep(idle_sleep_s)
+
+        self._loop_thread = threading.Thread(
+            target=loop, name="serving-loop", daemon=True)
+        self._loop_thread.start()
+
+    def stop(self, timeout_s=5.0):
+        if self._loop_thread is None:
+            return
+        self._stop.set()
+        self._loop_thread.join(timeout_s)
+        self._loop_thread = None
+
+    def close(self):
+        self.stop()
+        self.metrics.close()
+
+    # -- internals ------------------------------------------------------
+    def _admit(self, req):
+        """Prefill ``req`` at its bucket length and install it into a
+        slot. Returns 1 when the request retired on its very first token
+        (max_new_tokens=1 or instant EOS), else 0."""
+        bucket = bucket_for(len(req.prompt), self.scheduler.buckets)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(req.prompt)] = req.prompt
+        new_k, new_v, first = _prefill_request_jit(
+            self.params, jnp.asarray(padded), jnp.int32(len(req.prompt)),
+            n_layers=self.n_layers, n_heads=self.n_heads,
+            head_dim=self.head_dim, total=self.max_seq_len)
+        first_tok = int(first[0])                  # sync: TTFT endpoint
+        req.first_token_time = time.monotonic()
+        self.metrics.record_first_token(req.first_token_time - req.submit_time)
+
+        slot = self.pool.allocate()
+        self.pool.install(new_k, new_v, slot, position=len(req.prompt))
+        req.slot = slot
+        self._active[slot] = req
+        self._lane_tokens[slot] = first_tok
+        self._lane_active[slot] = True
+        self._emit(req, first_tok)
+        return self._maybe_retire(req, first_tok, time.monotonic())
+
+    def _emit(self, req, token):
+        req.emitted += 1
+        req.future._append(token)
+        if req.stream_cb is not None:
+            try:
+                req.stream_cb(req.id, token)
+            except Exception:  # a broken callback must not kill the loop
+                pass
+
+    def _maybe_retire(self, req, token, now):
+        stuck = (self.injector is not None
+                 and self.injector.request_is_stuck(req.id))
+        if req.deadline_exceeded(now):
+            self._finish_timeout(req, phase="decoding")
+            return 1
+        if self.scheduler.should_retire(req, token, stuck=stuck) is not None:
+            self._release_slot(req)
+            req.future._finish()
+            self.scheduler.completed += 1
+            self.metrics.record_completion()
+            return 1
+        return 0
+
+    def _finish_timeout(self, req, phase):
+        self._release_slot(req)
+        req.future._finish(RequestTimeoutError(
+            req.id, req.timeout_s, phase, tokens_done=req.emitted))
+        self.scheduler.timed_out += 1
+        self.metrics.record_timeout()
+
+    def _release_slot(self, req):
+        if req.slot is not None:
+            self._lane_active[req.slot] = False
+            self._active.pop(req.slot, None)
+            self.pool.free(req.slot)
+            req.slot = None
+
+    # -- introspection ---------------------------------------------------
+    def occupancy(self):
+        return self.pool.occupancy()
+
+    @staticmethod
+    def decode_compile_count():
+        """Compiled decode-step program count (jit cache size) — the
+        recompile-pin tests assert this stays at 1 across slot churn."""
+        return _decode_step_jit._cache_size()
+
+    @staticmethod
+    def prefill_compile_count():
+        """Compiled prefill program count — bounded by the bucket ladder."""
+        return _prefill_request_jit._cache_size()

@@ -1,0 +1,104 @@
+"""Deterministic fault injection for the serving engine.
+
+Fourth member of the injector family: extends the PR 2 step-level
+``StepFaultInjector`` (itself extending the PR 1 checkpoint I/O
+``FaultInjector``), adding *serving-loop* faults so the engine's
+recovery paths are testable on CPU without real stragglers:
+
+    slow_decode     sleep ``seconds`` before the batched decode step at
+                    scheduler iteration N (a straggler device / slow
+                    relay: exercises deadline accounting under a slow
+                    loop — queued peers keep their deadlines honest)
+    stuck_request   request ``request_id`` never retires naturally: its
+                    EOS / max_new_tokens retirements are suppressed, so
+                    ONLY the per-request deadline can reap it
+                    (exercises RequestTimeoutError recovery + slot
+                    reclamation while neighbors keep decoding)
+
+Arms take ``at_step``/``times`` like the step arms (``slow_decode``) or
+``request_id`` (``stuck_request``, persistent by default). Because the
+class sits at the bottom of the injector hierarchy, one spec may combine
+serving faults with step and I/O faults::
+
+    {"slow_decode": {"at_step": 2, "seconds": 0.05},
+     "stuck_request": {"request_id": 1}}
+
+Programmatically::
+
+    fi = ServingFaultInjector()
+    fi.arm_serving("slow_decode", at_step=2, seconds=0.05)
+    fi.arm_serving("stuck_request", request_id=1)
+"""
+
+import time
+
+from deepspeed_tpu.runtime.resilience.fault_injection import StepFaultInjector
+
+SERVING_POINTS = ("slow_decode", "stuck_request")
+
+
+class _ServingArm:
+    __slots__ = ("at_step", "times", "seconds", "request_id")
+
+    def __init__(self, at_step=None, times=None, seconds=0.05, request_id=None):
+        self.at_step = None if at_step is None else int(at_step)
+        self.times = None if times is None else int(times)
+        self.seconds = float(seconds)
+        self.request_id = None if request_id is None else int(request_id)
+
+
+class ServingFaultInjector(StepFaultInjector):
+    """Checkpoint I/O + step + serving-loop fault injector."""
+
+    def __init__(self, spec=None):
+        spec = dict(spec or {})
+        serving_spec = {p: spec.pop(p) for p in list(spec) if p in SERVING_POINTS}
+        super().__init__(spec)  # remaining points are step / I/O arms
+        self._serving_arms = {}
+        for point, cfg in serving_spec.items():
+            self.arm_serving(point, **dict(cfg or {}))
+
+    def arm_serving(self, point, **kwargs):
+        if point not in SERVING_POINTS:
+            raise ValueError(
+                f"unknown serving fault point '{point}' "
+                f"(known: {', '.join(SERVING_POINTS)})")
+        if point == "stuck_request" and kwargs.get("request_id") is None:
+            raise ValueError("stuck_request requires request_id")
+        self._serving_arms[point] = _ServingArm(**kwargs)
+        return self
+
+    def disarm_serving(self, point=None):
+        if point is None:
+            self._serving_arms.clear()
+        else:
+            self._serving_arms.pop(point, None)
+
+    # -- hooks the serving engine calls ---------------------------------
+    def maybe_slow_decode(self, step):
+        """Sleep before decode when the slow_decode arm matches ``step``."""
+        arm = self._serving_arms.get("slow_decode")
+        if arm is None:
+            return
+        if arm.at_step is not None and step != arm.at_step:
+            return
+        if arm.times is not None:
+            if arm.times <= 0:
+                return
+            arm.times -= 1
+        self._fire("slow_decode")
+        time.sleep(arm.seconds)
+
+    def request_is_stuck(self, request_id):
+        """True while the stuck_request arm pins ``request_id`` (persistent
+        unless ``times`` bounds it; ``fired`` counts suppressed
+        retirements)."""
+        arm = self._serving_arms.get("stuck_request")
+        if arm is None or arm.request_id != request_id:
+            return False
+        if arm.times is not None:
+            if arm.times <= 0:
+                return False
+            arm.times -= 1
+        self._fire("stuck_request")
+        return True

@@ -1,0 +1,139 @@
+"""Slot-based KV-cache pool for continuous-batching inference.
+
+One device-resident cache pair shaped ``[L, MaxSlots, nh, S_max, hd]``
+holds every in-flight request's keys/values; a *slot* is one lane of the
+MaxSlots axis. The pool is the reason admission never recompiles: the
+arrays' shapes are fixed at construction, so a request joining or
+retiring only changes *which* lanes the (single compiled) decode step
+treats as active — never the program.
+
+Slot hygiene contract (relied on by the engine, proved in
+``tests/unit/test_serving.py``):
+
+- installing a prefilled request overwrites the ENTIRE lane
+  (``[L, nh, S_max, hd]``), so whatever a previous occupant left behind
+  can never be read by the new one;
+- while a slot is inactive, the masked decode step may keep writing
+  garbage k/v at the lane's stale position — harmless, because lanes are
+  computed independently (vmap) and the causal mask hides positions
+  beyond any reader's own counter.
+
+Host-side bookkeeping (free list, per-slot position counters, occupancy
+stats) is plain Python/numpy: it runs once per scheduler iteration, not
+per token-lane.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class PoolExhaustedError(RuntimeError):
+    """allocate() found no free slot. The scheduler treats this as "keep
+    the request queued", never as a hard failure — it is an error type so
+    direct pool users cannot mistake -1 style sentinels for a slot id."""
+
+
+def _install_slot(pool_k, pool_v, new_k, new_v, slot):
+    """Copy a prefilled single-request cache ([L, 1, nh, S_max, hd]) into
+    lane ``slot`` of the pool. ``slot`` is a traced scalar: installing
+    into different slots reuses one compiled program."""
+    pool_k = jax.lax.dynamic_update_index_in_dim(pool_k, new_k[:, 0], slot, axis=1)
+    pool_v = jax.lax.dynamic_update_index_in_dim(pool_v, new_v[:, 0], slot, axis=1)
+    return pool_k, pool_v
+
+
+# Donate the pool buffers: the install is an in-place lane overwrite, the
+# old pool is dead the moment the new one exists.
+_install_slot_jit = jax.jit(_install_slot, donate_argnums=(0, 1))
+
+
+class KVCachePool:
+    """Fixed-capacity KV-cache slots plus their host-side bookkeeping."""
+
+    def __init__(self, n_layers, max_slots, n_heads, max_seq_len, head_dim,
+                 dtype=jnp.float32):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if max_seq_len < 2:
+            raise ValueError(f"max_seq_len must be >= 2, got {max_seq_len}")
+        self.n_layers = int(n_layers)
+        self.max_slots = int(max_slots)
+        self.n_heads = int(n_heads)
+        self.max_seq_len = int(max_seq_len)
+        self.head_dim = int(head_dim)
+        shape = (self.n_layers, self.max_slots, self.n_heads,
+                 self.max_seq_len, self.head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        # lowest-index-first allocation keeps slot assignment deterministic
+        # for a given arrival order (the oracle tests replay schedules)
+        self._free = sorted(range(self.max_slots), reverse=True)
+        # per-slot NEXT write/read position (== tokens cached so far)
+        self.positions = np.zeros(self.max_slots, np.int32)
+        self.allocations = 0
+        self.frees = 0
+        self.peak_in_use = 0
+
+    # -- slot lifecycle -------------------------------------------------
+    @property
+    def slots_in_use(self):
+        return self.max_slots - len(self._free)
+
+    @property
+    def free_slots(self):
+        return len(self._free)
+
+    def allocate(self):
+        """Claim the lowest free slot; PoolExhaustedError when full."""
+        if not self._free:
+            raise PoolExhaustedError(
+                f"all {self.max_slots} KV-cache slots are in use")
+        slot = self._free.pop()
+        self.allocations += 1
+        self.peak_in_use = max(self.peak_in_use, self.slots_in_use)
+        self.positions[slot] = 0
+        return slot
+
+    def free(self, slot):
+        if not 0 <= slot < self.max_slots:
+            raise ValueError(f"slot {slot} outside [0, {self.max_slots})")
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is already free (double free)")
+        self.frees += 1
+        self.positions[slot] = 0
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+
+    def install(self, new_k, new_v, slot, position):
+        """Install a prefilled request cache into ``slot`` and set its
+        position counter (= prompt length: the next decode write index)."""
+        if not 0 <= position < self.max_seq_len:
+            raise ValueError(
+                f"position {position} outside [0, {self.max_seq_len})")
+        self.k, self.v = _install_slot_jit(self.k, self.v, new_k, new_v, slot)
+        self.positions[slot] = position
+
+    def advance(self, slot):
+        """Bump a slot's position after a decode step wrote its token.
+        Clamped at the last cache index: a (injected-fault) runaway
+        request keeps overwriting the final position instead of relying
+        on XLA's silent OOB-scatter clamping."""
+        self.positions[slot] = min(self.positions[slot] + 1,
+                                   self.max_seq_len - 1)
+
+    # -- stats ----------------------------------------------------------
+    def occupancy(self):
+        """Occupancy snapshot for metrics/debugging."""
+        in_use = self.slots_in_use
+        return {
+            "max_slots": self.max_slots,
+            "in_use": in_use,
+            "free": self.free_slots,
+            "utilization": in_use / self.max_slots,
+            "allocations": self.allocations,
+            "frees": self.frees,
+            "peak_in_use": self.peak_in_use,
+            "cached_tokens": int(self.positions.sum()),
+        }

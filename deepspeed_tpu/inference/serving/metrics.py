@@ -1,0 +1,85 @@
+"""Serving metrics: tokens/s, TTFT, queue depth, batch occupancy.
+
+Recorded through the SAME ``monitor_from_config`` backends the training
+engines use (tensorboard/csv/both), so a serving deployment's dashboards
+come from the one construction path — a new monitor backend lights up
+here for free. All aggregation is host-side and O(1) per scheduler
+iteration; with no monitor configured the recorder is still useful as a
+cheap in-process stats object (``snapshot()``).
+"""
+
+import time
+
+
+class ServingMetrics:
+    """Aggregates serving counters and forwards gauges to a monitor."""
+
+    def __init__(self, monitor=None):
+        self.monitor = monitor
+        self.decode_steps = 0
+        self.tokens_emitted = 0
+        self.requests_completed = 0
+        self.requests_timed_out = 0
+        self.decode_time_s = 0.0
+        # TTFT: time from submit() to the request's first token
+        self._ttft_sum = 0.0
+        self._ttft_count = 0
+        self._ttft_max = 0.0
+        self._started = time.monotonic()
+
+    # -- recording hooks (engine calls these) ---------------------------
+    def record_first_token(self, ttft_s):
+        self._ttft_sum += ttft_s
+        self._ttft_count += 1
+        self._ttft_max = max(self._ttft_max, ttft_s)
+        self._record("Serving/ttft_s", ttft_s, self._ttft_count)
+
+    def record_completion(self):
+        self.requests_completed += 1
+
+    def record_timeout(self):
+        self.requests_timed_out += 1
+
+    def record_step(self, queue_depth, active_slots, max_slots,
+                    tokens_this_step, step_s):
+        self.decode_steps += 1
+        self.tokens_emitted += tokens_this_step
+        self.decode_time_s += step_s
+        step = self.decode_steps
+        self._record("Serving/queue_depth", queue_depth, step)
+        self._record("Serving/batch_occupancy",
+                     active_slots / max_slots if max_slots else 0.0, step)
+        if step_s > 0:
+            self._record("Serving/tokens_per_sec",
+                         tokens_this_step / step_s, step)
+
+    def _record(self, tag, value, step):
+        if self.monitor is not None:
+            self.monitor.record(tag, value, step)
+
+    # -- reading --------------------------------------------------------
+    def avg_ttft_s(self):
+        return self._ttft_sum / self._ttft_count if self._ttft_count else None
+
+    def tokens_per_sec(self):
+        """Decode-loop throughput (excludes idle wall time between
+        requests — the number a capacity planner wants)."""
+        if self.decode_time_s <= 0:
+            return None
+        return self.tokens_emitted / self.decode_time_s
+
+    def snapshot(self):
+        return {
+            "decode_steps": self.decode_steps,
+            "tokens_emitted": self.tokens_emitted,
+            "requests_completed": self.requests_completed,
+            "requests_timed_out": self.requests_timed_out,
+            "tokens_per_sec": self.tokens_per_sec(),
+            "avg_ttft_s": self.avg_ttft_s(),
+            "max_ttft_s": self._ttft_max if self._ttft_count else None,
+            "uptime_s": time.monotonic() - self._started,
+        }
+
+    def close(self):
+        if self.monitor is not None:
+            self.monitor.flush()

@@ -1,0 +1,212 @@
+"""Continuous-batching scheduler: admission queue, bucketing, retirement.
+
+Pure host-side policy — no jax. The scheduler decides *which* requests
+run; the engine (engine.py) owns *how* (prefill/decode programs and the
+KV pool). Keeping the policy import-light makes it unit-testable without
+a device and reusable by any future engine variant.
+
+Three decisions live here:
+
+- **admission**: a bounded FIFO queue with named backpressure
+  (``QueueFullError``) — under overload the caller learns immediately
+  instead of the queue growing without bound; requests join the batch
+  whenever a KV slot frees (join-at-free-slot), not at epoch boundaries.
+- **bucketing**: prompt lengths are rounded up to a fixed ladder of
+  bucket lengths, so the number of distinct prefill programs XLA ever
+  compiles is bounded by ``len(buckets)`` no matter what lengths traffic
+  brings (the recompile pin in tests/unit/test_serving.py).
+- **retirement**: a sequence leaves its slot on EOS, on reaching its
+  ``max_new_tokens``, or on blowing its per-request deadline
+  (``RequestTimeoutError`` delivered through the request's future).
+"""
+
+import itertools
+import threading
+import time
+from collections import deque
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue is at capacity — backpressure signal to callers.
+
+    Deliberately raised from ``submit()`` (not parked/blocked): a serving
+    front-end under overload must shed or retry with its own policy."""
+
+
+class RequestTimeoutError(TimeoutError):
+    """A request exceeded its deadline (queued or mid-decode) and was
+    retired; delivered via the request's future."""
+
+    def __init__(self, request_id, timeout_s, phase, tokens_done=0):
+        self.request_id = request_id
+        self.timeout_s = timeout_s
+        self.phase = phase          # "queued" | "decoding"
+        self.tokens_done = tokens_done
+        super().__init__(
+            f"request {request_id} exceeded its {timeout_s}s deadline "
+            f"while {phase} ({tokens_done} token(s) generated)")
+
+
+def default_buckets(max_prompt_len, smallest=8):
+    """Power-of-two ladder up to (and including a cover of)
+    ``max_prompt_len`` — log2 many prefill programs bound the compile
+    count for arbitrary traffic."""
+    buckets = []
+    b = smallest
+    while b < max_prompt_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_prompt_len)
+    return tuple(buckets)
+
+
+def bucket_for(length, buckets):
+    """Smallest bucket >= length (buckets are validated ascending)."""
+    for b in buckets:
+        if length <= b:
+            return b
+    raise ValueError(
+        f"prompt length {length} exceeds the largest bucket {buckets[-1]}")
+
+
+class ServingFuture:
+    """Result handle returned by ``submit()``.
+
+    ``tokens`` is the streaming view (tokens emitted so far);
+    ``result()`` blocks until retirement and returns the full token list
+    or raises the retirement error (e.g. ``RequestTimeoutError``)."""
+
+    def __init__(self, request_id):
+        self.request_id = request_id
+        self._tokens = []
+        self._event = threading.Event()
+        self._exc = None
+
+    @property
+    def tokens(self):
+        return list(self._tokens)
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished after {timeout}s "
+                "(serving loop not running?)")
+        if self._exc is not None:
+            raise self._exc
+        return list(self._tokens)
+
+    # engine-side hooks
+    def _append(self, token):
+        self._tokens.append(token)
+
+    def _finish(self, exc=None):
+        self._exc = exc
+        self._event.set()
+
+
+class Request:
+    """One generation request plus its in-flight state."""
+
+    def __init__(self, request_id, prompt, max_new_tokens, eos_token_id=None,
+                 timeout_s=None, stream_cb=None):
+        self.id = request_id
+        self.prompt = prompt                    # list[int]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.timeout_s = timeout_s              # None = no deadline
+        self.stream_cb = stream_cb
+        self.future = ServingFuture(request_id)
+        self.submit_time = time.monotonic()
+        self.first_token_time = None            # TTFT endpoint
+        self.slot = None
+        self.emitted = 0
+
+    def deadline_exceeded(self, now):
+        return (self.timeout_s is not None
+                and now - self.submit_time > self.timeout_s)
+
+
+class ContinuousBatchingScheduler:
+    """Bounded admission queue + bucketing + retirement policy."""
+
+    def __init__(self, max_queue, buckets, default_max_new_tokens=64,
+                 request_timeout_s=0.0):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        buckets = tuple(int(b) for b in buckets)
+        if not buckets or any(b < 1 for b in buckets):
+            raise ValueError(f"buckets must be positive ints, got {buckets}")
+        if list(buckets) != sorted(set(buckets)):
+            raise ValueError(
+                f"buckets must be strictly ascending, got {buckets}")
+        self.max_queue = int(max_queue)
+        self.buckets = buckets
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.request_timeout_s = float(request_timeout_s)
+        self._queue = deque()
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        # retirement counters (metrics reads these)
+        self.completed = 0
+        self.timed_out = 0
+
+    def queue_depth(self):
+        with self._lock:
+            return len(self._queue)
+
+    def submit(self, prompt, max_new_tokens=None, eos_token_id=None,
+               timeout_s=None, stream_cb=None):
+        """Enqueue a request; QueueFullError when at capacity."""
+        if max_new_tokens is None:
+            max_new_tokens = self.default_max_new_tokens
+        if timeout_s is None and self.request_timeout_s > 0:
+            timeout_s = self.request_timeout_s
+        req = Request(next(self._ids), list(prompt), max_new_tokens,
+                      eos_token_id=eos_token_id, timeout_s=timeout_s,
+                      stream_cb=stream_cb)
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                raise QueueFullError(
+                    f"admission queue is full ({self.max_queue} waiting); "
+                    f"request rejected — retry with backpressure")
+            self._queue.append(req)
+        return req
+
+    def pop_expired(self, now):
+        """Remove and return queued requests whose deadline passed while
+        waiting (they must not waste a prefill)."""
+        expired = []
+        with self._lock:
+            keep = deque()
+            for req in self._queue:
+                (expired if req.deadline_exceeded(now) else keep).append(req)
+            self._queue = keep
+        return expired
+
+    def pop_next(self):
+        """Next request to admit (FIFO), or None."""
+        with self._lock:
+            return self._queue.popleft() if self._queue else None
+
+    def requeue_front(self, req):
+        """Put an admitted-but-unplaced request back at the head (e.g. the
+        pool filled between pop and placement)."""
+        with self._lock:
+            self._queue.appendleft(req)
+
+    # -- retirement policy ---------------------------------------------
+    def should_retire(self, req, token, stuck=False):
+        """Retirement verdict after ``token`` was emitted for ``req``:
+        'eos', 'length', or None (keep decoding). ``stuck`` (fault
+        injection) suppresses both natural retirements so only the
+        deadline can reap the request."""
+        if stuck:
+            return None
+        if req.eos_token_id is not None and token == req.eos_token_id:
+            return "eos"
+        if req.emitted >= req.max_new_tokens:
+            return "length"
+        return None

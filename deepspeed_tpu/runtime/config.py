@@ -471,6 +471,79 @@ def get_resilience_config(param_dict):
     )
 
 
+def get_serving_config(param_dict):
+    """serving: continuous-batching inference engine (inference/serving/).
+    Opt-in like the resilience block: present enables (unless it sets
+    "enabled": false); absent means no serving state is built. Validation
+    here is shape-only — capacity checks against the model (max_seq_len vs
+    max_position_embeddings, bucket headroom) happen in ServingEngine,
+    which knows the model config."""
+    from deepspeed_tpu.inference.serving.config import ServingConfig
+
+    section = param_dict.get(SERVING, None)
+    params = section or {}
+    enabled = bool(get_scalar_param(params, SERVING_ENABLED, section is not None))
+    max_slots = get_scalar_param(params, SERVING_MAX_SLOTS, SERVING_MAX_SLOTS_DEFAULT)
+    if not isinstance(max_slots, int) or max_slots < 1:
+        raise ValueError(
+            f"serving.{SERVING_MAX_SLOTS} must be an int >= 1 (it is the "
+            f"static decode batch dimension), got {max_slots!r}"
+        )
+    max_queue = get_scalar_param(params, SERVING_MAX_QUEUE, SERVING_MAX_QUEUE_DEFAULT)
+    if not isinstance(max_queue, int) or max_queue < 1:
+        raise ValueError(
+            f"serving.{SERVING_MAX_QUEUE} must be an int >= 1, got {max_queue!r}"
+        )
+    max_seq_len = get_scalar_param(params, SERVING_MAX_SEQ_LEN, SERVING_MAX_SEQ_LEN_DEFAULT)
+    if max_seq_len is not None and (not isinstance(max_seq_len, int) or max_seq_len < 2):
+        raise ValueError(
+            f"serving.{SERVING_MAX_SEQ_LEN} must be an int >= 2 (room for a "
+            f"prompt token and a generated token) or absent, got {max_seq_len!r}"
+        )
+    buckets = get_scalar_param(params, SERVING_PROMPT_BUCKETS, SERVING_PROMPT_BUCKETS_DEFAULT)
+    if buckets is not None:
+        if (not isinstance(buckets, (list, tuple)) or not buckets
+                or not all(isinstance(b, int) and b >= 1 for b in buckets)
+                or list(buckets) != sorted(set(buckets))):
+            raise ValueError(
+                f"serving.{SERVING_PROMPT_BUCKETS} must be a strictly "
+                f"ascending list of ints >= 1, got {buckets!r}"
+            )
+        buckets = tuple(buckets)
+    default_max_new = get_scalar_param(
+        params, SERVING_DEFAULT_MAX_NEW_TOKENS, SERVING_DEFAULT_MAX_NEW_TOKENS_DEFAULT
+    )
+    if not isinstance(default_max_new, int) or default_max_new < 1:
+        raise ValueError(
+            f"serving.{SERVING_DEFAULT_MAX_NEW_TOKENS} must be an int >= 1, "
+            f"got {default_max_new!r}"
+        )
+    request_timeout_s = get_scalar_param(
+        params, SERVING_REQUEST_TIMEOUT, SERVING_REQUEST_TIMEOUT_DEFAULT
+    )
+    if request_timeout_s < 0:
+        raise ValueError(
+            f"serving.{SERVING_REQUEST_TIMEOUT} must be >= 0 "
+            f"(0 disables per-request deadlines), got {request_timeout_s!r}"
+        )
+    fault_injection = params.get(SERVING_FAULT_INJECTION, None)
+    if fault_injection is not None and not isinstance(fault_injection, dict):
+        raise ValueError(
+            f"serving.{SERVING_FAULT_INJECTION} must be a dict of "
+            f"fault-point specs, got {type(fault_injection).__name__}"
+        )
+    return ServingConfig(
+        enabled=enabled,
+        max_slots=max_slots,
+        max_queue=max_queue,
+        max_seq_len=max_seq_len,
+        prompt_buckets=buckets,
+        default_max_new_tokens=default_max_new,
+        request_timeout_s=float(request_timeout_s),
+        fault_injection=fault_injection,
+    )
+
+
 def get_progressive_layer_drop(param_dict):
     pld_dict = param_dict.get(PROGRESSIVE_LAYER_DROP, {})
     enabled = get_scalar_param(pld_dict, PLD_ENABLED, PLD_ENABLED_DEFAULT)
@@ -633,6 +706,7 @@ class DeepSpeedConfig:
         self.checkpoint_tag_validation_fail = mode == CHECKPOINT_TAG_VALIDATION_FAIL
         self.checkpoint_config = get_checkpoint_config(param_dict)
         self.resilience_config = get_resilience_config(param_dict)
+        self.serving_config = get_serving_config(param_dict)
 
         (
             self.pld_enabled,

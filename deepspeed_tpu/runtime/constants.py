@@ -202,6 +202,27 @@ RESILIENCE_COMM_TIMEOUT = "comm_timeout_s"
 RESILIENCE_COMM_TIMEOUT_DEFAULT = 0.0  # 0 = unbounded comm waits
 
 #############################################
+# Serving (inference/serving/ subsystem: continuous-batching engine, KV
+# slot pool, bounded admission queue). Opt-in like resilience: the block
+# being present enables it; absent means no serving state is built.
+#############################################
+SERVING = "serving"
+SERVING_ENABLED = "enabled"
+SERVING_MAX_SLOTS = "max_slots"
+SERVING_MAX_SLOTS_DEFAULT = 8
+SERVING_MAX_QUEUE = "max_queue"
+SERVING_MAX_QUEUE_DEFAULT = 64
+SERVING_MAX_SEQ_LEN = "max_seq_len"
+SERVING_MAX_SEQ_LEN_DEFAULT = None  # None = model max_position_embeddings
+SERVING_PROMPT_BUCKETS = "prompt_buckets"
+SERVING_PROMPT_BUCKETS_DEFAULT = None  # None = powers-of-two ladder
+SERVING_DEFAULT_MAX_NEW_TOKENS = "default_max_new_tokens"
+SERVING_DEFAULT_MAX_NEW_TOKENS_DEFAULT = 64
+SERVING_REQUEST_TIMEOUT = "request_timeout_s"
+SERVING_REQUEST_TIMEOUT_DEFAULT = 0.0  # 0 = no per-request deadline
+SERVING_FAULT_INJECTION = "fault_injection"
+
+#############################################
 # Sparse attention
 #############################################
 SPARSE_ATTENTION = "sparse_attention"

@@ -1333,7 +1333,7 @@ class PipelineEngine:
                     # the compiled executor is ONE program — step wall time
                     # is the only meaningful breakdown granularity
                     sps = self.tput_timer.avg_samples_per_sec()
-                    if np.isfinite(sps):
+                    if sps is not None and np.isfinite(sps):
                         log_dist(
                             f"wall_clock: train_batch {sps:.1f} samples/sec "
                             "(compiled single-program step)", ranks=[0])

@@ -148,15 +148,22 @@ class ThroughputTimer:
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
             if report_speed and self.global_step_count % self.steps_per_output == 0:
-                self.logging(
-                    f"{self.global_step_count}/{self.micro_step_count}, "
-                    f"SamplesPerSec={self.avg_samples_per_sec():.2f}"
-                )
+                sps = self.avg_samples_per_sec()
+                # a steps_per_output that fires inside the warmup window
+                # has no measured window yet — stay silent rather than
+                # logging SamplesPerSec=-inf
+                if sps is not None:
+                    self.logging(
+                        f"{self.global_step_count}/{self.micro_step_count}, "
+                        f"SamplesPerSec={sps:.2f}"
+                    )
 
     def avg_samples_per_sec(self):
+        """Windowed samples/sec, or None until the warmup window
+        (``start_step`` steps) has completed and time has accumulated."""
         if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
             samples_per_step = self.batch_size * self.num_workers
             total_step_offset = self.global_step_count - self.start_step
             avg_time_per_step = self.total_elapsed_time / total_step_offset
             return samples_per_step / avg_time_per_step
-        return float("-inf")
+        return None

@@ -183,6 +183,17 @@ def test_generate_sampling_knob_validation():
                  rng=jax.random.PRNGKey(0), top_p=0.0)
 
 
+def test_generate_rejects_nonpositive_max_new_tokens():
+    """Mirrors beam_search's check: a zero/negative count would silently
+    scan nothing and return an empty [B, 0] array."""
+    cfg = _tiny_config()
+    _, params = init_gpt2(cfg, batch_size=1, seq_len=2, seed=6)
+    p = jnp.zeros((1, 2), jnp.int32)
+    for bad in (0, -3):
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            generate(params, cfg, p, bad)
+
+
 def test_generate_bf16_params():
     """Decode must run in the params' compute dtype: a bf16 checkpoint
     previously crashed at trace time (f32-hardcoded caches/carry vs bf16

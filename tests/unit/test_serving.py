@@ -1,0 +1,430 @@
+"""Continuous-batching serving engine (inference/serving/).
+
+The load-bearing property is the BITWISE oracle: continuous-batched
+greedy output equals per-request one-shot ``generate()`` output for any
+arrival order — admission mid-decode, retirement, and slot reuse must be
+numerically invisible to every other request. The recompile pins assert
+the performance contract that makes continuous batching viable on XLA:
+slot churn never recompiles the decode step, and prefill compiles are
+bounded by the prompt-length bucket ladder.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference import generate
+from deepspeed_tpu.inference.serving import (
+    ContinuousBatchingScheduler,
+    KVCachePool,
+    PoolExhaustedError,
+    QueueFullError,
+    RequestTimeoutError,
+    ServingConfig,
+    ServingEngine,
+    ServingFaultInjector,
+    bucket_for,
+    default_buckets,
+)
+from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2
+
+
+def _tiny_config():
+    return GPT2Config(
+        vocab_size=64, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=32,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _tiny_config()
+    _, params = init_gpt2(cfg, batch_size=2, seq_len=4, seed=0)
+    return cfg, params
+
+
+def _engine(cfg, params, **overrides):
+    kw = dict(max_slots=3, max_queue=8, max_seq_len=32, prompt_buckets=(4, 8))
+    kw.update(overrides)
+    return ServingEngine(params, cfg, ServingConfig(**kw))
+
+
+def _prompts(n, lengths=(4, 6, 3, 5, 8, 2, 7, 4)):
+    rng = np.random.RandomState(7)
+    return [rng.randint(0, 64, (lengths[i % len(lengths)],)).tolist()
+            for i in range(n)]
+
+
+def _oneshot(cfg, params, prompt, n_new):
+    out = generate(params, cfg, jnp.asarray([prompt], jnp.int32), n_new)
+    return np.asarray(out)[0].tolist()
+
+
+# -- the bitwise oracle under three arrival schedules -----------------------
+
+def test_oracle_all_upfront_with_queueing(model):
+    """Schedule 1: every request submitted before the first step; more
+    requests than slots, so the tail waits in the queue and reuses
+    retired slots."""
+    cfg, params = model
+    eng = _engine(cfg, params, max_slots=2)
+    prompts = _prompts(5)
+    wants = [_oneshot(cfg, params, p, 6) for p in prompts]
+
+    futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.drain(max_steps=200)
+
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+    occ = eng.occupancy()
+    assert occ["in_use"] == 0 and occ["allocations"] == 5 and occ["frees"] == 5
+    assert occ["peak_in_use"] <= 2
+
+
+def test_oracle_mid_decode_admission(model):
+    """Schedule 2: a wave of requests joins while the first wave is
+    mid-decode — the joiners must not perturb in-flight lanes and must
+    themselves decode bitwise-correctly from a partially-filled pool."""
+    cfg, params = model
+    eng = _engine(cfg, params)
+    prompts = _prompts(5)
+    wants = [_oneshot(cfg, params, p, 6) for p in prompts]
+
+    futs = [eng.submit(p, max_new_tokens=6) for p in prompts[:3]]
+    eng.step()
+    eng.step()
+    assert any(not f.done() for f in futs)      # genuinely mid-decode
+    futs += [eng.submit(p, max_new_tokens=6) for p in prompts[3:]]
+    eng.drain(max_steps=200)
+
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+
+
+def test_oracle_staggered_lengths_and_slot_reuse(model):
+    """Schedule 3: mixed max_new_tokens so requests retire at different
+    steps; late arrivals land in freed slots whose cache still holds the
+    previous occupant's (stale) keys/values."""
+    cfg, params = model
+    eng = _engine(cfg, params, max_slots=2)
+    prompts = _prompts(6)
+    lens = [2, 7, 4, 3, 6, 5]
+    wants = [_oneshot(cfg, params, p, n) for p, n in zip(prompts, lens)]
+
+    futs = [eng.submit(p, max_new_tokens=n)
+            for p, n in zip(prompts[:2], lens[:2])]
+    eng.step()                                   # req0 (2 tokens) retires fast
+    futs.append(eng.submit(prompts[2], max_new_tokens=lens[2]))
+    eng.step()
+    eng.step()
+    futs += [eng.submit(p, max_new_tokens=n)
+             for p, n in zip(prompts[3:], lens[3:])]
+    eng.drain(max_steps=200)
+
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+    occ = eng.occupancy()
+    assert occ["allocations"] == 6 and occ["peak_in_use"] <= 2
+
+
+def test_eos_retires_early(model):
+    cfg, params = model
+    eng = _engine(cfg, params)
+    prompt = _prompts(1)[0]
+    want = _oneshot(cfg, params, prompt, 8)
+    eos = want[3]
+    cut = want.index(eos)                        # first occurrence wins
+
+    got = eng.submit(prompt, max_new_tokens=8, eos_token_id=eos)
+    eng.drain(max_steps=100)
+    assert got.result(timeout=1) == want[:cut + 1]
+    assert eng.occupancy()["in_use"] == 0
+
+
+def test_streaming_callback_sees_every_token(model):
+    cfg, params = model
+    eng = _engine(cfg, params)
+    prompt = _prompts(1)[0]
+    seen = []
+    fut = eng.submit(prompt, max_new_tokens=5,
+                     stream_cb=lambda rid, tok: seen.append((rid, tok)))
+    eng.drain(max_steps=100)
+    final = fut.result(timeout=1)
+    assert [t for _, t in seen] == final == _oneshot(cfg, params, prompt, 5)
+    assert len({rid for rid, _ in seen}) == 1
+
+
+# -- backpressure and deadlines ---------------------------------------------
+
+def test_queue_backpressure(model):
+    cfg, params = model
+    eng = _engine(cfg, params, max_queue=2)
+    prompts = _prompts(3)
+    futs = [eng.submit(p, max_new_tokens=2) for p in prompts[:2]]
+    with pytest.raises(QueueFullError):
+        eng.submit(prompts[2], max_new_tokens=2)
+    eng.drain(max_steps=100)                     # shed load -> queue drains
+    for f, p in zip(futs, prompts):
+        assert f.result(timeout=1) == _oneshot(cfg, params, p, 2)
+    eng.submit(prompts[2], max_new_tokens=2)     # capacity is back
+
+
+def test_deadline_mid_decode(model):
+    cfg, params = model
+    eng = _engine(cfg, params)
+    prompts = _prompts(2)
+    doomed = eng.submit(prompts[0], max_new_tokens=8, timeout_s=60.0)
+    healthy = eng.submit(prompts[1], max_new_tokens=4)
+    eng.step()                                   # both admitted, 1 token out
+    assert not doomed.done()
+    # shrink the in-flight deadline so the NEXT step reaps it mid-decode
+    # (a submit-time micro-deadline would expire while still queued)
+    next(r for r in eng._active.values()
+         if r.future is doomed).timeout_s = 1e-6
+    eng.drain(max_steps=100)
+
+    with pytest.raises(RequestTimeoutError) as ei:
+        doomed.result(timeout=1)
+    assert ei.value.phase == "decoding" and ei.value.tokens_done >= 1
+    assert healthy.result(timeout=1) == _oneshot(cfg, params, prompts[1], 4)
+    assert eng.occupancy()["in_use"] == 0        # the slot was reclaimed
+
+
+def test_deadline_while_queued(model):
+    cfg, params = model
+    eng = _engine(cfg, params, max_slots=1)
+    prompts = _prompts(2)
+    hog = eng.submit(prompts[0], max_new_tokens=6)
+    doomed = eng.submit(prompts[1], max_new_tokens=6, timeout_s=1e-6)
+    eng.drain(max_steps=100)
+
+    with pytest.raises(RequestTimeoutError) as ei:
+        doomed.result(timeout=1)
+    assert ei.value.phase == "queued" and ei.value.tokens_done == 0
+    assert hog.result(timeout=1) == _oneshot(cfg, params, prompts[0], 6)
+
+
+def test_submit_validation(model):
+    cfg, params = model
+    eng = _engine(cfg, params)
+    with pytest.raises(ValueError):
+        eng.submit([], max_new_tokens=2)
+    with pytest.raises(ValueError):
+        eng.submit([1, 2], max_new_tokens=0)
+    with pytest.raises(ValueError):
+        eng.submit(list(range(9)), max_new_tokens=2)   # beyond largest bucket
+    with pytest.raises(ValueError):
+        eng.submit(list(range(8)), max_new_tokens=30)  # blows max_seq_len
+    with pytest.raises(ValueError):
+        eng.submit([1, 2], max_new_tokens=2, eos_token_id=64)
+
+
+# -- the recompile pins -----------------------------------------------------
+
+def test_recompile_pin_over_slot_churn(model):
+    """A full serve of 2x MaxSlots requests spanning every bucket: the
+    decode step compiles at most once, prefill at most once per bucket —
+    the jit cache sizes pin it."""
+    cfg, params = model
+    eng = _engine(cfg, params, max_slots=2)
+    decode0 = ServingEngine.decode_compile_count()
+    prefill0 = ServingEngine.prefill_compile_count()
+
+    prompts = _prompts(4, lengths=(3, 6, 4, 8))  # buckets 4,8,4,8
+    wants = [_oneshot(cfg, params, p, 5) for p in prompts]
+    futs = [eng.submit(p, max_new_tokens=5) for p in prompts[:2]]
+    eng.step()
+    futs += [eng.submit(p, max_new_tokens=5) for p in prompts[2:]]
+    eng.drain(max_steps=200)
+
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+    assert ServingEngine.decode_compile_count() - decode0 <= 1
+    assert ServingEngine.prefill_compile_count() - prefill0 <= 2  # |buckets|
+
+
+# -- fault injection --------------------------------------------------------
+
+@pytest.mark.faults
+def test_stuck_request_reaped_and_slot_reused(model):
+    """stuck_request suppresses natural retirement; only the deadline can
+    reap it. Neighbors must finish bitwise-correct and the reclaimed slot
+    must serve a fresh request."""
+    cfg, params = model
+    fi = ServingFaultInjector()
+    fi.arm_serving("stuck_request", request_id=0)
+    eng = ServingEngine(params, cfg, ServingConfig(
+        max_slots=2, max_queue=8, max_seq_len=32, prompt_buckets=(4, 8)),
+        injector=fi)
+    prompts = _prompts(3)
+
+    stuck = eng.submit(prompts[0], max_new_tokens=2, timeout_s=0.3)
+    healthy = eng.submit(prompts[1], max_new_tokens=6)
+    eng.drain(max_steps=5000)
+
+    with pytest.raises(RequestTimeoutError) as ei:
+        stuck.result(timeout=1)
+    assert ei.value.phase == "decoding"
+    assert ei.value.tokens_done > 2              # decoded PAST max_new_tokens
+    assert fi.fired["stuck_request"] >= 1
+    assert healthy.result(timeout=1) == _oneshot(cfg, params, prompts[1], 6)
+    assert eng.occupancy()["in_use"] == 0
+
+    after = eng.submit(prompts[2], max_new_tokens=3)   # reuse the freed slot
+    eng.drain(max_steps=100)
+    assert after.result(timeout=1) == _oneshot(cfg, params, prompts[2], 3)
+
+
+@pytest.mark.faults
+def test_slow_decode_arm_delays_but_preserves_output(model):
+    cfg, params = model
+    fi = ServingFaultInjector({"slow_decode": {"at_step": 0, "seconds": 0.05,
+                                               "times": 1}})
+    eng = ServingEngine(params, cfg, ServingConfig(
+        max_slots=2, max_queue=8, max_seq_len=32, prompt_buckets=(4, 8)),
+        injector=fi)
+    prompt = _prompts(1)[0]
+    t0 = time.monotonic()
+    fut = eng.submit(prompt, max_new_tokens=3)
+    eng.drain(max_steps=100)
+    assert time.monotonic() - t0 >= 0.05
+    assert fi.fired["slow_decode"] == 1
+    assert fut.result(timeout=1) == _oneshot(cfg, params, prompt, 3)
+
+
+def test_fault_injection_via_config(model):
+    """The serving config block's fault_injection spec builds the
+    injector (same spec-driven path the checkpoint/step injectors use)."""
+    cfg, params = model
+    eng = ServingEngine(params, cfg, ServingConfig(
+        max_slots=2, max_queue=4, max_seq_len=32, prompt_buckets=(4, 8),
+        fault_injection={"slow_decode": {"at_step": 0, "seconds": 0.0}}))
+    assert isinstance(eng.injector, ServingFaultInjector)
+    fut = eng.submit(_prompts(1)[0], max_new_tokens=2)
+    eng.drain(max_steps=100)
+    assert fut.result(timeout=1)
+    assert eng.injector.fired["slow_decode"] >= 1
+
+
+# -- pool and scheduler units -----------------------------------------------
+
+def test_kv_pool_allocate_free_lifecycle():
+    pool = KVCachePool(n_layers=2, max_slots=2, n_heads=4, max_seq_len=16,
+                       head_dim=8)
+    a, b = pool.allocate(), pool.allocate()
+    assert {a, b} == {0, 1}
+    with pytest.raises(PoolExhaustedError):
+        pool.allocate()
+    pool.free(a)
+    with pytest.raises(ValueError):
+        pool.free(a)                             # double free
+    assert pool.allocate() == a                  # lowest-first determinism
+    occ = pool.occupancy()
+    assert occ["max_slots"] == 2 and occ["in_use"] == 2
+    assert occ["allocations"] == 3 and occ["frees"] == 1
+    assert occ["peak_in_use"] == 2 and occ["utilization"] == 1.0
+
+
+def test_scheduler_bucketing_and_retirement():
+    assert default_buckets(31) == (8, 16, 31)
+    assert default_buckets(8) == (8,)
+    assert bucket_for(5, (4, 8)) == 8 and bucket_for(4, (4, 8)) == 4
+    with pytest.raises(ValueError):
+        bucket_for(9, (4, 8))
+    with pytest.raises(ValueError):
+        ContinuousBatchingScheduler(max_queue=2, buckets=(8, 4))
+    sched = ContinuousBatchingScheduler(max_queue=1, buckets=(8,))
+    req = sched.submit([1, 2], max_new_tokens=3, eos_token_id=5)
+    with pytest.raises(QueueFullError):
+        sched.submit([3], max_new_tokens=1)
+    req.emitted = 1
+    assert sched.should_retire(req, 5) == "eos"
+    assert sched.should_retire(req, 4) is None
+    assert sched.should_retire(req, 5, stuck=True) is None
+    req.emitted = 3
+    assert sched.should_retire(req, 4) == "length"
+
+
+# -- config plumbing --------------------------------------------------------
+
+def test_serving_config_block_validated():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    base = {"train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": 1}
+    off = DeepSpeedConfig(dict(base), world_size=1)
+    assert off.serving_config.enabled is False
+
+    on = DeepSpeedConfig(
+        dict(base, serving={"max_slots": 4, "prompt_buckets": [4, 8],
+                            "request_timeout_s": 1.5}), world_size=1)
+    sc = on.serving_config
+    assert sc.enabled and sc.max_slots == 4
+    assert sc.prompt_buckets == (4, 8) and sc.request_timeout_s == 1.5
+    assert sc.max_queue == 64 and sc.default_max_new_tokens == 64
+
+    for bad in ({"max_slots": 0}, {"max_queue": 0}, {"max_seq_len": 1},
+                {"prompt_buckets": [8, 4]}, {"prompt_buckets": [4, 4]},
+                {"default_max_new_tokens": 0}, {"request_timeout_s": -1},
+                {"fault_injection": "nope"}):
+        with pytest.raises(ValueError):
+            DeepSpeedConfig(dict(base, serving=bad), world_size=1)
+
+
+def test_from_config_builds_engine_with_monitor(model, tmpdir):
+    cfg, params = model
+    out = str(tmpdir.join("csv"))
+    ds = {"train_micro_batch_size_per_gpu": 1,
+          "gradient_accumulation_steps": 1,
+          "serving": {"max_slots": 2, "prompt_buckets": [4, 8],
+                      "max_seq_len": 32},
+          "csv_monitor": {"enabled": True, "output_path": out,
+                          "job_name": "serve"}}
+    eng = ServingEngine.from_config(params, cfg, ds)
+    prompt = _prompts(1)[0]
+    fut = eng.submit(prompt, max_new_tokens=3)
+    eng.drain(max_steps=100)
+    assert fut.result(timeout=1) == _oneshot(cfg, params, prompt, 3)
+    eng.close()                                  # flushes the monitor
+    written = os.listdir(os.path.join(out, "serve"))
+    assert any(f.startswith("Serving_") for f in written)
+
+
+def test_engine_rejects_bad_geometry(model):
+    cfg, params = model
+    with pytest.raises(ValueError):              # > max_position_embeddings
+        _engine(cfg, params, max_seq_len=64)
+    with pytest.raises(ValueError):              # bucket leaves no decode room
+        _engine(cfg, params, max_seq_len=8, prompt_buckets=(8,))
+
+
+# -- background-thread mode -------------------------------------------------
+
+def test_background_loop_serves_from_another_thread(model):
+    cfg, params = model
+    eng = _engine(cfg, params)
+    prompts = _prompts(3)
+    eng.start(idle_sleep_s=0.001)
+    try:
+        futs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+        for f, p in zip(futs, prompts):
+            assert f.result(timeout=10) == _oneshot(cfg, params, p, 4)
+    finally:
+        eng.stop()
+    assert eng.occupancy()["in_use"] == 0
+
+
+def test_metrics_snapshot(model):
+    cfg, params = model
+    eng = _engine(cfg, params)
+    futs = [eng.submit(p, max_new_tokens=4) for p in _prompts(2)]
+    eng.drain(max_steps=100)
+    for f in futs:
+        f.result(timeout=1)
+    snap = eng.metrics.snapshot()
+    assert snap["requests_completed"] == 2 and snap["requests_timed_out"] == 0
+    assert snap["avg_ttft_s"] > 0 and snap["tokens_per_sec"] > 0
+    assert snap["decode_steps"] > 0 and snap["tokens_emitted"] >= 6

@@ -1,0 +1,38 @@
+"""ThroughputTimer warmup semantics (utils/timer.py).
+
+A ``steps_per_output`` that fires inside the warmup window used to log
+``SamplesPerSec=-inf`` (zero elapsed time yet); the timer must stay
+silent until the warmup window has completed.
+"""
+
+import time
+
+from deepspeed_tpu.utils.timer import ThroughputTimer
+
+
+def _run_steps(t, n, work_s=0.0):
+    for _ in range(n):
+        t.start(sync=False)
+        if work_s:
+            time.sleep(work_s)
+        t.stop(sync=False)
+
+
+def test_no_report_during_warmup():
+    logs = []
+    t = ThroughputTimer(batch_size=1, start_step=2, steps_per_output=1,
+                        logging_fn=logs.append)
+    _run_steps(t, 2)                      # entirely inside the warmup window
+    assert logs == []                     # silent, not SamplesPerSec=-inf
+    assert t.avg_samples_per_sec() is None
+
+
+def test_reports_resume_after_warmup():
+    logs = []
+    t = ThroughputTimer(batch_size=4, start_step=2, steps_per_output=1,
+                        logging_fn=logs.append)
+    _run_steps(t, 5, work_s=0.001)
+    assert logs, "expected reports once the warmup window completed"
+    assert all("-inf" not in line for line in logs)
+    sps = t.avg_samples_per_sec()
+    assert sps is not None and sps > 0
