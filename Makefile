@@ -23,9 +23,10 @@ test-cluster:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_cluster_resilience.py -q
 
 # Continuous-batching serving engine: bitwise oracle vs generate(),
-# recompile pins, backpressure/deadline/fault-injection recovery.
+# batched/chunked prefill, prefix KV cache, recompile pins,
+# backpressure/deadline/fault-injection recovery.
 test-serving:
-	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_serving.py -q
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_serving.py tests/unit/test_prefix_cache.py -q
 
 ops:
 	$(MAKE) -C csrc

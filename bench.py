@@ -280,9 +280,13 @@ def serving_child_main():
     Same tiny GPT-2 shape as tests/perf/decode_bench.py, so the aggregate
     number reads directly against that artifact's single-stream
     ``kv_cache_tok_per_s`` rows — the delta IS the continuous-batching
-    win. Writes SERVING_BENCH[_CPU].json next to DECODE_BENCH[_CPU].json
-    and prints the usual one JSON line. Knobs: BENCH_SERVE_REQUESTS /
-    BENCH_SERVE_SLOTS / BENCH_SERVE_NEW_TOKENS."""
+    win. Prompts share a system-prompt-style prefix so the prefix KV
+    cache has something to hit. Writes SERVING_BENCH[_CPU].json next to
+    DECODE_BENCH[_CPU].json (and prints a before/after TTFT line when a
+    previous artifact exists) plus the usual one JSON line. Knobs:
+    BENCH_SERVE_REQUESTS / BENCH_SERVE_SLOTS / BENCH_SERVE_NEW_TOKENS /
+    BENCH_SERVE_CHUNK (chunked prefill, 0=off) / BENCH_SERVE_PREFIX_MB
+    (prefix cache budget, 0=off)."""
     import jax
     import numpy as np
 
@@ -295,6 +299,8 @@ def serving_child_main():
     n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "16"))
     max_slots = int(os.environ.get("BENCH_SERVE_SLOTS", "8"))
     max_new = int(os.environ.get("BENCH_SERVE_NEW_TOKENS", "32"))
+    chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "0"))
+    prefix_mb = float(os.environ.get("BENCH_SERVE_PREFIX_MB", "8"))
 
     cfg = GPT2Config(
         vocab_size=512, hidden_size=128, num_hidden_layers=4,
@@ -303,24 +309,34 @@ def serving_child_main():
     _, params = init_gpt2(cfg, batch_size=1, seq_len=8, seed=0)
 
     rng = np.random.RandomState(0)
-    prompts = [rng.randint(0, cfg.vocab_size, (int(n),)).tolist()
-               for n in rng.randint(4, 17, size=n_requests)]
+    system_prefix = rng.randint(0, cfg.vocab_size, (6,)).tolist()
+    prompts = [system_prefix
+               + rng.randint(0, cfg.vocab_size, (int(n),)).tolist()
+               for n in rng.randint(1, 11, size=n_requests)]  # len 7..16
 
     def make_engine():
         return ServingEngine(params, cfg, ServingConfig(
             max_slots=max_slots, max_queue=max(n_requests, 1),
-            max_seq_len=64, prompt_buckets=(8, 16)))
+            max_seq_len=64, prompt_buckets=(8, 16),
+            prefill_chunk_tokens=chunk, prefix_cache_mb=prefix_mb))
 
-    # warmup engine: pays every compile (per-bucket prefill + the one
-    # decode program) and anchors correctness against one-shot generate()
+    # warmup engine: pays every compile (batched prefill at BOTH buckets
+    # + the one decode program) and anchors correctness against one-shot
+    # generate(). The warm prompts deliberately share no prefix with each
+    # other, so the second one cannot hit the warm engine's prefix cache
+    # and shrink its computed suffix out of bucket 16.
+    wrng = np.random.RandomState(99)
+    short_p = wrng.randint(0, cfg.vocab_size, (8,)).tolist()    # bucket 8
+    long_p = wrng.randint(0, cfg.vocab_size, (16,)).tolist()    # bucket 16
     warm = make_engine()
-    w0, w1 = warm.submit(prompts[0], max_new_tokens=max_new), \
-        warm.submit(prompts[1], max_new_tokens=max_new)
+    w0 = warm.submit(short_p, max_new_tokens=max_new)
     warm.drain(max_steps=10 * max_new)
-    want = np.asarray(generate(
-        params, cfg, np.asarray([prompts[0]], np.int32), max_new))[0].tolist()
-    assert w0.result(timeout=5) == want, "serving diverged from generate()"
-    w1.result(timeout=5)
+    w1 = warm.submit(long_p, max_new_tokens=max_new)
+    warm.drain(max_steps=10 * max_new)
+    for fut, p in ((w0, short_p), (w1, long_p)):
+        want = np.asarray(generate(
+            params, cfg, np.asarray([p], np.int32), max_new))[0].tolist()
+        assert fut.result(timeout=5) == want, "serving diverged from generate()"
 
     eng = make_engine()
     t0 = time.perf_counter()
@@ -336,27 +352,49 @@ def serving_child_main():
         "requests": n_requests,
         "max_slots": max_slots,
         "max_new_tokens": max_new,
+        "prefill_chunk_tokens": chunk,
+        "prefix_cache_mb": prefix_mb,
         "tokens_per_sec": round(tokens / wall_s, 1),
         "decode_tokens_per_sec": round(snap["tokens_per_sec"] or 0.0, 1),
+        "prefill_tokens_per_sec": round(
+            snap["prefill_tokens_per_sec"] or 0.0, 1),
         "avg_ttft_s": round(snap["avg_ttft_s"], 4),
         "max_ttft_s": round(snap["max_ttft_s"], 4),
+        "ttft_p50_s": round(snap["ttft_p50_s"], 4),
+        "ttft_p95_s": round(snap["ttft_p95_s"], 4),
+        "prefix_hit_rate": (None if snap["prefix_hit_rate"] is None
+                            else round(snap["prefix_hit_rate"], 3)),
         "decode_steps": snap["decode_steps"],
         "complete": True,
     }
     suffix = "" if platform == "tpu" else f"_{platform.upper()}"
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        f"SERVING_BENCH{suffix}.json")
+    previous = None
+    if os.path.exists(out):
+        try:
+            with open(out) as f:
+                previous = json.load(f)
+        except (OSError, ValueError):
+            previous = None
     with open(out, "w") as f:
         f.write(json.dumps(result, indent=1) + "\n")
+    if previous and previous.get("avg_ttft_s"):
+        before, after = previous["avg_ttft_s"], result["avg_ttft_s"]
+        print(f"# avg TTFT: {before:.4f}s -> {after:.4f}s "
+              f"({before / after:.2f}x)" if after else
+              f"# avg TTFT: {before:.4f}s -> {after}")
 
     print(json.dumps({
         "metric": f"continuous-batching serving tokens/sec ({platform})",
         "value": result["tokens_per_sec"],
         "unit": "tokens/sec",
         "vs_baseline": None,
-        **{k: result[k] for k in ("avg_ttft_s", "max_ttft_s", "requests",
-                                  "max_slots", "max_new_tokens",
-                                  "decode_tokens_per_sec")},
+        **{k: result[k] for k in ("avg_ttft_s", "ttft_p50_s", "ttft_p95_s",
+                                  "max_ttft_s", "requests", "max_slots",
+                                  "max_new_tokens", "decode_tokens_per_sec",
+                                  "prefill_tokens_per_sec",
+                                  "prefix_hit_rate")},
     }))
     return 0
 

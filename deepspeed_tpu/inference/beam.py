@@ -18,7 +18,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.inference.generation import _prefill, _step
+from deepspeed_tpu.inference.generation import _forward_full, _step
 
 
 @partial(jax.jit, static_argnames=("n_layers", "n_heads", "head_dim",
@@ -31,9 +31,10 @@ def _beam_jit(params, prompt_ids, n_layers, n_heads, head_dim,
     total = S + max_new_tokens
     NEG = jnp.asarray(-1e9, jnp.float32)
 
-    # prefill on [B] lanes, then tile the caches to [B*W] beam lanes
-    caches, last_logits = _prefill(
-        params, prompt_ids, n_layers, n_heads, head_dim, total)
+    # single-pass prefill on [B] lanes, then tile the caches to [B*W]
+    # beam lanes
+    caches, last_logits = _forward_full(
+        params, prompt_ids, S, n_layers, n_heads, head_dim, total)
     caches = tuple(jnp.repeat(c, W, axis=1) for c in caches)   # [L,B*W,...]
     logits = jnp.repeat(last_logits, W, axis=0)                # [B*W, V]
 

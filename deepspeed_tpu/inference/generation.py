@@ -1,7 +1,10 @@
 """KV-cache autoregressive decoding over the training stack's params.
 
 Beyond the v0.3.10 reference (DeepSpeed-Inference came later). TPU-first
-design: the whole decode is ONE jitted ``lax.scan`` over positions — no
+design: prefill is ONE single-pass causal forward over the whole prompt
+(``_forward_full`` — every K/V computed in one batched call, so the
+compiler sees whole-sequence GEMMs instead of S sequential batch-1
+matmuls), and decode is ONE jitted ``lax.scan`` over positions — no
 per-token host round-trips — with an inner ``lax.scan`` over the
 scan-stacked layer params (the same [L, ...] stacking the training path
 uses, so a trained checkpoint drops in unchanged). Static shapes
@@ -137,11 +140,13 @@ def filter_logits(logits, top_k=0, top_p=1.0):
 
 
 def _prefill(params, prompt_ids, n_layers, n_heads, head_dim, total):
-    """Allocate the KV caches for ``total`` positions and scan the prompt
-    through them (same step as decode). Only the LAST position's logits
-    matter — carried in the scan state instead of stacking [S, B, V]
-    outputs (S x B x vocab f32 would dwarf the KV cache for long
-    prompts). Shared by every decode mode (greedy/sampling/beam)."""
+    """Token-by-token scan prefill — the PARITY REFERENCE.
+
+    Allocates the KV caches for ``total`` positions and scans the prompt
+    through them one position at a time (same step as decode). No live
+    path uses this anymore: ``generate()``/``beam_search()``/serving all
+    prefill through the single-pass ``_forward_full``, and the tests pin
+    that path bitwise (greedy tokens) / allclose (KV) against this one."""
     B, S = prompt_ids.shape
     tr = params["params"]["transformer"]
     # Compute dtype = what `_step` actually produces: int8-quantized tables
@@ -166,6 +171,104 @@ def _prefill(params, prompt_ids, n_layers, n_heads, head_dim, total):
     return caches, last_logits
 
 
+def _chunk_layer(layer_p, h, cache_k, cache_v, starts, nh):
+    """A whole chunk of positions through one layer against the cache.
+
+    h [B, C, H]; cache_k/v [B, nh, S_cache, hd]; starts [B] is each
+    lane's first position (0 for plain prefill, the chunk/prefix offset
+    otherwise). The chunk's K/V are written into the cache FIRST, then
+    every query attends over the full cache under the same
+    ``arange(S) <= pos`` mask the decode step uses — cached positions
+    before ``starts`` (earlier chunks, prefix-cache hits) are visible,
+    later positions mask to exact-zero probability."""
+    B, C, H = h.shape
+    hd = H // nh
+
+    a_in = _ln(h, layer_p["ln_attn"])
+    qkv = a_in @ maybe_dequant(layer_p["qkv"]) + layer_p["qkv"]["bias"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, C, nh, hd)
+    k = jnp.moveaxis(k.reshape(B, C, nh, hd), 1, 2)          # [B, nh, C, hd]
+    v = jnp.moveaxis(v.reshape(B, C, nh, hd), 1, 2)
+
+    def put(cache, new, s):
+        # per-position scatter, NOT dynamic_update_slice: when a lane's
+        # bucket pad runs past the cache end (large start + padded chunk)
+        # the OOB pad writes must be DROPPED — a slice update would clamp
+        # the start and shift real KV onto wrong positions
+        return cache.at[:, s + jnp.arange(C), :].set(new, mode="drop")
+
+    cache_k = jax.vmap(put)(cache_k, k, starts)
+    cache_v = jax.vmap(put)(cache_v, v, starts)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, h.dtype))
+    scores = jnp.einsum("bqnd,bnsd->bnqs", q, cache_k) * scale  # [B,nh,C,S]
+    S_cache = cache_k.shape[2]
+    pos = starts[:, None] + jnp.arange(C)[None, :]              # [B, C]
+    valid = jnp.arange(S_cache)[None, None, :] <= pos[:, :, None]
+    scores = jnp.where(valid[:, None, :, :], scores,
+                       jnp.asarray(-1e30, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(h.dtype)
+    ctx = jnp.einsum("bnqs,bnsd->bqnd", probs, cache_v).reshape(B, C, H)
+    a = ctx @ maybe_dequant(layer_p["attn_out"]) + layer_p["attn_out"]["bias"]
+    h = h + a
+
+    f_in = _ln(h, layer_p["ln_ffn"])
+    f = f_in @ maybe_dequant(layer_p["ff1"]) + layer_p["ff1"]["bias"]
+    f = jax.nn.gelu(f, approximate=False)
+    f = f @ maybe_dequant(layer_p["ff2"]) + layer_p["ff2"]["bias"]
+    return h + f, cache_k, cache_v
+
+
+def _forward_chunk(params, n_heads, caches, ids, starts):
+    """Single-pass causal forward of ``ids`` [B, C] written into
+    ``caches`` ([L, B, nh, S_cache, hd]) at per-lane offsets ``starts``
+    [B]. Returns (hidden states [B, C, H] BEFORE the final LN, updated
+    caches). The shared core under full-sequence prefill, chunked
+    prefill, and prefix-cache-seeded prefill: ``starts`` and the cache
+    contents are traced operands, so one compiled program per (B, C,
+    S_cache) covers all of them."""
+    tr = params["params"]["transformer"]
+    layer_p = _layer_tree(params)
+    C = ids.shape[1]
+    pos = starts[:, None] + jnp.arange(C)[None, :]               # [B, C]
+    h = embed_rows(tr["wte"], ids) + tr["wpe"]["embedding"][pos]
+
+    def layer_body(h, inputs):
+        lp, ck_l, cv_l = inputs
+        h, ck_l, cv_l = _chunk_layer(lp, h, ck_l, cv_l, starts, n_heads)
+        return h, (ck_l, cv_l)
+
+    h, caches = jax.lax.scan(layer_body, h, (layer_p,) + tuple(caches))
+    return h, caches
+
+
+def _forward_full(params, ids, true_len, n_layers, n_heads, head_dim, total):
+    """Single-pass full-sequence causal prefill: every K/V for the
+    (padded) prompt ``ids`` [B, S] computed in ONE batched forward into a
+    fresh ``total``-long cache, with the logits selected at the true last
+    prompt position (``true_len`` — scalar or [B], traced) so padding is
+    invisible to the emitted token. Replaces the sequential scan prefill
+    (``_prefill``, kept as the parity reference) on every live path:
+    ``generate()``, ``beam_search()``, and the serving engine."""
+    B, S = ids.shape
+    tr = params["params"]["transformer"]
+    emb_dtype = (jnp.float32 if "kernel_q" in tr["wte"]
+                 else tr["wte"]["embedding"].dtype)
+    dtype = jnp.result_type(emb_dtype, tr["wpe"]["embedding"].dtype)
+    shape = (n_layers, B, n_heads, total, head_dim)
+    caches = (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    h, caches = _forward_chunk(params, n_heads, caches, ids,
+                               jnp.zeros((B,), jnp.int32))
+    idx = jnp.clip(jnp.broadcast_to(
+        jnp.asarray(true_len, jnp.int32) - 1, (B,)), 0, S - 1)
+    h_last = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
+    h_last = _ln(h_last, tr["ln_f"])
+    last_logits = h_last @ logits_table(tr["wte"], h_last.dtype).T
+    return caches, last_logits
+
+
 @partial(jax.jit, static_argnames=("n_layers", "n_heads", "head_dim",
                                    "max_new_tokens", "greedy", "filtered"))
 def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
@@ -173,8 +276,8 @@ def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
                   top_p, rng):
     B, S = prompt_ids.shape
     total = S + max_new_tokens
-    caches, last_logits = _prefill(
-        params, prompt_ids, n_layers, n_heads, head_dim, total)
+    caches, last_logits = _forward_full(
+        params, prompt_ids, S, n_layers, n_heads, head_dim, total)
 
     def decode_body(carry, pos):
         caches, logits, rng = carry

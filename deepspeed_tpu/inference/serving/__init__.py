@@ -5,12 +5,16 @@ The one-shot ``generate()`` path answers a fixed batch; this subsystem
 answers *traffic*: a bounded admission queue feeds a slot-based KV-cache
 pool, and a single compiled masked batched decode step serves every
 in-flight request — new requests join whenever a slot frees, finished
-ones retire per sequence, and none of that churn recompiles. Greedy
-outputs are bitwise identical to per-request ``generate()`` regardless
-of arrival order (the oracle in tests/unit/test_serving.py).
+ones retire per sequence, and none of that churn recompiles. Prompts are
+prefilled in ONE single-pass batched causal forward per same-bucket
+admission group (optionally chunked for long prompts, optionally seeded
+from the prefix KV cache). Greedy outputs are bitwise identical to
+per-request ``generate()`` regardless of arrival order (the oracle in
+tests/unit/test_serving.py).
 
-Layering: kv_pool (device state) <- engine (compiled step + loop) <-
-scheduler (host policy: queue/buckets/retirement) <- metrics (monitor).
+Layering: kv_pool (device state) <- engine (compiled prefill/step +
+loop) <- scheduler (host policy: queue/buckets/retirement) <-
+prefix_cache (host prompt-KV reuse) <- metrics (monitor).
 """
 
 from deepspeed_tpu.inference.serving.config import ServingConfig  # noqa: F401
@@ -23,6 +27,9 @@ from deepspeed_tpu.inference.serving.kv_pool import (  # noqa: F401
     PoolExhaustedError,
 )
 from deepspeed_tpu.inference.serving.metrics import ServingMetrics  # noqa: F401
+from deepspeed_tpu.inference.serving.prefix_cache import (  # noqa: F401
+    PrefixKVCache,
+)
 from deepspeed_tpu.inference.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
     QueueFullError,
@@ -34,7 +41,7 @@ from deepspeed_tpu.inference.serving.scheduler import (  # noqa: F401
 
 __all__ = [
     "ServingEngine", "ServingConfig", "ServingMetrics", "ServingFuture",
-    "KVCachePool", "PoolExhaustedError", "ContinuousBatchingScheduler",
-    "QueueFullError", "RequestTimeoutError", "ServingFaultInjector",
-    "bucket_for", "default_buckets",
+    "KVCachePool", "PoolExhaustedError", "PrefixKVCache",
+    "ContinuousBatchingScheduler", "QueueFullError", "RequestTimeoutError",
+    "ServingFaultInjector", "bucket_for", "default_buckets",
 ]

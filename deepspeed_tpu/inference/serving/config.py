@@ -32,6 +32,15 @@ class ServingConfig:
     # Default per-request deadline (queued + decoding); 0 = none. A
     # request past it is retired with RequestTimeoutError.
     request_timeout_s: float = 0.0
+    # Chunked prefill: prompts whose to-be-computed length exceeds this
+    # are prefilled in fixed-size chunks of this many tokens, interleaved
+    # with decode steps, so one long prompt cannot stall every in-flight
+    # request's inter-token latency. 0 = always single-pass.
+    prefill_chunk_tokens: int = 0
+    # Prefix KV cache budget in MiB (host RAM): stores served prompts'
+    # KV keyed by token prefix so shared system-prompt prefixes skip
+    # recomputation. 0 = disabled.
+    prefix_cache_mb: float = 0.0
     # Serving/step/I-O fault-injection spec (tests only): see
     # serving/fault_injection.py for the accepted points.
     fault_injection: dict = field(default=None)

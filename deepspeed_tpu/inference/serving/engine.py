@@ -5,25 +5,39 @@ masked batched step over the pool's ``MaxSlots`` lanes, each lane
 running the SAME per-token ``_step`` the one-shot ``generate()`` path
 uses (vmapped with a per-lane position counter). ``MaxSlots`` is static,
 the lane-active mask and positions are traced operands — so requests
-joining, retiring, or swapping slots NEVER recompile. Prompt prefill is
-per-request at a bucketed length (one compile per bucket, bounded by the
-bucket ladder) and is copied into the request's slot with a traced-slot
-install (one compile total).
+joining, retiring, or swapping slots NEVER recompile.
+
+Prefill is a SINGLE-PASS batched causal forward (``_forward_chunk`` —
+the same core ``generate()``/``beam_search()`` prefill with): the
+scheduler groups queued requests that share a prompt bucket and
+prefills them as one ``[MaxSlots, Sb]`` call straight into their pool
+slots, so a prompt of length S costs one whole-sequence forward instead
+of S sequential batch-1 matmuls. The batch dimension is padded to the
+static ``MaxSlots`` and per-lane starts/true-lengths are traced, so the
+compile count stays bounded by the bucket ladder — never by how many
+requests happen to arrive together. Long prompts can additionally be
+split into fixed-size chunks (``serving.prefill_chunk_tokens``)
+interleaved with decode steps, and previously-served prompt prefixes
+can be seeded from the prefix KV cache (``serving.prefix_cache_mb``,
+prefix_cache.py) instead of recomputed.
 
 Correctness oracle (tests/unit/test_serving.py): continuous-batched
 greedy output is BITWISE equal to per-request ``generate()`` output for
 any arrival order. Why it holds:
 
 - prefill pads the prompt up to its bucket but *selects* the logits at
-  the true last prompt position; positions < prompt_len only ever see
-  true prompt tokens, so the selected logits match the unpadded scan;
+  the true last prompt position; a valid query position only ever
+  attends true prompt tokens (causal mask), so the selected logits
+  match the unpadded forward;
 - pad/stale cache beyond a lane's position is either overwritten before
   it is reachable (decode writes position p before attending to it) or
   hidden by the causal mask, whose -1e30 scores underflow to exactly 0
   probability — extra masked cache length is numerically invisible;
 - lanes are vmapped, hence computed independently: a neighbor admitting,
   retiring, or holding garbage cannot perturb another lane's values
-  (the batch-independence property test_generation.py already pins).
+  (the batch-independence property test_generation.py already pins);
+- a prefix-cache hit seeds bits a previous identical computation
+  produced, so seeding and recomputing are the same bits.
 
 Greedy only: serving argmax-decodes (temperature-0), the mode with a
 bitwise oracle. Sampling needs per-request RNG streams and is future
@@ -39,11 +53,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.inference.generation import _step
+from deepspeed_tpu.inference.generation import _forward_chunk, _ln, _step
+from deepspeed_tpu.inference.quantization import logits_table
 from deepspeed_tpu.inference.serving.config import ServingConfig
 from deepspeed_tpu.inference.serving.fault_injection import ServingFaultInjector
 from deepspeed_tpu.inference.serving.kv_pool import KVCachePool
 from deepspeed_tpu.inference.serving.metrics import ServingMetrics
+from deepspeed_tpu.inference.serving.prefix_cache import PrefixKVCache
 from deepspeed_tpu.inference.serving.scheduler import (
     ContinuousBatchingScheduler,
     RequestTimeoutError,
@@ -52,40 +68,31 @@ from deepspeed_tpu.inference.serving.scheduler import (
 )
 
 
-@partial(jax.jit, static_argnames=("n_layers", "n_heads", "head_dim", "total"))
-def _prefill_request_jit(params, padded_ids, true_len, *, n_layers, n_heads,
-                         head_dim, total):
-    """Prefill ONE request at its bucketed length into a fresh
-    ``total``-long cache; return (k, v, first greedy token).
+@partial(jax.jit, static_argnames=("n_heads",), donate_argnums=(1, 2))
+def _prefill_batch_jit(params, init_k, init_v, padded_ids, starts, true_lens,
+                       *, n_heads):
+    """Single-pass batched prefill: ``padded_ids`` [B, Sb] (each lane's
+    to-be-computed tokens, right-padded to the bucket) forwarded in ONE
+    causal call into ``init_k``/``init_v`` ([L, B, nh, S_max, hd] —
+    zeros, or prefix-cache KV for lanes resuming at ``starts[i] > 0``).
+    Returns (k, v, first greedy token per lane).
 
-    ``padded_ids`` is [1, Sb] (prompt right-padded to its bucket);
-    ``true_len`` is traced, so every prompt length inside a bucket shares
-    the bucket's one compiled program. The scan runs the same ``_step``
-    as ``_prefill``; the carried logits are *selected* at the true last
-    prompt position instead of taken from the scan's end, which makes
-    the padding invisible to the emitted token."""
+    ``starts`` and ``true_lens`` are traced [B] vectors, so ONE compiled
+    program per (B, Sb, S_max) serves every group composition: plain
+    prompts, prefix-cache hits at any offset, and (at B=1, Sb=chunk)
+    every chunk of a chunked prefill. The logits are *selected* at each
+    lane's true last prompt position, which makes both pad tokens and
+    dummy lanes invisible to the emitted token."""
     B, Sb = padded_ids.shape
     tr = params["params"]["transformer"]
-    emb_dtype = (jnp.float32 if "kernel_q" in tr["wte"]
-                 else tr["wte"]["embedding"].dtype)
-    dtype = jnp.result_type(emb_dtype, tr["wpe"]["embedding"].dtype)
-    shape = (n_layers, B, n_heads, total, head_dim)
-    caches = (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
-
-    from deepspeed_tpu.inference.quantization import vocab_size
-
-    V = vocab_size(tr["wte"])
-
-    def body(carry, pos):
-        caches, sel = carry
-        logits, caches = _step(params, n_heads, caches, padded_ids[:, pos], pos)
-        sel = jnp.where(pos == true_len - 1, logits, sel)
-        return (caches, sel), None
-
-    (caches, sel), _ = jax.lax.scan(
-        body, (caches, jnp.zeros((B, V), dtype)), jnp.arange(Sb))
-    first = jnp.argmax(sel, axis=-1).astype(jnp.int32)
-    return caches[0], caches[1], first
+    h, (k, v) = _forward_chunk(params, n_heads, (init_k, init_v),
+                               padded_ids, starts)
+    idx = jnp.clip(true_lens - 1 - starts, 0, Sb - 1)
+    h_sel = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
+    h_sel = _ln(h_sel, tr["ln_f"])
+    logits = h_sel @ logits_table(tr["wte"], h_sel.dtype).T
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return k, v, first
 
 
 @partial(jax.jit, static_argnames=("n_heads",), donate_argnums=(1, 2))
@@ -109,6 +116,23 @@ def _decode_step_jit(params, pool_k, pool_v, tokens, positions, active, *,
         pool_k, pool_v, tokens, positions)
     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jnp.where(active, nxt, tokens), pool_k, pool_v
+
+
+class _ChunkedPrefill:
+    """In-flight chunked prefill: the request, its private cache pair
+    (carried across engine steps between chunk calls), how far it has
+    prefilled, and the pool slot reserved for it at start."""
+
+    __slots__ = ("req", "k", "v", "pos", "reuse", "slot", "prefill_s")
+
+    def __init__(self, req, k, v, pos, reuse, slot):
+        self.req = req
+        self.k = k
+        self.v = v
+        self.pos = pos
+        self.reuse = reuse
+        self.slot = slot
+        self.prefill_s = 0.0
 
 
 class ServingEngine:
@@ -139,6 +163,14 @@ class ServingEngine:
             raise ValueError(
                 f"largest prompt bucket ({buckets[-1]}) must leave room for "
                 f"one generated token (max_seq_len={self.max_seq_len})")
+        if cfg.prefill_chunk_tokens < 0:
+            raise ValueError(
+                f"serving.prefill_chunk_tokens must be >= 0 "
+                f"(0 disables chunked prefill), got {cfg.prefill_chunk_tokens}")
+        if cfg.prefix_cache_mb < 0:
+            raise ValueError(
+                f"serving.prefix_cache_mb must be >= 0 "
+                f"(0 disables the prefix cache), got {cfg.prefix_cache_mb}")
 
         tr = params["params"]["transformer"]
         emb_dtype = (jnp.float32 if "kernel_q" in tr["wte"]
@@ -151,6 +183,9 @@ class ServingEngine:
             default_max_new_tokens=cfg.default_max_new_tokens,
             request_timeout_s=cfg.request_timeout_s)
         self.metrics = ServingMetrics(monitor)
+        self.prefix_cache = (
+            PrefixKVCache(max(1, int(cfg.prefix_cache_mb * 2 ** 20)))
+            if cfg.prefix_cache_mb > 0 else None)
         if injector is None and cfg.fault_injection:
             injector = ServingFaultInjector(cfg.fault_injection)
         self.injector = injector
@@ -158,6 +193,10 @@ class ServingEngine:
         self._active = {}                                   # slot -> Request
         self._lane_tokens = np.zeros(cfg.max_slots, np.int32)
         self._lane_active = np.zeros(cfg.max_slots, bool)
+        # batched prefill always runs at the pool width: the batch dim is
+        # STATIC, so any admission-group size shares one program per bucket
+        self._prefill_batch = cfg.max_slots
+        self._chunking = None               # at most one chunked prefill
         self._step_count = 0
         self._loop_thread = None
         self._stop = threading.Event()
@@ -214,24 +253,27 @@ class ServingEngine:
 
     # -- the serving loop ----------------------------------------------
     def step(self):
-        """One scheduler iteration: expire, admit, one batched decode
-        step, retire. Returns an activity dict (all zeros = idle)."""
+        """One scheduler iteration: expire, advance any chunked prefill,
+        admit (batched per bucket), one batched decode step, retire.
+        Returns an activity dict (all zeros = idle)."""
         now = time.monotonic()
-        stats = {"admitted": 0, "decoded": 0, "retired": 0}
+        stats = {"admitted": 0, "decoded": 0, "retired": 0,
+                 "prefill_chunks": 0}
 
         for req in self.scheduler.pop_expired(now):
             self._finish_timeout(req, phase="queued")
             stats["retired"] += 1
 
-        # join-at-free-slot admission: fill every free lane from the queue
-        while self.pool.free_slots > 0:
-            req = self.scheduler.pop_next()
-            if req is None:
-                break
-            retired = self._admit(req)
-            stats["admitted"] += 1
-            stats["retired"] += retired
+        # one chunk per step: a long prompt makes progress without ever
+        # stalling the in-flight lanes' inter-token latency
+        if self._chunking is not None:
+            self._advance_chunk(stats)
 
+        self._admit_from_queue(stats)
+
+        if self.injector is not None:
+            self.injector.maybe_evict_prefix(self._step_count,
+                                             self.prefix_cache)
         if self._active:
             if self.injector is not None:
                 self.injector.maybe_slow_decode(self._step_count)
@@ -261,11 +303,12 @@ class ServingEngine:
         return stats
 
     def drain(self, max_steps=None):
-        """Step until no request is queued or in flight. ``max_steps``
-        bounds the loop (a deadline-less stuck request would otherwise
-        spin forever under fault injection)."""
+        """Step until no request is queued, prefilling, or in flight.
+        ``max_steps`` bounds the loop (a deadline-less stuck request
+        would otherwise spin forever under fault injection)."""
         steps = 0
-        while self._active or self.scheduler.queue_depth() > 0:
+        while (self._active or self._chunking is not None
+               or self.scheduler.queue_depth() > 0):
             self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:
@@ -300,30 +343,208 @@ class ServingEngine:
         self.stop()
         self.metrics.close()
 
-    # -- internals ------------------------------------------------------
-    def _admit(self, req):
-        """Prefill ``req`` at its bucket length and install it into a
-        slot. Returns 1 when the request retired on its very first token
-        (max_new_tokens=1 or instant EOS), else 0."""
-        bucket = bucket_for(len(req.prompt), self.scheduler.buckets)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :len(req.prompt)] = req.prompt
-        new_k, new_v, first = _prefill_request_jit(
-            self.params, jnp.asarray(padded), jnp.int32(len(req.prompt)),
-            n_layers=self.n_layers, n_heads=self.n_heads,
-            head_dim=self.head_dim, total=self.max_seq_len)
-        first_tok = int(first[0])                  # sync: TTFT endpoint
-        req.first_token_time = time.monotonic()
-        self.metrics.record_first_token(req.first_token_time - req.submit_time)
+    # -- admission ------------------------------------------------------
+    def _admit_from_queue(self, stats):
+        """Join-at-free-slot admission, batched per bucket: pop the FIFO
+        head, gather every queued request sharing its (prefix-adjusted)
+        bucket up to the free-slot count, and prefill them as ONE call.
+        Long prompts divert to the chunked path (one at a time)."""
+        while self.pool.free_slots > 0:
+            head = self.scheduler.pop_next()
+            if head is None:
+                return
+            if self._needs_chunking(head):
+                if self._chunking is None:
+                    self._start_chunked(head)
+                    stats["admitted"] += 1
+                    continue
+                self.scheduler.requeue_front(head)   # chunk lane is busy
+                return
+            bucket = bucket_for(self._suffix_len(head), self.scheduler.buckets)
+            group = [head]
+            room = min(self.pool.free_slots - 1, self._prefill_batch - 1)
+            if room > 0:
+                group += self.scheduler.pop_matching(
+                    lambda r: (not self._needs_chunking(r)
+                               and bucket_for(self._suffix_len(r),
+                                              self.scheduler.buckets)
+                               == bucket),
+                    room)
+            stats["admitted"] += len(group)
+            stats["retired"] += self._admit_batch(group, bucket)
 
-        slot = self.pool.allocate()
-        self.pool.install(new_k, new_v, slot, position=len(req.prompt))
+    def _admit_batch(self, group, bucket):
+        """Prefill ``group`` (same bucket) as one [MaxSlots, bucket] call
+        and install each lane into its slot. Returns how many requests
+        retired on their very first token."""
+        B, total = self._prefill_batch, self.max_seq_len
+        ids = np.zeros((B, bucket), np.int32)
+        starts = np.zeros(B, np.int32)
+        lens = np.ones(B, np.int32)        # dummy lanes: 1-token no-ops
+        plan = []
+        any_hit = False
+        for i, req in enumerate(group):
+            reuse, entry = self._acquire_prefix(req)
+            suffix = req.prompt[reuse:]
+            ids[i, :len(suffix)] = suffix
+            starts[i] = reuse
+            lens[i] = len(req.prompt)
+            plan.append((req, reuse, entry))
+            any_hit = any_hit or reuse > 0
+        shape = (self.n_layers, B, self.n_heads, total, self.head_dim)
+        if any_hit:
+            # seed hit lanes from host-resident prefix KV; one transfer
+            init_k = np.zeros(shape, self.pool.k.dtype)
+            init_v = np.zeros(shape, self.pool.k.dtype)
+            for i, (req, reuse, entry) in enumerate(plan):
+                if reuse > 0:
+                    init_k[:, i, :, :reuse] = entry.k[:, :, :reuse]
+                    init_v[:, i, :, :reuse] = entry.v[:, :, :reuse]
+            init_k, init_v = jnp.asarray(init_k), jnp.asarray(init_v)
+        else:
+            init_k = jnp.zeros(shape, self.pool.k.dtype)
+            init_v = jnp.zeros(shape, self.pool.k.dtype)
+
+        t0 = time.monotonic()
+        k, v, first = _prefill_batch_jit(
+            self.params, init_k, init_v, jnp.asarray(ids),
+            jnp.asarray(starts), jnp.asarray(lens), n_heads=self.n_heads)
+        first_host = np.asarray(first)             # sync: TTFT endpoint
+        prefill_s = time.monotonic() - t0
+        self.metrics.record_prefill(
+            tokens=sum(len(r.prompt) - re for r, re, _ in plan),
+            reused_tokens=sum(re for _, re, _ in plan),
+            requests=len(group), prefill_s=prefill_s)
+
+        now = time.monotonic()
+        retired = 0
+        for i, (req, reuse, entry) in enumerate(plan):
+            self._maybe_insert_prefix(req, reuse, k, v, lane=i)
+            slot = self.pool.allocate()
+            self.pool.install_lane(k, v, lane=i, slot=slot,
+                                   position=len(req.prompt))
+            req.prefix_entry = entry
+            req.first_token_time = now
+            self.metrics.record_first_token(now - req.submit_time)
+            self._activate(req, slot, int(first_host[i]))
+            retired += self._maybe_retire(req, int(first_host[i]), now)
+        # settle the queued lane installs here so they are accounted to
+        # admission, not silently absorbed into the next decode step's
+        # measured latency
+        self.pool.k.block_until_ready()
+        return retired
+
+    # -- chunked prefill ------------------------------------------------
+    def _needs_chunking(self, req):
+        chunk = self.config.prefill_chunk_tokens
+        return chunk > 0 and self._suffix_len(req) > chunk
+
+    def _start_chunked(self, req):
+        """Reserve a slot and a private cache for ``req`` and let
+        ``_advance_chunk`` feed it one chunk per engine step."""
+        reuse, entry = self._acquire_prefix(req)
+        req.prefix_entry = entry
+        slot = self.pool.allocate()       # reserved: completion can't stall
+        shape = (self.n_layers, 1, self.n_heads, self.max_seq_len,
+                 self.head_dim)
+        if reuse > 0:
+            k0 = np.zeros(shape, self.pool.k.dtype)
+            v0 = np.zeros(shape, self.pool.k.dtype)
+            k0[:, 0, :, :reuse] = entry.k[:, :, :reuse]
+            v0[:, 0, :, :reuse] = entry.v[:, :, :reuse]
+            k0, v0 = jnp.asarray(k0), jnp.asarray(v0)
+        else:
+            k0 = jnp.zeros(shape, self.pool.k.dtype)
+            v0 = jnp.zeros(shape, self.pool.k.dtype)
+        self._chunking = _ChunkedPrefill(req, k0, v0, pos=reuse, reuse=reuse,
+                                         slot=slot)
+
+    def _advance_chunk(self, stats):
+        """Run the next chunk of the in-flight chunked prefill (same
+        compiled program as batched prefill, at B=1/Sb=chunk); install
+        and activate on the final chunk. Mid chunks never block the host
+        — only the final chunk syncs, for its first token."""
+        st = self._chunking
+        req = st.req
+        now = time.monotonic()
+        if req.deadline_exceeded(now):
+            req.slot = st.slot             # hand the reserved slot back
+            self._finish_timeout(req, phase="prefill")
+            self._chunking = None
+            stats["retired"] += 1
+            return
+        chunk_len = self.config.prefill_chunk_tokens
+        chunk = req.prompt[st.pos:st.pos + chunk_len]
+        ids = np.zeros((1, chunk_len), np.int32)
+        ids[0, :len(chunk)] = chunk
+        t0 = time.monotonic()
+        st.k, st.v, first = _prefill_batch_jit(
+            self.params, st.k, st.v, jnp.asarray(ids),
+            jnp.asarray([st.pos], jnp.int32),
+            jnp.asarray([len(req.prompt)], jnp.int32), n_heads=self.n_heads)
+        st.pos += len(chunk)
+        stats["prefill_chunks"] += 1
+        if st.pos < len(req.prompt):
+            st.prefill_s += time.monotonic() - t0
+            return
+        first_tok = int(np.asarray(first)[0])      # sync: TTFT endpoint
+        st.prefill_s += time.monotonic() - t0
+        now = time.monotonic()
+        self.metrics.record_prefill(
+            tokens=len(req.prompt) - st.reuse, reused_tokens=st.reuse,
+            requests=1, prefill_s=st.prefill_s)
+        self._maybe_insert_prefix(req, st.reuse, st.k, st.v, lane=0)
+        self.pool.install(st.k, st.v, st.slot, position=len(req.prompt))
+        req.first_token_time = now
+        self.metrics.record_first_token(now - req.submit_time)
+        self._activate(req, st.slot, first_tok)
+        stats["retired"] += self._maybe_retire(req, first_tok, now)
+        self._chunking = None
+
+    # -- prefix cache ---------------------------------------------------
+    def _suffix_len(self, req):
+        """Tokens a prefill would actually compute for ``req`` after
+        prefix-cache reuse (always >= 1: the last prompt position is
+        recomputed to produce the first token's logits)."""
+        if self.prefix_cache is None:
+            return len(req.prompt)
+        length, _ = self.prefix_cache.match(req.prompt)
+        return len(req.prompt) - min(length, len(req.prompt) - 1)
+
+    def _acquire_prefix(self, req):
+        """Counted, ref-taking lookup at admission time. Returns
+        (reused_tokens, entry-or-None); the ref is released at the
+        request's retirement (any path)."""
+        if self.prefix_cache is None:
+            return 0, None
+        length, entry = self.prefix_cache.acquire(req.prompt)
+        reuse = min(length, len(req.prompt) - 1)
+        if entry is not None and reuse <= 0:
+            self.prefix_cache.release(entry)
+            entry, reuse = None, 0
+        self.metrics.record_prefix_lookup(hit=reuse > 0)
+        return reuse, entry
+
+    def _maybe_insert_prefix(self, req, reuse, k, v, lane):
+        """Store the freshly-prefilled prompt's KV for future requests
+        (skipped when an existing entry already covers the whole prompt
+        — nothing new to add)."""
+        if self.prefix_cache is None:
+            return
+        n = len(req.prompt)
+        if reuse >= n - 1:
+            return
+        self.prefix_cache.insert(
+            req.prompt,
+            np.asarray(k[:, lane, :, :n]), np.asarray(v[:, lane, :, :n]))
+
+    # -- internals ------------------------------------------------------
+    def _activate(self, req, slot, first_tok):
         req.slot = slot
         self._active[slot] = req
         self._lane_tokens[slot] = first_tok
         self._lane_active[slot] = True
         self._emit(req, first_tok)
-        return self._maybe_retire(req, first_tok, time.monotonic())
 
     def _emit(self, req, token):
         req.emitted += 1
@@ -361,10 +582,17 @@ class ServingEngine:
             self._active.pop(req.slot, None)
             self.pool.free(req.slot)
             req.slot = None
+        if req.prefix_entry is not None and self.prefix_cache is not None:
+            self.prefix_cache.release(req.prefix_entry)
+            req.prefix_entry = None
 
     # -- introspection ---------------------------------------------------
     def occupancy(self):
         return self.pool.occupancy()
+
+    def prefix_stats(self):
+        """Prefix-cache counters, or None when the cache is disabled."""
+        return None if self.prefix_cache is None else self.prefix_cache.stats()
 
     @staticmethod
     def decode_compile_count():
@@ -374,5 +602,7 @@ class ServingEngine:
 
     @staticmethod
     def prefill_compile_count():
-        """Compiled prefill program count — bounded by the bucket ladder."""
-        return _prefill_request_jit._cache_size()
+        """Compiled prefill program count — bounded by the bucket ladder
+        (batched admission runs at the static pool width; chunked prefill
+        adds at most one B=1 program per chunk size)."""
+        return _prefill_batch_jit._cache_size()

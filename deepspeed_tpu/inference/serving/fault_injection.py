@@ -14,11 +14,18 @@ recovery paths are testable on CPU without real stragglers:
                     ONLY the per-request deadline can reap it
                     (exercises RequestTimeoutError recovery + slot
                     reclamation while neighbors keep decoding)
+    evict_under_decode
+                    forcibly evict every unreferenced prefix-cache entry
+                    right before the decode step at scheduler iteration
+                    N (cache churn under live traffic: in-flight lanes
+                    already copied their KV, so eviction must be
+                    output-invisible and later admissions simply miss)
 
-Arms take ``at_step``/``times`` like the step arms (``slow_decode``) or
-``request_id`` (``stuck_request``, persistent by default). Because the
-class sits at the bottom of the injector hierarchy, one spec may combine
-serving faults with step and I/O faults::
+Arms take ``at_step``/``times`` like the step arms (``slow_decode``,
+``evict_under_decode``) or ``request_id`` (``stuck_request``, persistent
+by default). Because the class sits at the bottom of the injector
+hierarchy, one spec may combine serving faults with step and I/O
+faults::
 
     {"slow_decode": {"at_step": 2, "seconds": 0.05},
      "stuck_request": {"request_id": 1}}
@@ -28,13 +35,14 @@ Programmatically::
     fi = ServingFaultInjector()
     fi.arm_serving("slow_decode", at_step=2, seconds=0.05)
     fi.arm_serving("stuck_request", request_id=1)
+    fi.arm_serving("evict_under_decode", at_step=3)
 """
 
 import time
 
 from deepspeed_tpu.runtime.resilience.fault_injection import StepFaultInjector
 
-SERVING_POINTS = ("slow_decode", "stuck_request")
+SERVING_POINTS = ("slow_decode", "stuck_request", "evict_under_decode")
 
 
 class _ServingArm:
@@ -88,6 +96,21 @@ class ServingFaultInjector(StepFaultInjector):
             arm.times -= 1
         self._fire("slow_decode")
         time.sleep(arm.seconds)
+
+    def maybe_evict_prefix(self, step, prefix_cache):
+        """Evict every unreferenced prefix-cache entry when the
+        evict_under_decode arm matches ``step`` (no-op without a cache)."""
+        arm = self._serving_arms.get("evict_under_decode")
+        if arm is None or prefix_cache is None:
+            return
+        if arm.at_step is not None and step != arm.at_step:
+            return
+        if arm.times is not None:
+            if arm.times <= 0:
+                return
+            arm.times -= 1
+        self._fire("evict_under_decode")
+        prefix_cache.evict_unreferenced()
 
     def request_is_stuck(self, request_id):
         """True while the stuck_request arm pins ``request_id`` (persistent

@@ -115,6 +115,14 @@ class KVCachePool:
         self.k, self.v = _install_slot_jit(self.k, self.v, new_k, new_v, slot)
         self.positions[slot] = position
 
+    def install_lane(self, batch_k, batch_v, lane, slot, position):
+        """Install lane ``lane`` of a BATCHED prefill result
+        ([L, B, nh, S_max, hd]) into ``slot``. Reuses the single-lane
+        install program (the lane slice is a static index, the slot stays
+        traced), so batched admission adds no install compiles."""
+        self.install(batch_k[:, lane:lane + 1], batch_v[:, lane:lane + 1],
+                     slot, position)
+
     def advance(self, slot):
         """Bump a slot's position after a decode step wrote its token.
         Clamped at the last cache index: a (injected-fault) runaway

@@ -1,14 +1,31 @@
-"""Serving metrics: tokens/s, TTFT, queue depth, batch occupancy.
+"""Serving metrics: tokens/s, TTFT percentiles, queue depth, occupancy,
+prefill-vs-decode split, prefix-cache hit rate.
 
 Recorded through the SAME ``monitor_from_config`` backends the training
 engines use (tensorboard/csv/both), so a serving deployment's dashboards
 come from the one construction path — a new monitor backend lights up
 here for free. All aggregation is host-side and O(1) per scheduler
-iteration; with no monitor configured the recorder is still useful as a
-cheap in-process stats object (``snapshot()``).
+iteration (TTFT percentiles sort a bounded sample window at
+``snapshot()`` time, not on the serving loop); with no monitor
+configured the recorder is still useful as a cheap in-process stats
+object (``snapshot()``).
 """
 
 import time
+
+# TTFT percentile window: newest samples win once full (a long-running
+# server's p95 should describe current traffic, not hour-old compiles).
+_TTFT_WINDOW = 8192
+
+
+def _percentile(sorted_samples, q):
+    """Nearest-rank percentile over an ascending list (deterministic, no
+    interpolation — matches how SLOs are usually stated)."""
+    if not sorted_samples:
+        return None
+    n = len(sorted_samples)
+    rank = max(1, -(-q * n // 100))              # ceil(q/100 * n)
+    return sorted_samples[min(int(rank), n) - 1]
 
 
 class ServingMetrics:
@@ -21,10 +38,22 @@ class ServingMetrics:
         self.requests_completed = 0
         self.requests_timed_out = 0
         self.decode_time_s = 0.0
+        # prefill: whole-prompt forwards (batched / chunked); ``tokens``
+        # counts positions actually computed, so prefix-cache reuse shows
+        # up as the gap between prompt tokens and prefill tokens
+        self.prefill_calls = 0
+        self.prefill_tokens = 0
+        self.prefill_reused_tokens = 0
+        self.prefill_time_s = 0.0
+        # prefix cache lookups (mirrors the cache's own counters so a
+        # snapshot works without reaching into the engine)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
         # TTFT: time from submit() to the request's first token
         self._ttft_sum = 0.0
         self._ttft_count = 0
         self._ttft_max = 0.0
+        self._ttft_window = []
         self._started = time.monotonic()
 
     # -- recording hooks (engine calls these) ---------------------------
@@ -32,7 +61,32 @@ class ServingMetrics:
         self._ttft_sum += ttft_s
         self._ttft_count += 1
         self._ttft_max = max(self._ttft_max, ttft_s)
+        if len(self._ttft_window) >= _TTFT_WINDOW:
+            self._ttft_window.pop(0)
+        self._ttft_window.append(ttft_s)
         self._record("Serving/ttft_s", ttft_s, self._ttft_count)
+
+    def record_prefill(self, tokens, reused_tokens, requests, prefill_s):
+        """One prefill call: ``tokens`` computed this call (suffix only
+        on a prefix hit), ``reused_tokens`` seeded from the prefix cache,
+        over ``requests`` prompts in ``prefill_s`` seconds."""
+        self.prefill_calls += 1
+        self.prefill_tokens += tokens
+        self.prefill_reused_tokens += reused_tokens
+        self.prefill_time_s += prefill_s
+        if prefill_s > 0:
+            self._record("Serving/prefill_tokens_per_sec",
+                         tokens / prefill_s, self.prefill_calls)
+        self._record("Serving/prefill_batch", requests, self.prefill_calls)
+
+    def record_prefix_lookup(self, hit):
+        if hit:
+            self.prefix_hits += 1
+        else:
+            self.prefix_misses += 1
+        lookups = self.prefix_hits + self.prefix_misses
+        self._record("Serving/PrefixHitRate",
+                     self.prefix_hits / lookups, lookups)
 
     def record_completion(self):
         self.requests_completed += 1
@@ -61,6 +115,11 @@ class ServingMetrics:
     def avg_ttft_s(self):
         return self._ttft_sum / self._ttft_count if self._ttft_count else None
 
+    def ttft_percentiles(self):
+        """(p50, p95) over the recent TTFT window, (None, None) empty."""
+        window = sorted(self._ttft_window)
+        return _percentile(window, 50), _percentile(window, 95)
+
     def tokens_per_sec(self):
         """Decode-loop throughput (excludes idle wall time between
         requests — the number a capacity planner wants)."""
@@ -68,7 +127,17 @@ class ServingMetrics:
             return None
         return self.tokens_emitted / self.decode_time_s
 
+    def prefill_tokens_per_sec(self):
+        if self.prefill_time_s <= 0:
+            return None
+        return self.prefill_tokens / self.prefill_time_s
+
+    def prefix_hit_rate(self):
+        lookups = self.prefix_hits + self.prefix_misses
+        return self.prefix_hits / lookups if lookups else None
+
     def snapshot(self):
+        p50, p95 = self.ttft_percentiles()
         return {
             "decode_steps": self.decode_steps,
             "tokens_emitted": self.tokens_emitted,
@@ -77,6 +146,16 @@ class ServingMetrics:
             "tokens_per_sec": self.tokens_per_sec(),
             "avg_ttft_s": self.avg_ttft_s(),
             "max_ttft_s": self._ttft_max if self._ttft_count else None,
+            "ttft_p50_s": p50,
+            "ttft_p95_s": p95,
+            # prefill-vs-decode token split: prompt positions computed by
+            # prefill forwards vs tokens emitted by the decode loop
+            "prefill_tokens": self.prefill_tokens,
+            "decode_tokens": self.tokens_emitted,
+            "prefill_calls": self.prefill_calls,
+            "prefill_tokens_per_sec": self.prefill_tokens_per_sec(),
+            "prefix_reused_tokens": self.prefill_reused_tokens,
+            "prefix_hit_rate": self.prefix_hit_rate(),
             "uptime_s": time.monotonic() - self._started,
         }
 

@@ -1,0 +1,209 @@
+"""Prefix KV cache: skip recomputing shared prompt prefixes entirely.
+
+Serving traffic repeats prompt *prefixes* — system prompts, few-shot
+preambles, multi-turn histories. Their keys/values are a pure function
+of the token prefix, so a request whose prompt starts with a
+previously-served prefix can seed its KV cache from memory and prefill
+only the suffix.
+
+Design (host-side, no jax):
+
+- a **token trie** indexes every stored prompt; lookup walks the query
+  prompt token by token and returns the LONGEST match against any
+  stored entry (a stored prompt's KV covers every prefix of itself —
+  the match slices ``entry.k[:, :, :match_len]``);
+- entries are **ref-counted**: the engine acquires a ref when a request
+  seeds from an entry and releases it at retirement (any path — EOS,
+  length, deadline, stuck-request reap), so eviction can never pull KV
+  out from under an in-flight admission;
+- **LRU eviction under a byte budget**: inserts evict
+  least-recently-used *unreferenced* entries until the new entry fits;
+  an entry that can never fit (bigger than the whole budget) is
+  rejected;
+- **hit/miss/evict counters** feed ``Serving/PrefixHitRate``.
+
+Entries hold NUMPY arrays (shape [L, nh, P, hd]): host RAM is the cheap
+pool, and the engine assembles the seeded device cache in one transfer
+per admission batch — a deliberate host-device copy traded against
+recomputing the prefix.
+"""
+
+import threading
+
+
+class PrefixEntry:
+    """One stored prompt's KV plus its bookkeeping."""
+
+    __slots__ = ("tokens", "k", "v", "nbytes", "refs", "last_used")
+
+    def __init__(self, tokens, k, v):
+        self.tokens = tokens                    # tuple[int]
+        self.k = k                              # np [L, nh, P, hd]
+        self.v = v
+        self.nbytes = int(k.nbytes) + int(v.nbytes)
+        self.refs = 0
+        self.last_used = 0
+
+
+class _Node:
+    __slots__ = ("children", "covering")
+
+    def __init__(self):
+        self.children = {}                      # token -> _Node
+        self.covering = set()                   # entries passing through
+
+
+class PrefixKVCache:
+    """Trie-indexed, ref-counted, byte-budgeted prompt-prefix KV store."""
+
+    def __init__(self, budget_bytes):
+        if budget_bytes < 1:
+            raise ValueError(
+                f"budget_bytes must be >= 1, got {budget_bytes}")
+        self.budget_bytes = int(budget_bytes)
+        self._root = _Node()
+        self._by_key = {}                       # tuple[int] -> PrefixEntry
+        self._lock = threading.Lock()
+        self._clock = 0
+        self.total_bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.insert_rejections = 0
+
+    # -- lookup ----------------------------------------------------------
+    def match(self, tokens):
+        """Longest stored prefix of ``tokens``: (match_len, entry) or
+        (0, None). Pure — no counters, no refs (grouping decisions call
+        this; ``acquire`` is the counted path)."""
+        with self._lock:
+            return self._match_locked(tokens)
+
+    def _match_locked(self, tokens):
+        node, depth, best = self._root, 0, (0, None)
+        for tok in tokens:
+            node = node.children.get(int(tok))
+            if node is None:
+                break
+            depth += 1
+            if node.covering:
+                # MRU entry covering this depth (any of them has
+                # identical KV for positions < depth)
+                best = (depth, max(node.covering, key=lambda e: e.last_used))
+        return best
+
+    def acquire(self, tokens):
+        """Counted lookup: returns (match_len, entry) and takes a ref on
+        the entry so eviction cannot reclaim it while the requester is in
+        flight. Release with ``release(entry)``."""
+        with self._lock:
+            length, entry = self._match_locked(tokens)
+            if entry is None:
+                self.misses += 1
+                return 0, None
+            self.hits += 1
+            entry.refs += 1
+            self._touch(entry)
+            return length, entry
+
+    def release(self, entry):
+        with self._lock:
+            if entry.refs < 1:
+                raise ValueError("release() without a matching acquire()")
+            entry.refs -= 1
+
+    # -- insert / evict --------------------------------------------------
+    def insert(self, tokens, k, v):
+        """Store ``tokens``' KV ([L, nh, len(tokens), hd] numpy pair).
+        Returns the entry, the existing entry when the exact prompt is
+        already stored, or None when it cannot fit even after evicting
+        every unreferenced entry."""
+        key = tuple(int(t) for t in tokens)
+        if not key:
+            raise ValueError("cannot insert an empty prefix")
+        with self._lock:
+            existing = self._by_key.get(key)
+            if existing is not None:
+                self._touch(existing)
+                return existing
+            entry = PrefixEntry(key, k, v)
+            if entry.nbytes > self.budget_bytes:
+                self.insert_rejections += 1
+                return None
+            if not self._make_room_locked(entry.nbytes):
+                self.insert_rejections += 1
+                return None
+            node = self._root
+            for tok in key:
+                node = node.children.setdefault(tok, _Node())
+                node.covering.add(entry)
+            self._by_key[key] = entry
+            self.total_bytes += entry.nbytes
+            self._touch(entry)
+            return entry
+
+    def _make_room_locked(self, need):
+        """Evict LRU unreferenced entries until ``need`` bytes fit."""
+        while self.total_bytes + need > self.budget_bytes:
+            victims = [e for e in self._by_key.values() if e.refs == 0]
+            if not victims:
+                return False
+            self._evict_locked(min(victims, key=lambda e: e.last_used))
+        return True
+
+    def _evict_locked(self, entry):
+        del self._by_key[entry.tokens]
+        self.total_bytes -= entry.nbytes
+        node, path = self._root, []
+        for tok in entry.tokens:
+            node = node.children[tok]
+            node.covering.discard(entry)
+            path.append((tok, node))
+        # prune now-dead trie branches (leaf upward)
+        for (tok, node), (_, parent) in zip(
+                reversed(path), reversed([(None, self._root)] + path[:-1])):
+            if not node.covering and not node.children:
+                del parent.children[tok]
+        self.evictions += 1
+
+    def evict_unreferenced(self):
+        """Drop every unreferenced entry (the ``evict_under_decode``
+        fault arm — in-flight lanes already copied their KV, so this must
+        be output-invisible). Returns how many were evicted."""
+        with self._lock:
+            victims = [e for e in self._by_key.values() if e.refs == 0]
+            for e in victims:
+                self._evict_locked(e)
+            return len(victims)
+
+    def _touch(self, entry):
+        self._clock += 1
+        entry.last_used = self._clock
+
+    # -- stats -----------------------------------------------------------
+    def hit_rate(self):
+        lookups = self.hits + self.misses
+        return self.hits / lookups if lookups else 0.0
+
+    @property
+    def referenced(self):
+        with self._lock:
+            return sum(1 for e in self._by_key.values() if e.refs > 0)
+
+    def __len__(self):
+        return len(self._by_key)
+
+    def stats(self):
+        with self._lock:
+            return {
+                "entries": len(self._by_key),
+                "bytes": self.total_bytes,
+                "budget_bytes": self.budget_bytes,
+                "referenced": sum(
+                    1 for e in self._by_key.values() if e.refs > 0),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "insert_rejections": self.insert_rejections,
+                "hit_rate": self.hit_rate(),
+            }

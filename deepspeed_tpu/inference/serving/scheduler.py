@@ -40,7 +40,7 @@ class RequestTimeoutError(TimeoutError):
     def __init__(self, request_id, timeout_s, phase, tokens_done=0):
         self.request_id = request_id
         self.timeout_s = timeout_s
-        self.phase = phase          # "queued" | "decoding"
+        self.phase = phase          # "queued" | "prefill" | "decoding"
         self.tokens_done = tokens_done
         super().__init__(
             f"request {request_id} exceeded its {timeout_s}s deadline "
@@ -123,6 +123,7 @@ class Request:
         self.first_token_time = None            # TTFT endpoint
         self.slot = None
         self.emitted = 0
+        self.prefix_entry = None                # held prefix-cache ref
 
     def deadline_exceeded(self, now):
         return (self.timeout_s is not None
@@ -190,6 +191,25 @@ class ContinuousBatchingScheduler:
         """Next request to admit (FIFO), or None."""
         with self._lock:
             return self._queue.popleft() if self._queue else None
+
+    def pop_matching(self, pred, max_n):
+        """Pop up to ``max_n`` queued requests satisfying ``pred``,
+        preserving FIFO order among them; non-matching requests keep
+        their queue positions. The engine's batched-per-bucket prefill
+        admission uses this to group same-bucket prompts into one
+        prefill call."""
+        if max_n < 1:
+            return []
+        taken = []
+        with self._lock:
+            keep = deque()
+            for req in self._queue:
+                if len(taken) < max_n and pred(req):
+                    taken.append(req)
+                else:
+                    keep.append(req)
+            self._queue = keep
+        return taken
 
     def requeue_front(self, req):
         """Put an admitted-but-unplaced request back at the head (e.g. the

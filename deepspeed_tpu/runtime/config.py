@@ -526,6 +526,24 @@ def get_serving_config(param_dict):
             f"serving.{SERVING_REQUEST_TIMEOUT} must be >= 0 "
             f"(0 disables per-request deadlines), got {request_timeout_s!r}"
         )
+    prefill_chunk = get_scalar_param(
+        params, SERVING_PREFILL_CHUNK_TOKENS, SERVING_PREFILL_CHUNK_TOKENS_DEFAULT
+    )
+    if (not isinstance(prefill_chunk, int) or isinstance(prefill_chunk, bool)
+            or prefill_chunk < 0):
+        raise ValueError(
+            f"serving.{SERVING_PREFILL_CHUNK_TOKENS} must be an int >= 0 "
+            f"(0 disables chunked prefill), got {prefill_chunk!r}"
+        )
+    prefix_cache_mb = get_scalar_param(
+        params, SERVING_PREFIX_CACHE_MB, SERVING_PREFIX_CACHE_MB_DEFAULT
+    )
+    if not isinstance(prefix_cache_mb, (int, float)) or isinstance(
+            prefix_cache_mb, bool) or prefix_cache_mb < 0:
+        raise ValueError(
+            f"serving.{SERVING_PREFIX_CACHE_MB} must be a number >= 0 "
+            f"(0 disables the prefix KV cache), got {prefix_cache_mb!r}"
+        )
     fault_injection = params.get(SERVING_FAULT_INJECTION, None)
     if fault_injection is not None and not isinstance(fault_injection, dict):
         raise ValueError(
@@ -540,6 +558,8 @@ def get_serving_config(param_dict):
         prompt_buckets=buckets,
         default_max_new_tokens=default_max_new,
         request_timeout_s=float(request_timeout_s),
+        prefill_chunk_tokens=prefill_chunk,
+        prefix_cache_mb=float(prefix_cache_mb),
         fault_injection=fault_injection,
     )
 

@@ -220,6 +220,10 @@ SERVING_DEFAULT_MAX_NEW_TOKENS = "default_max_new_tokens"
 SERVING_DEFAULT_MAX_NEW_TOKENS_DEFAULT = 64
 SERVING_REQUEST_TIMEOUT = "request_timeout_s"
 SERVING_REQUEST_TIMEOUT_DEFAULT = 0.0  # 0 = no per-request deadline
+SERVING_PREFILL_CHUNK_TOKENS = "prefill_chunk_tokens"
+SERVING_PREFILL_CHUNK_TOKENS_DEFAULT = 0  # 0 = always single-pass prefill
+SERVING_PREFIX_CACHE_MB = "prefix_cache_mb"
+SERVING_PREFIX_CACHE_MB_DEFAULT = 0.0  # 0 = prefix KV cache disabled
 SERVING_FAULT_INJECTION = "fault_injection"
 
 #############################################

@@ -15,6 +15,14 @@ from deepspeed_tpu.inference.beam import beam_search
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadModel, init_gpt2
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _drop_jit_caches():
+    # Beam tests compile per-(beam, length) programs; drop them once the
+    # module is done so later suite compiles stay fast.
+    yield
+    jax.clear_caches()
+
+
 def _tiny(vocab=16):
     cfg = GPT2Config(
         vocab_size=vocab, hidden_size=32, num_hidden_layers=2,

@@ -14,6 +14,15 @@ from deepspeed_tpu.inference import generate
 from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _drop_jit_caches():
+    # The scan-vs-full parity matrix compiles one program per prompt
+    # length; keeping them cached for the rest of the suite slows every
+    # later compile (XLA CPU compile time grows with live executables).
+    yield
+    jax.clear_caches()
+
+
 def _tiny_config():
     return GPT2Config(
         vocab_size=64, hidden_size=32, num_hidden_layers=2,
@@ -215,3 +224,93 @@ def test_generate_bf16_params():
     s = generate(bf16_params, cfg, prompt, 4, temperature=0.9,
                  rng=jax.random.PRNGKey(1), top_k=8)
     assert s.shape == (2, 4)
+
+
+# -- single-pass prefill vs the scan reference -------------------------------
+
+def _prefill_parity(params, cfg, length, bucket, total=32):
+    """Run the scan reference and the single-pass prefill on one prompt;
+    return (greedy_ref, greedy_full, caches_ref, caches_full, S)."""
+    from deepspeed_tpu.inference.generation import _forward_full, _prefill
+
+    n_layers = cfg.num_hidden_layers
+    n_heads = cfg.num_attention_heads
+    head_dim = cfg.hidden_size // n_heads
+    rng = np.random.RandomState(length * 31 + bucket)
+    prompt = rng.randint(0, cfg.vocab_size, (length,)).tolist()
+
+    ids = jnp.asarray([prompt], jnp.int32)
+    caches_ref, logits_ref = _prefill(params, ids, n_layers, n_heads,
+                                      head_dim, total)
+    padded = np.zeros((1, bucket), np.int32)
+    padded[0, :length] = prompt
+    caches_full, logits_full = _forward_full(
+        params, jnp.asarray(padded), length, n_layers, n_heads, head_dim,
+        total)
+    greedy_ref = int(jnp.argmax(logits_ref, axis=-1)[0])
+    greedy_full = int(jnp.argmax(logits_full, axis=-1)[0])
+    return greedy_ref, greedy_full, caches_ref, caches_full
+
+
+@pytest.mark.parametrize("bucket", [8, 16, 31])   # default_buckets(31)
+def test_full_prefill_parity_every_bucket(bucket):
+    """The tentpole contract: for every default bucket, single-pass
+    prefill of a padded prompt yields the BITWISE-identical greedy token
+    and allclose KV vs the token-by-token scan reference — including odd
+    (non-power-of-two) prompt lengths."""
+    cfg = _tiny_config()
+    _, params = init_gpt2(cfg, batch_size=1, seq_len=4, seed=0)
+    # short prompts padded far up the bucket, plus odd lengths near the top
+    lengths = {8: (1, 3, 5, 7, 8), 16: (3, 9, 13, 16), 31: (3, 17, 29, 31)}
+    for length in lengths[bucket]:
+        g_ref, g_full, c_ref, c_full = _prefill_parity(
+            params, cfg, length, bucket)
+        assert g_ref == g_full, (bucket, length)
+        for ref, full in zip(c_ref, c_full):
+            np.testing.assert_allclose(
+                np.asarray(ref)[:, :, :, :length],
+                np.asarray(full)[:, :, :, :length],
+                rtol=1e-5, atol=1e-6, err_msg=f"bucket={bucket} S={length}")
+
+
+def test_full_prefill_parity_int8():
+    """Same parity under int8 weight-only quantization (dequant happens
+    inside both paths, so the compared math is still f32)."""
+    from deepspeed_tpu.inference import quantize_for_decode
+
+    cfg = _tiny_config()
+    _, params = init_gpt2(cfg, batch_size=1, seq_len=4, seed=9)
+    qparams = quantize_for_decode(params)
+    for length, bucket in ((3, 8), (7, 8), (11, 16)):
+        g_ref, g_full, c_ref, c_full = _prefill_parity(
+            qparams, cfg, length, bucket)
+        assert g_ref == g_full, (bucket, length)
+        for ref, full in zip(c_ref, c_full):
+            np.testing.assert_allclose(
+                np.asarray(ref)[:, :, :, :length],
+                np.asarray(full)[:, :, :, :length],
+                rtol=1e-5, atol=1e-6)
+
+
+def test_full_prefill_greedy_generation_bitwise():
+    """End-to-end: multi-token greedy generate() (which prefills via
+    _forward_full) equals a manual scan-prefill + decode replay."""
+    from deepspeed_tpu.inference.generation import _prefill, _step
+
+    cfg = _tiny_config()
+    _, params = init_gpt2(cfg, batch_size=2, seq_len=4, seed=0)
+    rng = np.random.RandomState(5)
+    prompt = jnp.asarray(rng.randint(0, cfg.vocab_size, (2, 5)), jnp.int32)
+    n_new = 6
+    got = np.asarray(generate(params, cfg, prompt, n_new))
+
+    n_heads = cfg.num_attention_heads
+    head_dim = cfg.hidden_size // n_heads
+    caches, logits = _prefill(params, prompt, cfg.num_hidden_layers,
+                              n_heads, head_dim, 5 + n_new)
+    toks = [jnp.argmax(logits, axis=-1).astype(jnp.int32)]
+    for i in range(n_new - 1):
+        logits, caches = _step(params, n_heads, caches, toks[-1], 5 + i)
+        toks.append(jnp.argmax(logits, axis=-1).astype(jnp.int32))
+    want = np.stack([np.asarray(t) for t in toks], axis=1)
+    np.testing.assert_array_equal(got, want)

@@ -15,6 +15,7 @@ import time
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.inference import generate
@@ -38,6 +39,14 @@ def _tiny_config():
         vocab_size=64, hidden_size=32, num_hidden_layers=2,
         num_attention_heads=4, max_position_embeddings=32,
         hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _drop_jit_caches():
+    # Oracle replays compile per-engine prefill/decode programs; drop
+    # them once the module is done so later suite compiles stay fast.
+    yield
+    jax.clear_caches()
 
 
 @pytest.fixture(scope="module")
@@ -428,3 +437,239 @@ def test_metrics_snapshot(model):
     assert snap["requests_completed"] == 2 and snap["requests_timed_out"] == 0
     assert snap["avg_ttft_s"] > 0 and snap["tokens_per_sec"] > 0
     assert snap["decode_steps"] > 0 and snap["tokens_emitted"] >= 6
+
+
+# -- batched prefill admission ----------------------------------------------
+
+def test_batched_admission_one_prefill_call(model):
+    """Same-bucket requests queued together prefill as ONE call: the
+    whole group shares a single [MaxSlots, Sb] forward."""
+    cfg, params = model
+    eng = _engine(cfg, params, max_slots=3)
+    prompts = _prompts(3, lengths=(3, 4, 2))     # all bucket 4
+    wants = [_oneshot(cfg, params, p, 4) for p in prompts]
+    futs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.step()                                   # one admission pass
+    assert eng.metrics.prefill_calls == 1        # grouped, not per-request
+    eng.drain(max_steps=100)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+
+
+def test_recompile_pin_varying_group_size(model):
+    """The prefill batch dimension is padded to the static MaxSlots:
+    admission groups of 1, 2, and 3 same-bucket requests must all share
+    one compiled program."""
+    cfg, params = model
+    eng = _engine(cfg, params, max_slots=3)
+    prefill0 = ServingEngine.prefill_compile_count()
+    for group in (1, 3, 2):
+        prompts = _prompts(group, lengths=(3, 4, 2))
+        wants = [_oneshot(cfg, params, p, 3) for p in prompts]
+        futs = [eng.submit(p, max_new_tokens=3) for p in prompts]
+        eng.drain(max_steps=100)
+        for f, want in zip(futs, wants):
+            assert f.result(timeout=1) == want
+    assert ServingEngine.prefill_compile_count() - prefill0 <= 1
+
+
+# -- chunked prefill --------------------------------------------------------
+
+def test_chunked_prefill_oracle_and_interleaving(model):
+    """A long prompt prefills in chunks interleaved with decode steps:
+    the in-flight short request keeps emitting tokens while the long
+    prompt progresses, and both finish bitwise-correct."""
+    cfg, params = model
+    eng = _engine(cfg, params, prefill_chunk_tokens=3)
+    short, long_p = _prompts(2, lengths=(3, 8))
+    want_short = _oneshot(cfg, params, short, 8)
+    want_long = _oneshot(cfg, params, long_p, 4)
+
+    f_short = eng.submit(short, max_new_tokens=8)
+    eng.step()                                   # short admitted, decoding
+    f_long = eng.submit(long_p, max_new_tokens=4)
+    chunk_steps = decode_during_chunks = 0
+    while not f_long.done():
+        stats = eng.step()
+        if stats["prefill_chunks"]:
+            chunk_steps += stats["prefill_chunks"]
+            decode_during_chunks += stats["decoded"]
+        assert stats["prefill_chunks"] <= 1      # one chunk per step
+    eng.drain(max_steps=100)
+    assert chunk_steps == 3                      # ceil(8 / 3)
+    assert decode_during_chunks >= 1             # decode ran BETWEEN chunks
+    assert f_short.result(timeout=1) == want_short
+    assert f_long.result(timeout=1) == want_long
+
+
+def test_chunked_prefill_compile_bounded(model):
+    """Chunked prefill adds at most ONE compiled program (B=1, Sb=chunk)
+    regardless of how many long prompts stream through."""
+    cfg, params = model
+    eng = _engine(cfg, params, prefill_chunk_tokens=3)
+    prefill0 = ServingEngine.prefill_compile_count()
+    for p in _prompts(3, lengths=(8, 7, 8)):
+        fut = eng.submit(p, max_new_tokens=3)
+        eng.drain(max_steps=100)
+        assert fut.result(timeout=1) == _oneshot(cfg, params, p, 3)
+    assert ServingEngine.prefill_compile_count() - prefill0 <= 1
+
+
+def test_chunked_prefill_deadline_aborts_with_prefill_phase(model):
+    cfg, params = model
+    eng = _engine(cfg, params, prefill_chunk_tokens=2)
+    doomed = eng.submit(_prompts(1, lengths=(8,))[0], max_new_tokens=4,
+                        timeout_s=60.0)
+    eng.step()                                   # chunked prefill started
+    assert eng._chunking is not None
+    eng._chunking.req.timeout_s = 1e-6           # expire it mid-prefill
+    eng.drain(max_steps=100)
+    with pytest.raises(RequestTimeoutError) as ei:
+        doomed.result(timeout=1)
+    assert ei.value.phase == "prefill" and ei.value.tokens_done == 0
+    assert eng.occupancy()["in_use"] == 0        # reserved slot reclaimed
+
+
+# -- prefix KV cache --------------------------------------------------------
+
+def _shared_prefix_prompts(n, prefix_len=5):
+    rng = np.random.RandomState(11)
+    prefix = rng.randint(0, 64, (prefix_len,)).tolist()
+    return [prefix + rng.randint(0, 64, (1 + i % 3,)).tolist()
+            for i in range(n)]
+
+
+@pytest.mark.parametrize("schedule", ["upfront", "mid_decode", "staggered"])
+def test_oracle_with_prefix_cache(model, schedule):
+    """The bitwise oracle holds with the prefix cache ON, under every
+    arrival schedule: seeding KV from a stored prefix must be invisible
+    to the emitted tokens."""
+    cfg, params = model
+    eng = _engine(cfg, params, max_slots=2, prefix_cache_mb=4.0)
+    prompts = _shared_prefix_prompts(5)
+    wants = [_oneshot(cfg, params, p, 5) for p in prompts]
+
+    if schedule == "upfront":
+        futs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+    elif schedule == "mid_decode":
+        futs = [eng.submit(p, max_new_tokens=5) for p in prompts[:2]]
+        eng.step()
+        eng.step()
+        futs += [eng.submit(p, max_new_tokens=5) for p in prompts[2:]]
+    else:                                        # staggered retirement
+        futs = [eng.submit(p, max_new_tokens=5) for p in prompts[:2]]
+        eng.drain(max_steps=100)                 # retire the first wave
+        futs += [eng.submit(p, max_new_tokens=5) for p in prompts[2:]]
+    eng.drain(max_steps=200)
+
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+    stats = eng.prefix_stats()
+    assert stats["hits"] >= 1                    # later prompts reused KV
+    assert stats["referenced"] == 0              # every ref released
+    assert eng.metrics.prefix_hit_rate() > 0
+
+
+def test_prefix_cache_recompile_pin(model):
+    """Prefix-cache hits reuse the SAME compiled prefill program: the
+    seeded cache and per-lane start offsets are traced operands."""
+    cfg, params = model
+    eng = _engine(cfg, params, prefix_cache_mb=4.0)
+    prefill0 = ServingEngine.prefill_compile_count()
+    prompts = _shared_prefix_prompts(4)
+    for p in prompts:                            # serial: every later one hits
+        fut = eng.submit(p, max_new_tokens=3)
+        eng.drain(max_steps=100)
+        assert fut.result(timeout=1) == _oneshot(cfg, params, p, 3)
+    assert eng.prefix_stats()["hits"] >= 2
+    assert ServingEngine.prefill_compile_count() - prefill0 <= 2  # |buckets|
+
+
+def test_prefix_refs_released_after_stuck_reap(model):
+    """A stuck request holding a prefix-cache ref is reaped by its
+    deadline; the reap must release the ref (no leak after drain)."""
+    cfg, params = model
+    fi = ServingFaultInjector()
+    fi.arm_serving("stuck_request", request_id=1)
+    eng = ServingEngine(params, cfg, ServingConfig(
+        max_slots=2, max_queue=8, max_seq_len=32, prompt_buckets=(4, 8),
+        prefix_cache_mb=4.0), injector=fi)
+    prompts = _shared_prefix_prompts(2)
+    seed = eng.submit(prompts[0], max_new_tokens=2)          # id 0: inserts
+    eng.drain(max_steps=100)
+    seed.result(timeout=1)
+    stuck = eng.submit(prompts[1], max_new_tokens=2, timeout_s=0.3)  # id 1: hits
+    eng.drain(max_steps=5000)
+    with pytest.raises(RequestTimeoutError):
+        stuck.result(timeout=1)
+    assert eng.prefix_stats()["hits"] >= 1
+    assert eng.prefix_stats()["referenced"] == 0             # ref released
+    assert eng.occupancy()["in_use"] == 0
+
+
+@pytest.mark.faults
+def test_evict_under_decode_preserves_output(model):
+    """The evict_under_decode arm drops every unreferenced prefix entry
+    mid-serve: in-flight lanes already copied their KV, so outputs stay
+    bitwise-correct and later admissions simply miss."""
+    cfg, params = model
+    fi = ServingFaultInjector({"evict_under_decode": {"at_step": 1}})
+    eng = ServingEngine(params, cfg, ServingConfig(
+        max_slots=2, max_queue=8, max_seq_len=32, prompt_buckets=(4, 8),
+        prefix_cache_mb=4.0), injector=fi)
+    prompts = _shared_prefix_prompts(3)
+    wants = [_oneshot(cfg, params, p, 5) for p in prompts]
+    futs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+    eng.drain(max_steps=200)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+    assert fi.fired["evict_under_decode"] >= 1
+    assert eng.prefix_stats()["evictions"] >= 1
+
+
+# -- new config keys --------------------------------------------------------
+
+def test_prefill_config_block_validated():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    base = {"train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": 1}
+    on = DeepSpeedConfig(
+        dict(base, serving={"prefill_chunk_tokens": 16,
+                            "prefix_cache_mb": 2.5}), world_size=1)
+    assert on.serving_config.prefill_chunk_tokens == 16
+    assert on.serving_config.prefix_cache_mb == 2.5
+    off = DeepSpeedConfig(dict(base, serving={}), world_size=1)
+    assert off.serving_config.prefill_chunk_tokens == 0
+    assert off.serving_config.prefix_cache_mb == 0.0
+    for bad in ({"prefill_chunk_tokens": -1}, {"prefill_chunk_tokens": 2.5},
+                {"prefix_cache_mb": -0.5}, {"prefix_cache_mb": "big"}):
+        with pytest.raises(ValueError):
+            DeepSpeedConfig(dict(base, serving=bad), world_size=1)
+
+
+def test_engine_rejects_bad_prefill_config(model):
+    cfg, params = model
+    with pytest.raises(ValueError):
+        _engine(cfg, params, prefill_chunk_tokens=-1)
+    with pytest.raises(ValueError):
+        _engine(cfg, params, prefix_cache_mb=-1.0)
+    assert _engine(cfg, params).prefix_cache is None         # 0 = disabled
+    assert _engine(cfg, params).prefix_stats() is None
+
+
+def test_metrics_snapshot_prefill_keys(model):
+    cfg, params = model
+    eng = _engine(cfg, params, prefix_cache_mb=4.0)
+    prompts = _shared_prefix_prompts(3)
+    futs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.drain(max_steps=100)
+    for f in futs:
+        f.result(timeout=1)
+    snap = eng.metrics.snapshot()
+    assert snap["ttft_p50_s"] > 0 and snap["ttft_p95_s"] >= snap["ttft_p50_s"]
+    assert snap["prefill_tokens"] >= sum(len(p) for p in prompts) - \
+        snap["prefix_reused_tokens"]
+    assert snap["decode_tokens"] == snap["tokens_emitted"]
+    assert snap["prefill_calls"] >= 1
+    assert snap["prefill_tokens_per_sec"] > 0
+    assert snap["prefix_hit_rate"] is not None
