@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving ops bench bench-serving
+.PHONY: test test-fast test-faults test-cluster test-serving lint-jax lint-jax-baseline ops bench bench-serving
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -27,6 +27,18 @@ test-cluster:
 # backpressure/deadline/fault-injection recovery.
 test-serving:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_serving.py tests/unit/test_prefix_cache.py -q
+
+# Static JAX hazard analysis (tools/jaxlint): recompile, host-sync,
+# leaked-tracer, donation and fp16-dtype rules. AST-only — no jax import,
+# finishes in seconds. Fails on any finding not in jaxlint_baseline.json
+# (see docs/static_analysis.md for rules, suppressions, and the workflow).
+lint-jax:
+	python -m tools.jaxlint deepspeed_tpu tools --baseline jaxlint_baseline.json
+
+# Regenerate the baseline after intentionally fixing findings (shrinking it).
+# Never use this to absorb NEW findings — fix or suppress them with a reason.
+lint-jax-baseline:
+	python -m tools.jaxlint deepspeed_tpu tools --baseline jaxlint_baseline.json --write-baseline
 
 ops:
 	$(MAKE) -C csrc

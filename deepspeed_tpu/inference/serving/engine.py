@@ -46,6 +46,7 @@ work.
 
 import threading
 import time
+from contextlib import nullcontext
 from functools import partial
 
 import numpy as np
@@ -54,6 +55,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.inference.generation import _forward_chunk, _ln, _step
+from deepspeed_tpu.profiling.sentinels import CompileSentinel, transfer_free
 from deepspeed_tpu.inference.quantization import logits_table
 from deepspeed_tpu.inference.serving.config import ServingConfig
 from deepspeed_tpu.inference.serving.fault_injection import ServingFaultInjector
@@ -95,7 +97,7 @@ def _prefill_batch_jit(params, init_k, init_v, padded_ids, starts, true_lens,
     return k, v, first
 
 
-@partial(jax.jit, static_argnames=("n_heads",), donate_argnums=(1, 2))
+@partial(jax.jit, static_argnames=("n_heads",), donate_argnums=(1, 2, 3, 4))
 def _decode_step_jit(params, pool_k, pool_v, tokens, positions, active, *,
                      n_heads):
     """One masked batched decode step over every pool lane.
@@ -103,8 +105,10 @@ def _decode_step_jit(params, pool_k, pool_v, tokens, positions, active, *,
     Each lane feeds its last token at its own position through the
     one-shot path's ``_step`` (vmapped as a B=1 lane). Inactive lanes
     compute garbage into their own (dead) lane and keep their token via
-    the ``active`` mask; the pool buffers are donated — the step is an
-    in-place update of the serving state."""
+    the ``active`` mask; pool buffers, tokens and positions are donated —
+    the step is an in-place update of device-resident serving state, and
+    active lanes advance their position counter HERE, so steady-state
+    decode needs no per-step host->device upload at all."""
 
     def lane(ck, cv, tok, pos):
         logits, (ck2, cv2) = _step(params, n_heads, (ck[:, None], cv[:, None]),
@@ -115,7 +119,9 @@ def _decode_step_jit(params, pool_k, pool_v, tokens, positions, active, *,
         lane, in_axes=(1, 1, 0, 0), out_axes=(0, 1, 1))(
         pool_k, pool_v, tokens, positions)
     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jnp.where(active, nxt, tokens), pool_k, pool_v
+    tokens = jnp.where(active, nxt, tokens)
+    positions = jnp.where(active, positions + 1, positions)
+    return tokens, positions, pool_k, pool_v
 
 
 class _ChunkedPrefill:
@@ -143,7 +149,7 @@ class ServingEngine:
     with ``submit()`` from any thread."""
 
     def __init__(self, params, model_config, serving_config=None,
-                 monitor=None, injector=None):
+                 monitor=None, injector=None, sentinel_config=None):
         cfg = serving_config or ServingConfig()
         self.params = params
         self.model_config = model_config
@@ -193,6 +199,24 @@ class ServingEngine:
         self._active = {}                                   # slot -> Request
         self._lane_tokens = np.zeros(cfg.max_slots, np.int32)
         self._lane_active = np.zeros(cfg.max_slots, bool)
+        # device-resident decode operands: uploaded ONLY on lane churn
+        # (_lane_dirty), advanced in-jit otherwise — steady-state decode
+        # performs exactly one explicit transfer per step (the EOS read)
+        self._dev_tokens = None
+        self._dev_positions = None
+        self._dev_active = None
+        self._lane_dirty = True
+        if sentinel_config is not None and sentinel_config.enabled:
+            budget = sentinel_config.compile_budget
+            self.decode_sentinel = CompileSentinel(
+                _decode_step_jit, budget, name="serving decode step")
+            self.prefill_sentinel = CompileSentinel(
+                _prefill_batch_jit, budget, name="serving batched prefill")
+            self._transfer_guard = bool(sentinel_config.transfer_guard)
+        else:
+            self.decode_sentinel = None
+            self.prefill_sentinel = None
+            self._transfer_guard = False
         # batched prefill always runs at the pool width: the batch dim is
         # STATIC, so any admission-group size shares one program per bucket
         self._prefill_batch = cfg.max_slots
@@ -214,7 +238,8 @@ class ServingEngine:
         return cls(params, model_config,
                    serving_config=ds_config.serving_config,
                    monitor=monitor_from_config(ds_config, rank),
-                   injector=injector)
+                   injector=injector,
+                   sentinel_config=ds_config.sentinel_config)
 
     # -- request intake -------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=None, eos_token_id=None,
@@ -278,23 +303,38 @@ class ServingEngine:
             if self.injector is not None:
                 self.injector.maybe_slow_decode(self._step_count)
             t0 = time.monotonic()
-            tokens, self.pool.k, self.pool.v = _decode_step_jit(
-                self.params, self.pool.k, self.pool.v,
-                jnp.asarray(self._lane_tokens),
-                jnp.asarray(self.pool.positions),
-                jnp.asarray(self._lane_active),
-                n_heads=self.n_heads)
-            host_tokens = np.asarray(tokens)       # sync point: EOS checks
+            if self._lane_dirty:
+                # lane churn: ONE explicit upload of the lane vectors;
+                # between churn events they live on device and never move
+                self._dev_tokens, self._dev_positions, self._dev_active = \
+                    jax.device_put(  # jaxlint: disable=JL002(churn-only explicit upload)
+                        (self._lane_tokens,
+                         np.ascontiguousarray(self.pool.positions,
+                                              dtype=np.int32),
+                         self._lane_active))
+                self._lane_dirty = False
+            guard = transfer_free() if self._transfer_guard else nullcontext()
+            with guard:
+                (self._dev_tokens, self._dev_positions,
+                 self.pool.k, self.pool.v) = _decode_step_jit(
+                    self.params, self.pool.k, self.pool.v,
+                    self._dev_tokens, self._dev_positions, self._dev_active,
+                    n_heads=self.n_heads)
+            if self.decode_sentinel is not None:
+                self.decode_sentinel.check()
+            # the step's single deliberate sync: EOS checks need the tokens
+            host_tokens = jax.device_get(self._dev_tokens)  # jaxlint: disable=JL002(one explicit host read per step)
             step_s = time.monotonic() - t0
             self._lane_tokens = host_tokens.copy()
+            toks = host_tokens.tolist()
             now = time.monotonic()
             n_active = len(self._active)
             for slot in list(self._active):
                 req = self._active[slot]
                 self.pool.advance(slot)
-                self._emit(req, int(host_tokens[slot]))
+                self._emit(req, toks[slot])
                 stats["decoded"] += 1
-                stats["retired"] += self._maybe_retire(req, int(host_tokens[slot]), now)
+                stats["retired"] += self._maybe_retire(req, toks[slot], now)
             self.metrics.record_step(
                 queue_depth=self.scheduler.queue_depth(),
                 active_slots=n_active, max_slots=self.pool.max_slots,
@@ -409,6 +449,8 @@ class ServingEngine:
         k, v, first = _prefill_batch_jit(
             self.params, init_k, init_v, jnp.asarray(ids),
             jnp.asarray(starts), jnp.asarray(lens), n_heads=self.n_heads)
+        if self.prefill_sentinel is not None:
+            self.prefill_sentinel.check()
         first_host = np.asarray(first)             # sync: TTFT endpoint
         prefill_s = time.monotonic() - t0
         self.metrics.record_prefill(
@@ -482,6 +524,8 @@ class ServingEngine:
             self.params, st.k, st.v, jnp.asarray(ids),
             jnp.asarray([st.pos], jnp.int32),
             jnp.asarray([len(req.prompt)], jnp.int32), n_heads=self.n_heads)
+        if self.prefill_sentinel is not None:
+            self.prefill_sentinel.check()
         st.pos += len(chunk)
         stats["prefill_chunks"] += 1
         if st.pos < len(req.prompt):
@@ -544,6 +588,7 @@ class ServingEngine:
         self._active[slot] = req
         self._lane_tokens[slot] = first_tok
         self._lane_active[slot] = True
+        self._lane_dirty = True
         self._emit(req, first_tok)
 
     def _emit(self, req, token):
@@ -579,6 +624,7 @@ class ServingEngine:
     def _release_slot(self, req):
         if req.slot is not None:
             self._lane_active[req.slot] = False
+            self._lane_dirty = True
             self._active.pop(req.slot, None)
             self.pool.free(req.slot)
             req.slot = None
@@ -593,16 +639,3 @@ class ServingEngine:
     def prefix_stats(self):
         """Prefix-cache counters, or None when the cache is disabled."""
         return None if self.prefix_cache is None else self.prefix_cache.stats()
-
-    @staticmethod
-    def decode_compile_count():
-        """Compiled decode-step program count (jit cache size) — the
-        recompile-pin tests assert this stays at 1 across slot churn."""
-        return _decode_step_jit._cache_size()
-
-    @staticmethod
-    def prefill_compile_count():
-        """Compiled prefill program count — bounded by the bucket ladder
-        (batched admission runs at the static pool width; chunked prefill
-        adds at most one B=1 program per chunk size)."""
-        return _prefill_batch_jit._cache_size()

@@ -1,0 +1,13 @@
+from deepspeed_tpu.profiling.sentinels import (
+    CompileBudgetExceededError,
+    CompileSentinel,
+    compile_cache_size,
+    transfer_free,
+)
+
+__all__ = [
+    "CompileBudgetExceededError",
+    "CompileSentinel",
+    "compile_cache_size",
+    "transfer_free",
+]

@@ -20,6 +20,49 @@ FLOPS_PROFILER_DETAILED = "detailed"
 FLOPS_PROFILER_DETAILED_DEFAULT = True
 
 
+JAX_SENTINELS = "jax_sentinels"
+
+JAX_SENTINELS_ENABLED = "enabled"
+JAX_SENTINELS_ENABLED_DEFAULT = False
+
+# Compiled programs a sentinel-wrapped hot function may accumulate before
+# CompileSentinel raises. >=1: the first trace is always charged.
+JAX_SENTINELS_COMPILE_BUDGET = "compile_budget"
+JAX_SENTINELS_COMPILE_BUDGET_DEFAULT = 4
+
+# Wrap hot-loop dispatch in transfer_free() (jax.transfer_guard) so any
+# implicit host<->device transfer raises instead of silently stalling.
+JAX_SENTINELS_TRANSFER_GUARD = "transfer_guard"
+JAX_SENTINELS_TRANSFER_GUARD_DEFAULT = False
+
+
+class DeepSpeedSentinelConfig:
+    """``jax_sentinels`` block: runtime compile/transfer watchdogs.
+
+    Static hazards are jaxlint's job (tools/jaxlint); this block arms the
+    dynamic side — CompileSentinel budgets on the engines' jitted hot
+    functions and, optionally, a transfer guard around their dispatch.
+    """
+
+    def __init__(self, param_dict):
+        sent_dict = param_dict.get(JAX_SENTINELS, {})
+        if not isinstance(sent_dict, dict):
+            raise ValueError(f"'{JAX_SENTINELS}' must be a dict, got {type(sent_dict).__name__}")
+        self.enabled = get_scalar_param(sent_dict, JAX_SENTINELS_ENABLED, JAX_SENTINELS_ENABLED_DEFAULT)
+        self.compile_budget = get_scalar_param(sent_dict, JAX_SENTINELS_COMPILE_BUDGET,
+                                               JAX_SENTINELS_COMPILE_BUDGET_DEFAULT)
+        self.transfer_guard = get_scalar_param(sent_dict, JAX_SENTINELS_TRANSFER_GUARD,
+                                               JAX_SENTINELS_TRANSFER_GUARD_DEFAULT)
+        if not isinstance(self.compile_budget, int) or isinstance(self.compile_budget, bool) \
+                or self.compile_budget < 1:
+            raise ValueError(
+                f"'{JAX_SENTINELS}.{JAX_SENTINELS_COMPILE_BUDGET}' must be an int >= 1, "
+                f"got {self.compile_budget!r}")
+
+    def repr(self):
+        return self.__dict__
+
+
 class DeepSpeedFlopsProfilerConfig:
     def __init__(self, param_dict):
         prof_dict = param_dict.get(FLOPS_PROFILER, {})

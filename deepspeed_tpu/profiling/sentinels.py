@@ -1,0 +1,141 @@
+"""Runtime compile/transfer sentinels — jaxlint's dynamic counterpart.
+
+Static analysis (tools/jaxlint) catches hazards the AST can prove;
+these two catch the ones only the runtime can see:
+
+- ``CompileSentinel`` wraps a jitted callable and fails loudly when it
+  compiles more programs than its budget. Replaces the hand-rolled
+  ``fn._cache_size()`` pins the serving/generation tests used — the
+  cache-size read lives HERE, in one sanctioned place, instead of being
+  copy-pasted into every test that wants a recompile guarantee.
+- ``transfer_free()`` is a context manager over ``jax.transfer_guard``
+  asserting a region performs no implicit host<->device transfers
+  (numpy arrays silently fed into jit, ``float()``/``.item()`` on
+  device values). Explicit ``jax.device_put``/``jax.device_get`` remain
+  allowed — the point is that every transfer in a hot region must be a
+  visible, deliberate one.
+
+Both are usable straight from tests and, under the ``jax_sentinels``
+config block (profiling/config.py), from the engines themselves.
+"""
+
+import threading
+from contextlib import contextmanager
+
+import jax
+
+__all__ = [
+    "CompileBudgetExceededError",
+    "CompileSentinel",
+    "compile_cache_size",
+    "transfer_free",
+]
+
+
+class CompileBudgetExceededError(RuntimeError):
+    """A CompileSentinel-wrapped function compiled past its budget."""
+
+    def __init__(self, name, compiles, budget):
+        self.name = name
+        self.compiles = compiles
+        self.budget = budget
+        super().__init__(
+            f"'{name}' compiled {compiles} program(s), budget is {budget} — "
+            f"an operand that should be traced is varying statically "
+            f"(shape, dtype, static_argnums value, or python structure). "
+            f"Run tools/jaxlint for the static view of likely causes.")
+
+
+def compile_cache_size(fn):
+    """Compiled-program count of a jitted callable (its jit cache size).
+
+    The single sanctioned accessor for the private ``_cache_size`` hook;
+    raises TypeError for callables that don't expose one (plain python
+    functions, closures over jit)."""
+    cache_size = getattr(fn, "_cache_size", None)
+    if cache_size is None:
+        raise TypeError(
+            f"{getattr(fn, '__name__', fn)!r} exposes no jit cache "
+            f"(_cache_size) — pass the jax.jit-wrapped callable itself")
+    return cache_size()
+
+
+class CompileSentinel:
+    """Budgeted recompile watchdog around one jitted callable.
+
+    Counts compiles as cache-size deltas since construction (or the last
+    ``reset()``), so a warm cache never charges the budget. Use it three
+    ways: call through it (`sentinel(*args)` — raises the moment the
+    budget is exceeded), assert at the end of a scenario
+    (``sentinel.check()``), or just read ``sentinel.compiles``.
+
+    Thread-safe to call through (the serving engine drives it from a
+    background loop thread); the budget check itself is read-only."""
+
+    def __init__(self, fn, budget, name=None):
+        if budget < 0:
+            raise ValueError(f"budget must be >= 0, got {budget}")
+        compile_cache_size(fn)     # validate up front, not at first call
+        self._fn = fn
+        self.budget = int(budget)
+        self.name = name or getattr(fn, "__name__", "jitted function")
+        self._lock = threading.Lock()
+        self._baseline = compile_cache_size(fn)
+
+    @property
+    def compiles(self):
+        """New programs compiled since construction / last reset()."""
+        return max(0, compile_cache_size(self._fn) - self._baseline)
+
+    def check(self):
+        """Raise CompileBudgetExceededError past the budget; returns the
+        current compile count otherwise (handy for asserts)."""
+        compiles = self.compiles
+        if compiles > self.budget:
+            raise CompileBudgetExceededError(self.name, compiles, self.budget)
+        return compiles
+
+    def reset(self, budget=None):
+        """Forgive past compiles (e.g. after an intentional reshape) and
+        optionally move the budget."""
+        with self._lock:
+            self._baseline = compile_cache_size(self._fn)
+            if budget is not None:
+                if budget < 0:
+                    raise ValueError(f"budget must be >= 0, got {budget}")
+                self.budget = int(budget)
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        self.check()
+        return out
+
+    def __getattr__(self, item):
+        # transparent proxy: engines stash sentinels where jitted fns
+        # lived, so pytree/cache introspection must keep working
+        return getattr(self._fn, item)
+
+    def __repr__(self):
+        return (f"CompileSentinel({self.name!r}, compiles={self.compiles}, "
+                f"budget={self.budget})")
+
+
+@contextmanager
+def transfer_free(level="disallow"):
+    """Assert a region performs no implicit host<->device transfers.
+
+    ``level`` is a ``jax.transfer_guard`` level; the default
+    ``"disallow"`` raises on *implicit* transfers — a numpy array fed
+    straight into a jitted call, ``float()``/``int()``/``.item()`` on a
+    device value — while explicit ``jax.device_put``/``device_get``
+    stay allowed. That is exactly the steady-state contract of a hot
+    loop: transfers are fine, *accidental* ones are not. Pass
+    ``"disallow_explicit"`` to forbid host->device entirely.
+
+    Platform note (pinned in tests/unit/test_sentinels.py): on the CPU
+    backend device->host reads are zero-copy and never trip the guard,
+    but numpy-into-jit and scalar coercions do — so CPU CI still
+    catches the dominant hazard class, and the same region is strictly
+    checked on TPU where every direction is a real copy."""
+    with jax.transfer_guard(level):
+        yield

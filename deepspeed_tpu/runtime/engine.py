@@ -24,6 +24,7 @@ throughput/timers, progressive layer drop) — redesigned TPU-first:
 import dataclasses
 import os
 import pickle
+from contextlib import nullcontext
 
 import numpy as np
 
@@ -31,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
+from deepspeed_tpu.profiling.sentinels import CompileSentinel, transfer_free
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.constants import (
     ADAM_OPTIMIZER,
@@ -1068,7 +1070,13 @@ class DeepSpeedEngine:
                 )
                 return new_params, new_opt_state, new_scaler, jnp.mean(losses), overflow, gnorm
 
-            self._jit_cache[key] = jax.jit(train_step, donate_argnums=(0, 1, 2))
+            jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
+            sent = self._config.sentinel_config
+            if sent.enabled:
+                # transparent proxy: pytree/cache introspection still works
+                jitted = CompileSentinel(jitted, sent.compile_budget,
+                                         name="fused train_step")
+            self._jit_cache[key] = jitted
         return self._jit_cache[key]
 
     def _ensure_opt_state(self):
@@ -1412,10 +1420,15 @@ class DeepSpeedEngine:
             jnp.float32,
         )
         lr = self.get_lr()[0] if self.lr_scheduler is not None else self._optimizer_base_lr()
-        self.params, self.opt_state, self.scaler_state, loss, overflow, gnorm = fused(
-            self.params, self.opt_state, self.scaler_state, self._next_rng(), theta,
-            jnp.asarray(lr, jnp.float32), *stacked,
-        )
+        lr = jnp.asarray(lr, jnp.float32)
+        sent = self._config.sentinel_config
+        guard = (transfer_free() if sent.enabled and sent.transfer_guard
+                 else nullcontext())
+        with guard:
+            self.params, self.opt_state, self.scaler_state, loss, overflow, gnorm = fused(
+                self.params, self.opt_state, self.scaler_state, self._next_rng(), theta,
+                lr, *stacked,
+            )
         self._last_loss = loss
         self._loss_sum = loss * gas
         self.micro_steps += gas
@@ -1453,10 +1466,10 @@ class DeepSpeedEngine:
         if overflow:
             self.skipped_steps += 1
             if self.dynamic_loss_scale() and self.global_rank == 0:
+                cur_scale = float(jax.device_get(self.scaler_state.cur_scale))
                 logger.info(
                     "[deepspeed_tpu] OVERFLOW! Skipping step. Attempted loss scale: "
-                    f"{float(jax.device_get(self.scaler_state.cur_scale) * 2)}, reducing to "
-                    f"{float(jax.device_get(self.scaler_state.cur_scale))}"
+                    f"{cur_scale * 2}, reducing to {cur_scale}"
                 )
         else:
             if self.lr_scheduler is not None:
@@ -1491,7 +1504,9 @@ class DeepSpeedEngine:
         float. This is the callable the resilience supervisor retries and
         replays — it must consume ONLY its arguments and engine state."""
         if self._can_fuse_train_step():
-            return float(jax.device_get(self.train_step(micro)))
+            loss = self.train_step(micro)
+            # the step's single deliberate sync: the mean loss for the caller
+            return float(jax.device_get(loss))  # jaxlint: disable=JL002(one explicit host read per step)
         losses = []
         for batch in micro:
             if not isinstance(batch, (tuple, list)):
@@ -1500,7 +1515,9 @@ class DeepSpeedEngine:
             self.backward(loss)
             losses.append(loss)  # device values: sync ONCE after the loop
             self.step()
-        return float(np.mean([float(jax.device_get(l)) for l in losses]))
+        # ONE batched transfer for all gas microbatch losses, not gas syncs
+        host_losses = jax.device_get(losses)  # jaxlint: disable=JL002(one explicit host read per step)
+        return float(np.mean(host_losses))  # jaxlint: disable=JL002(host-side scalar, already transferred)
 
     # ------------------------------------------------------------------
     # checkpointing (parity: engine.py:1271-1561), routed through the

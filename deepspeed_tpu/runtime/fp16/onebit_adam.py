@@ -36,7 +36,8 @@ def pack_signs(x):
     n = x.shape[0]
     assert n % 8 == 0, "pack_signs needs n % 8 == 0"
     bits = (x >= 0).astype(jnp.uint8).reshape(n // 8, 8)
-    return jnp.sum(bits * jnp.asarray(_POWERS), axis=1, dtype=jnp.uint8)
+    return jnp.sum(bits * jnp.asarray(_POWERS, dtype=jnp.uint8), axis=1,
+                   dtype=jnp.uint8)
 
 
 def unpack_signs(packed, n):

@@ -1304,7 +1304,8 @@ class PipelineEngine:
                         "and no interpreter fallback exists across processes"
                     )
         if mode is not None:
-            self.agg_train_loss = float(jax.device_get(loss))
+            # the step's single deliberate sync: the mean loss for the caller
+            self.agg_train_loss = float(jax.device_get(loss))  # jaxlint: disable=JL002(one explicit host read per step)
             self.global_steps += 1
             self.global_samples += self.micro_batch_size * self.micro_batches * self.dp_world_size
             if self.lr_scheduler is not None and not self._last_overflow:
@@ -1349,7 +1350,9 @@ class PipelineEngine:
         sched = _MergedSchedule(pipe_schedule.TrainSchedule, self.micro_batches, self.num_stages)
         self._exec_schedule(sched, micro)
 
-        self.agg_train_loss = float(np.mean([float(jax.device_get(l)) for l in self._losses]))
+        # ONE batched transfer for every microbatch loss, not micro_batches syncs
+        host_losses = jax.device_get(self._losses)  # jaxlint: disable=JL002(one explicit host read per step)
+        self.agg_train_loss = float(np.mean(host_losses))  # jaxlint: disable=JL002(host-side scalar, already transferred)
         self.global_steps += 1
         self.global_samples += self.micro_batch_size * self.micro_batches * self.dp_world_size
         if self.curriculum_scheduler is not None:
@@ -1644,11 +1647,14 @@ class PipelineEngine:
         # allreduced overflow check + model-global clip norm).
         scale = float(jax.device_get(self.scaler_state.cur_scale))
         mb = float(self.micro_batches)
-        sq_total, finite = 0.0, True
-        for st in range(self.num_stages):
-            sq, fin = self._stage_norm_overflow_fn(st)(self._acc_grads[st])
-            sq_total += float(jax.device_get(sq))
-            finite = finite and bool(jax.device_get(fin))
+        stage_stats = [
+            self._stage_norm_overflow_fn(st)(self._acc_grads[st])
+            for st in range(self.num_stages)
+        ]
+        # one batched transfer for every stage's (sq, finite), not 2*stages
+        stage_stats = jax.device_get(stage_stats)
+        sq_total = float(sum(sq for sq, _ in stage_stats))
+        finite = all(bool(fin) for _, fin in stage_stats)
         overflow = self._fp16 and not finite
 
         if overflow:

@@ -1,0 +1,3 @@
+# Fixture corpus for tests/unit/test_jaxlint.py: one positive and one
+# negative file per rule. These files are PARSED by the linter, never
+# imported/executed — the code only needs to be syntactically valid.

@@ -1,0 +1,14 @@
+"""JL006 negatives ("fp16" path): dtype always explicit."""
+import jax.numpy as jnp
+
+
+def make_master(shape):
+    return jnp.zeros(shape, dtype=jnp.float32)
+
+
+def make_compute(shape):
+    return jnp.ones(shape, jnp.float16)    # positional dtype counts too
+
+
+def staircase(n):
+    return jnp.arange(n, dtype=jnp.int32)

@@ -1,0 +1,10 @@
+"""JL006 positives ("fp16" is in this file's path): bare jnp ctors."""
+import jax.numpy as jnp
+
+
+def make_master(shape):
+    return jnp.zeros(shape)            # JL006: defaults to float32
+
+
+def staircase(n):
+    return jnp.arange(n)               # JL006: dtype picked by value

@@ -1,0 +1,44 @@
+"""JL001 negatives: branches that ARE static under tracing."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def relu_where(x):
+    return jnp.where(x > 0, x, -x)      # traced select, no python branch
+
+
+@jax.jit
+def rank_dispatch(x):
+    if x.ndim == 2:                     # shape attributes are static
+        return x.sum(axis=1)
+    return x.sum()
+
+
+@partial(jax.jit, static_argnames=("greedy",))
+def static_flag(x, greedy):
+    if greedy:                          # declared static: fine
+        return jnp.argmax(x)
+    return x
+
+
+@jax.jit
+def optional_mask(x, mask=None):
+    if mask is None:                    # identity check: static
+        return x
+    return x * mask
+
+
+@jax.jit
+def structure_dispatch(x):
+    if isinstance(x, tuple):            # host predicate: static
+        x = x[0]
+    return x * 2
+
+
+def plain_branch(x):
+    if x > 0:                           # not jitted: python branch is fine
+        return x
+    return -x

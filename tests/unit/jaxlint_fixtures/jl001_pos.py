@@ -1,0 +1,30 @@
+"""JL001 positives: python control flow on traced arguments in jit."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def relu_branch(x):
+    if x > 0:                     # JL001: traced `if`
+        return x
+    return -x
+
+
+@jax.jit
+def halve_until_small(x):
+    while x > 1.0:                # JL001: traced `while`
+        x = x / 2
+    return x
+
+
+@jax.jit
+def checked_log(x):
+    assert x > 0, "needs positive"   # JL001: traced `assert`
+    return jnp.log(x)
+
+
+@partial(jax.jit, static_argnames=("scale",))
+def conditional_expr(x, scale):
+    return x if x > 0 else -x     # JL001: traced conditional expression

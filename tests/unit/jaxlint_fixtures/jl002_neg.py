@@ -1,0 +1,15 @@
+"""JL002 negatives: device-resident hot loop, syncs only outside it."""
+import numpy as np
+
+import jax
+
+
+def decode_loop(fn, tokens):  # jaxlint: hot
+    tokens = fn(tokens)       # stays on device: no sync in the hot path
+    return tokens
+
+
+def report(tokens):
+    # not a hot loop: syncing here is the intended place
+    host = np.asarray(jax.device_get(tokens))
+    return float(host.mean())

@@ -1,0 +1,13 @@
+"""JL002 positives: host syncs inside a marked hot loop."""
+import numpy as np
+
+import jax
+
+
+def decode_loop(fn, tokens):  # jaxlint: hot
+    tokens = fn(tokens)
+    host = np.asarray(tokens)                 # JL002: d->h copy
+    loss = float(jax.device_get(tokens))      # JL002: float + device_get
+    tokens.block_until_ready()                # JL002: device drain
+    first = tokens[0].item()                  # JL002: .item() sync
+    return host, loss, first

@@ -1,0 +1,20 @@
+"""JL003 negatives: pure jitted functions, stateful plain ones."""
+import jax
+
+_step_count = 0
+
+
+@jax.jit
+def pure_fn(x):
+    y = x * 2                      # local binding: fine
+    return y
+
+
+class Model:
+    def forward(self, x):          # not jitted: storing on self is fine
+        self.last = x
+        return x * 2
+
+    def bump(self):
+        global _step_count
+        _step_count += 1           # not jitted: global store is fine

@@ -1,0 +1,18 @@
+"""JL003 positives: traced values stored on self/globals inside jit."""
+import jax
+
+_last_activations = None
+
+
+class Model:
+    @jax.jit
+    def forward(self, x):
+        self.last = x * 2          # JL003: tracer escapes onto self
+        return x * 2
+
+
+@jax.jit
+def record(x):
+    global _last_activations
+    _last_activations = x          # JL003: tracer escapes to a global
+    return x

@@ -1,0 +1,27 @@
+"""JL004 negatives: constant statics and traced loop-varying operands."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("width",))
+def pad_to(x, width):
+    return x
+
+
+@jax.jit
+def accumulate(x, item):
+    return x + item
+
+
+def sweep_constant(xs):
+    out = []
+    for x in xs:
+        out.append(pad_to(x, width=128))   # static arg is loop-invariant
+    return out
+
+
+def fold(x, items):
+    for item in items:
+        x = accumulate(x, item)            # loop var at a TRACED position
+    return x

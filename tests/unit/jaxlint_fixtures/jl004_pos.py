@@ -1,0 +1,28 @@
+"""JL004 positives: loop-varying values at static argument positions."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("width",))
+def pad_to(x, width):
+    return x
+
+
+@partial(jax.jit, static_argnums=(1,))
+def scale_by(x, factor):
+    return x * factor
+
+
+def sweep_kw(x, widths):
+    out = []
+    for w in widths:
+        out.append(pad_to(x, width=w))     # JL004: loop var at static kwarg
+    return out
+
+
+def sweep_pos(x, factors):
+    out = []
+    for f in factors:
+        out.append(scale_by(x, f))         # JL004: loop var at static pos
+    return out

@@ -1,0 +1,29 @@
+"""JL005 negatives: rebinding before any further read."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def apply_update(state, grads):
+    return state + grads
+
+
+def train_step(state, grads):
+    state = apply_update(state, grads)   # rebound: old buffer never read
+    return state.sum()
+
+
+def read_before_donation(state, grads):
+    norm = state.sum()                   # read BEFORE donating: fine
+    state = apply_update(state, grads)
+    return state, norm
+
+
+def helper_defined_later(state):
+    fresh = apply_update(state, state * 0)
+
+    def metrics(state):                  # nested def: different binding
+        return state.sum()
+
+    return fresh, metrics

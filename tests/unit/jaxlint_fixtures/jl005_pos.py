@@ -1,0 +1,20 @@
+"""JL005 positives: donated buffers read after the donating call."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def apply_update(state, grads):
+    return state + grads
+
+
+def train_step(state, grads):
+    new_state = apply_update(state, grads)
+    norm = state.sum()                # JL005: `state` was donated above
+    return new_state, norm
+
+
+def pool_step(pool, fn):
+    out = fn(apply_update(pool.k, pool.grads))
+    return out + pool.k.sum()         # JL005: `pool.k` was donated above

@@ -1,0 +1,25 @@
+"""Suppression fixture: inline disables, same-line and line-above."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def vetted_same_line(x):
+    if x > 0:  # jaxlint: disable=JL001(scalar weak-typed python input by contract)
+        return x
+    return -x
+
+
+@jax.jit
+def vetted_line_above(x):
+    # jaxlint: disable=JL001(see vetted_same_line)
+    if x > 0:
+        return x
+    return -x
+
+
+@jax.jit
+def wrong_code_still_flagged(x):
+    if x > 0:  # jaxlint: disable=JL002(wrong code: does not silence JL001)
+        return x
+    return -x

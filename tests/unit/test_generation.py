@@ -95,10 +95,11 @@ def test_temperature_sweep_shares_one_program():
     generate(params, cfg, prompt, 4, temperature=0.7,
              rng=jax.random.PRNGKey(0))
     from deepspeed_tpu.inference.generation import _generate_jit
-    misses_after_first = _generate_jit._cache_size()
+    from deepspeed_tpu.profiling import CompileSentinel
+    sentinel = CompileSentinel(_generate_jit, budget=0, name="generate")
     generate(params, cfg, prompt, 4, temperature=1.3,
              rng=jax.random.PRNGKey(0))
-    assert _generate_jit._cache_size() == misses_after_first
+    assert sentinel.check() == 0
 
 
 def test_generate_with_tp_sharded_params():

@@ -1,0 +1,170 @@
+"""Runtime sentinel tests: CompileSentinel budgets, transfer_free()
+semantics on the CPU backend, and the ``jax_sentinels`` config block.
+
+Platform pin (documented in profiling/sentinels.py): under
+``transfer_guard("disallow")`` on CPU, a numpy array fed straight into a
+jitted call and ``float()``/``.item()`` scalar coercions RAISE, while
+explicit ``jax.device_put``/``jax.device_get`` stay allowed. This file
+asserts exactly that contract so a jax upgrade that shifts it fails
+loudly here instead of silently degrading the serving test's guarantee.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.profiling import (
+    CompileBudgetExceededError,
+    CompileSentinel,
+    compile_cache_size,
+    transfer_free,
+)
+from deepspeed_tpu.profiling.config import DeepSpeedSentinelConfig
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+def _fresh_jit():
+    @jax.jit
+    def double(x):
+        return x * 2
+
+    return double
+
+
+# -- CompileSentinel ----------------------------------------------------------
+
+def test_warm_cache_never_charges_budget():
+    fn = _fresh_jit()
+    fn(jnp.ones(4))                       # compiled BEFORE the sentinel
+    sentinel = CompileSentinel(fn, budget=0)
+    for _ in range(3):
+        sentinel(jnp.ones(4))             # warm hits: zero new programs
+    assert sentinel.compiles == 0
+    assert sentinel.check() == 0
+
+
+def test_budget_exceeded_raises_with_context():
+    sentinel = CompileSentinel(_fresh_jit(), budget=1, name="double")
+    sentinel(jnp.ones(4))                 # first trace: within budget
+    with pytest.raises(CompileBudgetExceededError) as exc:
+        sentinel(jnp.ones(8))             # new shape: second program
+    assert exc.value.name == "double"
+    assert exc.value.compiles == 2 and exc.value.budget == 1
+    assert "jaxlint" in str(exc.value)    # points at the static analyzer
+
+
+def test_check_is_lazy_and_reset_forgives():
+    fn = _fresh_jit()
+    sentinel = CompileSentinel(fn, budget=1)
+    fn(jnp.ones(4))                       # direct calls never raise...
+    fn(jnp.ones(8))
+    fn(jnp.ones(16))
+    with pytest.raises(CompileBudgetExceededError):
+        sentinel.check()                  # ...the assert at the end does
+    sentinel.reset()                      # intentional reshape: forgiven
+    assert sentinel.check() == 0
+    sentinel.reset(budget=2)
+    assert sentinel.budget == 2
+
+
+def test_sentinel_is_transparent_proxy():
+    fn = _fresh_jit()
+    sentinel = CompileSentinel(fn, budget=4)
+    y = sentinel(jnp.arange(3.0))
+    np.testing.assert_array_equal(np.asarray(y), [0.0, 2.0, 4.0])
+    # attribute passthrough: jit introspection keeps working through it
+    assert sentinel._cache_size() == compile_cache_size(fn)
+    assert "budget=4" in repr(sentinel)
+
+
+def test_sentinel_rejects_non_jitted_and_bad_budget():
+    with pytest.raises(TypeError):
+        CompileSentinel(lambda x: x, budget=1)
+    with pytest.raises(TypeError):
+        compile_cache_size(len)
+    with pytest.raises(ValueError):
+        CompileSentinel(_fresh_jit(), budget=-1)
+    with pytest.raises(ValueError):
+        CompileSentinel(_fresh_jit(), budget=3).reset(budget=-2)
+
+
+# -- transfer_free ------------------------------------------------------------
+
+def test_transfer_free_blocks_numpy_into_jit():
+    fn = _fresh_jit()
+    fn(jnp.ones(4))                       # compile outside the guard
+    with pytest.raises(RuntimeError, match="[Dd]isallowed"):
+        with transfer_free():
+            fn(np.ones(4, np.float32))    # implicit h->d: the hazard
+
+
+def test_transfer_free_blocks_scalar_coercion():
+    y = _fresh_jit()(jnp.ones(4))
+    with pytest.raises(RuntimeError, match="[Dd]isallowed"):
+        with transfer_free():
+            float(y[0])
+
+
+def test_transfer_free_allows_device_side_work_and_explicit_transfers():
+    fn = _fresh_jit()
+    x = jnp.ones(8)
+    fn(x)
+    with transfer_free():
+        y = fn(x)                         # pure device work: fine
+        z = jax.device_put(np.ones(8, np.float32))   # explicit: fine
+        host = jax.device_get(y)          # explicit: fine
+    np.testing.assert_array_equal(host, np.full(8, 2.0, np.float32))
+    assert z.shape == (8,)
+
+
+def test_transfer_free_restores_previous_policy():
+    fn = _fresh_jit()
+    fn(jnp.ones(4))
+    with pytest.raises(RuntimeError):
+        with transfer_free():
+            fn(np.ones(4, np.float32))
+    # outside the context the implicit transfer is permitted again
+    np.testing.assert_array_equal(
+        np.asarray(fn(np.ones(4, np.float32))), np.full(4, 2.0))
+
+
+# -- the jax_sentinels config block ------------------------------------------
+
+def test_sentinel_config_defaults_off():
+    cfg = DeepSpeedSentinelConfig({})
+    assert cfg.enabled is False
+    assert cfg.compile_budget == 4
+    assert cfg.transfer_guard is False
+
+
+def test_sentinel_config_parses_block():
+    cfg = DeepSpeedSentinelConfig({"jax_sentinels": {
+        "enabled": True, "compile_budget": 2, "transfer_guard": True}})
+    assert cfg.enabled is True
+    assert cfg.compile_budget == 2
+    assert cfg.transfer_guard is True
+
+
+@pytest.mark.parametrize("budget", [0, -3, 1.5, True, "four"])
+def test_sentinel_config_rejects_bad_budget(budget):
+    with pytest.raises(ValueError):
+        DeepSpeedSentinelConfig({"jax_sentinels": {"compile_budget": budget}})
+
+
+def test_sentinel_config_rejects_non_dict_block():
+    with pytest.raises(ValueError):
+        DeepSpeedSentinelConfig({"jax_sentinels": "yes"})
+
+
+def test_ds_config_exposes_sentinel_config():
+    ds = DeepSpeedConfig({
+        "train_batch_size": 4,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 1,
+        "jax_sentinels": {"enabled": True, "compile_budget": 7},
+    }, world_size=1)
+    assert ds.sentinel_config.enabled is True
+    assert ds.sentinel_config.compile_budget == 7
+    assert ds.sentinel_config.transfer_guard is False

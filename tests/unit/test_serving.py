@@ -31,7 +31,10 @@ from deepspeed_tpu.inference.serving import (
     bucket_for,
     default_buckets,
 )
+from deepspeed_tpu.inference.serving import engine as serving_engine_mod
 from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2
+from deepspeed_tpu.profiling import CompileSentinel, transfer_free
+from deepspeed_tpu.profiling.config import DeepSpeedSentinelConfig
 
 
 def _tiny_config():
@@ -56,10 +59,21 @@ def model():
     return cfg, params
 
 
-def _engine(cfg, params, **overrides):
+def _engine(cfg, params, sentinel_config=None, **overrides):
     kw = dict(max_slots=3, max_queue=8, max_seq_len=32, prompt_buckets=(4, 8))
     kw.update(overrides)
-    return ServingEngine(params, cfg, ServingConfig(**kw))
+    return ServingEngine(params, cfg, ServingConfig(**kw),
+                         sentinel_config=sentinel_config)
+
+
+def _decode_sentinel(budget):
+    return CompileSentinel(serving_engine_mod._decode_step_jit, budget,
+                           name="decode step")
+
+
+def _prefill_sentinel(budget):
+    return CompileSentinel(serving_engine_mod._prefill_batch_jit, budget,
+                           name="batched prefill")
 
 
 def _prompts(n, lengths=(4, 6, 3, 5, 8, 2, 7, 4)):
@@ -237,11 +251,11 @@ def test_submit_validation(model):
 def test_recompile_pin_over_slot_churn(model):
     """A full serve of 2x MaxSlots requests spanning every bucket: the
     decode step compiles at most once, prefill at most once per bucket —
-    the jit cache sizes pin it."""
+    CompileSentinel budgets pin it (check() raises past the budget)."""
     cfg, params = model
     eng = _engine(cfg, params, max_slots=2)
-    decode0 = ServingEngine.decode_compile_count()
-    prefill0 = ServingEngine.prefill_compile_count()
+    decode_sent = _decode_sentinel(budget=1)
+    prefill_sent = _prefill_sentinel(budget=2)   # |buckets|
 
     prompts = _prompts(4, lengths=(3, 6, 4, 8))  # buckets 4,8,4,8
     wants = [_oneshot(cfg, params, p, 5) for p in prompts]
@@ -252,8 +266,51 @@ def test_recompile_pin_over_slot_churn(model):
 
     for f, want in zip(futs, wants):
         assert f.result(timeout=1) == want
-    assert ServingEngine.decode_compile_count() - decode0 <= 1
-    assert ServingEngine.prefill_compile_count() - prefill0 <= 2  # |buckets|
+    assert decode_sent.check() <= 1
+    assert prefill_sent.check() <= 2
+
+
+def test_steady_state_decode_is_transfer_free(model):
+    """The serving contract the lane-state refactor buys: once lanes are
+    admitted, decode steps perform ZERO implicit host<->device transfers
+    — the lane vectors live on device, positions advance inside the jit,
+    and the only per-step host contact is the explicit EOS read. The
+    transfer guard raises on any regression (a numpy operand sneaking
+    into the jitted call, a float()/.item() on a device value)."""
+    cfg, params = model
+    eng = _engine(cfg, params)
+    prompts = _prompts(2, lengths=(3, 4))
+    wants = [_oneshot(cfg, params, p, 8) for p in prompts]
+    futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.step()             # admission: prefill + lane-churn upload queued
+    eng.step()             # flushes the churn upload (explicit device_put)
+    assert eng._lane_dirty is False and len(eng._active) == 2
+    with transfer_free():
+        for _ in range(4):  # steady state: no admission, no retirement
+            stats = eng.step()
+            assert stats["decoded"] == 2
+    eng.drain(max_steps=100)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+
+
+def test_armed_sentinels_via_config(model):
+    """jax_sentinels wiring: an engine built with the block enabled
+    checks its own compile budgets and runs decode under the transfer
+    guard — and still serves bitwise-correct output."""
+    cfg, params = model
+    sent_cfg = DeepSpeedSentinelConfig({"jax_sentinels": {
+        "enabled": True, "compile_budget": 8, "transfer_guard": True}})
+    eng = _engine(cfg, params, sentinel_config=sent_cfg)
+    assert eng.decode_sentinel is not None
+    assert eng.prefill_sentinel is not None and eng._transfer_guard
+    prompts = _prompts(3)
+    wants = [_oneshot(cfg, params, p, 4) for p in prompts]
+    futs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.drain(max_steps=200)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+    assert eng.decode_sentinel.check() <= 8
 
 
 # -- fault injection --------------------------------------------------------
@@ -462,15 +519,16 @@ def test_recompile_pin_varying_group_size(model):
     one compiled program."""
     cfg, params = model
     eng = _engine(cfg, params, max_slots=3)
-    prefill0 = ServingEngine.prefill_compile_count()
+    prefill_sent = _prefill_sentinel(budget=1)
     for group in (1, 3, 2):
         prompts = _prompts(group, lengths=(3, 4, 2))
         wants = [_oneshot(cfg, params, p, 3) for p in prompts]
         futs = [eng.submit(p, max_new_tokens=3) for p in prompts]
         eng.drain(max_steps=100)
+        prefill_sent.check()     # raises on the offending group size
         for f, want in zip(futs, wants):
             assert f.result(timeout=1) == want
-    assert ServingEngine.prefill_compile_count() - prefill0 <= 1
+    assert prefill_sent.check() <= 1
 
 
 # -- chunked prefill --------------------------------------------------------
@@ -507,12 +565,12 @@ def test_chunked_prefill_compile_bounded(model):
     regardless of how many long prompts stream through."""
     cfg, params = model
     eng = _engine(cfg, params, prefill_chunk_tokens=3)
-    prefill0 = ServingEngine.prefill_compile_count()
+    prefill_sent = _prefill_sentinel(budget=1)
     for p in _prompts(3, lengths=(8, 7, 8)):
         fut = eng.submit(p, max_new_tokens=3)
         eng.drain(max_steps=100)
         assert fut.result(timeout=1) == _oneshot(cfg, params, p, 3)
-    assert ServingEngine.prefill_compile_count() - prefill0 <= 1
+    assert prefill_sent.check() <= 1
 
 
 def test_chunked_prefill_deadline_aborts_with_prefill_phase(model):
@@ -575,14 +633,14 @@ def test_prefix_cache_recompile_pin(model):
     seeded cache and per-lane start offsets are traced operands."""
     cfg, params = model
     eng = _engine(cfg, params, prefix_cache_mb=4.0)
-    prefill0 = ServingEngine.prefill_compile_count()
+    prefill_sent = _prefill_sentinel(budget=2)   # |buckets|
     prompts = _shared_prefix_prompts(4)
     for p in prompts:                            # serial: every later one hits
         fut = eng.submit(p, max_new_tokens=3)
         eng.drain(max_steps=100)
         assert fut.result(timeout=1) == _oneshot(cfg, params, p, 3)
     assert eng.prefix_stats()["hits"] >= 2
-    assert ServingEngine.prefill_compile_count() - prefill0 <= 2  # |buckets|
+    assert prefill_sent.check() <= 2
 
 
 def test_prefix_refs_released_after_stuck_reap(model):

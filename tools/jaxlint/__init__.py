@@ -1,0 +1,36 @@
+"""jaxlint: static analysis for JAX hazards.
+
+AST-only (never imports jax): finds unintended-recompile, host-sync,
+leaked-tracer, donation and fp16-dtype hazards before they cost a step.
+See docs/static_analysis.md for every rule with bad/good examples, the
+suppression syntax, and the baseline workflow. The runtime complements
+(CompileSentinel, transfer_free) live in deepspeed_tpu/profiling/.
+"""
+
+from tools.jaxlint.analyzer import (
+    Finding,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+)
+from tools.jaxlint.baseline import (
+    count_findings,
+    diff_against_baseline,
+    load_baseline,
+    write_baseline,
+)
+from tools.jaxlint.rules import ALL_CODES, HOT_LOOPS, RULES
+
+__all__ = [
+    "ALL_CODES",
+    "Finding",
+    "HOT_LOOPS",
+    "RULES",
+    "analyze_file",
+    "analyze_paths",
+    "analyze_source",
+    "count_findings",
+    "diff_against_baseline",
+    "load_baseline",
+    "write_baseline",
+]

@@ -1,0 +1,5 @@
+import sys
+
+from tools.jaxlint.cli import main
+
+sys.exit(main())

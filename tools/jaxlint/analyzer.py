@@ -1,0 +1,667 @@
+"""AST analysis pass behind jaxlint.
+
+Pure stdlib (``ast`` only — importing jax would drag device init into a
+lint step); one parse per file, all rules evaluated in a single walk
+over pre-computed per-file indexes:
+
+- the *jit registry*: every function the file jits, whether by
+  decorator (``@jax.jit``, ``@pjit``, ``@partial(jax.jit, ...)``) or by
+  binding (``f = jax.jit(g, ...)``), with its static/donated argument
+  positions and names;
+- the *hot-loop set*: functions named in rules.HOT_LOOPS plus any
+  ``def`` carrying a ``# jaxlint: hot`` marker;
+- the *suppression map*: ``# jaxlint: disable=JLxxx(reason)`` comments,
+  applying to their own line and the line below.
+
+Heuristics are deliberately conservative-with-escape-hatch: a rule that
+cannot decide statically stays quiet, and a justified true positive is
+silenced inline with a reason rather than weakening the rule.
+"""
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+from tools.jaxlint.rules import (
+    FP16_PATH_FRAGMENTS,
+    HOT_LOOPS,
+    HOT_MARKER,
+    RULES,
+)
+
+_JIT_NAMES = {"jit", "pjit"}
+_PARTIAL_NAMES = {"partial"}
+_NP_MODULES = {"np", "numpy", "onp"}
+_SHAPE_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "weak_type",
+                "sharding"}
+_HOST_PRED_FUNCS = {"len", "isinstance", "issubclass", "hasattr", "getattr",
+                    "callable", "type", "id"}
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+_JNP_CTORS_MIN_ARGS = {
+    # constructor -> positional-arg count at which dtype is already given
+    "zeros": 2, "ones": 2, "empty": 2, "asarray": 2, "array": 2,
+    "full": 3, "arange": 4, "eye": 3, "linspace": 7,
+}
+
+_SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([^#]*)")
+_CODE_RE = re.compile(r"(JL\d{3})(?:\(([^)]*)\))?")
+
+
+@dataclass
+class Finding:
+    path: str          # posix path relative to the scan root
+    line: int
+    code: str
+    symbol: str        # enclosing function qualname, or "<module>"
+    message: str
+    text: str          # stripped source line the finding anchors to
+
+    def fingerprint(self):
+        """Line-number-free identity so unrelated edits shifting a file
+        don't churn the baseline: path + code + symbol + the normalized
+        source text of the flagged line."""
+        norm = " ".join(self.text.split())
+        return f"{self.path}::{self.code}::{self.symbol}::{norm}"
+
+    def to_dict(self):
+        return {"path": self.path, "line": self.line, "code": self.code,
+                "symbol": self.symbol, "message": self.message,
+                "text": self.text}
+
+    def render(self):
+        return (f"{self.path}:{self.line}: {self.code} "
+                f"[{RULES[self.code].name if self.code in RULES else '?'}] "
+                f"in {self.symbol}: {self.message}\n    {self.text}")
+
+
+@dataclass
+class JitInfo:
+    """Static/donate geometry of one jitted callable."""
+    static_nums: frozenset = frozenset()
+    static_names: frozenset = frozenset()
+    donate_nums: frozenset = frozenset()
+    donate_names: frozenset = frozenset()
+    params: tuple = ()     # positional parameter names, when known
+
+    def static_params(self):
+        out = set(self.static_names)
+        for i in self.static_nums:
+            if 0 <= i < len(self.params):
+                out.add(self.params[i])
+        return out
+
+
+def _literal(node):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError, TypeError):
+        return None
+
+
+def _as_index_set(value):
+    if value is None:
+        return frozenset()
+    if isinstance(value, int):
+        return frozenset((value,))
+    if isinstance(value, (tuple, list)) and all(
+            isinstance(v, int) for v in value):
+        return frozenset(value)
+    return frozenset()
+
+
+def _as_name_set(value):
+    if value is None:
+        return frozenset()
+    if isinstance(value, str):
+        return frozenset((value,))
+    if isinstance(value, (tuple, list)) and all(
+            isinstance(v, str) for v in value):
+        return frozenset(value)
+    return frozenset()
+
+
+def _is_jit_ref(node):
+    """``jit`` / ``pjit`` / ``jax.jit`` / ``jax.experimental.pjit.pjit``."""
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    return False
+
+
+def _jit_kwargs(call):
+    info = {}
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnames",
+                      "donate_argnums", "donate_argnames"):
+            info[kw.arg] = _literal(kw.value)
+    return JitInfo(
+        static_nums=_as_index_set(info.get("static_argnums")),
+        static_names=_as_name_set(info.get("static_argnames")),
+        donate_nums=_as_index_set(info.get("donate_argnums")),
+        donate_names=_as_name_set(info.get("donate_argnames")),
+    )
+
+
+def _decorator_jit_info(dec):
+    """JitInfo when ``dec`` jits the function it decorates, else None."""
+    if _is_jit_ref(dec):
+        return JitInfo()
+    if isinstance(dec, ast.Call):
+        if _is_jit_ref(dec.func):
+            return _jit_kwargs(dec)
+        # partial(jax.jit, static_argnames=...) / functools.partial(...)
+        fname = (dec.func.id if isinstance(dec.func, ast.Name)
+                 else dec.func.attr if isinstance(dec.func, ast.Attribute)
+                 else None)
+        if fname in _PARTIAL_NAMES and dec.args and _is_jit_ref(dec.args[0]):
+            return _jit_kwargs(dec)
+    return None
+
+
+def _expr_key(node):
+    """Stable key for a simple lvalue-ish expression (Name or dotted
+    attribute chain); None for anything more complex."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _target_keys(target):
+    """Every simple expression a statement's assignment target rebinds."""
+    out = []
+    for node in ast.walk(target):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            key = _expr_key(node)
+            if key is not None:
+                out.append(key)
+    return out
+
+
+class _FileIndex:
+    """Per-file context shared by every rule."""
+
+    def __init__(self, path, rel_path, source):
+        self.rel_path = rel_path
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # parent/qualname annotation
+        self.qualname = {}
+        self._annotate(self.tree, ())
+        self.suppressions = self._parse_suppressions()
+        self.jit_registry = {}     # name -> JitInfo (module-visible names)
+        self._collect_jit_registry()
+
+    def _annotate(self, node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                child_stack = stack + (child.name,)
+                self.qualname[child] = ".".join(child_stack)
+                self._annotate(child, child_stack)
+            else:
+                self._annotate(child, stack)
+
+    def _parse_suppressions(self):
+        sup = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            codes = {code: (reason or "").strip() or None
+                     for code, reason in _CODE_RE.findall(m.group(1))}
+            if codes:
+                sup[i] = codes
+        return sup
+
+    def suppressed(self, line, code):
+        for at in (line, line - 1):
+            if code in self.suppressions.get(at, {}):
+                return True
+        return False
+
+    def line_text(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def _collect_jit_registry(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    info = _decorator_jit_info(dec)
+                    if info is not None:
+                        params = tuple(
+                            a.arg for a in node.args.posonlyargs
+                            + node.args.args)
+                        self.jit_registry[node.name] = JitInfo(
+                            info.static_nums, info.static_names,
+                            info.donate_nums, info.donate_names, params)
+                        break
+            elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) and _is_jit_ref(node.value.func):
+                info = _jit_kwargs(node.value)
+                # params known when the wrapped fn is defined in this file
+                params = ()
+                if node.value.args and isinstance(node.value.args[0],
+                                                  ast.Name):
+                    wrapped = node.value.args[0].id
+                    for n in ast.walk(self.tree):
+                        if (isinstance(n, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))
+                                and n.name == wrapped):
+                            params = tuple(a.arg for a in n.args.posonlyargs
+                                           + n.args.args)
+                            break
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.jit_registry[tgt.id] = JitInfo(
+                            info.static_nums, info.static_names,
+                            info.donate_nums, info.donate_names, params)
+
+    def jitted_defs(self):
+        out = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                info = _decorator_jit_info(dec)
+                if info is not None:
+                    params = tuple(a.arg for a in node.args.posonlyargs
+                                   + node.args.args)
+                    out.append((node, JitInfo(
+                        info.static_nums, info.static_names,
+                        info.donate_nums, info.donate_names, params)))
+                    break
+        return out
+
+    def hot_defs(self):
+        """Functions in the HOT_LOOPS registry or carrying the marker."""
+        out = []
+        posix = self.rel_path.replace(os.sep, "/")
+        registered = {qual for suffix, qual in HOT_LOOPS
+                      if posix.endswith(suffix)}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            qual = self.qualname.get(node, node.name)
+            if qual in registered:
+                out.append(node)
+                continue
+            for at in (node.lineno, node.lineno - 1):
+                if HOT_MARKER in self.line_text(at):
+                    out.append(node)
+                    break
+        return out
+
+
+# -- rule implementations ----------------------------------------------------
+
+def _traced_value_names(test):
+    """Names used *by value* in a branch test: skips shape/dtype/ndim
+    attribute reads, host predicates (len/isinstance/...), and pure
+    identity checks (`x is None`) — those are static under tracing."""
+    names = set()
+
+    def visit(node):
+        if isinstance(node, ast.Attribute) and node.attr in _SHAPE_ATTRS:
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            fn_name = (fn.id if isinstance(fn, ast.Name)
+                       else fn.attr if isinstance(fn, ast.Attribute) else "")
+            if fn_name in _HOST_PRED_FUNCS:
+                return
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            names.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(test)
+    return names
+
+
+def _check_traced_branch(index, findings):
+    """JL001: if/while/assert on a traced argument inside a jitted fn."""
+    for fn, info in index.jitted_defs():
+        traced = set(info.params) - info.static_params()
+        traced.discard("self")
+        traced.discard("cls")
+        if not traced:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While, ast.Assert)):
+                test, kind = node.test, type(node).__name__.lower()
+            elif isinstance(node, ast.IfExp):
+                test, kind = node.test, "conditional expression"
+            else:
+                continue
+            offenders = _traced_value_names(test) & traced
+            if offenders:
+                findings.append(Finding(
+                    index.rel_path, node.lineno, "JL001",
+                    index.qualname.get(fn, fn.name),
+                    f"python {kind} on traced argument(s) "
+                    f"{', '.join(sorted(offenders))} inside a jitted "
+                    f"function — use jnp.where/lax.cond or mark the "
+                    f"argument static", index.line_text(node.lineno)))
+
+
+def _check_host_sync(index, findings):
+    """JL002: host syncs inside registered hot-loop functions."""
+    for fn in index.hot_defs():
+        qual = index.qualname.get(fn, fn.name)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = None
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "item" and not node.args:
+                    msg = ".item() host sync"
+                elif f.attr == "block_until_ready":
+                    msg = "block_until_ready() device drain"
+                elif f.attr in ("device_get", "device_put") and \
+                        isinstance(f.value, ast.Name) and f.value.id == "jax":
+                    msg = f"jax.{f.attr}() host transfer"
+                elif f.attr in ("asarray", "array") and isinstance(
+                        f.value, ast.Name) and f.value.id in _NP_MODULES:
+                    msg = f"{f.value.id}.{f.attr}() device->host copy"
+            elif isinstance(f, ast.Name):
+                if f.id == "block_until_ready":
+                    msg = "block_until_ready() device drain"
+                elif f.id in _SYNC_BUILTINS and node.args and isinstance(
+                        node.args[0], (ast.Name, ast.Attribute, ast.Call,
+                                       ast.Subscript)):
+                    msg = (f"{f.id}() on a (possibly device) value forces a "
+                           f"host sync")
+            if msg:
+                findings.append(Finding(
+                    index.rel_path, node.lineno, "JL002", qual,
+                    f"{msg} inside hot loop '{qual}' — hoist it out of the "
+                    f"per-step path, batch to one transfer, or suppress "
+                    f"with a reason", index.line_text(node.lineno)))
+
+
+def _check_leaked_tracer(index, findings):
+    """JL003: stores to self.<attr>/globals from inside a jitted fn."""
+    for fn, _info in index.jitted_defs():
+        global_names = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Global, ast.Nonlocal)):
+                global_names.update(node.names)
+        for node in ast.walk(fn):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for tgt in targets:
+                for sub in ast.walk(tgt):
+                    bad = None
+                    if isinstance(sub, ast.Attribute) and isinstance(
+                            sub.value, ast.Name) and sub.value.id in (
+                            "self", "cls"):
+                        bad = f"{sub.value.id}.{sub.attr}"
+                    elif isinstance(sub, ast.Name) and isinstance(
+                            sub.ctx, ast.Store) and sub.id in global_names:
+                        bad = f"global {sub.id}"
+                    if bad:
+                        findings.append(Finding(
+                            index.rel_path, node.lineno, "JL003",
+                            index.qualname.get(fn, fn.name),
+                            f"store to {bad} from inside a jitted function "
+                            f"leaks a tracer — return the value instead",
+                            index.line_text(node.lineno)))
+
+
+def _call_name(call):
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _check_varying_static(index, findings):
+    """JL004: jitted call in a loop with the loop variable at a static
+    argument position."""
+    if not index.jit_registry:
+        return
+    for loop in ast.walk(index.tree):
+        if not isinstance(loop, ast.For):
+            continue
+        loop_vars = {n.id for n in ast.walk(loop.target)
+                     if isinstance(n, ast.Name)}
+        if not loop_vars:
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            info = index.jit_registry.get(name)
+            if info is None or not (info.static_nums or info.static_names):
+                continue
+            offenders = []
+            for i, arg in enumerate(node.args):
+                if i in info.static_nums or (
+                        i < len(info.params)
+                        and info.params[i] in info.static_names):
+                    used = {n.id for n in ast.walk(arg)
+                            if isinstance(n, ast.Name)}
+                    if used & loop_vars:
+                        offenders.append(f"positional arg {i}")
+            for kw in node.keywords:
+                if kw.arg in info.static_names or (
+                        kw.arg in info.params
+                        and info.params.index(kw.arg) in info.static_nums):
+                    used = {n.id for n in ast.walk(kw.value)
+                            if isinstance(n, ast.Name)}
+                    if used & loop_vars:
+                        offenders.append(f"keyword '{kw.arg}'")
+            if offenders:
+                findings.append(Finding(
+                    index.rel_path, node.lineno, "JL004",
+                    next((index.qualname[p] for p in index.qualname
+                          if loop in ast.walk(p)), "<module>"),
+                    f"call to jitted '{name}' inside a loop passes the loop "
+                    f"variable at static {', '.join(offenders)} — one "
+                    f"recompile per iteration; make it traced or hoist",
+                    index.line_text(node.lineno)))
+
+
+def _enclosing_functions(index):
+    """(function node, qualname) pairs plus the module body itself."""
+    out = [(index.tree, "<module>")]
+    for node in ast.walk(index.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append((node, index.qualname.get(node, node.name)))
+    return out
+
+
+def _walk_same_scope(stmt):
+    """ast.walk that does NOT descend into nested function/class defs —
+    their bodies run at a different time against different bindings."""
+    scopes = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+              ast.Lambda)
+    if isinstance(stmt, scopes):
+        yield stmt          # the def statement itself, not its body
+        return
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, scopes):
+                continue
+            stack.append(child)
+
+
+def _check_donated_read(index, findings):
+    """JL005: a buffer passed at a donated position is read after the
+    donating call without being rebound first."""
+    if not any(info.donate_nums or info.donate_names
+               for info in index.jit_registry.values()):
+        return
+    for scope, qual in _enclosing_functions(index):
+        body = getattr(scope, "body", [])
+        # statements in source order, with the exprs each one rebinds
+        stmts = [(s, _stmt_rebinds(s)) for s in body]
+        for si, (stmt, rebinds) in enumerate(stmts):
+            for call in _walk_same_scope(stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                info = index.jit_registry.get(_call_name(call))
+                if info is None:
+                    continue
+                donated = []
+                for i, arg in enumerate(call.args):
+                    if i in info.donate_nums or (
+                            i < len(info.params)
+                            and info.params[i] in info.donate_names):
+                        key = _expr_key(arg)
+                        if key is not None:
+                            donated.append(key)
+                for kw in call.keywords:
+                    if kw.arg in info.donate_names:
+                        key = _expr_key(kw.value)
+                        if key is not None:
+                            donated.append(key)
+                if not donated:
+                    continue
+                live = [k for k in donated if k not in rebinds]
+                for later, later_rebinds in stmts[si + 1:]:
+                    if not live:
+                        break
+                    still = []
+                    for key in live:
+                        if _stmt_reads(later, key):
+                            findings.append(Finding(
+                                index.rel_path, later.lineno, "JL005", qual,
+                                f"'{key}' was donated to jitted "
+                                f"'{_call_name(call)}' on line "
+                                f"{call.lineno} and is read here — the "
+                                f"buffer is invalidated; rebind the result "
+                                f"first", index.line_text(later.lineno)))
+                        elif key not in later_rebinds:
+                            still.append(key)
+                        # rebound or flagged: stop tracking either way
+                    live = still
+
+
+def _stmt_rebinds(stmt):
+    keys = set()
+    for node in _walk_same_scope(stmt):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.For):
+            targets = [node.target]
+        for tgt in targets:
+            keys.update(_target_keys(tgt))
+    return keys
+
+
+def _stmt_reads(stmt, key):
+    for node in _walk_same_scope(stmt):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if _expr_key(node) == key and isinstance(
+                    getattr(node, "ctx", None), ast.Load):
+                # attribute chains nest: only match the full chain root
+                return True
+    return False
+
+
+def _check_fp16_dtype(index, findings):
+    """JL006: jnp constructors without an explicit dtype in fp16 paths."""
+    posix = index.rel_path.replace(os.sep, "/")
+    if not any(frag in posix for frag in FP16_PATH_FRAGMENTS):
+        return
+    for node in ast.walk(index.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == "jnp" and f.attr in _JNP_CTORS_MIN_ARGS):
+            continue
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        if len(node.args) >= _JNP_CTORS_MIN_ARGS[f.attr]:
+            continue
+        qual = "<module>"
+        for p, q in _enclosing_functions(index)[1:]:
+            if node in ast.walk(p):
+                qual = q
+        findings.append(Finding(
+            index.rel_path, node.lineno, "JL006", qual,
+            f"jnp.{f.attr}(...) without an explicit dtype in an fp16 code "
+            f"path defaults to float32 — pass dtype= to keep the intended "
+            f"precision", index.line_text(node.lineno)))
+
+
+_CHECKS = (
+    _check_traced_branch,
+    _check_host_sync,
+    _check_leaked_tracer,
+    _check_varying_static,
+    _check_donated_read,
+    _check_fp16_dtype,
+)
+
+
+def analyze_source(source, rel_path="<string>", path=None):
+    """Findings for one python source string (suppressions applied)."""
+    index = _FileIndex(path or rel_path, rel_path, source)
+    findings = []
+    for check in _CHECKS:
+        check(index, findings)
+    findings = [f for f in findings if not index.suppressed(f.line, f.code)]
+    findings.sort(key=lambda f: (f.line, f.code))
+    return findings
+
+
+def analyze_file(path, root):
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        return analyze_source(source, rel_path=rel, path=path)
+    except SyntaxError as e:
+        return [Finding(rel, e.lineno or 1, "JL000", "<module>",
+                        f"file does not parse: {e.msg}", "")]
+
+
+def iter_python_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git", "node_modules"))
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+
+
+def analyze_paths(paths, root):
+    findings = []
+    n_files = 0
+    for path in iter_python_files(paths):
+        n_files += 1
+        findings.extend(analyze_file(path, root))
+    return findings, n_files

@@ -1,0 +1,74 @@
+"""Checked-in finding baseline: pre-existing findings don't block CI,
+any NEW finding does.
+
+The baseline maps finding fingerprints (path + code + symbol +
+normalized line text — no line numbers, so unrelated edits don't churn
+it) to occurrence counts. A lint run fails when any fingerprint's
+current count exceeds its baselined count; fingerprints that disappeared
+are reported as stale so the file can be shrunk intentionally
+(``make lint-jax-baseline``).
+"""
+
+import json
+from collections import Counter
+
+BASELINE_VERSION = 1
+
+
+def count_findings(findings):
+    return Counter(f.fingerprint() for f in findings)
+
+
+def load_baseline(path):
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(f"{path}: not a jaxlint baseline (no 'findings')")
+    version = data.get("version")
+    if version != BASELINE_VERSION:
+        raise ValueError(
+            f"{path}: baseline version {version!r} != {BASELINE_VERSION} — "
+            f"regenerate with --write-baseline")
+    counts = data["findings"]
+    if not isinstance(counts, dict) or not all(
+            isinstance(v, int) and v >= 1 for v in counts.values()):
+        raise ValueError(f"{path}: 'findings' must map fingerprints to "
+                         f"positive counts")
+    return Counter(counts)
+
+
+def write_baseline(path, findings):
+    counts = count_findings(findings)
+    data = {
+        "version": BASELINE_VERSION,
+        "tool": "jaxlint",
+        "note": ("Pre-existing findings grandfathered out of the CI gate. "
+                 "Shrink me: fix a finding, then run make lint-jax-baseline. "
+                 "Never grow me by hand — new findings must be fixed or "
+                 "suppressed inline with a reason."),
+        "findings": {fp: n for fp, n in sorted(counts.items())},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return counts
+
+
+def diff_against_baseline(findings, baseline_counts):
+    """(new_findings, stale_fingerprints): ``new_findings`` are the
+    concrete Finding objects past each fingerprint's baselined count
+    (deterministic: the highest line numbers are the "new" ones);
+    ``stale_fingerprints`` are baselined entries that no longer occur."""
+    current = {}
+    for f in findings:
+        current.setdefault(f.fingerprint(), []).append(f)
+    new = []
+    for fp, group in current.items():
+        allowed = baseline_counts.get(fp, 0)
+        if len(group) > allowed:
+            group = sorted(group, key=lambda f: f.line)
+            new.extend(group[allowed:])
+    stale = [fp for fp, n in baseline_counts.items()
+             if len(current.get(fp, ())) < n]
+    new.sort(key=lambda f: (f.path, f.line, f.code))
+    return new, sorted(stale)

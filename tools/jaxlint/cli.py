@@ -1,0 +1,115 @@
+"""jaxlint command line.
+
+    python -m tools.jaxlint deepspeed_tpu --baseline jaxlint_baseline.json
+    python -m tools.jaxlint deepspeed_tpu --baseline jaxlint_baseline.json \
+        --write-baseline
+
+Exit codes: 0 = clean (or only baselined findings), 1 = new findings,
+2 = usage/baseline error. No jax import anywhere on this path — the
+whole run is AST-only and finishes in seconds (< 30 s CI budget).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from tools.jaxlint import baseline as baseline_mod
+from tools.jaxlint.analyzer import analyze_paths
+from tools.jaxlint.rules import RULES
+
+
+def _summarize(findings):
+    by_code = {}
+    for f in findings:
+        by_code.setdefault(f.code, []).append(f)
+    return by_code
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="Static JAX hazard analyzer (recompiles, host syncs, "
+                    "leaked tracers, donation bugs, fp16 dtype drift).")
+    parser.add_argument("paths", nargs="+",
+                        help="files or directories to analyze")
+    parser.add_argument("--root", default=os.getcwd(),
+                        help="paths in findings are relative to this "
+                             "(default: cwd)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON; findings in it don't fail the "
+                             "run, new ones do")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="regenerate --baseline from the current "
+                             "findings and exit 0")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule codes to run (default: "
+                             "all)")
+    args = parser.parse_args(argv)
+
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"jaxlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    t0 = time.monotonic()
+    findings, n_files = analyze_paths(args.paths, args.root)
+    if args.select:
+        keep = {c.strip() for c in args.select.split(",") if c.strip()}
+        unknown = keep - set(RULES) - {"JL000"}
+        if unknown:
+            print(f"jaxlint: unknown rule code(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        findings = [f for f in findings if f.code in keep]
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline:
+        if not args.baseline:
+            print("jaxlint: --write-baseline requires --baseline",
+                  file=sys.stderr)
+            return 2
+        counts = baseline_mod.write_baseline(args.baseline, findings)
+        print(f"jaxlint: wrote {args.baseline}: {sum(counts.values())} "
+              f"finding(s) across {len(counts)} fingerprint(s) "
+              f"({n_files} files, {elapsed:.2f}s)")
+        return 0
+
+    baseline_counts = {}
+    if args.baseline:
+        try:
+            baseline_counts = baseline_mod.load_baseline(args.baseline)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"jaxlint: cannot load baseline: {e}", file=sys.stderr)
+            return 2
+
+    new, stale = baseline_mod.diff_against_baseline(findings, baseline_counts)
+
+    if args.format == "json":
+        print(json.dumps({
+            "files": n_files,
+            "elapsed_s": round(elapsed, 3),
+            "total_findings": len(findings),
+            "baselined": len(findings) - len(new),
+            "new": [f.to_dict() for f in new],
+            "stale_baseline_entries": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"jaxlint: note: {len(stale)} baseline entr"
+                  f"{'y is' if len(stale) == 1 else 'ies are'} stale "
+                  f"(fixed findings) — run make lint-jax-baseline to shrink "
+                  f"the baseline")
+        status = "FAILED" if new else "ok"
+        print(f"jaxlint {status}: {n_files} files in {elapsed:.2f}s — "
+              f"{len(findings)} finding(s), "
+              f"{len(findings) - len(new)} baselined, {len(new)} new")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

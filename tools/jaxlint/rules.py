@@ -1,0 +1,71 @@
+"""jaxlint rule registry: every hazard the analyzer knows, by code.
+
+Each rule is a static JAX-hazard class with a stable ``JLxxx`` code used
+in findings, inline suppressions (``# jaxlint: disable=JL002(reason)``),
+and the checked-in baseline. The detection logic lives in analyzer.py;
+this module is the single place codes, names, and one-line rationales
+are defined (docs/static_analysis.md documents each with examples).
+"""
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str
+    name: str
+    summary: str
+
+
+RULES = {
+    "JL001": Rule(
+        "JL001", "traced-python-branch",
+        "Python if/while/assert on a traced argument inside a jitted "
+        "function: concretization error at trace time, or a silent "
+        "recompile per value if the arg is marked static later."),
+    "JL002": Rule(
+        "JL002", "host-sync-in-hot-loop",
+        "Host-synchronizing call (.item(), float()/int()/bool() on device "
+        "values, np.asarray, jax.device_get, block_until_ready) inside a "
+        "registered hot-loop function: stalls the device pipeline every "
+        "iteration."),
+    "JL003": Rule(
+        "JL003", "leaked-tracer-store",
+        "Store to self.<attr> or a global from inside a jitted function: "
+        "the stored value is a tracer that escapes the trace and raises "
+        "(or silently goes stale) when read later."),
+    "JL004": Rule(
+        "JL004", "varying-static-arg-in-loop",
+        "Jitted call inside a Python loop passing the loop variable at a "
+        "static argument position: one full recompile per iteration."),
+    "JL005": Rule(
+        "JL005", "donated-buffer-read",
+        "Buffer passed at a donated argument position is read again after "
+        "the donating call: donated buffers are invalidated by XLA and "
+        "reads return garbage or raise."),
+    "JL006": Rule(
+        "JL006", "fp16-implicit-dtype",
+        "jnp array constructor without an explicit dtype inside an fp16 "
+        "code path: defaults to float32 and silently upcasts the mixed "
+        "expression (or doubles memory) where fp16 was intended."),
+}
+
+ALL_CODES = tuple(sorted(RULES))
+
+# -- JL002 hot-loop registry -------------------------------------------------
+# Fully-qualified (posix path suffix, function qualname) pairs the repo
+# considers steady-state hot loops: the serving decode step and both
+# training engines' per-step core. A function is also treated as hot when
+# its `def` line (or the line above) carries a `# jaxlint: hot` marker,
+# so new hot loops opt in without editing this table.
+HOT_LOOPS = (
+    ("deepspeed_tpu/inference/serving/engine.py", "ServingEngine.step"),
+    ("deepspeed_tpu/runtime/engine.py", "DeepSpeedEngine._train_batch_now"),
+    ("deepspeed_tpu/runtime/pipe/engine.py", "PipelineEngine._train_batch_now"),
+)
+
+HOT_MARKER = "jaxlint: hot"
+
+# JL006 applies to fp16 code paths: files whose path contains a component
+# matching one of these fragments.
+FP16_PATH_FRAGMENTS = ("fp16",)
