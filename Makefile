@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving lint-jax lint-jax-baseline ops bench bench-serving
+.PHONY: test test-fast test-faults test-cluster test-serving lint-jax lint-jax-baseline ops bench bench-serving trace-smoke
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -39,6 +39,14 @@ lint-jax:
 # Never use this to absorb NEW findings — fix or suppress them with a reason.
 lint-jax-baseline:
 	python -m tools.jaxlint deepspeed_tpu tools --baseline jaxlint_baseline.json --write-baseline
+
+# End-to-end telemetry smoke on the CPU backend: short train loop +
+# serving burst + a real supervisor restart with the telemetry block
+# enabled, then validates the merged Chrome trace (train/serving spans,
+# request ids, lifecycle instants) and the live /metrics//healthz
+# endpoint. Writes trace_smoke.json (see docs/observability.md).
+trace-smoke:
+	python -m tools.trace_smoke
 
 ops:
 	$(MAKE) -C csrc

@@ -56,6 +56,7 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.inference.generation import _forward_chunk, _ln, _step
 from deepspeed_tpu.profiling.sentinels import CompileSentinel, transfer_free
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.inference.quantization import logits_table
 from deepspeed_tpu.inference.serving.config import ServingConfig
 from deepspeed_tpu.inference.serving.fault_injection import ServingFaultInjector
@@ -149,7 +150,8 @@ class ServingEngine:
     with ``submit()`` from any thread."""
 
     def __init__(self, params, model_config, serving_config=None,
-                 monitor=None, injector=None, sentinel_config=None):
+                 monitor=None, injector=None, sentinel_config=None,
+                 telemetry_config=None):
         cfg = serving_config or ServingConfig()
         self.params = params
         self.model_config = model_config
@@ -225,6 +227,39 @@ class ServingEngine:
         self._loop_thread = None
         self._stop = threading.Event()
 
+        # telemetry: an explicit block arms the process-global tracer and
+        # registry; an absent block leaves them untouched. Hot-path guard
+        # is one attribute read (self._tracer.enabled).
+        telemetry.configure_from_config(telemetry_config)
+        self._tracer = telemetry.get_tracer()
+        self._trace_file = None
+        self.telemetry_server = None
+        if telemetry_config is not None and telemetry_config.enabled:
+            self._trace_file = telemetry_config.trace_file
+            self.metrics.export_to(telemetry.get_registry())
+            if telemetry_config.http_port is not None:
+                self.telemetry_server = self._build_telemetry_server(
+                    telemetry_config.http_port)
+
+    def _build_telemetry_server(self, port):
+        srv = telemetry.TelemetryServer(
+            registry=telemetry.get_registry(), tracer=self._tracer, port=port)
+        srv.add_snapshot_provider("serving", self.metrics.snapshot)
+        srv.add_snapshot_provider("kv_pool", self.occupancy)
+        srv.add_snapshot_provider("prefix_cache", self.prefix_stats)
+        srv.add_health_provider("serving_loop", self._loop_health)
+        return srv.start()
+
+    def _loop_health(self):
+        """Healthy unless a background loop was started and then died
+        (synchronous step()/drain() driving is always healthy)."""
+        t = self._loop_thread
+        return {"healthy": t is None or t.is_alive(),
+                "background_loop": t is not None,
+                "steps": self._step_count,
+                "active_requests": len(self._active),
+                "queue_depth": self.scheduler.queue_depth()}
+
     @classmethod
     def from_config(cls, params, model_config, ds_config, rank=0,
                     injector=None):
@@ -239,7 +274,8 @@ class ServingEngine:
                    serving_config=ds_config.serving_config,
                    monitor=monitor_from_config(ds_config, rank),
                    injector=injector,
-                   sentinel_config=ds_config.sentinel_config)
+                   sentinel_config=ds_config.sentinel_config,
+                   telemetry_config=ds_config.telemetry_config)
 
     # -- request intake -------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=None, eos_token_id=None,
@@ -302,6 +338,16 @@ class ServingEngine:
         if self._active:
             if self.injector is not None:
                 self.injector.maybe_slow_decode(self._step_count)
+            # span args (request ids) are built ONLY when tracing is armed:
+            # disabled-mode cost is this one attribute read
+            if self._tracer.enabled:
+                dspan = self._tracer.span(
+                    "serving/decode_step", cat="serving",
+                    args={"request_ids": [r.id for r in self._active.values()],
+                          "active": len(self._active)})
+            else:
+                dspan = telemetry.NULL_SPAN
+            dspan.__enter__()
             t0 = time.monotonic()
             if self._lane_dirty:
                 # lane churn: ONE explicit upload of the lane vectors;
@@ -325,6 +371,7 @@ class ServingEngine:
             # the step's single deliberate sync: EOS checks need the tokens
             host_tokens = jax.device_get(self._dev_tokens)  # jaxlint: disable=JL002(one explicit host read per step)
             step_s = time.monotonic() - t0
+            dspan.__exit__(None, None, None)
             self._lane_tokens = host_tokens.copy()
             toks = host_tokens.tolist()
             now = time.monotonic()
@@ -382,6 +429,11 @@ class ServingEngine:
     def close(self):
         self.stop()
         self.metrics.close()
+        if self.telemetry_server is not None:
+            self.telemetry_server.stop()
+            self.telemetry_server = None
+        if self._trace_file:
+            self._tracer.write(self._trace_file)
 
     # -- admission ------------------------------------------------------
     def _admit_from_queue(self, stats):
@@ -389,6 +441,15 @@ class ServingEngine:
         head, gather every queued request sharing its (prefix-adjusted)
         bucket up to the free-slot count, and prefill them as ONE call.
         Long prompts divert to the chunked path (one at a time)."""
+        if self._tracer.enabled and self.scheduler.queue_depth() > 0:
+            with self._tracer.span(
+                    "serving/admission", cat="serving",
+                    args={"queue_depth": self.scheduler.queue_depth()}):
+                self._admit_from_queue_now(stats)
+        else:
+            self._admit_from_queue_now(stats)
+
+    def _admit_from_queue_now(self, stats):
         while self.pool.free_slots > 0:
             head = self.scheduler.pop_next()
             if head is None:
@@ -417,6 +478,12 @@ class ServingEngine:
         """Prefill ``group`` (same bucket) as one [MaxSlots, bucket] call
         and install each lane into its slot. Returns how many requests
         retired on their very first token."""
+        pspan = (self._tracer.span(
+                     "serving/prefill_batch", cat="serving",
+                     args={"request_ids": [r.id for r in group],
+                           "bucket": bucket})
+                 if self._tracer.enabled else telemetry.NULL_SPAN)
+        pspan.__enter__()
         B, total = self._prefill_batch, self.max_seq_len
         ids = np.zeros((B, bucket), np.int32)
         starts = np.zeros(B, np.int32)
@@ -474,6 +541,7 @@ class ServingEngine:
         # admission, not silently absorbed into the next decode step's
         # measured latency
         self.pool.k.block_until_ready()
+        pspan.__exit__(None, None, None)
         return retired
 
     # -- chunked prefill ------------------------------------------------
@@ -519,13 +587,19 @@ class ServingEngine:
         chunk = req.prompt[st.pos:st.pos + chunk_len]
         ids = np.zeros((1, chunk_len), np.int32)
         ids[0, :len(chunk)] = chunk
+        cspan = (self._tracer.span("serving/prefill_chunk", cat="serving",
+                                   args={"request_id": req.id, "pos": st.pos,
+                                         "chunk": len(chunk)})
+                 if self._tracer.enabled else telemetry.NULL_SPAN)
         t0 = time.monotonic()
-        st.k, st.v, first = _prefill_batch_jit(
-            self.params, st.k, st.v, jnp.asarray(ids),
-            jnp.asarray([st.pos], jnp.int32),
-            jnp.asarray([len(req.prompt)], jnp.int32), n_heads=self.n_heads)
-        if self.prefill_sentinel is not None:
-            self.prefill_sentinel.check()
+        with cspan:
+            st.k, st.v, first = _prefill_batch_jit(
+                self.params, st.k, st.v, jnp.asarray(ids),
+                jnp.asarray([st.pos], jnp.int32),
+                jnp.asarray([len(req.prompt)], jnp.int32),
+                n_heads=self.n_heads)
+            if self.prefill_sentinel is not None:
+                self.prefill_sentinel.check()
         st.pos += len(chunk)
         stats["prefill_chunks"] += 1
         if st.pos < len(req.prompt):
@@ -611,11 +685,19 @@ class ServingEngine:
             req.future._finish()
             self.scheduler.completed += 1
             self.metrics.record_completion()
+            if self._tracer.enabled:
+                self._tracer.instant("serving/retire", cat="serving",
+                                     args={"request_id": req.id,
+                                           "tokens": req.emitted})
             return 1
         return 0
 
     def _finish_timeout(self, req, phase):
         self._release_slot(req)
+        if self._tracer.enabled:
+            self._tracer.instant("serving/retire_timeout", cat="serving",
+                                 args={"request_id": req.id, "phase": phase,
+                                       "tokens": req.emitted})
         req.future._finish(RequestTimeoutError(
             req.id, req.timeout_s, phase, tokens_done=req.emitted))
         self.scheduler.timed_out += 1

@@ -12,6 +12,7 @@ object (``snapshot()``).
 """
 
 import time
+from collections import deque
 
 # TTFT percentile window: newest samples win once full (a long-running
 # server's p95 should describe current traffic, not hour-old compiles).
@@ -53,7 +54,9 @@ class ServingMetrics:
         self._ttft_sum = 0.0
         self._ttft_count = 0
         self._ttft_max = 0.0
-        self._ttft_window = []
+        # deque(maxlen=...) evicts the oldest sample in O(1); the old list
+        # did an O(n) pop(0) memmove per TTFT once full
+        self._ttft_window = deque(maxlen=_TTFT_WINDOW)
         self._started = time.monotonic()
 
     # -- recording hooks (engine calls these) ---------------------------
@@ -61,8 +64,6 @@ class ServingMetrics:
         self._ttft_sum += ttft_s
         self._ttft_count += 1
         self._ttft_max = max(self._ttft_max, ttft_s)
-        if len(self._ttft_window) >= _TTFT_WINDOW:
-            self._ttft_window.pop(0)
         self._ttft_window.append(ttft_s)
         self._record("Serving/ttft_s", ttft_s, self._ttft_count)
 
@@ -158,6 +159,17 @@ class ServingMetrics:
             "prefix_hit_rate": self.prefix_hit_rate(),
             "uptime_s": time.monotonic() - self._started,
         }
+
+    def export_to(self, registry, name="Serving/Snapshot"):
+        """Expose the numeric ``snapshot()`` fields as pull gauges on a
+        telemetry registry — rendered live at every ``/metrics`` scrape
+        (pushed gauges would be stale between monitor flushes)."""
+        registry.gauge_fn(
+            name,
+            lambda: {k: v for k, v in self.snapshot().items()
+                     if isinstance(v, (int, float)) and not isinstance(v, bool)},
+            help="live ServingMetrics.snapshot()")
+        return registry
 
     def close(self):
         if self.monitor is not None:

@@ -44,6 +44,10 @@ def parse_args():
                         help="Kill and restart a worker whose step heartbeat goes "
                              "stale for this long (0 = no liveness monitoring; must "
                              "exceed first-step compile time)")
+    parser.add_argument("--telemetry_port", default=None, type=int,
+                        help="Serve /healthz, /metrics, /snapshot and /trace from "
+                             "the supervisor on this port (0 = ephemeral; omit to "
+                             "disable)")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return parser.parse_args()
@@ -105,6 +109,7 @@ def main():
         max_restarts=args.max_restarts,
         backoff_s=args.restart_backoff_s,
         heartbeat_timeout_s=args.heartbeat_timeout_s,
+        http_port=args.telemetry_port,
         log=lambda msg: logger.warning(f"launch[{node_rank}]: {msg}"),
     )
     sys.exit(supervisor.run())

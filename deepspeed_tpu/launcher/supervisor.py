@@ -33,7 +33,9 @@ other nonzero / signal   crash; restart with exponential backoff
 
 This module is stdlib-only on purpose: the supervisor must stay importable
 (and restart workers) even when the training stack itself is the thing
-crashing.
+crashing. The optional telemetry endpoint (``http_port``) is imported
+lazily from ``deepspeed_tpu.telemetry`` — itself stdlib-only — and only
+when requested, so the no-telemetry path never touches it.
 """
 
 import os
@@ -83,7 +85,7 @@ class WorkerSupervisor:
     def __init__(self, cmd, env=None, max_restarts=0, backoff_s=1.0,
                  max_backoff_s=30.0, heartbeat_timeout_s=0.0,
                  heartbeat_file=None, poll_interval_s=0.05, term_grace_s=5.0,
-                 fatal_exit_codes=(EXIT_POISONED,), log=None):
+                 fatal_exit_codes=(EXIT_POISONED,), log=None, http_port=None):
         self.cmd = list(cmd)
         self.env = dict(env if env is not None else os.environ)
         self.max_restarts = int(max_restarts)
@@ -109,6 +111,8 @@ class WorkerSupervisor:
         self.exit_history = []  # [(exit_class, returncode), ...]
         self._shutdown_signal = None
         self._spawned_at = 0.0
+        self.http_port = http_port
+        self.telemetry_server = None
 
     # -- lifecycle -----------------------------------------------------
     def run(self):
@@ -118,9 +122,13 @@ class WorkerSupervisor:
                 prev[sig] = signal.signal(sig, self._on_signal)
             except ValueError:  # not the main thread (tests): no forwarding
                 pass
+        if self.http_port is not None:
+            self._start_telemetry_server()
         try:
             return self._supervise()
         finally:
+            if self.telemetry_server is not None:
+                self.telemetry_server.stop()
             for sig, handler in prev.items():
                 signal.signal(sig, handler)
 
@@ -138,6 +146,7 @@ class WorkerSupervisor:
                 return returncode
             cls = CLASS_HUNG if hung else classify_exit(returncode, self.fatal_exit_codes)
             self.exit_history.append((cls, returncode))
+            self._note_exit(cls, returncode)
             if cls == CLASS_CLEAN:
                 return EXIT_CLEAN
             if cls == CLASS_FATAL:
@@ -154,6 +163,7 @@ class WorkerSupervisor:
                 delay = 0.0  # resumable checkpoint committed: come back fast
             else:
                 delay = min(self.backoff_s * (2 ** (self.restarts - 1)), self.max_backoff_s)
+            self._note_restart(cls, returncode, delay)
             self._log(
                 f"worker {cls} (exit {returncode}); restart "
                 f"{self.restarts}/{self.max_restarts} in {delay:.1f}s"
@@ -220,6 +230,82 @@ class WorkerSupervisor:
         except subprocess.TimeoutExpired:
             self.child.kill()
             self.child.wait()
+
+    # -- telemetry (all lazily imported; no-ops unless requested) ------
+    def _telemetry(self):
+        """The telemetry package, or None. Imported only when the endpoint
+        was requested or something else in-process already loaded it, so a
+        bare supervisor never drags the package in just to note an exit."""
+        if self.http_port is None and "deepspeed_tpu.telemetry" not in sys.modules:
+            return None
+        try:
+            from deepspeed_tpu import telemetry
+            return telemetry
+        except Exception:
+            return None
+
+    def _start_telemetry_server(self):
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.telemetry import TelemetryServer
+
+        srv = TelemetryServer(registry=telemetry.get_registry(),
+                              tracer=telemetry.get_tracer(),
+                              port=int(self.http_port))
+        srv.add_health_provider("worker", self._worker_health)
+        srv.add_snapshot_provider("supervisor", self._snapshot)
+        telemetry.get_registry().gauge_fn(
+            "Supervisor/restarts", lambda: float(self.restarts),
+            help="worker restarts performed so far")
+        self.telemetry_server = srv.start()
+        self._log(f"telemetry endpoint at {srv.url}")
+        return srv
+
+    def _worker_health(self):
+        alive = self.child is not None and self.child.poll() is None
+        doc = {"healthy": alive, "restarts": self.restarts,
+               "max_restarts": self.max_restarts}
+        if alive and self.heartbeat_file is not None:
+            now = time.monotonic()
+            doc["heartbeat_age_s"] = round(now - self._last_beat(now), 3)
+            if self._heartbeat_stale(now):
+                doc["healthy"] = False
+                doc["reason"] = "heartbeat stale"
+        return doc
+
+    def _snapshot(self):
+        return {
+            "restarts": self.restarts,
+            "max_restarts": self.max_restarts,
+            "exit_history": [
+                {"class": cls, "returncode": rc} for cls, rc in self.exit_history
+            ],
+            "child_pid": getattr(self.child, "pid", None),
+            "child_alive": self.child is not None and self.child.poll() is None,
+        }
+
+    def _note_exit(self, cls, returncode):
+        tel = self._telemetry()
+        if tel is None:
+            return
+        tel.instant("worker/exit", cat="lifecycle",
+                    args={"class": cls, "returncode": returncode,
+                          "restarts": self.restarts})
+        tel.get_registry().counter(
+            f"Supervisor/exits/{cls}",
+            help="worker exits by supervision class").inc()
+
+    def _note_restart(self, cls, returncode, delay):
+        tel = self._telemetry()
+        if tel is None:
+            return
+        tel.instant("worker/restart", cat="lifecycle",
+                    args={"class": cls, "returncode": returncode,
+                          "restart": self.restarts,
+                          "max_restarts": self.max_restarts,
+                          "delay_s": delay})
+        tel.get_registry().counter(
+            "Supervisor/restarts_total",
+            help="worker restarts performed by the supervisor").inc()
 
     def _on_signal(self, signum, frame):
         self._shutdown_signal = signum

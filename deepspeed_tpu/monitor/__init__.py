@@ -26,7 +26,10 @@ class MultiMonitor:
 def monitor_from_config(config, rank):
     """Build the configured monitor (None / one backend / MultiMonitor) —
     the ONE construction path shared by every engine, so a new backend
-    cannot be wired into one engine and silently ignored by another."""
+    cannot be wired into one engine and silently ignored by another.
+    With the ``telemetry`` block enabled, a ``MonitorBridge`` rides along
+    so every recorded scalar also lands in the process-global metrics
+    registry (rendered on the introspection endpoint's ``/metrics``)."""
     monitors = []
     if config.tensorboard_enabled:
         monitors.append(TensorBoardMonitor(
@@ -36,6 +39,11 @@ def monitor_from_config(config, rank):
         monitors.append(CsvMonitor(
             config.csv_monitor_output_path, config.csv_monitor_job_name,
             rank=rank))
+    tel = getattr(config, "telemetry_config", None)
+    if tel is not None and tel.enabled:
+        from deepspeed_tpu import telemetry
+        monitors.append(telemetry.MonitorBridge(telemetry.get_registry(),
+                                                rank=rank))
     if not monitors:
         return None
     return monitors[0] if len(monitors) == 1 else MultiMonitor(monitors)

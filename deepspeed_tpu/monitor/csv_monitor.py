@@ -9,8 +9,14 @@ Config::
                     "job_name": "DeepSpeedJobName"} # default
 """
 
+import atexit
 import os
 import time
+
+# Bounded auto-flush: a run that dies between explicit flush() calls loses
+# at most this many rows (and the atexit hook catches clean interpreter
+# exits — only a hard kill inside the window can drop rows).
+_AUTO_FLUSH_EVERY = 256
 
 
 class CsvMonitor:
@@ -18,7 +24,8 @@ class CsvMonitor:
     ``step,value,walltime``. Buffered like TensorBoardMonitor: ``record``
     defers the host transfer, ``flush`` converts and appends."""
 
-    def __init__(self, output_path, job_name, rank=0):
+    def __init__(self, output_path, job_name, rank=0,
+                 auto_flush_every=_AUTO_FLUSH_EVERY):
         base = output_path or os.path.join("runs", "deepspeed_tpu")
         self.enabled = rank == 0
         self.dir = os.path.join(base, job_name)
@@ -26,10 +33,15 @@ class CsvMonitor:
             os.makedirs(self.dir, exist_ok=True)
         self._pending = []
         self._headers_written = set()
+        self._auto_flush_every = int(auto_flush_every)
+        if self.enabled:
+            atexit.register(self.flush)
 
     def record(self, tag, value, step):
         if self.enabled:
             self._pending.append((tag, value, int(step), time.time()))
+            if len(self._pending) >= self._auto_flush_every:
+                self.flush()
 
     def _path(self, tag):
         safe = "".join(c if (c.isalnum() or c in "-_.") else "_" for c in tag)
@@ -58,3 +70,8 @@ class CsvMonitor:
 
     def close(self):
         self.flush()
+        if self.enabled:
+            try:
+                atexit.unregister(self.flush)
+            except Exception:
+                pass

@@ -26,6 +26,30 @@ import jax
 
 from deepspeed_tpu.utils.logging import logger
 
+# Published bf16 peak TFLOPs per chip by device-kind substring (the table
+# bench.py uses for its MFU column — kept here so the profiler's exported
+# Train/Samples/mfu gauge and the bench agree on the denominator).
+_PEAK_TFLOPS = [
+    ("v6", 918.0),        # Trillium
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),   # v5e reports "TPU v5 lite"
+    ("v5e", 197.0),
+    ("v5", 459.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
+
+
+def device_peak_tflops(device_kind):
+    """Peak bf16 TFLOPs for a jax ``device_kind`` string, None if unknown
+    (CPU / unrecognized accelerator — MFU is then unreportable)."""
+    kind = (device_kind or "").lower()
+    for sub, peak in _PEAK_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
+
 
 def _count_params(params):
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
@@ -190,6 +214,24 @@ class FlopsProfiler:
 
     def set_params(self, params_tree):
         self.params = _count_params(params_tree)
+
+    def achieved_tflops(self):
+        """Model TFLOPs/s of the profiled step (flops / wall duration), or
+        None before a profile completes."""
+        if not self.flops or self.duration <= 0:
+            return None
+        return self.flops / self.duration / 1e12
+
+    def mfu(self, device_kind=None):
+        """Model FLOPs utilization vs the device's peak, or None when the
+        peak is unknown (CPU, unrecognized accelerator)."""
+        achieved = self.achieved_tflops()
+        if achieved is None:
+            return None
+        if device_kind is None:
+            device_kind = jax.devices()[0].device_kind
+        peak = device_peak_tflops(device_kind)
+        return achieved / peak if peak else None
 
     def _inclusive_tree(self):
         """Inclusive per-scope totals: every scope accumulates its subtree

@@ -24,6 +24,8 @@ from contextlib import contextmanager
 
 import jax
 
+from deepspeed_tpu import telemetry
+
 __all__ = [
     "CompileBudgetExceededError",
     "CompileSentinel",
@@ -81,6 +83,7 @@ class CompileSentinel:
         self.name = name or getattr(fn, "__name__", "jitted function")
         self._lock = threading.Lock()
         self._baseline = compile_cache_size(fn)
+        self._last_seen = 0
 
     @property
     def compiles(self):
@@ -91,6 +94,12 @@ class CompileSentinel:
         """Raise CompileBudgetExceededError past the budget; returns the
         current compile count otherwise (handy for asserts)."""
         compiles = self.compiles
+        if compiles > self._last_seen:
+            telemetry.instant(
+                "jax/recompile", cat="lifecycle",
+                args={"name": self.name, "compiles": compiles,
+                      "budget": self.budget})
+            self._last_seen = compiles
         if compiles > self.budget:
             raise CompileBudgetExceededError(self.name, compiles, self.budget)
         return compiles
@@ -100,6 +109,7 @@ class CompileSentinel:
         optionally move the budget."""
         with self._lock:
             self._baseline = compile_cache_size(self._fn)
+            self._last_seen = 0
             if budget is not None:
                 if budget < 0:
                     raise ValueError(f"budget must be >= 0, got {budget}")

@@ -27,6 +27,7 @@ from deepspeed_tpu.runtime.zero.constants import (
 )
 from deepspeed_tpu.runtime.activation_checkpointing.config import DeepSpeedActivationCheckpointingConfig
 from deepspeed_tpu.profiling.config import DeepSpeedFlopsProfilerConfig, DeepSpeedSentinelConfig
+from deepspeed_tpu.telemetry.config import DeepSpeedTelemetryConfig
 from deepspeed_tpu.utils.logging import logger
 
 TENSOR_CORE_ALIGN_SIZE = 8
@@ -688,6 +689,7 @@ class DeepSpeedConfig:
         self.activation_checkpointing_config = DeepSpeedActivationCheckpointingConfig(param_dict)
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
         self.sentinel_config = DeepSpeedSentinelConfig(param_dict)
+        self.telemetry_config = DeepSpeedTelemetryConfig(param_dict)
 
         self.fp16_enabled = get_fp16_enabled(param_dict)
         self.bfloat16_enabled = get_bfloat16_enabled(param_dict)

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from deepspeed_tpu.profiling.sentinels import CompileSentinel, transfer_free
+from deepspeed_tpu.telemetry import NULL_SPAN as _NULL_SPAN
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.constants import (
     ADAM_OPTIMIZER,
@@ -324,6 +325,14 @@ class DeepSpeedEngine:
         self.monitor = None
         self._last_loss = None
         self._loss_sum = None
+        # telemetry: an explicit `telemetry` block arms the process-global
+        # tracer + metrics registry (absent block: no-op); the monitor
+        # construction below then rides a MonitorBridge so every Train/*
+        # scalar also lands on the introspection endpoint's /metrics
+        from deepspeed_tpu import telemetry
+
+        telemetry.configure_from_config(self._config.telemetry_config)
+        self._tracer = telemetry.get_tracer()
         from deepspeed_tpu.monitor import monitor_from_config
 
         self.monitor = monitor_from_config(self._config, self.global_rank)
@@ -1132,7 +1141,10 @@ class DeepSpeedEngine:
                 fwd_bwd = self._get_fwd_bwd_onebit(needs_rng, len(batch))
             else:
                 fwd_bwd = self._get_fwd_bwd(needs_rng)
-            loss, grads = fwd_bwd(self.params, self.scaler_state.cur_scale, self._next_rng(), theta, *batch)
+            with (self._tracer.span("train/forward_backward", cat="train",
+                                    args={"step": self.global_steps})
+                  if self._tracer.enabled else _NULL_SPAN):
+                loss, grads = fwd_bwd(self.params, self.scaler_state.cur_scale, self._next_rng(), theta, *batch)
             self._cached_grads = grads
             self._last_loss = loss
             result = loss
@@ -1165,6 +1177,7 @@ class DeepSpeedEngine:
                 top_modules=self._config.flops_profiler_config.top_modules,
                 detailed=self._config.flops_profiler_config.detailed,
             )
+            self._record_flops_gauges()     # before end_profile resets
             self.flops_profiler.end_profile()
 
         if self.progressive_layer_drop:
@@ -1174,6 +1187,23 @@ class DeepSpeedEngine:
             self.timers("forward").stop(sync=False)
             self.timers("forward_microstep").stop()
         return result
+
+    def _record_flops_gauges(self):
+        """Export the profiled step's achieved model TFLOPs (and MFU when
+        the device's peak is known) through the monitor fan-out — the
+        profiler always computed these; now dashboards and /metrics see
+        them instead of just the printed report."""
+        prof = self.flops_profiler
+        if prof is None or self.monitor is None:
+            return
+        achieved = prof.achieved_tflops()
+        if achieved is None:
+            return
+        samples = self.global_samples
+        self.monitor.record("Train/Samples/model_tflops", achieved, samples)
+        mfu = prof.mfu()
+        if mfu is not None:
+            self.monitor.record("Train/Samples/mfu", mfu, samples)
 
     __call__ = forward
 
@@ -1271,12 +1301,19 @@ class DeepSpeedEngine:
         self._ensure_opt_state()
         lr = self.get_lr()[0] if self.lr_scheduler is not None else None
         if self.zero_optimization() and self.zero_cpu_offload():
-            self._take_model_step_host(lr)
+            with (self._tracer.span("train/optimizer_step", cat="train",
+                                    args={"step": self.global_steps,
+                                          "offload": True})
+                  if self._tracer.enabled else _NULL_SPAN):
+                self._take_model_step_host(lr)
             return
         step_fn = self._get_onebit_step_fn() if self._onebit_path() else self._get_step_fn()
-        self.params, self.opt_state, self.scaler_state, overflow, gnorm, self._acc_grads = step_fn(
-            self.params, self.opt_state, self._acc_grads, self.scaler_state, jnp.asarray(lr if lr is not None else self._optimizer_base_lr(), jnp.float32)
-        )
+        with (self._tracer.span("train/optimizer_step", cat="train",
+                                args={"step": self.global_steps})
+              if self._tracer.enabled else _NULL_SPAN):
+            self.params, self.opt_state, self.scaler_state, overflow, gnorm, self._acc_grads = step_fn(
+                self.params, self.opt_state, self._acc_grads, self.scaler_state, jnp.asarray(lr if lr is not None else self._optimizer_base_lr(), jnp.float32)
+            )
         # bf16/fp32 never overflow-skip — _finish_step_bookkeeping syncs the
         # overflow verdict only under fp16, so XLA queues steps back-to-back.
         self._finish_step_bookkeeping(overflow)
@@ -1424,7 +1461,13 @@ class DeepSpeedEngine:
         sent = self._config.sentinel_config
         guard = (transfer_free() if sent.enabled and sent.transfer_guard
                  else nullcontext())
-        with guard:
+        # fused path: fwd+bwd+grad-comm+update are ONE dispatch, so they
+        # share one span (the 3-call path gets per-phase spans instead)
+        fspan = (self._tracer.span("train/fwd_bwd_opt_step", cat="train",
+                                   args={"step": self.global_steps,
+                                         "gas": gas})
+                 if self._tracer.enabled else _NULL_SPAN)
+        with fspan, guard:
             self.params, self.opt_state, self.scaler_state, loss, overflow, gnorm = fused(
                 self.params, self.opt_state, self.scaler_state, self._next_rng(), theta,
                 lr, *stacked,
@@ -1495,7 +1538,10 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
         if self.resilience is not None:
             return self.resilience.train_batch(data_iter, self._train_batch_now, gas)
-        micro = [next(data_iter) for _ in range(gas)]
+        with (self._tracer.span("train/batch_fetch", cat="train",
+                                args={"step": self.global_steps, "gas": gas})
+              if self._tracer.enabled else _NULL_SPAN):
+            micro = [next(data_iter) for _ in range(gas)]
         return self._train_batch_now(micro)
 
     def _train_batch_now(self, micro):
@@ -1506,7 +1552,13 @@ class DeepSpeedEngine:
         if self._can_fuse_train_step():
             loss = self.train_step(micro)
             # the step's single deliberate sync: the mean loss for the caller
-            return float(jax.device_get(loss))  # jaxlint: disable=JL002(one explicit host read per step)
+            # (spanned separately from the dispatch — async dispatch means
+            # the compute wall time shows up HERE, not in the fused span)
+            sspan = (self._tracer.span("train/loss_sync", cat="train",
+                                       args={"step": self.global_steps})
+                     if self._tracer.enabled else _NULL_SPAN)
+            with sspan:
+                return float(jax.device_get(loss))  # jaxlint: disable=JL002(one explicit host read per step)
         losses = []
         for batch in micro:
             if not isinstance(batch, (tuple, list)):
@@ -1516,8 +1568,12 @@ class DeepSpeedEngine:
             losses.append(loss)  # device values: sync ONCE after the loop
             self.step()
         # ONE batched transfer for all gas microbatch losses, not gas syncs
-        host_losses = jax.device_get(losses)  # jaxlint: disable=JL002(one explicit host read per step)
-        return float(np.mean(host_losses))  # jaxlint: disable=JL002(host-side scalar, already transferred)
+        sspan = (self._tracer.span("train/loss_sync", cat="train",
+                                   args={"step": self.global_steps})
+                 if self._tracer.enabled else _NULL_SPAN)
+        with sspan:
+            host_losses = jax.device_get(losses)  # jaxlint: disable=JL002(one explicit host read per step)
+            return float(np.mean(host_losses))  # jaxlint: disable=JL002(host-side scalar, already transferred)
 
     # ------------------------------------------------------------------
     # checkpointing (parity: engine.py:1271-1561), routed through the
@@ -1590,6 +1646,11 @@ class DeepSpeedEngine:
             tag = f"global_step{self.global_steps}"
         client_state = client_state or {}
         self._checkpoint_tag_validation(tag)
+        ckspan = (self._tracer.span("train/checkpoint_save", cat="train",
+                                    args={"tag": tag,
+                                          "step": self.global_steps})
+                  if self._tracer.enabled else _NULL_SPAN)
+        ckspan.__enter__()
 
         storage = self.checkpoint_storage
         writer = storage.tag_writer(save_dir, tag, uncommit=self.global_rank == 0)
@@ -1636,6 +1697,10 @@ class DeepSpeedEngine:
                 storage.write_latest(save_dir, tag)
             storage.rotate(save_dir)
         self._ckpt_commit_barrier(tag)
+        if self._tracer.enabled:
+            self._tracer.instant("checkpoint/commit", cat="lifecycle",
+                                 args={"tag": tag, "step": self.global_steps})
+        ckspan.__exit__(None, None, None)
         if self.resilience is not None:
             # the committed tag is the new rollback target; the replay
             # buffer restarts from here
@@ -1833,6 +1898,12 @@ class DeepSpeedEngine:
             cfg.train_micro_batch_size_per_gpu != plan["micro_batch_size"]
             or cfg.gradient_accumulation_steps != plan["gradient_accumulation_steps"]
         )
+        if self._tracer.enabled:
+            self._tracer.instant(
+                "resilience/elastic_resume", cat="lifecycle",
+                args={"prev_dp": saved_dp, "new_dp": self.dp_world_size,
+                      "micro_batch_size": plan["micro_batch_size"],
+                      "gas": plan["gradient_accumulation_steps"]})
         cfg.train_batch_size = plan["train_batch_size"]
         cfg.train_micro_batch_size_per_gpu = plan["micro_batch_size"]
         cfg.gradient_accumulation_steps = plan["gradient_accumulation_steps"]

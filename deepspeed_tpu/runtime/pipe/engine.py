@@ -29,6 +29,7 @@ TPU-first redesign (single-controller, no NCCL p2p):
 
 import os
 import pickle
+import time
 
 import numpy as np
 
@@ -49,6 +50,7 @@ from deepspeed_tpu.runtime.lr_schedules import get_lr_schedule
 from deepspeed_tpu.runtime.pipe import schedule as pipe_schedule
 from deepspeed_tpu.runtime.pipe.module import PipelineModule, TiedLayerSpec
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from deepspeed_tpu.utils import distributed as dist
@@ -216,6 +218,17 @@ class PipelineEngine:
         # backend (tensorboard, csv, both) works identically here
         from deepspeed_tpu.monitor import monitor_from_config
 
+        # telemetry: same process-global tracer/registry as DeepSpeedEngine
+        # (armed only by an explicit `telemetry` block); monitor_from_config
+        # below bridges Train/* scalars into the registry when armed
+        from deepspeed_tpu import telemetry
+
+        telemetry.configure_from_config(self._config.telemetry_config)
+        self._tracer = telemetry.get_tracer()
+        # per-stage wall time of the LAST interpreted step (seconds),
+        # accumulated by _dispatch; exported as Train/Pipe/stage*_time_ms
+        self._stage_wall_s = [0.0] * self.num_stages
+
         self.monitor = monitor_from_config(self._config, dist.get_rank())
 
         # step-level resilience: divergence guard + watchdog + auto-rollback
@@ -259,9 +272,11 @@ class PipelineEngine:
         if getattr(self._config, "flops_profiler_config", None) is not None \
                 and getattr(self._config.flops_profiler_config, "enabled", False):
             logger.warning(
-                "flops_profiler is not implemented for PipelineEngine "
-                "(per-module attribution works on DeepSpeedEngine's forward "
-                "graph) — section ignored")
+                "flops_profiler per-module attribution is not implemented "
+                "for PipelineEngine (it works on DeepSpeedEngine's forward "
+                "graph) — flops totals are skipped; per-stage wall-time "
+                "gauges (Train/Pipe/stage*_time_ms) are exported through "
+                "the monitor instead")
         if getattr(self._config, "sparse_gradients_enabled", False):
             logger.warning(
                 "sparse_gradients (CSR embedding grads) is a DeepSpeedEngine "
@@ -1295,7 +1310,12 @@ class PipelineEngine:
                 "interpreter cannot cross process boundaries"
             )
         if mode is not None:
-            loss = self._train_batch_compiled(micro, mode)
+            cspan = (self._tracer.span("pipe/compiled_step", cat="pipe",
+                                       args={"step": self.global_steps,
+                                             "mode": mode})
+                     if self._tracer.enabled else telemetry.NULL_SPAN)
+            with cspan:
+                loss = self._train_batch_compiled(micro, mode)
             if loss is None:
                 mode = None  # compiled bowed out (e.g. uncarryable state)
                 if self._multi_host:
@@ -1348,7 +1368,12 @@ class PipelineEngine:
 
         self._losses = []
         sched = _MergedSchedule(pipe_schedule.TrainSchedule, self.micro_batches, self.num_stages)
-        self._exec_schedule(sched, micro)
+        espan = (self._tracer.span("pipe/exec_schedule", cat="pipe",
+                                   args={"step": self.global_steps,
+                                         "micro_batches": self.micro_batches})
+                 if self._tracer.enabled else telemetry.NULL_SPAN)
+        with espan:
+            self._exec_schedule(sched, micro)
 
         # ONE batched transfer for every microbatch loss, not micro_batches syncs
         host_losses = jax.device_get(self._losses)  # jaxlint: disable=JL002(one explicit host read per step)
@@ -1362,6 +1387,11 @@ class PipelineEngine:
             self.monitor.record("Train/Samples/lr", self.get_lr()[0], self.global_samples)
             if self._fp16:
                 self.monitor.record("Train/Samples/loss_scale", self.scaler_state.cur_scale, self.global_samples)
+            # per-stage host wall time of THIS step (accumulated by
+            # _dispatch over the schedule's instructions)
+            for s, wall_s in enumerate(self._stage_wall_s):
+                self.monitor.record(f"Train/Pipe/stage{s}_time_ms",
+                                    wall_s * 1000.0, self.global_samples)
         self.tput_timer.stop(self.global_steps % self._config.steps_per_print == 0)
         if self.global_steps % self._config.steps_per_print == 0:
             log_dist(
@@ -1496,6 +1526,7 @@ class PipelineEngine:
     def _exec_schedule(self, sched, micro):
         self.pipe_buffers = {s: {} for s in range(self.num_stages)}
         self._micro = micro
+        self._stage_wall_s = [0.0] * self.num_stages
         self._load_count = {s: 0 for s in range(self.num_stages)}
         self._fwd_count = {s: 0 for s in range(self.num_stages)}
         self._bwd_count = {s: 0 for s in range(self.num_stages)}
@@ -1540,7 +1571,16 @@ class PipelineEngine:
         fn = getattr(self, f"_exec_{_snake(name)}", None)
         if fn is None:
             raise RuntimeError(f"{self.__class__.__name__} does not understand instruction {cmd}")
-        fn(s, cmd)
+        # per-instruction span + per-stage wall-time accumulation: this is
+        # host dispatch time (XLA runs async), which is exactly what the
+        # schedule-interleaving trace view needs
+        span = (self._tracer.span(f"pipe/{_snake(name)}", cat="pipe",
+                                  args={"stage": s})
+                if self._tracer.enabled else telemetry.NULL_SPAN)
+        t0 = time.perf_counter()
+        with span:
+            fn(s, cmd)
+        self._stage_wall_s[s] += time.perf_counter() - t0
 
     # -- instruction implementations (reference _INSTRUCTION_MAP :1136) ----
     def _exec_load_micro_batch(self, s, cmd):

@@ -125,6 +125,11 @@ class PreemptionHandler:
             return
         eng = self.engine
         save_dir = self._resolve_save_dir()
+        from deepspeed_tpu import telemetry
+
+        telemetry.instant("resilience/preemption", cat="lifecycle",
+                          args={"signal": int(self._received),
+                                "step": eng.global_steps})
         logger.warning(
             f"[preemption] signal {self._received} received — committing "
             f"emergency checkpoint at step {eng.global_steps} "

@@ -28,6 +28,7 @@ fast-forward exact: same batches, same order, same restored optimizer/scaler
 cost) grows with the distance to the last commit.
 """
 
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.runtime.resilience.errors import StepTimeoutError, TrainingDivergenceError
 from deepspeed_tpu.runtime.resilience.guard import DivergenceGuard
 from deepspeed_tpu.runtime.resilience.watchdog import TimedFetcher, timed_call
@@ -211,6 +212,8 @@ class ResilienceSupervisor:
                 # The same window failed twice across a rollback: treat the
                 # data as poisoned, quarantine it, and let the caller move on.
                 self.quarantined_steps.append(step)
+                telemetry.instant("resilience/quarantine", cat="lifecycle",
+                                  args={"step": step, "reason": reason})
                 self._consecutive_quarantines += 1
                 if self._consecutive_quarantines > self.config.max_recoveries:
                     raise TrainingDivergenceError(
@@ -261,6 +264,12 @@ class ResilienceSupervisor:
                 (s, b) for (s, b) in self._history
                 if eng.global_steps <= s < failing_step
             ]
+            telemetry.instant(
+                "resilience/rollback", cat="lifecycle",
+                args={"failing_step": failing_step, "attempt": attempt,
+                      "restored_step": eng.global_steps,
+                      "tag": self._ckpt_tag, "replay_windows": len(replay),
+                      "reason": reason})
             logger.info(
                 f"[resilience] rolled back to tag '{self._ckpt_tag}' "
                 f"(step {eng.global_steps}); replaying {len(replay)} buffered "

@@ -1,0 +1,76 @@
+"""Unified telemetry: trace spans, metrics registry, introspection endpoint.
+
+One process-global :class:`Tracer` and :class:`MetricsRegistry`, so spans
+and gauges recorded by the training engines, the serving engine, the
+resilience layer and the sentinels all land in the same timeline and the
+same ``/metrics`` page. Both start disabled/empty; a ds_config with a
+``telemetry`` block arms them via :func:`configure_from_config` (an
+absent block leaves the global state alone, so a telemetry-armed process
+can construct helper engines without disarming itself).
+
+Hot-path cost when disabled: ``get_tracer().enabled`` is False, ``span()``
+returns the shared ``NULL_SPAN`` singleton, ``instant()`` returns before
+touching the clock — nothing is recorded and nothing is allocated.
+
+Stdlib-only (no jax/numpy): importable from the launcher supervisor.
+"""
+
+from deepspeed_tpu.telemetry.trace import NULL_SPAN, Tracer  # noqa: F401
+from deepspeed_tpu.telemetry.registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    HISTOGRAM_TAGS,
+    MetricsRegistry,
+    MonitorBridge,
+    prom_name,
+)
+from deepspeed_tpu.telemetry.server import TelemetryServer  # noqa: F401
+from deepspeed_tpu.telemetry.config import (  # noqa: F401
+    DeepSpeedTelemetryConfig,
+    TELEMETRY,
+)
+
+_tracer = Tracer(enabled=False)
+_registry = MetricsRegistry()
+
+
+def get_tracer():
+    return _tracer
+
+
+def get_registry():
+    return _registry
+
+
+def span(name, cat="train", args=None):
+    """Module-level convenience over the global tracer (cold call sites;
+    hot loops cache ``get_tracer()`` and guard on ``.enabled``)."""
+    return _tracer.span(name, cat=cat, args=args)
+
+
+def instant(name, cat="lifecycle", args=None):
+    return _tracer.instant(name, cat=cat, args=args)
+
+
+def configure(enabled, trace_max_events=None):
+    """Arm/disarm the global tracer explicitly (tests, scripts)."""
+    _tracer.configure(enabled, max_events=trace_max_events)
+    return _tracer, _registry
+
+
+def configure_from_config(telemetry_config):
+    """Apply a :class:`DeepSpeedTelemetryConfig`. A config whose
+    ``telemetry`` block was absent (``configured=False``) is a no-op —
+    only an explicit block changes global state."""
+    if telemetry_config is None or not telemetry_config.configured:
+        return _tracer, _registry
+    _tracer.configure(telemetry_config.enabled,
+                      max_events=telemetry_config.trace_max_events)
+    return _tracer, _registry
+
+
+__all__ = [
+    "Tracer", "NULL_SPAN", "MetricsRegistry", "MonitorBridge",
+    "TelemetryServer", "DeepSpeedTelemetryConfig", "DEFAULT_BUCKETS",
+    "HISTOGRAM_TAGS", "prom_name", "get_tracer", "get_registry", "span",
+    "instant", "configure", "configure_from_config",
+]

@@ -1,0 +1,77 @@
+"""``telemetry`` ds_config block (validated by ``runtime/config.py``).
+
+Off by default; enabling it arms the process-global tracer + metrics
+registry (``telemetry.configure_from_config``) and, when ``http_port`` is
+set, lets the serving engine attach the introspection endpoint.
+
+Config::
+
+    "telemetry": {"enabled": true,
+                  "trace_max_events": 65536,   # ring-buffer bound
+                  "http_port": 0,              # null: no server; 0: ephemeral
+                  "trace_file": "trace.json"}  # written on engine close (optional)
+
+Kept free of ``runtime/`` imports so the telemetry package stays
+importable without the training stack (the stdlib-only supervisor
+serves /healthz too).
+"""
+
+TELEMETRY = "telemetry"
+
+TELEMETRY_ENABLED = "enabled"
+TELEMETRY_ENABLED_DEFAULT = False
+
+TELEMETRY_TRACE_MAX_EVENTS = "trace_max_events"
+TELEMETRY_TRACE_MAX_EVENTS_DEFAULT = 65536
+
+# None: no HTTP server. 0: bind an ephemeral port (tests / single-host
+# debugging — read it back from ServingEngine.telemetry_server.port).
+TELEMETRY_HTTP_PORT = "http_port"
+TELEMETRY_HTTP_PORT_DEFAULT = None
+
+TELEMETRY_TRACE_FILE = "trace_file"
+TELEMETRY_TRACE_FILE_DEFAULT = None
+
+
+class DeepSpeedTelemetryConfig:
+    """Validated view of the ``telemetry`` block."""
+
+    def __init__(self, param_dict):
+        tel_dict = param_dict.get(TELEMETRY, {})
+        if not isinstance(tel_dict, dict):
+            raise ValueError(
+                f"'{TELEMETRY}' must be a dict, got {type(tel_dict).__name__}")
+        # block present at all? absent blocks must not clobber global
+        # telemetry state armed by an earlier engine in the same process
+        self.configured = TELEMETRY in param_dict
+        self.enabled = tel_dict.get(TELEMETRY_ENABLED, TELEMETRY_ENABLED_DEFAULT)
+        if not isinstance(self.enabled, bool):
+            raise ValueError(
+                f"'{TELEMETRY}.{TELEMETRY_ENABLED}' must be a bool, "
+                f"got {self.enabled!r}")
+        self.trace_max_events = tel_dict.get(
+            TELEMETRY_TRACE_MAX_EVENTS, TELEMETRY_TRACE_MAX_EVENTS_DEFAULT)
+        if not isinstance(self.trace_max_events, int) \
+                or isinstance(self.trace_max_events, bool) \
+                or self.trace_max_events < 1:
+            raise ValueError(
+                f"'{TELEMETRY}.{TELEMETRY_TRACE_MAX_EVENTS}' must be an int >= 1, "
+                f"got {self.trace_max_events!r}")
+        self.http_port = tel_dict.get(TELEMETRY_HTTP_PORT,
+                                      TELEMETRY_HTTP_PORT_DEFAULT)
+        if self.http_port is not None and (
+                not isinstance(self.http_port, int)
+                or isinstance(self.http_port, bool)
+                or not 0 <= self.http_port <= 65535):
+            raise ValueError(
+                f"'{TELEMETRY}.{TELEMETRY_HTTP_PORT}' must be null or an int "
+                f"in [0, 65535], got {self.http_port!r}")
+        self.trace_file = tel_dict.get(TELEMETRY_TRACE_FILE,
+                                       TELEMETRY_TRACE_FILE_DEFAULT)
+        if self.trace_file is not None and not isinstance(self.trace_file, str):
+            raise ValueError(
+                f"'{TELEMETRY}.{TELEMETRY_TRACE_FILE}' must be null or a "
+                f"string path, got {self.trace_file!r}")
+
+    def repr(self):
+        return self.__dict__

@@ -1,0 +1,264 @@
+"""Shared counter/gauge/histogram registry with Prometheus text rendering.
+
+One naming scheme for train and serve: metrics keep the repo's existing
+slash tags (``Train/Samples/train_loss``, ``Serving/tokens_per_sec``) as
+their registry keys and are sanitized to Prometheus identifiers only at
+exposition time (``Train/Samples/train_loss`` → ``Train_Samples_train_loss``),
+so the ``Train/*`` and ``Serving/*`` families stay recognizable on
+``/metrics`` and in dashboards.
+
+Two ways metrics arrive:
+
+- **push**: code sets gauges / bumps counters / observes histograms
+  directly (``registry.gauge(tag).set(v)``);
+- **monitor fan-out**: :class:`MonitorBridge` implements the repo's
+  monitor interface (``record``/``flush``/``close``) so the registry rides
+  the ONE ``monitor_from_config`` construction path — every existing
+  ``monitor.record("Train/..."/"Serving/...")`` call in the engines
+  populates the registry with no per-call-site changes. Like the other
+  monitor backends it buffers at ``record`` time (values may be device
+  arrays; the host transfer is deferred) and converts at ``flush``.
+- **pull**: ``gauge_fn(name, fn)`` registers a callback polled at render
+  time — used for live values (serving snapshot, pool occupancy,
+  supervisor restart counts) that would be stale as pushed gauges.
+
+Stdlib-only on purpose (see ``telemetry/trace.py``).
+"""
+
+import re
+import threading
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+# Latency-ish default buckets (seconds): sub-ms to tens of seconds.
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def prom_name(tag):
+    """Sanitize a slash tag into a legal Prometheus metric name."""
+    name = _PROM_BAD.sub("_", tag)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name or "_"
+
+
+class Counter:
+    """Monotonically increasing value."""
+
+    kind = "counter"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def inc(self, amount=1.0):
+        if amount < 0:
+            raise ValueError(f"counter '{self.name}' cannot decrease ({amount})")
+        self.value += amount
+
+
+class Gauge:
+    """Last-write-wins value."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def set(self, value):
+        self.value = float(value)
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus semantics: each ``le``
+    bucket counts observations <= its bound, plus ``+Inf``/sum/count)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, buckets=DEFAULT_BUCKETS, help=""):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError(f"histogram '{name}' needs at least one bucket")
+        self.counts = [0] * (len(self.buckets) + 1)   # +1 for +Inf
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value):
+        v = float(value)
+        self.sum += v
+        self.count += 1
+        for i, bound in enumerate(self.buckets):
+            if v <= bound:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def cumulative(self):
+        """[(le, cumulative_count), ...] ending with ('+Inf', count)."""
+        out, running = [], 0
+        for bound, c in zip(self.buckets, self.counts):
+            running += c
+            out.append((repr(bound), running))
+        out.append(("+Inf", self.count))
+        return out
+
+
+class MetricsRegistry:
+    """Name → metric map with get-or-create accessors and renderers."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+        self._gauge_fns = {}
+
+    def _get_or_create(self, cls, name, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, **kwargs)
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric '{name}' already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name, help=""):
+        return self._get_or_create(Counter, name, help=help)
+
+    def gauge(self, name, help=""):
+        return self._get_or_create(Gauge, name, help=help)
+
+    def histogram(self, name, buckets=DEFAULT_BUCKETS, help=""):
+        return self._get_or_create(Histogram, name, buckets=buckets, help=help)
+
+    def gauge_fn(self, name, fn, help=""):
+        """Register a pull gauge: ``fn()`` is called at render time and may
+        return a float, a flat {suffix: float} dict (rendered as
+        ``name/suffix``), or None to skip."""
+        with self._lock:
+            self._gauge_fns[name] = (fn, help)
+
+    def unregister(self, name):
+        with self._lock:
+            self._metrics.pop(name, None)
+            self._gauge_fns.pop(name, None)
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()
+            self._gauge_fns.clear()
+
+    # -- rendering ------------------------------------------------------
+    def _pulled(self):
+        """Materialize callback gauges as (name, help, value) rows."""
+        with self._lock:
+            fns = list(self._gauge_fns.items())
+        rows = []
+        for name, (fn, help) in fns:
+            try:
+                v = fn()
+            except Exception:
+                continue    # a broken callback must not take down /metrics
+            if v is None:
+                continue
+            if isinstance(v, dict):
+                for suffix, sub in v.items():
+                    if isinstance(sub, (int, float)) and not isinstance(sub, bool):
+                        rows.append((f"{name}/{suffix}", help, float(sub)))
+            else:
+                rows.append((name, help, float(v)))
+        return rows
+
+    def render_prometheus(self):
+        """Prometheus text exposition format (version 0.0.4)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines = []
+        for m in metrics:
+            pname = prom_name(m.name)
+            if m.help:
+                lines.append(f"# HELP {pname} {m.help}")
+            lines.append(f"# TYPE {pname} {m.kind}")
+            if m.kind == "histogram":
+                for le, c in m.cumulative():
+                    lines.append(f'{pname}_bucket{{le="{le}"}} {c}')
+                lines.append(f"{pname}_sum {m.sum}")
+                lines.append(f"{pname}_count {m.count}")
+            else:
+                lines.append(f"{pname} {m.value}")
+        for name, help, value in self._pulled():
+            pname = prom_name(name)
+            if help:
+                lines.append(f"# HELP {pname} {help}")
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {value}")
+        return "\n".join(lines) + "\n"
+
+    def as_dict(self):
+        """JSON-friendly snapshot of everything (raw slash names)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out = {}
+        for m in metrics:
+            if m.kind == "histogram":
+                out[m.name] = {"sum": m.sum, "count": m.count,
+                               "buckets": dict(m.cumulative())}
+            else:
+                out[m.name] = m.value
+        for name, _help, value in self._pulled():
+            out[name] = value
+        return out
+
+
+# Tags routed to histograms (not last-value gauges) when they arrive via
+# the monitor fan-out: latency distributions where p95 matters.
+HISTOGRAM_TAGS = frozenset({"Serving/ttft_s"})
+
+
+class MonitorBridge:
+    """Monitor-interface adapter feeding a :class:`MetricsRegistry`.
+
+    Appended to the ``monitor_from_config`` fan-out when telemetry is
+    enabled. ``record`` buffers (tag, value) — values may be device
+    arrays, and converting them would be a host sync on the training hot
+    path, so the transfer is deferred exactly like the tensorboard/csv
+    backends do. ``flush`` converts and applies. A bounded auto-flush
+    keeps the pending buffer (and /metrics staleness) in check for
+    callers that record per step but flush rarely.
+    """
+
+    def __init__(self, registry, histogram_tags=HISTOGRAM_TAGS,
+                 auto_flush_every=512, rank=0):
+        self.registry = registry
+        self.enabled = rank == 0
+        self._histogram_tags = frozenset(histogram_tags)
+        self._auto_flush_every = int(auto_flush_every)
+        self._pending = []
+
+    def record(self, tag, value, step):
+        if not self.enabled:
+            return
+        self._pending.append((tag, value, step))
+        if len(self._pending) >= self._auto_flush_every:
+            self.flush()
+
+    def flush(self):
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        for tag, value, step in pending:
+            v = float(value)
+            if tag in self._histogram_tags:
+                self.registry.histogram(tag).observe(v)
+            else:
+                self.registry.gauge(tag).set(v)
+            self.registry.counter(f"{tag}/samples_total").inc()
+
+    def close(self):
+        self.flush()

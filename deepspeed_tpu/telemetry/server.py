@@ -1,0 +1,169 @@
+"""Live introspection endpoint: a stdlib-only background HTTP server.
+
+Attachable to the :class:`ServingEngine` (decode-loop liveness, serving
+snapshot, KV-pool occupancy, prefix-cache stats) and to the launcher's
+:class:`WorkerSupervisor` (child liveness, restart counts) — and to
+anything else that can hand it a registry/tracer and a few callbacks.
+
+Routes:
+
+``/metrics``
+    Prometheus text exposition from the attached :class:`MetricsRegistry`.
+``/healthz``
+    JSON liveness: ``{"status": "ok"|"unhealthy", ...}`` merged from the
+    registered health providers. Any provider reporting falsy health (or
+    raising) flips the status and the HTTP code to 503 — so a k8s/GCE
+    probe needs no JSON parsing.
+``/snapshot``
+    JSON merged from the registered snapshot providers (serving metrics
+    snapshot, pool occupancy, prefix-cache stats, supervisor restarts).
+``/trace``
+    Drains the tracer ring buffer as Chrome trace JSON (load the response
+    body straight into Perfetto). ``?drain=0`` peeks without draining.
+
+The server runs on a daemon thread (``ThreadingHTTPServer``), binds
+127.0.0.1 by default, and ``port=0`` picks an ephemeral port (tests).
+Request handling never touches the hot path: scrapes read the registry
+under its lock and render off-thread.
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse, parse_qs
+
+
+class TelemetryServer:
+    """Background HTTP server over a registry + tracer + provider callbacks."""
+
+    def __init__(self, registry=None, tracer=None, host="127.0.0.1", port=0):
+        self.registry = registry
+        self.tracer = tracer
+        self._host = host
+        self._port = int(port)
+        self._httpd = None
+        self._thread = None
+        self._snapshot_providers = {}
+        self._health_providers = {}
+
+    # -- wiring ---------------------------------------------------------
+    def add_snapshot_provider(self, name, fn):
+        """``fn()`` → JSON-serializable value, merged into ``/snapshot``
+        under ``name``. A raising provider reports its error string."""
+        self._snapshot_providers[name] = fn
+        return self
+
+    def add_health_provider(self, name, fn):
+        """``fn()`` → truthy (healthy) / falsy (unhealthy), or a dict with
+        a boolean ``"healthy"`` key plus detail fields for ``/healthz``."""
+        self._health_providers[name] = fn
+        return self
+
+    # -- lifecycle ------------------------------------------------------
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else self._port
+
+    @property
+    def url(self):
+        return f"http://{self._host}:{self.port}"
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # noqa: A003 - silence stderr
+                pass
+
+            def do_GET(self):
+                server._handle(self)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="telemetry-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._httpd = None
+        self._thread = None
+
+    # -- request handling ------------------------------------------------
+    def _handle(self, handler):
+        parsed = urlparse(handler.path)
+        route = parsed.path.rstrip("/") or "/"
+        try:
+            if route == "/metrics":
+                body = (self.registry.render_prometheus()
+                        if self.registry is not None else "")
+                self._send(handler, 200, body,
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif route == "/healthz":
+                status, doc = self._health()
+                self._send_json(handler, status, doc)
+            elif route == "/snapshot":
+                self._send_json(handler, 200, self._snapshot())
+            elif route == "/trace":
+                qs = parse_qs(parsed.query)
+                drain = qs.get("drain", ["1"])[0] not in ("0", "false")
+                doc = (self.tracer.to_chrome_trace(drain=drain)
+                       if self.tracer is not None
+                       else {"traceEvents": []})
+                self._send_json(handler, 200, doc)
+            else:
+                self._send_json(handler, 404, {"error": f"no route {route}",
+                                               "routes": ["/metrics", "/healthz",
+                                                          "/snapshot", "/trace"]})
+        except Exception as e:   # a broken provider must not kill the thread
+            self._send_json(handler, 500, {"error": repr(e)})
+
+    def _health(self):
+        doc, healthy = {}, True
+        for name, fn in list(self._health_providers.items()):
+            try:
+                v = fn()
+            except Exception as e:
+                doc[name] = {"healthy": False, "error": repr(e)}
+                healthy = False
+                continue
+            if isinstance(v, dict):
+                ok = bool(v.get("healthy", True))
+                doc[name] = v
+            else:
+                ok = bool(v)
+                doc[name] = {"healthy": ok}
+            healthy = healthy and ok
+        doc["status"] = "ok" if healthy else "unhealthy"
+        return (200 if healthy else 503), doc
+
+    def _snapshot(self):
+        doc = {}
+        for name, fn in list(self._snapshot_providers.items()):
+            try:
+                doc[name] = fn()
+            except Exception as e:
+                doc[name] = {"error": repr(e)}
+        return doc
+
+    @staticmethod
+    def _send(handler, status, body, content_type):
+        data = body.encode("utf-8")
+        handler.send_response(status)
+        handler.send_header("Content-Type", content_type)
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+
+    def _send_json(self, handler, status, doc):
+        self._send(handler, status, json.dumps(doc, default=str),
+                   "application/json")

@@ -1,0 +1,173 @@
+"""End-to-end telemetry smoke: train + serve + lifecycle in ONE trace.
+
+Runs a short training loop and a serving burst on the CPU backend with the
+``telemetry`` config block enabled, exercises a real supervisor restart
+(lifecycle instant events), then asserts the whole pipeline held together:
+
+- the merged Chrome trace JSON is valid (required ``ph``/``ts``/``pid``/
+  ``tid``/``name`` keys) and contains train-step spans, serving
+  prefill/decode spans carrying request ids, and at least one lifecycle
+  instant event;
+- ``/metrics`` (scraped over a real socket from the serving engine's
+  endpoint) serves Prometheus text with BOTH ``Train_*`` and ``Serving_*``
+  families — one registry, one naming scheme.
+
+Run it as ``make trace-smoke``; exits nonzero on any failed check. The
+trace lands in ``trace_smoke.json`` (load it in Perfetto — see
+docs/observability.md for how to read it).
+"""
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+# CPU backend, axon plugin out of the process (same contract as tests/).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+import numpy as np  # noqa: E402
+
+REQUIRED_KEYS = {"ph", "ts", "pid", "tid", "name"}
+
+_failures = []
+
+
+def check(ok, what):
+    tag = "ok" if ok else "FAIL"
+    print(f"[trace-smoke] {tag:4s} {what}")
+    if not ok:
+        _failures.append(what)
+    return ok
+
+
+def run_train_loop(steps=4):
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+
+    def model(params, x, y):
+        pred = x @ params["w"]
+        return jnp.mean((pred - y) ** 2)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        model_parameters={"w": jnp.ones((8, 4))},
+        config_params={
+            "train_batch_size": 4,
+            "train_micro_batch_size_per_gpu": 4,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "steps_per_print": 100,
+            "telemetry": {"enabled": True},
+        },
+    )
+    rng = np.random.RandomState(0)
+
+    def batches():
+        while True:
+            yield (rng.randn(4, 8).astype(np.float32),
+                   rng.randn(4, 4).astype(np.float32))
+
+    it = batches()
+    for _ in range(steps):
+        engine.train_batch(data_iter=it)
+    engine.monitor.flush()   # push Train/* scalars through to the registry
+    return engine
+
+
+def run_serving_burst(n_requests=4):
+    from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2
+    from deepspeed_tpu.telemetry import DeepSpeedTelemetryConfig
+
+    cfg = GPT2Config(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=32,
+                     hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    _, params = init_gpt2(cfg, batch_size=2, seq_len=4, seed=0)
+    eng = ServingEngine(
+        params, cfg,
+        ServingConfig(max_slots=3, max_queue=8, max_seq_len=32,
+                      prompt_buckets=(4, 8)),
+        telemetry_config=DeepSpeedTelemetryConfig(
+            {"telemetry": {"enabled": True, "http_port": 0}}))
+    rng = np.random.RandomState(7)
+    futs = [eng.submit(rng.randint(0, 64, (4,)).tolist(), max_new_tokens=4)
+            for _ in range(n_requests)]
+    eng.drain(max_steps=100)
+    for f in futs:
+        f.result(timeout=5)
+    return eng
+
+
+def run_supervised_restart():
+    """A real worker crash + restart through WorkerSupervisor — the
+    lifecycle instant events the trace must carry."""
+    from deepspeed_tpu.launcher.supervisor import WorkerSupervisor
+
+    sup = WorkerSupervisor([sys.executable, "-c", "import sys; sys.exit(7)"],
+                           max_restarts=1, backoff_s=0.0)
+    sup.run()
+    return sup
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default="trace_smoke.json",
+                        help="merged Chrome trace output path")
+    args = parser.parse_args()
+
+    from deepspeed_tpu import telemetry
+
+    run_train_loop()
+    eng = run_serving_burst()
+    sup = run_supervised_restart()
+    check(sup.restarts == 1, "supervisor performed one restart")
+
+    # one registry: /metrics must expose BOTH families over a real socket
+    url = eng.telemetry_server.url
+    with urllib.request.urlopen(url + "/metrics", timeout=5) as resp:
+        metrics = resp.read().decode("utf-8")
+        ctype = resp.headers["Content-Type"]
+    check(ctype.startswith("text/plain; version=0.0.4"),
+          f"/metrics content type is Prometheus text ({ctype})")
+    check("Train_Samples_train_loss" in metrics, "/metrics has Train_* family")
+    check(any(line.startswith("Serving_") for line in metrics.splitlines()),
+          "/metrics has Serving_* family")
+    with urllib.request.urlopen(url + "/healthz", timeout=5) as resp:
+        check(json.loads(resp.read())["status"] == "ok", "/healthz reports ok")
+
+    # one tracer: write + re-load the merged trace, then validate it
+    path = telemetry.get_tracer().write(args.out)
+    eng.close()
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    check(len(events) > 0, f"trace has events ({len(events)})")
+    check(all(REQUIRED_KEYS <= set(e) for e in events),
+          "every event has ph/ts/pid/tid/name")
+
+    names = {e["name"] for e in events}
+    check("train/batch_fetch" in names, "train batch-fetch spans present")
+    check("train/fwd_bwd_opt_step" in names, "train step spans present")
+    prefill = [e for e in events if e["name"] == "serving/prefill_batch"]
+    decode = [e for e in events if e["name"] == "serving/decode_step"]
+    check(bool(prefill) and prefill[0].get("args", {}).get("request_ids"),
+          "serving prefill spans carry request ids")
+    check(bool(decode) and decode[0].get("args", {}).get("request_ids"),
+          "serving decode spans carry request ids")
+    instants = [e for e in events if e["ph"] == "i"]
+    check(any(e["name"] == "worker/restart" for e in instants),
+          "lifecycle instant events present (worker/restart)")
+
+    if _failures:
+        print(f"[trace-smoke] {len(_failures)} check(s) FAILED")
+        return 1
+    print(f"[trace-smoke] all checks passed — trace written to {path} "
+          f"(load it in Perfetto / chrome://tracing)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
