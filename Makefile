@@ -23,10 +23,11 @@ test-cluster:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_cluster_resilience.py -q
 
 # Continuous-batching serving engine: bitwise oracle vs generate(),
-# batched/chunked prefill, prefix KV cache, recompile pins,
+# batched/chunked prefill, prefix KV cache, speculative decoding,
+# int8/bf16 KV quantization, recompile pins,
 # backpressure/deadline/fault-injection recovery.
 test-serving:
-	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_serving.py tests/unit/test_prefix_cache.py -q
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_serving.py tests/unit/test_prefix_cache.py tests/unit/test_speculative.py -q
 
 # Static JAX hazard analysis (tools/jaxlint): recompile, host-sync,
 # leaked-tracer, donation and fp16-dtype rules. AST-only — no jax import,
@@ -52,7 +53,10 @@ ops:
 	$(MAKE) -C csrc
 
 # Continuous-batching serving throughput + TTFT on the CPU backend;
-# writes SERVING_BENCH_CPU.json (see docs/serving.md).
+# runs the decode leg with speculation off AND on (BENCH_SERVE_SPEC_K,
+# default 4; BENCH_SERVE_KV_DTYPE picks fp32|bf16|int8 KV storage) and
+# writes SERVING_BENCH_CPU.json with both rates + accept_rate
+# (see docs/serving.md).
 bench-serving:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=serving python bench.py --child
 

@@ -282,11 +282,15 @@ def serving_child_main():
     ``kv_cache_tok_per_s`` rows — the delta IS the continuous-batching
     win. Prompts share a system-prompt-style prefix so the prefix KV
     cache has something to hit. Writes SERVING_BENCH[_CPU].json next to
-    DECODE_BENCH[_CPU].json (and prints a before/after TTFT line when a
-    previous artifact exists) plus the usual one JSON line. Knobs:
+    DECODE_BENCH[_CPU].json (and prints before/after TTFT and decode
+    throughput lines when a previous artifact exists) plus the usual one
+    JSON line. The decode leg runs TWICE — speculation off then on — so
+    the artifact carries both numbers and the accept rate. Knobs:
     BENCH_SERVE_REQUESTS / BENCH_SERVE_SLOTS / BENCH_SERVE_NEW_TOKENS /
     BENCH_SERVE_CHUNK (chunked prefill, 0=off) / BENCH_SERVE_PREFIX_MB
-    (prefix cache budget, 0=off)."""
+    (prefix cache budget, 0=off) / BENCH_SERVE_SPEC_K (self-drafted
+    speculative tokens per step, 0=off) / BENCH_SERVE_KV_DTYPE
+    (fp32|bf16|int8 KV-pool storage)."""
     import jax
     import numpy as np
 
@@ -301,6 +305,8 @@ def serving_child_main():
     max_new = int(os.environ.get("BENCH_SERVE_NEW_TOKENS", "32"))
     chunk = int(os.environ.get("BENCH_SERVE_CHUNK", "0"))
     prefix_mb = float(os.environ.get("BENCH_SERVE_PREFIX_MB", "8"))
+    spec_k = int(os.environ.get("BENCH_SERVE_SPEC_K", "4"))
+    kv_dtype = os.environ.get("BENCH_SERVE_KV_DTYPE", "fp32")
 
     cfg = GPT2Config(
         vocab_size=512, hidden_size=128, num_hidden_layers=4,
@@ -314,11 +320,12 @@ def serving_child_main():
                + rng.randint(0, cfg.vocab_size, (int(n),)).tolist()
                for n in rng.randint(1, 11, size=n_requests)]  # len 7..16
 
-    def make_engine():
+    def make_engine(k=0):
         return ServingEngine(params, cfg, ServingConfig(
             max_slots=max_slots, max_queue=max(n_requests, 1),
             max_seq_len=64, prompt_buckets=(8, 16),
-            prefill_chunk_tokens=chunk, prefix_cache_mb=prefix_mb))
+            prefill_chunk_tokens=chunk, prefix_cache_mb=prefix_mb,
+            speculative_k=k, kv_cache_dtype=kv_dtype))
 
     # warmup engine: pays every compile (batched prefill at BOTH buckets
     # + the one decode program) and anchors correctness against one-shot
@@ -336,15 +343,32 @@ def serving_child_main():
     for fut, p in ((w0, short_p), (w1, long_p)):
         want = np.asarray(generate(
             params, cfg, np.asarray([p], np.int32), max_new))[0].tolist()
-        assert fut.result(timeout=5) == want, "serving diverged from generate()"
+        got = fut.result(timeout=5)
+        if kv_dtype == "fp32":
+            assert got == want, "serving diverged from generate()"
+        else:                       # quantized KV: threshold, not bitwise
+            match = sum(g == w for g, w in zip(got, want)) / len(want)
+            assert match >= 0.9, f"quantized KV parity too low ({match:.2f})"
+    if spec_k > 0:                  # pay the speculative-step compile too
+        warm_spec = make_engine(spec_k)
+        ws = warm_spec.submit(short_p, max_new_tokens=max_new)
+        warm_spec.drain(max_steps=10 * max_new)
+        ws.result(timeout=5)
 
-    eng = make_engine()
-    t0 = time.perf_counter()
-    futs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
-    eng.drain(max_steps=100 * max_new * max(1, n_requests // max_slots))
-    tokens = sum(len(f.result(timeout=5)) for f in futs)
-    wall_s = time.perf_counter() - t0
-    snap = eng.metrics.snapshot()
+    def measure(k):
+        eng = make_engine(k)
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        eng.drain(max_steps=100 * max_new * max(1, n_requests // max_slots))
+        tokens = sum(len(f.result(timeout=5)) for f in futs)
+        return tokens, time.perf_counter() - t0, eng.metrics.snapshot()
+
+    # spec-off leg first: its decode tokens/sec is the comparison anchor
+    _, _, snap_off = measure(0)
+    if spec_k > 0:
+        tokens, wall_s, snap = measure(spec_k)
+    else:
+        tokens, wall_s, snap = measure(0)
 
     result = {
         "platform": platform,
@@ -354,8 +378,17 @@ def serving_child_main():
         "max_new_tokens": max_new,
         "prefill_chunk_tokens": chunk,
         "prefix_cache_mb": prefix_mb,
+        "speculative_k": spec_k,
+        "kv_cache_dtype": kv_dtype,
         "tokens_per_sec": round(tokens / wall_s, 1),
         "decode_tokens_per_sec": round(snap["tokens_per_sec"] or 0.0, 1),
+        "decode_tokens_per_sec_spec_off": round(
+            snap_off["tokens_per_sec"] or 0.0, 1),
+        "accept_rate": (None if snap["accept_rate"] is None
+                        else round(snap["accept_rate"], 3)),
+        "tokens_per_step": (None if snap["tokens_per_step"] is None
+                            else round(snap["tokens_per_step"], 2)),
+        "kv_pool_bytes": snap["kv_pool_bytes"],
         "prefill_tokens_per_sec": round(
             snap["prefill_tokens_per_sec"] or 0.0, 1),
         "avg_ttft_s": round(snap["avg_ttft_s"], 4),
@@ -384,6 +417,19 @@ def serving_child_main():
         print(f"# avg TTFT: {before:.4f}s -> {after:.4f}s "
               f"({before / after:.2f}x)" if after else
               f"# avg TTFT: {before:.4f}s -> {after}")
+    if previous and previous.get("decode_tokens_per_sec"):
+        before = previous["decode_tokens_per_sec"]
+        after = result["decode_tokens_per_sec"]
+        print(f"# decode tokens/sec: {before:.1f} -> {after:.1f} "
+              f"({after / before:.2f}x, speculative_k={spec_k}, "
+              f"kv={kv_dtype})")
+    if spec_k > 0 and result["decode_tokens_per_sec_spec_off"]:
+        off = result["decode_tokens_per_sec_spec_off"]
+        on = result["decode_tokens_per_sec"]
+        rate = result["accept_rate"]
+        print(f"# speculation off->on this run: {off:.1f} -> {on:.1f} "
+              f"({on / off:.2f}x, accept_rate="
+              f"{rate if rate is None else round(rate, 3)})")
 
     print(json.dumps({
         "metric": f"continuous-batching serving tokens/sec ({platform})",
@@ -393,6 +439,9 @@ def serving_child_main():
         **{k: result[k] for k in ("avg_ttft_s", "ttft_p50_s", "ttft_p95_s",
                                   "max_ttft_s", "requests", "max_slots",
                                   "max_new_tokens", "decode_tokens_per_sec",
+                                  "decode_tokens_per_sec_spec_off",
+                                  "speculative_k", "kv_cache_dtype",
+                                  "accept_rate", "tokens_per_step",
                                   "prefill_tokens_per_sec",
                                   "prefix_hit_rate")},
     }))

@@ -47,6 +47,19 @@ def _ln(x, p):
     return (x - mu) / jnp.sqrt(var + 1e-6) * p["scale"] + p["bias"]
 
 
+def _cache_dtype(params):
+    """The dtype ``_step``/``_forward_chunk`` actually produce (and so
+    the dtype KV caches must carry): int8-quantized tables dequantize to
+    f32; otherwise the embedding dtype flows through the residual stream,
+    so a bf16 checkpoint decodes (and caches) in bf16. Hardcoding f32
+    would make the cache/carry dtypes disagree with bf16 k/v slices and
+    logits and crash at trace time."""
+    tr = params["params"]["transformer"]
+    emb_dtype = (jnp.float32 if "kernel_q" in tr["wte"]
+                 else tr["wte"]["embedding"].dtype)
+    return jnp.result_type(emb_dtype, tr["wpe"]["embedding"].dtype)
+
+
 def _decode_one(layer_p, h, cache_k, cache_v, pos, nh):
     """One token through one layer against the cache.
 
@@ -149,14 +162,7 @@ def _prefill(params, prompt_ids, n_layers, n_heads, head_dim, total):
     that path bitwise (greedy tokens) / allclose (KV) against this one."""
     B, S = prompt_ids.shape
     tr = params["params"]["transformer"]
-    # Compute dtype = what `_step` actually produces: int8-quantized tables
-    # dequantize to f32; otherwise the embedding dtype flows through the
-    # residual stream, so a bf16 checkpoint decodes (and caches) in bf16.
-    # Hardcoding f32 here made the cache/carry dtypes disagree with the bf16
-    # k/v slices and logits and crashed at trace time.
-    emb_dtype = (jnp.float32 if "kernel_q" in tr["wte"]
-                 else tr["wte"]["embedding"].dtype)
-    dtype = jnp.result_type(emb_dtype, tr["wpe"]["embedding"].dtype)
+    dtype = _cache_dtype(params)
     shape = (n_layers, B, n_heads, total, head_dim)
     caches = (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
@@ -243,6 +249,69 @@ def _forward_chunk(params, n_heads, caches, ids, starts):
     return h, caches
 
 
+def _ngram_draft(history, pos, k):
+    """Self-drafting proposal: ``k`` draft tokens from a bigram
+    (prompt-lookup) match over one lane's own token history — no second
+    model, so the drafter is free relative to a forward pass.
+
+    ``history`` [S] holds the lane's tokens by position (prompt, then
+    every emitted token); ``history[pos]`` is the PENDING token about to
+    be fed at position ``pos``. The drafter finds the LATEST earlier
+    occurrence of the bigram ``(history[pos-1], history[pos])`` and
+    proposes the tokens that followed it, CYCLING the matched stretch
+    once it runs out instead of reading past ``pos``: entries above the
+    pending position hold junk from rejected speculation, and the latest
+    match of a loopy sequence sits right below ``pos``, so a straight
+    gather would draft garbage from position 2 onward — the periodic
+    extension instead turns a period-p greedy loop into k exact drafts.
+    With no match it proposes k repeats of the pending token (free, and
+    exactly right once greedy decoding enters a period-1 loop). Drafts
+    only ever affect SPEED — the verify forward recomputes the greedy
+    oracle at every position."""
+    S = history.shape[0]
+    j = jnp.arange(S - 1)
+    prev = history[jnp.maximum(pos - 1, 0)]
+    cur = history[pos]
+    # candidate j: bigram at (j, j+1) strictly before the pending bigram
+    m = (j + 1 < pos) & (history[:-1] == prev) & (history[1:] == cur)
+    jstar = jnp.argmax(jnp.where(m, j, -1))
+    # matched continuation spans [jstar+2, pos] — period >= 1 always,
+    # and cycling it keeps every read at or below the pending position
+    period = jnp.maximum(pos - jstar - 1, 1)
+    idx = jstar + 2 + jnp.arange(k) % period
+    cont = history[jnp.clip(idx, 0, S - 1)]
+    return jnp.where(jnp.any(m), cont,
+                     jnp.full((k,), cur, history.dtype)).astype(jnp.int32)
+
+
+def _speculative_verify(params, n_heads, caches, tokens, drafts, positions):
+    """Verify ``k`` drafts per lane in ONE batched causal forward.
+
+    ``tokens`` [B] are the pending tokens, ``drafts`` [B, k] the
+    proposals, ``positions`` [B] each lane's next KV write index. The
+    k+1 ids run through ``_forward_chunk`` (each position attends to the
+    cache plus the draft prefix before it — exactly what sequential
+    decode would have seen IF every earlier draft was correct), giving
+    the greedy ``oracle`` [B, k+1] at all positions. ``accepted`` [B]
+    counts the leading drafts that matched their oracle; everything the
+    caller emits comes from ``oracle``, so a wrong draft can never
+    change output — only how many tokens this step yields. Rejected
+    drafts leave stale KV above the accepted point, which the NEXT
+    step's k+1 writes fully overwrite (the stale range [new_pos,
+    old_pos+k] always sits inside the next write window), so "rollback"
+    is nothing more than advancing ``positions`` by accepted+1."""
+    tr = params["params"]["transformer"]
+    k = drafts.shape[1]
+    ids = jnp.concatenate([tokens[:, None], drafts], axis=1)     # [B, k+1]
+    h, caches = _forward_chunk(params, n_heads, caches, ids, positions)
+    h = _ln(h, tr["ln_f"])
+    logits = h @ logits_table(tr["wte"], h.dtype).T
+    oracle = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [B, k+1]
+    ok = (drafts == oracle[:, :k]).astype(jnp.int32)
+    accepted = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)          # [B]
+    return oracle, accepted, caches
+
+
 def _forward_full(params, ids, true_len, n_layers, n_heads, head_dim, total):
     """Single-pass full-sequence causal prefill: every K/V for the
     (padded) prompt ``ids`` [B, S] computed in ONE batched forward into a
@@ -253,9 +322,7 @@ def _forward_full(params, ids, true_len, n_layers, n_heads, head_dim, total):
     ``generate()``, ``beam_search()``, and the serving engine."""
     B, S = ids.shape
     tr = params["params"]["transformer"]
-    emb_dtype = (jnp.float32 if "kernel_q" in tr["wte"]
-                 else tr["wte"]["embedding"].dtype)
-    dtype = jnp.result_type(emb_dtype, tr["wpe"]["embedding"].dtype)
+    dtype = _cache_dtype(params)
     shape = (n_layers, B, n_heads, total, head_dim)
     caches = (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 

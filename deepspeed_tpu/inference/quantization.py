@@ -14,7 +14,18 @@ fp32 (negligible bytes, precision-critical).
 
     qparams = quantize_for_decode(params)
     tokens = generate(qparams, cfg, prompt, 64)   # same API
+
+The same at-use-dequant design extends to the serving KV-cache pool
+(``quantize_kv``/``dequantize_kv``/``requantize_kv``): keys/values are
+stored int8 with per-head symmetric fp32 scales and dequantized inside
+the decode/verify attention reads, roughly doubling KV slots per byte
+of pool versus bf16 (4x versus fp32). Weights are quantized once and
+never rewritten; KV is append-mostly, so decode-written tokens are
+requantized against the FIXED install-time scales (``requantize_kv``)
+instead of rescaling the whole lane every step.
 """
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -69,6 +80,55 @@ def logits_table(wte_blk, dtype):
     if "kernel_q" in wte_blk:
         return dequantize_tensor(wte_blk, dtype)
     return wte_blk["embedding"].astype(dtype)
+
+
+def quantize_kv(kv, axis=(-2, -1)):
+    """Symmetric int8 quantization of a KV tensor with per-head scales.
+
+    ``kv`` is ``[..., nh, S, hd]``; the scale reduces over ``axis``
+    (sequence and head-dim by default) so each head carries ONE fp32
+    scale — the granularity the serving pool stores per (slot, head).
+    Returns ``(int8 values, fp32 scale with keepdims)``."""
+    kv = jnp.asarray(kv, jnp.float32)
+    amax = jnp.max(jnp.abs(kv), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(kv / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """At-use dequant: ``int8 * scale`` in ``dtype``. Inside a jitted
+    attention read this fuses into the consuming contraction, so the
+    fp32 view is never materialized at rest."""
+    return q.astype(dtype) * scale.astype(dtype)
+
+
+def requantize_kv(kv, scale):
+    """Quantize ``kv`` against FIXED per-head scales (clipping at ±127).
+
+    The serving decode loop appends tokens to an already-quantized lane;
+    rescaling the whole lane every step would change the stored value of
+    every PRIOR token. Instead the install-time scale is kept and new
+    tokens are clipped into its range. Idempotent on entries that came
+    from ``dequantize_kv`` with the same scale — ``round(q*s/s) == q``
+    exactly, since the fp32 roundtrip error is far below 0.5 ulp of the
+    int grid — so re-storing an untouched lane is a bitwise no-op."""
+    return jnp.clip(jnp.round(kv.astype(jnp.float32) / scale),
+                    -127, 127).astype(jnp.int8)
+
+
+def quantize_kv_np(kv, axis=(-2, -1)):
+    """Host-side (numpy) twin of ``quantize_kv`` for the prefix-cache
+    path, which stores entries as host arrays outside any trace."""
+    kv = np.asarray(kv, np.float32)
+    amax = np.max(np.abs(kv), axis=axis, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(kv / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def dequantize_kv_np(q, scale, dtype=np.float32):
+    return np.asarray(q, dtype) * np.asarray(scale, dtype)
 
 
 def quantize_for_decode(params):

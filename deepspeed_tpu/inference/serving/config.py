@@ -41,6 +41,18 @@ class ServingConfig:
     # KV keyed by token prefix so shared system-prompt prefixes skip
     # recomputation. 0 = disabled.
     prefix_cache_mb: float = 0.0
+    # Speculative decoding: propose up to this many self-drafted tokens
+    # per lane per step (n-gram lookup over the lane's own history) and
+    # verify them all in ONE batched forward — each step emits 1..k+1
+    # tokens per lane, output-identical to k=0. STATIC like max_slots:
+    # varying per-lane acceptance never recompiles. 0 = classic
+    # one-token decode (the bitwise-oracle path).
+    speculative_k: int = 0
+    # KV-pool storage dtype: "fp32" (the model's compute dtype —
+    # bitwise-transparent default), "bf16" (half the pool bytes, cast at
+    # use), or "int8" (quarter, per-(slot, head) symmetric fp32 scales,
+    # dequantized at use; threshold-based parity instead of bitwise).
+    kv_cache_dtype: str = "fp32"
     # Serving/step/I-O fault-injection spec (tests only): see
     # serving/fault_injection.py for the accepted points.
     fault_injection: dict = field(default=None)

@@ -42,6 +42,31 @@ any arrival order. Why it holds:
 Greedy only: serving argmax-decodes (temperature-0), the mode with a
 bitwise oracle. Sampling needs per-request RNG streams and is future
 work.
+
+Speculative decoding (``serving.speculative_k > 0``): each step drafts
+``k`` tokens per lane with a free n-gram drafter over the lane's own
+history (no second model), verifies all k+1 positions in ONE batched
+causal forward (the same ``_forward_chunk`` core prefill uses), and
+emits the longest draft prefix the greedy oracle confirms — plus the
+oracle's own next token, so every step yields between 1 and k+1 tokens
+per lane. Emitted tokens always COME FROM the oracle, so draft quality
+affects only throughput, never output: the emitted sequence is
+output-identical to ``speculative_k=0`` (and the k=0 path itself stays
+bitwise — it runs the exact same program as before). Rejected drafts
+need no KV rollback: their stale cache rows sit inside the next step's
+k+1-wide write window and are overwritten before any mask can expose
+them, so "rollback" is just advancing the position counter by
+accepted+1. ``k`` and ``MaxSlots`` are static; acceptance counts,
+drafts, and noise are traced — variable acceptance never recompiles and
+steady state still runs under ``transfer_free()``.
+
+KV quantization (``serving.kv_cache_dtype``): "fp32" stores the model's
+compute dtype (bitwise-transparent default); "bf16" and "int8" store
+the pool narrower and dequantize at use inside the decode/verify reads
+(int8 carries per-(slot, head) symmetric scales, fixed at install — see
+kv_pool.py). Quantized modes trade a threshold-based parity oracle
+(token-match rate, allclose attention outputs) for 2-4x more KV slots
+per byte.
 """
 
 import threading
@@ -54,13 +79,27 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.inference.generation import _forward_chunk, _ln, _step
+from deepspeed_tpu.inference.generation import (
+    _cache_dtype,
+    _forward_chunk,
+    _ln,
+    _ngram_draft,
+    _speculative_verify,
+    _step,
+)
 from deepspeed_tpu.profiling.sentinels import CompileSentinel, transfer_free
 from deepspeed_tpu import telemetry
-from deepspeed_tpu.inference.quantization import logits_table
+from deepspeed_tpu.inference.quantization import (
+    dequantize_kv,
+    dequantize_kv_np,
+    logits_table,
+    quantize_kv_np,
+    requantize_kv,
+    vocab_size,
+)
 from deepspeed_tpu.inference.serving.config import ServingConfig
 from deepspeed_tpu.inference.serving.fault_injection import ServingFaultInjector
-from deepspeed_tpu.inference.serving.kv_pool import KVCachePool
+from deepspeed_tpu.inference.serving.kv_pool import KV_CACHE_DTYPES, KVCachePool
 from deepspeed_tpu.inference.serving.metrics import ServingMetrics
 from deepspeed_tpu.inference.serving.prefix_cache import PrefixKVCache
 from deepspeed_tpu.inference.serving.scheduler import (
@@ -125,6 +164,132 @@ def _decode_step_jit(params, pool_k, pool_v, tokens, positions, active, *,
     return tokens, positions, pool_k, pool_v
 
 
+@partial(jax.jit, static_argnames=("n_heads", "qmode"),
+         donate_argnums=(1, 2, 5, 6))
+def _decode_step_quant_jit(params, pool_k, pool_v, k_scale, v_scale, tokens,
+                           positions, active, *, n_heads, qmode):
+    """``_decode_step_jit`` over a QUANTIZED pool: each lane dequantizes
+    its KV at use (int8 * per-head scale, or a bf16 cast), runs the same
+    vmapped ``_step``, and re-stores against its FIXED install-time
+    scales — idempotent on untouched positions (see ``requantize_kv``),
+    so the step still only logically appends one token per lane. Scales
+    are NOT donated: they are returned unchanged and the host keeps its
+    reference. ``qmode`` is static — one program per storage mode, no
+    traced branching (for "bf16" the scale operands are None)."""
+    dtype = _cache_dtype(params)
+
+    if qmode == "int8":
+        def lane(ck, cv, sk, sv, tok, pos):
+            logits, (ck2, cv2) = _step(
+                params, n_heads,
+                (dequantize_kv(ck, sk, dtype)[:, None],
+                 dequantize_kv(cv, sv, dtype)[:, None]),
+                tok[None], pos)
+            return (logits[0], requantize_kv(ck2[:, 0], sk),
+                    requantize_kv(cv2[:, 0], sv))
+
+        logits, pool_k, pool_v = jax.vmap(
+            lane, in_axes=(1, 1, 1, 1, 0, 0), out_axes=(0, 1, 1))(
+            pool_k, pool_v, k_scale, v_scale, tokens, positions)
+    else:
+        def lane(ck, cv, tok, pos):
+            logits, (ck2, cv2) = _step(
+                params, n_heads,
+                (ck.astype(dtype)[:, None], cv.astype(dtype)[:, None]),
+                tok[None], pos)
+            return (logits[0], ck2[:, 0].astype(jnp.bfloat16),
+                    cv2[:, 0].astype(jnp.bfloat16))
+
+        logits, pool_k, pool_v = jax.vmap(
+            lane, in_axes=(1, 1, 0, 0), out_axes=(0, 1, 1))(
+            pool_k, pool_v, tokens, positions)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    tokens = jnp.where(active, nxt, tokens)
+    positions = jnp.where(active, positions + 1, positions)
+    return tokens, positions, pool_k, pool_v
+
+
+def _spec_core(params, n_heads, caches, history, tokens, positions, active,
+               draft_noise, k):
+    """Shared body of the speculative step programs: draft -> (optional
+    noise) -> one-forward verify -> advance. Operates on COMPUTE-dtype
+    caches; the quantized wrapper handles storage conversion."""
+    S_max = history.shape[1]
+    V = vocab_size(params["params"]["transformer"]["wte"])
+    drafts = jax.vmap(partial(_ngram_draft, k=k))(history, positions)
+    # fault-injection hook: draft_noise is normally all-zeros (the mod-V
+    # add is then the identity, bitwise) — the corrupt_draft arm swaps in
+    # nonzero values without changing shapes, so scrambling never
+    # recompiles
+    drafts = (drafts + draft_noise) % V
+    oracle, accepted, caches = _speculative_verify(
+        params, n_heads, caches, tokens, drafts, positions)
+    # append all k+1 oracle tokens to the history at the lane's write
+    # window; positions past the accepted point hold speculative
+    # continuations the next step overwrites — the drafter's bigram scan
+    # only trusts positions below its pending one, and emitted output
+    # never comes from history, so they cannot corrupt anything
+    idx = jnp.where(active[:, None],
+                    positions[:, None] + 1 + jnp.arange(k + 1)[None, :],
+                    S_max)                                   # OOB -> dropped
+    history = jax.vmap(
+        lambda h, i, t: h.at[i].set(t, mode="drop"))(history, idx, oracle)
+    last = jnp.take_along_axis(oracle, accepted[:, None], axis=1)[:, 0]
+    tokens = jnp.where(active, last, tokens)
+    positions = jnp.where(active,
+                          jnp.minimum(positions + accepted + 1, S_max - 1),
+                          positions)
+    return tokens, positions, caches, history, oracle, accepted
+
+
+@partial(jax.jit, static_argnames=("n_heads", "k"),
+         donate_argnums=(1, 2, 3, 4, 5))
+def _spec_step_jit(params, pool_k, pool_v, history, tokens, positions,
+                   active, draft_noise, *, n_heads, k):
+    """One SPECULATIVE masked batched decode step over every pool lane.
+
+    Per lane: draft ``k`` tokens (n-gram lookup over ``history``), feed
+    pending-token + drafts through ONE k+1-wide causal forward against
+    the pool (``_forward_chunk`` — the pool IS the chunk cache, no per
+    lane re-batching), accept the longest draft prefix the greedy oracle
+    confirms, and advance position by accepted+1. ``k`` and the lane
+    count are static; drafts/acceptance/noise are traced operands, so
+    acceptance variation and slot churn reuse one compiled program.
+    Returns the full oracle [B, k+1] and per-lane accepted counts so the
+    host emit loop can hand out between 1 and k+1 tokens per lane."""
+    tokens, positions, (pool_k, pool_v), history, oracle, accepted = \
+        _spec_core(params, n_heads, (pool_k, pool_v), history, tokens,
+                   positions, active, draft_noise, k)
+    return tokens, positions, pool_k, pool_v, history, oracle, accepted
+
+
+@partial(jax.jit, static_argnames=("n_heads", "k", "qmode"),
+         donate_argnums=(1, 2, 5, 6, 7))
+def _spec_step_quant_jit(params, pool_k, pool_v, k_scale, v_scale, history,
+                         tokens, positions, active, draft_noise, *,
+                         n_heads, k, qmode):
+    """Speculative step over a quantized pool: dequantize the pool at
+    use, run the same draft/verify core in the compute dtype, then
+    requantize against the FIXED per-(slot, head) install scales (or a
+    bf16 cast). Untouched positions round-trip bitwise (idempotent
+    requant), so only the k+1 freshly-written rows actually change."""
+    dtype = _cache_dtype(params)
+    if qmode == "int8":
+        kf = dequantize_kv(pool_k, k_scale, dtype)
+        vf = dequantize_kv(pool_v, v_scale, dtype)
+    else:
+        kf, vf = pool_k.astype(dtype), pool_v.astype(dtype)
+    tokens, positions, (kf, vf), history, oracle, accepted = _spec_core(
+        params, n_heads, (kf, vf), history, tokens, positions, active,
+        draft_noise, k)
+    if qmode == "int8":
+        pool_k = requantize_kv(kf, k_scale)
+        pool_v = requantize_kv(vf, v_scale)
+    else:
+        pool_k, pool_v = kf.astype(jnp.bfloat16), vf.astype(jnp.bfloat16)
+    return tokens, positions, pool_k, pool_v, history, oracle, accepted
+
+
 class _ChunkedPrefill:
     """In-flight chunked prefill: the request, its private cache pair
     (carried across engine steps between chunk calls), how far it has
@@ -179,18 +344,43 @@ class ServingEngine:
             raise ValueError(
                 f"serving.prefix_cache_mb must be >= 0 "
                 f"(0 disables the prefix cache), got {cfg.prefix_cache_mb}")
+        if (isinstance(cfg.speculative_k, bool)
+                or not isinstance(cfg.speculative_k, int)
+                or cfg.speculative_k < 0):
+            raise ValueError(
+                f"serving.speculative_k must be an int >= 0 "
+                f"(0 disables speculative decoding), "
+                f"got {cfg.speculative_k!r}")
+        if cfg.speculative_k >= self.max_seq_len:
+            raise ValueError(
+                f"serving.speculative_k={cfg.speculative_k} must be "
+                f"smaller than max_seq_len={self.max_seq_len}")
+        if cfg.kv_cache_dtype not in KV_CACHE_DTYPES:
+            raise ValueError(
+                f"serving.kv_cache_dtype must be one of {KV_CACHE_DTYPES}, "
+                f"got {cfg.kv_cache_dtype!r}")
 
-        tr = params["params"]["transformer"]
-        emb_dtype = (jnp.float32 if "kernel_q" in tr["wte"]
-                     else tr["wte"]["embedding"].dtype)
-        dtype = jnp.result_type(emb_dtype, tr["wpe"]["embedding"].dtype)
+        dtype = _cache_dtype(params)
         self.pool = KVCachePool(self.n_layers, cfg.max_slots, self.n_heads,
-                                self.max_seq_len, self.head_dim, dtype=dtype)
+                                self.max_seq_len, self.head_dim, dtype=dtype,
+                                kv_cache_dtype=cfg.kv_cache_dtype)
+        # _qmode: storage<->compute conversion the decode programs need.
+        # "fp32" stores the compute dtype directly, and "bf16" on a bf16
+        # checkpoint is ALSO storage==compute — both take the plain
+        # (bitwise) programs; only a real narrowing pays the quant path.
+        if cfg.kv_cache_dtype == "int8":
+            self._qmode = "int8"
+        elif jnp.dtype(self.pool.k.dtype) != jnp.dtype(dtype):
+            self._qmode = "bf16"
+        else:
+            self._qmode = None
+        self._spec_k = int(cfg.speculative_k)
         self.scheduler = ContinuousBatchingScheduler(
             max_queue=cfg.max_queue, buckets=buckets,
             default_max_new_tokens=cfg.default_max_new_tokens,
             request_timeout_s=cfg.request_timeout_s)
         self.metrics = ServingMetrics(monitor)
+        self.metrics.record_kv_pool_bytes(self.pool.nbytes())
         self.prefix_cache = (
             PrefixKVCache(max(1, int(cfg.prefix_cache_mb * 2 ** 20)))
             if cfg.prefix_cache_mb > 0 else None)
@@ -208,10 +398,26 @@ class ServingEngine:
         self._dev_positions = None
         self._dev_active = None
         self._lane_dirty = True
+        # speculative state: per-lane token-by-position history feeding
+        # the n-gram drafter (host mirror for churn re-upload, device
+        # buffer advanced in-jit between churns) and the corrupt_draft
+        # noise operand (all-zeros = bitwise no-op)
+        self._lane_history = (
+            np.zeros((cfg.max_slots, self.max_seq_len), np.int32)
+            if self._spec_k > 0 else None)
+        self._dev_history = None
+        self._dev_noise = None
+        self._noise_armed = False
         if sentinel_config is not None and sentinel_config.enabled:
             budget = sentinel_config.compile_budget
+            if self._spec_k > 0:
+                decode_prog = (_spec_step_quant_jit if self._qmode
+                               else _spec_step_jit)
+            else:
+                decode_prog = (_decode_step_quant_jit if self._qmode
+                               else _decode_step_jit)
             self.decode_sentinel = CompileSentinel(
-                _decode_step_jit, budget, name="serving decode step")
+                decode_prog, budget, name="serving decode step")
             self.prefill_sentinel = CompileSentinel(
                 _prefill_batch_jit, budget, name="serving batched prefill")
             self._transfer_guard = bool(sentinel_config.transfer_guard)
@@ -339,55 +545,164 @@ class ServingEngine:
             if self.injector is not None:
                 self.injector.maybe_slow_decode(self._step_count)
             # span args (request ids) are built ONLY when tracing is armed:
-            # disabled-mode cost is this one attribute read
+            # disabled-mode cost is this one attribute read. The dict is
+            # kept so the spec path can fill in `accepted` post-step (the
+            # tracer renders args lazily, at write time).
+            span_args = None
             if self._tracer.enabled:
-                dspan = self._tracer.span(
-                    "serving/decode_step", cat="serving",
-                    args={"request_ids": [r.id for r in self._active.values()],
-                          "active": len(self._active)})
+                span_args = {
+                    "request_ids": [r.id for r in self._active.values()],
+                    "active": len(self._active), "accepted": 0}
+                dspan = self._tracer.span("serving/decode_step",
+                                          cat="serving", args=span_args)
             else:
                 dspan = telemetry.NULL_SPAN
             dspan.__enter__()
             t0 = time.monotonic()
             if self._lane_dirty:
-                # lane churn: ONE explicit upload of the lane vectors;
-                # between churn events they live on device and never move
-                self._dev_tokens, self._dev_positions, self._dev_active = \
-                    jax.device_put(  # jaxlint: disable=JL002(churn-only explicit upload)
-                        (self._lane_tokens,
-                         np.ascontiguousarray(self.pool.positions,
-                                              dtype=np.int32),
-                         self._lane_active))
-                self._lane_dirty = False
+                self._upload_lane_state()
             guard = transfer_free() if self._transfer_guard else nullcontext()
-            with guard:
-                (self._dev_tokens, self._dev_positions,
-                 self.pool.k, self.pool.v) = _decode_step_jit(
-                    self.params, self.pool.k, self.pool.v,
-                    self._dev_tokens, self._dev_positions, self._dev_active,
-                    n_heads=self.n_heads)
-            if self.decode_sentinel is not None:
-                self.decode_sentinel.check()
-            # the step's single deliberate sync: EOS checks need the tokens
-            host_tokens = jax.device_get(self._dev_tokens)  # jaxlint: disable=JL002(one explicit host read per step)
-            step_s = time.monotonic() - t0
-            dspan.__exit__(None, None, None)
-            self._lane_tokens = host_tokens.copy()
-            toks = host_tokens.tolist()
-            now = time.monotonic()
-            n_active = len(self._active)
-            for slot in list(self._active):
-                req = self._active[slot]
-                self.pool.advance(slot)
-                self._emit(req, toks[slot])
-                stats["decoded"] += 1
-                stats["retired"] += self._maybe_retire(req, toks[slot], now)
-            self.metrics.record_step(
-                queue_depth=self.scheduler.queue_depth(),
-                active_slots=n_active, max_slots=self.pool.max_slots,
-                tokens_this_step=n_active, step_s=step_s)
+            if self._spec_k > 0:
+                self._maybe_update_noise()
+                with guard:
+                    (self._dev_tokens, self._dev_positions, self.pool.k,
+                     self.pool.v, self._dev_history, oracle_dev,
+                     accepted_dev) = self._call_spec_step()
+                if self.decode_sentinel is not None:
+                    self.decode_sentinel.check()
+                # the step's single deliberate sync: the emit loop needs
+                # the oracle tokens and per-lane acceptance counts
+                oracle, accepted = jax.device_get(  # jaxlint: disable=JL002(one explicit host read per step)
+                    (oracle_dev, accepted_dev))
+                step_s = time.monotonic() - t0
+                oracle = oracle.tolist()        # host numpy -> python ints
+                accepted = accepted.tolist()
+                acc_total = sum(accepted[s] for s in self._active)
+                if span_args is not None:
+                    span_args["accepted"] = acc_total
+                dspan.__exit__(None, None, None)
+                now = time.monotonic()
+                n_active = len(self._active)
+                decoded_before = stats["decoded"]
+                for slot in list(self._active):
+                    req = self._active[slot]
+                    acc = accepted[slot]
+                    # mirror the device lane state: the pending token is
+                    # now the oracle's post-acceptance token
+                    self._lane_tokens[slot] = oracle[slot][acc]
+                    base = self.pool.positions[slot]    # host-side counter
+                    for j in range(acc + 1):
+                        tok = oracle[slot][j]
+                        self.pool.advance(slot)
+                        if base + 1 + j < self.max_seq_len:
+                            self._lane_history[slot, base + 1 + j] = tok
+                        self._emit(req, tok)
+                        stats["decoded"] += 1
+                        if self._maybe_retire(req, tok, now):
+                            # EOS/length/deadline truncates the step's
+                            # remaining oracle tokens — exactly where a
+                            # non-speculative server would have stopped
+                            stats["retired"] += 1
+                            break
+                self.metrics.record_step(
+                    queue_depth=self.scheduler.queue_depth(),
+                    active_slots=n_active, max_slots=self.pool.max_slots,
+                    tokens_this_step=stats["decoded"] - decoded_before,
+                    step_s=step_s, accepted_tokens=acc_total,
+                    proposed_tokens=self._spec_k * n_active)
+            else:
+                with guard:
+                    if self._qmode is not None:
+                        (self._dev_tokens, self._dev_positions, self.pool.k,
+                         self.pool.v) = _decode_step_quant_jit(
+                            self.params, self.pool.k, self.pool.v,
+                            self.pool.k_scale, self.pool.v_scale,
+                            self._dev_tokens, self._dev_positions,
+                            self._dev_active, n_heads=self.n_heads,
+                            qmode=self._qmode)
+                    else:
+                        (self._dev_tokens, self._dev_positions,
+                         self.pool.k, self.pool.v) = _decode_step_jit(
+                            self.params, self.pool.k, self.pool.v,
+                            self._dev_tokens, self._dev_positions,
+                            self._dev_active, n_heads=self.n_heads)
+                if self.decode_sentinel is not None:
+                    self.decode_sentinel.check()
+                # the step's single deliberate sync: EOS checks need the
+                # tokens
+                host_tokens = jax.device_get(self._dev_tokens)  # jaxlint: disable=JL002(one explicit host read per step)
+                step_s = time.monotonic() - t0
+                dspan.__exit__(None, None, None)
+                self._lane_tokens = host_tokens.copy()
+                toks = host_tokens.tolist()
+                now = time.monotonic()
+                n_active = len(self._active)
+                for slot in list(self._active):
+                    req = self._active[slot]
+                    self.pool.advance(slot)
+                    self._emit(req, toks[slot])
+                    stats["decoded"] += 1
+                    stats["retired"] += self._maybe_retire(req, toks[slot],
+                                                           now)
+                self.metrics.record_step(
+                    queue_depth=self.scheduler.queue_depth(),
+                    active_slots=n_active, max_slots=self.pool.max_slots,
+                    tokens_this_step=n_active, step_s=step_s)
         self._step_count += 1
         return stats
+
+    def _upload_lane_state(self):
+        """Lane churn: ONE explicit upload of the lane vectors (and the
+        drafter history when speculation is armed); between churn events
+        they live on device and never move."""
+        pos = np.ascontiguousarray(self.pool.positions, dtype=np.int32)
+        if self._spec_k > 0:
+            (self._dev_tokens, self._dev_positions, self._dev_active,
+             self._dev_history) = jax.device_put(
+                (self._lane_tokens, pos, self._lane_active,
+                 self._lane_history))
+            if self._dev_noise is None:
+                self._dev_noise = jax.device_put(
+                    np.zeros((self.pool.max_slots, self._spec_k), np.int32))
+        else:
+            self._dev_tokens, self._dev_positions, self._dev_active = \
+                jax.device_put((self._lane_tokens, pos, self._lane_active))
+        self._lane_dirty = False
+
+    def _call_spec_step(self):
+        """Dispatch the speculative step program for the pool's storage
+        mode. Both return (tokens, positions, k, v, history, oracle,
+        accepted)."""
+        if self._qmode is not None:
+            return _spec_step_quant_jit(
+                self.params, self.pool.k, self.pool.v,
+                self.pool.k_scale, self.pool.v_scale, self._dev_history,
+                self._dev_tokens, self._dev_positions, self._dev_active,
+                self._dev_noise, n_heads=self.n_heads, k=self._spec_k,
+                qmode=self._qmode)
+        return _spec_step_jit(  # jaxlint: disable=JL005(exclusive branch: the quant dispatch above never ran)
+            self.params, self.pool.k, self.pool.v, self._dev_history,
+            self._dev_tokens, self._dev_positions, self._dev_active,
+            self._dev_noise, n_heads=self.n_heads, k=self._spec_k)
+
+    def _maybe_update_noise(self):
+        """Swap the device-resident draft-noise operand when the
+        corrupt_draft fault arm fires (and restore zeros after). The
+        operand always exists with the same shape, so firing the fault
+        can never recompile the step."""
+        if self.injector is None:
+            return
+        noise = self.injector.corrupt_draft_noise(
+            self._step_count, self._spec_k, self.model_config.vocab_size)
+        if noise is not None:
+            self._dev_noise = jax.device_put(np.ascontiguousarray(
+                np.broadcast_to(np.asarray(noise, np.int32),
+                                (self.pool.max_slots, self._spec_k))))
+            self._noise_armed = True
+        elif self._noise_armed:
+            self._dev_noise = jax.device_put(
+                np.zeros((self.pool.max_slots, self._spec_k), np.int32))
+            self._noise_armed = False
 
     def drain(self, max_steps=None):
         """Step until no request is queued, prefilling, or in flight.
@@ -498,19 +813,23 @@ class ServingEngine:
             lens[i] = len(req.prompt)
             plan.append((req, reuse, entry))
             any_hit = any_hit or reuse > 0
+        # prefill runs in the COMPUTE dtype regardless of pool storage:
+        # the quantize happens once, at lane install
         shape = (self.n_layers, B, self.n_heads, total, self.head_dim)
+        cdtype = self.pool.compute_dtype
         if any_hit:
             # seed hit lanes from host-resident prefix KV; one transfer
-            init_k = np.zeros(shape, self.pool.k.dtype)
-            init_v = np.zeros(shape, self.pool.k.dtype)
+            init_k = np.zeros(shape, cdtype)
+            init_v = np.zeros(shape, cdtype)
             for i, (req, reuse, entry) in enumerate(plan):
                 if reuse > 0:
-                    init_k[:, i, :, :reuse] = entry.k[:, :, :reuse]
-                    init_v[:, i, :, :reuse] = entry.v[:, :, :reuse]
+                    ek, ev = self._entry_prefix_kv(entry, reuse)
+                    init_k[:, i, :, :reuse] = ek
+                    init_v[:, i, :, :reuse] = ev
             init_k, init_v = jnp.asarray(init_k), jnp.asarray(init_v)
         else:
-            init_k = jnp.zeros(shape, self.pool.k.dtype)
-            init_v = jnp.zeros(shape, self.pool.k.dtype)
+            init_k = jnp.zeros(shape, cdtype)
+            init_v = jnp.zeros(shape, cdtype)
 
         t0 = time.monotonic()
         k, v, first = _prefill_batch_jit(
@@ -557,15 +876,17 @@ class ServingEngine:
         slot = self.pool.allocate()       # reserved: completion can't stall
         shape = (self.n_layers, 1, self.n_heads, self.max_seq_len,
                  self.head_dim)
+        cdtype = self.pool.compute_dtype
         if reuse > 0:
-            k0 = np.zeros(shape, self.pool.k.dtype)
-            v0 = np.zeros(shape, self.pool.k.dtype)
-            k0[:, 0, :, :reuse] = entry.k[:, :, :reuse]
-            v0[:, 0, :, :reuse] = entry.v[:, :, :reuse]
+            k0 = np.zeros(shape, cdtype)
+            v0 = np.zeros(shape, cdtype)
+            ek, ev = self._entry_prefix_kv(entry, reuse)
+            k0[:, 0, :, :reuse] = ek
+            v0[:, 0, :, :reuse] = ev
             k0, v0 = jnp.asarray(k0), jnp.asarray(v0)
         else:
-            k0 = jnp.zeros(shape, self.pool.k.dtype)
-            v0 = jnp.zeros(shape, self.pool.k.dtype)
+            k0 = jnp.zeros(shape, cdtype)
+            v0 = jnp.zeros(shape, cdtype)
         self._chunking = _ChunkedPrefill(req, k0, v0, pos=reuse, reuse=reuse,
                                          slot=slot)
 
@@ -646,15 +967,36 @@ class ServingEngine:
     def _maybe_insert_prefix(self, req, reuse, k, v, lane):
         """Store the freshly-prefilled prompt's KV for future requests
         (skipped when an existing entry already covers the whole prompt
-        — nothing new to add)."""
+        — nothing new to add). In int8 pool mode entries are stored
+        QUANTIZED (per-(layer, head) scales over the cached positions):
+        the trie's byte budget buys ~4x the prefix positions, same
+        at-use-dequant contract as the pool itself."""
         if self.prefix_cache is None:
             return
         n = len(req.prompt)
         if reuse >= n - 1:
             return
-        self.prefix_cache.insert(
-            req.prompt,
-            np.asarray(k[:, lane, :, :n]), np.asarray(v[:, lane, :, :n]))
+        pk = np.asarray(k[:, lane, :, :n])
+        pv = np.asarray(v[:, lane, :, :n])
+        if self.pool.kv_cache_dtype == "int8":
+            pk, k_scale = quantize_kv_np(pk)
+            pv, v_scale = quantize_kv_np(pv)
+            self.prefix_cache.insert(req.prompt, pk, pv,
+                                     k_scale=k_scale, v_scale=v_scale)
+            return
+        self.prefix_cache.insert(req.prompt, pk, pv)
+
+    def _entry_prefix_kv(self, entry, reuse):
+        """A prefix entry's first ``reuse`` positions in the pool's
+        COMPUTE dtype (int8-mode entries dequantize here, at seed
+        time — never inside the prefill program)."""
+        ek = entry.k[:, :, :reuse]
+        ev = entry.v[:, :, :reuse]
+        if entry.k_scale is not None:
+            dt = np.dtype(self.pool.compute_dtype)
+            return (dequantize_kv_np(ek, entry.k_scale, dt),
+                    dequantize_kv_np(ev, entry.v_scale, dt))
+        return ek, ev
 
     # -- internals ------------------------------------------------------
     def _activate(self, req, slot, first_tok):
@@ -662,6 +1004,13 @@ class ServingEngine:
         self._active[slot] = req
         self._lane_tokens[slot] = first_tok
         self._lane_active[slot] = True
+        if self._lane_history is not None:
+            # seed the drafter: prompt tokens by position, then the
+            # PENDING first generated token at position len(prompt)
+            row = self._lane_history[slot]
+            row[:] = 0
+            row[:len(req.prompt)] = req.prompt
+            row[len(req.prompt)] = first_tok
         self._lane_dirty = True
         self._emit(req, first_tok)
 

@@ -20,6 +20,13 @@ recovery paths are testable on CPU without real stragglers:
                     N (cache churn under live traffic: in-flight lanes
                     already copied their KV, so eviction must be
                     output-invisible and later admissions simply miss)
+    corrupt_draft   scramble every lane's proposed draft tokens before
+                    the speculative verify step at scheduler iteration N
+                    (a worst-case / adversarial drafter: the verify
+                    forward must reject the garbage and output must stay
+                    bitwise identical to non-speculative greedy — only
+                    throughput may suffer). No-op with speculation
+                    disabled.
 
 Arms take ``at_step``/``times`` like the step arms (``slow_decode``,
 ``evict_under_decode``) or ``request_id`` (``stuck_request``, persistent
@@ -40,9 +47,12 @@ Programmatically::
 
 import time
 
+import numpy as np
+
 from deepspeed_tpu.runtime.resilience.fault_injection import StepFaultInjector
 
-SERVING_POINTS = ("slow_decode", "stuck_request", "evict_under_decode")
+SERVING_POINTS = ("slow_decode", "stuck_request", "evict_under_decode",
+                  "corrupt_draft")
 
 
 class _ServingArm:
@@ -111,6 +121,28 @@ class ServingFaultInjector(StepFaultInjector):
             arm.times -= 1
         self._fire("evict_under_decode")
         prefix_cache.evict_unreferenced()
+
+    def corrupt_draft_noise(self, step, k, vocab_size):
+        """Per-draft-position noise [k] when the corrupt_draft arm
+        matches ``step``, else None (engine keeps its zero operand).
+
+        Values are deterministic in [1, vocab_size-1], so the engine's
+        ``(draft + noise) % vocab_size`` maps EVERY draft token to a
+        DIFFERENT token — a guaranteed-wrong drafter, not merely a
+        perturbed one."""
+        arm = self._serving_arms.get("corrupt_draft")
+        if arm is None or k <= 0:
+            return None
+        if arm.at_step is not None and step != arm.at_step:
+            return None
+        if arm.times is not None:
+            if arm.times <= 0:
+                return None
+            arm.times -= 1
+        self._fire("corrupt_draft")
+        if vocab_size < 2:
+            return None                  # nowhere to scramble to
+        return 1 + (np.arange(k, dtype=np.int32) * 7919) % (vocab_size - 1)
 
     def request_is_stuck(self, request_id):
         """True while the stuck_request arm pins ``request_id`` (persistent

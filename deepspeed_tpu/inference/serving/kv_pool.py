@@ -21,12 +21,26 @@ Slot hygiene contract (relied on by the engine, proved in
 Host-side bookkeeping (free list, per-slot position counters, occupancy
 stats) is plain Python/numpy: it runs once per scheduler iteration, not
 per token-lane.
+
+Storage dtype (``kv_cache_dtype``): the pool can hold its lanes in the
+model's compute dtype ("fp32", the default — bitwise-transparent), in
+bfloat16 ("bf16" — half the bytes, cast at use), or in int8 with
+per-(slot, head) symmetric fp32 scales ("int8" — quarter the bytes,
+dequantized at use inside the decode/verify reads). Scales are set once
+at install time from the prefilled lane's amax and kept FIXED while the
+lane decodes (new tokens clip into the install range), so re-storing an
+untouched lane is a bitwise no-op and the engine's requantize step never
+perturbs prior tokens.
 """
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from ..quantization import quantize_kv
+
+KV_CACHE_DTYPES = ("fp32", "bf16", "int8")
 
 
 class PoolExhaustedError(RuntimeError):
@@ -38,35 +52,76 @@ class PoolExhaustedError(RuntimeError):
 def _install_slot(pool_k, pool_v, new_k, new_v, slot):
     """Copy a prefilled single-request cache ([L, 1, nh, S_max, hd]) into
     lane ``slot`` of the pool. ``slot`` is a traced scalar: installing
-    into different slots reuses one compiled program."""
-    pool_k = jax.lax.dynamic_update_index_in_dim(pool_k, new_k[:, 0], slot, axis=1)
-    pool_v = jax.lax.dynamic_update_index_in_dim(pool_v, new_v[:, 0], slot, axis=1)
+    into different slots reuses one compiled program. The cast covers the
+    "bf16" storage mode and is a no-op (elided by XLA) when the incoming
+    dtype already matches the pool's."""
+    pool_k = jax.lax.dynamic_update_index_in_dim(
+        pool_k, new_k[:, 0].astype(pool_k.dtype), slot, axis=1)
+    pool_v = jax.lax.dynamic_update_index_in_dim(
+        pool_v, new_v[:, 0].astype(pool_v.dtype), slot, axis=1)
     return pool_k, pool_v
 
 
+def _install_slot_int8(pool_k, pool_v, k_scale, v_scale, new_k, new_v, slot):
+    """int8-mode install: quantize the prefilled lane ([L, nh, S_max, hd])
+    with fresh per-(layer, head) scales and overwrite both the lane and
+    its scale rows — a reallocated slot never inherits the previous
+    occupant's scale range."""
+    qk, sk = quantize_kv(new_k[:, 0])
+    qv, sv = quantize_kv(new_v[:, 0])
+    pool_k = jax.lax.dynamic_update_index_in_dim(pool_k, qk, slot, axis=1)
+    pool_v = jax.lax.dynamic_update_index_in_dim(pool_v, qv, slot, axis=1)
+    k_scale = jax.lax.dynamic_update_index_in_dim(k_scale, sk, slot, axis=1)
+    v_scale = jax.lax.dynamic_update_index_in_dim(v_scale, sv, slot, axis=1)
+    return pool_k, pool_v, k_scale, v_scale
+
+
 # Donate the pool buffers: the install is an in-place lane overwrite, the
-# old pool is dead the moment the new one exists.
+# old pool is dead the moment the new one exists. (Scales are donated too
+# in the int8 path — the install REPLACES the slot's scale rows, so the
+# old scale array is equally dead.)
 _install_slot_jit = jax.jit(_install_slot, donate_argnums=(0, 1))
+_install_slot_int8_jit = jax.jit(_install_slot_int8,
+                                 donate_argnums=(0, 1, 2, 3))
 
 
 class KVCachePool:
     """Fixed-capacity KV-cache slots plus their host-side bookkeeping."""
 
     def __init__(self, n_layers, max_slots, n_heads, max_seq_len, head_dim,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, kv_cache_dtype="fp32"):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if max_seq_len < 2:
             raise ValueError(f"max_seq_len must be >= 2, got {max_seq_len}")
+        if kv_cache_dtype not in KV_CACHE_DTYPES:
+            raise ValueError(
+                f"kv_cache_dtype must be one of {KV_CACHE_DTYPES}, "
+                f"got {kv_cache_dtype!r}")
         self.n_layers = int(n_layers)
         self.max_slots = int(max_slots)
         self.n_heads = int(n_heads)
         self.max_seq_len = int(max_seq_len)
         self.head_dim = int(head_dim)
+        # ``dtype`` is the model's COMPUTE dtype ("fp32" mode stores it
+        # directly); quantized modes store narrower and dequant at use.
+        self.compute_dtype = dtype
+        self.kv_cache_dtype = kv_cache_dtype
         shape = (self.n_layers, self.max_slots, self.n_heads,
                  self.max_seq_len, self.head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        storage = {"fp32": dtype, "bf16": jnp.bfloat16,
+                   "int8": jnp.int8}[kv_cache_dtype]
+        self.k = jnp.zeros(shape, storage)
+        self.v = jnp.zeros(shape, storage)
+        if kv_cache_dtype == "int8":
+            # one symmetric scale per (layer, slot, head); keepdims shape
+            # broadcasts directly against the lane in dequantize_kv
+            sshape = (self.n_layers, self.max_slots, self.n_heads, 1, 1)
+            self.k_scale = jnp.ones(sshape, jnp.float32)
+            self.v_scale = jnp.ones(sshape, jnp.float32)
+        else:
+            self.k_scale = None
+            self.v_scale = None
         # lowest-index-first allocation keeps slot assignment deterministic
         # for a given arrival order (the oracle tests replay schedules)
         self._free = sorted(range(self.max_slots), reverse=True)
@@ -112,7 +167,14 @@ class KVCachePool:
         if not 0 <= position < self.max_seq_len:
             raise ValueError(
                 f"position {position} outside [0, {self.max_seq_len})")
-        self.k, self.v = _install_slot_jit(self.k, self.v, new_k, new_v, slot)
+        if self.kv_cache_dtype == "int8":
+            (self.k, self.v, self.k_scale,
+             self.v_scale) = _install_slot_int8_jit(
+                self.k, self.v, self.k_scale, self.v_scale,
+                new_k, new_v, slot)
+        else:
+            self.k, self.v = _install_slot_jit(
+                self.k, self.v, new_k, new_v, slot)
         self.positions[slot] = position
 
     def install_lane(self, batch_k, batch_v, lane, slot, position):
@@ -132,6 +194,15 @@ class KVCachePool:
                                    self.max_seq_len - 1)
 
     # -- stats ----------------------------------------------------------
+    def nbytes(self):
+        """Device bytes held by the pool's KV storage (+ scales in int8
+        mode) — the number ``Serving/kv_pool_bytes`` reports, and the one
+        that halves/quarters when kv_cache_dtype narrows."""
+        total = self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            total += self.k_scale.nbytes + self.v_scale.nbytes
+        return int(total)
+
     def occupancy(self):
         """Occupancy snapshot for metrics/debugging."""
         in_use = self.slots_in_use
@@ -144,4 +215,6 @@ class KVCachePool:
             "frees": self.frees,
             "peak_in_use": self.peak_in_use,
             "cached_tokens": int(self.positions.sum()),
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "pool_bytes": self.nbytes(),
         }

@@ -50,6 +50,11 @@ class ServingMetrics:
         # snapshot works without reaching into the engine)
         self.prefix_hits = 0
         self.prefix_misses = 0
+        # speculative decoding: drafts proposed/accepted across steps and
+        # the pool's storage footprint (recorded once, at engine build)
+        self.draft_proposed = 0
+        self.draft_accepted = 0
+        self.kv_pool_bytes = 0
         # TTFT: time from submit() to the request's first token
         self._ttft_sum = 0.0
         self._ttft_count = 0
@@ -96,7 +101,12 @@ class ServingMetrics:
         self.requests_timed_out += 1
 
     def record_step(self, queue_depth, active_slots, max_slots,
-                    tokens_this_step, step_s):
+                    tokens_this_step, step_s, accepted_tokens=0,
+                    proposed_tokens=0):
+        """One decode step. With speculation armed, ``proposed_tokens``
+        is k * active lanes and ``accepted_tokens`` how many drafts the
+        oracle confirmed — tokens_this_step then exceeds the lane count
+        by exactly the accepted drafts (minus early retirements)."""
         self.decode_steps += 1
         self.tokens_emitted += tokens_this_step
         self.decode_time_s += step_s
@@ -107,6 +117,18 @@ class ServingMetrics:
         if step_s > 0:
             self._record("Serving/tokens_per_sec",
                          tokens_this_step / step_s, step)
+        self._record("Serving/tokens_per_step", tokens_this_step, step)
+        if proposed_tokens > 0:
+            self.draft_proposed += proposed_tokens
+            self.draft_accepted += accepted_tokens
+            self._record("Serving/accept_rate",
+                         accepted_tokens / proposed_tokens, step)
+
+    def record_kv_pool_bytes(self, nbytes):
+        """Pool storage footprint (KV + scales) — a construction-time
+        constant, re-recordable if a pool is ever rebuilt."""
+        self.kv_pool_bytes = int(nbytes)
+        self._record("Serving/kv_pool_bytes", int(nbytes), 1)
 
     def _record(self, tag, value, step):
         if self.monitor is not None:
@@ -137,6 +159,20 @@ class ServingMetrics:
         lookups = self.prefix_hits + self.prefix_misses
         return self.prefix_hits / lookups if lookups else None
 
+    def accept_rate(self):
+        """Cumulative draft acceptance rate, None before any
+        speculative step (or with speculation disabled)."""
+        if self.draft_proposed <= 0:
+            return None
+        return self.draft_accepted / self.draft_proposed
+
+    def tokens_per_step(self):
+        """Mean emitted tokens per decode step — the speculative
+        multiplier a capacity planner multiplies lane count by."""
+        if self.decode_steps <= 0:
+            return None
+        return self.tokens_emitted / self.decode_steps
+
     def snapshot(self):
         p50, p95 = self.ttft_percentiles()
         return {
@@ -157,6 +193,12 @@ class ServingMetrics:
             "prefill_tokens_per_sec": self.prefill_tokens_per_sec(),
             "prefix_reused_tokens": self.prefill_reused_tokens,
             "prefix_hit_rate": self.prefix_hit_rate(),
+            # speculative decoding + pool storage
+            "accept_rate": self.accept_rate(),
+            "tokens_per_step": self.tokens_per_step(),
+            "draft_proposed": self.draft_proposed,
+            "draft_accepted": self.draft_accepted,
+            "kv_pool_bytes": self.kv_pool_bytes,
             "uptime_s": time.monotonic() - self._started,
         }
 

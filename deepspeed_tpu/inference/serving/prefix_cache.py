@@ -32,15 +32,23 @@ import threading
 
 
 class PrefixEntry:
-    """One stored prompt's KV plus its bookkeeping."""
+    """One stored prompt's KV plus its bookkeeping. In an int8-pool
+    engine ``k``/``v`` are int8 with per-(layer, head) fp32 scales
+    (``k_scale``/``v_scale``, None otherwise) — quartering the bytes an
+    entry charges against the budget, dequantized at seed time."""
 
-    __slots__ = ("tokens", "k", "v", "nbytes", "refs", "last_used")
+    __slots__ = ("tokens", "k", "v", "k_scale", "v_scale", "nbytes",
+                 "refs", "last_used")
 
-    def __init__(self, tokens, k, v):
+    def __init__(self, tokens, k, v, k_scale=None, v_scale=None):
         self.tokens = tokens                    # tuple[int]
         self.k = k                              # np [L, nh, P, hd]
         self.v = v
+        self.k_scale = k_scale                  # np [L, nh, 1, 1] | None
+        self.v_scale = v_scale
         self.nbytes = int(k.nbytes) + int(v.nbytes)
+        if k_scale is not None:
+            self.nbytes += int(k_scale.nbytes) + int(v_scale.nbytes)
         self.refs = 0
         self.last_used = 0
 
@@ -113,11 +121,12 @@ class PrefixKVCache:
             entry.refs -= 1
 
     # -- insert / evict --------------------------------------------------
-    def insert(self, tokens, k, v):
-        """Store ``tokens``' KV ([L, nh, len(tokens), hd] numpy pair).
-        Returns the entry, the existing entry when the exact prompt is
-        already stored, or None when it cannot fit even after evicting
-        every unreferenced entry."""
+    def insert(self, tokens, k, v, k_scale=None, v_scale=None):
+        """Store ``tokens``' KV ([L, nh, len(tokens), hd] numpy pair,
+        optionally int8 + per-head scales — see PrefixEntry). Returns the
+        entry, the existing entry when the exact prompt is already
+        stored, or None when it cannot fit even after evicting every
+        unreferenced entry."""
         key = tuple(int(t) for t in tokens)
         if not key:
             raise ValueError("cannot insert an empty prefix")
@@ -126,7 +135,7 @@ class PrefixKVCache:
             if existing is not None:
                 self._touch(existing)
                 return existing
-            entry = PrefixEntry(key, k, v)
+            entry = PrefixEntry(key, k, v, k_scale=k_scale, v_scale=v_scale)
             if entry.nbytes > self.budget_bytes:
                 self.insert_rejections += 1
                 return None

@@ -545,6 +545,23 @@ def get_serving_config(param_dict):
             f"serving.{SERVING_PREFIX_CACHE_MB} must be a number >= 0 "
             f"(0 disables the prefix KV cache), got {prefix_cache_mb!r}"
         )
+    speculative_k = get_scalar_param(
+        params, SERVING_SPECULATIVE_K, SERVING_SPECULATIVE_K_DEFAULT
+    )
+    if (not isinstance(speculative_k, int) or isinstance(speculative_k, bool)
+            or speculative_k < 0):
+        raise ValueError(
+            f"serving.{SERVING_SPECULATIVE_K} must be an int >= 0 "
+            f"(0 disables speculative decoding), got {speculative_k!r}"
+        )
+    kv_cache_dtype = get_scalar_param(
+        params, SERVING_KV_CACHE_DTYPE, SERVING_KV_CACHE_DTYPE_DEFAULT
+    )
+    if kv_cache_dtype not in SERVING_KV_CACHE_DTYPES:
+        raise ValueError(
+            f"serving.{SERVING_KV_CACHE_DTYPE} must be one of "
+            f"{SERVING_KV_CACHE_DTYPES}, got {kv_cache_dtype!r}"
+        )
     fault_injection = params.get(SERVING_FAULT_INJECTION, None)
     if fault_injection is not None and not isinstance(fault_injection, dict):
         raise ValueError(
@@ -561,6 +578,8 @@ def get_serving_config(param_dict):
         request_timeout_s=float(request_timeout_s),
         prefill_chunk_tokens=prefill_chunk,
         prefix_cache_mb=float(prefix_cache_mb),
+        speculative_k=speculative_k,
+        kv_cache_dtype=kv_cache_dtype,
         fault_injection=fault_injection,
     )
 

@@ -224,6 +224,11 @@ SERVING_PREFILL_CHUNK_TOKENS = "prefill_chunk_tokens"
 SERVING_PREFILL_CHUNK_TOKENS_DEFAULT = 0  # 0 = always single-pass prefill
 SERVING_PREFIX_CACHE_MB = "prefix_cache_mb"
 SERVING_PREFIX_CACHE_MB_DEFAULT = 0.0  # 0 = prefix KV cache disabled
+SERVING_SPECULATIVE_K = "speculative_k"
+SERVING_SPECULATIVE_K_DEFAULT = 0  # 0 = classic one-token decode
+SERVING_KV_CACHE_DTYPE = "kv_cache_dtype"
+SERVING_KV_CACHE_DTYPE_DEFAULT = "fp32"  # model compute dtype (bitwise)
+SERVING_KV_CACHE_DTYPES = ("fp32", "bf16", "int8")
 SERVING_FAULT_INJECTION = "fault_injection"
 
 #############################################
