@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving lint-jax lint-jax-baseline ops bench bench-serving trace-smoke
+.PHONY: test test-fast test-faults test-cluster test-serving lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving trace-smoke
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -30,11 +30,19 @@ test-serving:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_serving.py tests/unit/test_prefix_cache.py tests/unit/test_speculative.py -q
 
 # Static JAX hazard analysis (tools/jaxlint): recompile, host-sync,
-# leaked-tracer, donation and fp16-dtype rules. AST-only — no jax import,
-# finishes in seconds. Fails on any finding not in jaxlint_baseline.json
-# (see docs/static_analysis.md for rules, suppressions, and the workflow).
+# leaked-tracer, donation, fp16-dtype, collective-axis, RNG-reuse,
+# quantized-dtype and sharding-consistency rules. AST-only — no jax
+# import, the two-pass analyzer covers the repo in well under 3 s. Fails
+# on any finding not in jaxlint_baseline.json (see docs/static_analysis.md
+# for rules, suppressions, and the workflow).
 lint-jax:
 	python -m tools.jaxlint deepspeed_tpu tools --baseline jaxlint_baseline.json
+
+# The PR gate: only findings on lines changed vs origin/main fail, so new
+# code lands at zero findings while untouched debt stays the baseline's
+# problem. Works on a shallow checkout (tree-vs-worktree diff).
+lint-jax-diff:
+	python -m tools.jaxlint deepspeed_tpu tools --diff origin/main
 
 # Regenerate the baseline after intentionally fixing findings (shrinking it).
 # Never use this to absorb NEW findings — fix or suppress them with a reason.

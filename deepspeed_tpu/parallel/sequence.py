@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from deepspeed_tpu.parallel.mesh import DATA_AXIS
 from deepspeed_tpu.utils.shard_map_compat import shard_map
 
 
@@ -87,7 +88,7 @@ def ring_attention_local(q, k, v, bias, axis_name, causal=False):
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mask=None, mesh=None, axis_name="data", causal=False):
+def ring_attention(q, k, v, mask=None, mesh=None, axis_name=DATA_AXIS, causal=False):
     """Driver: shards [B,H,S,D] inputs along ``axis_name`` over ``mesh`` and
     runs the ring. ``mask``: additive [B,S] (or [B,1,1,S]) key bias."""
     B, H, S, D = q.shape

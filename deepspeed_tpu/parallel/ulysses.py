@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
 from deepspeed_tpu.ops.transformer.attention import flash_attention
+from deepspeed_tpu.parallel.mesh import DATA_AXIS
 from deepspeed_tpu.utils.shard_map_compat import shard_map
 
 
@@ -61,7 +62,7 @@ def ulysses_attention_local(q, k, v, bias, axis_name, causal=False):
     return _heads_to_seq(out, axis_name, W)
 
 
-def ulysses_attention(q, k, v, mask=None, mesh=None, axis_name="data", causal=False):
+def ulysses_attention(q, k, v, mask=None, mesh=None, axis_name=DATA_AXIS, causal=False):
     """Driver: [B,H,S,D] inputs sequence-sharded along ``axis_name``."""
     B, H, S, D = q.shape
     if mesh is None:

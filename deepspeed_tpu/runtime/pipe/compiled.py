@@ -38,11 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from deepspeed_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 from deepspeed_tpu.utils.shard_map_compat import shard_map
-
-PIPE_AXIS = "pipe"
-DATA_AXIS = "data"
-MODEL_AXIS = "model"
 
 
 def _manual_axes(mesh):

@@ -53,10 +53,8 @@ from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoade
 from deepspeed_tpu import telemetry
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from deepspeed_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 from deepspeed_tpu.utils import distributed as dist
-
-DATA_AXIS = "data"
-MODEL_AXIS = "model"
 
 
 class PipelineError(Exception):

@@ -1,0 +1,21 @@
+"""JL007 negatives: every axis name flows through the named constants."""
+import jax
+from jax import lax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+MESH = Mesh((), (DATA_AXIS, MODEL_AXIS))
+
+
+def reduce_data(x):
+    return lax.psum(x, DATA_AXIS)
+
+
+def helper_sum(x, axis_name):
+    return lax.psum(x, axis_name)
+
+
+def reduce_model(x):
+    return helper_sum(x, MODEL_AXIS)

@@ -1,0 +1,24 @@
+"""JL007 positives: collective axis names drifting from the topology."""
+import jax
+from jax import lax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+
+MESH = Mesh((), (DATA_AXIS, "model"))
+
+
+def undefined_axis(x):
+    return lax.psum(x, "batch")       # JL007: no mesh/pmap defines "batch"
+
+
+def helper_sum(x, axis_name):
+    return lax.psum(x, axis_name)
+
+
+def undefined_through_helper(x):
+    return helper_sum(x, "rows")      # JL007: resolved through the call site
+
+
+def raw_literal_duplicate(x):
+    return lax.pmean(x, "data")       # JL007: DATA_AXIS already names this

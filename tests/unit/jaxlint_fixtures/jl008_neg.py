@@ -1,0 +1,24 @@
+"""JL008 negatives: helper donation with the buffer rebound (or never
+read) afterwards."""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _fused_add(state, delta):
+    return state + delta
+
+
+def apply_delta(state, delta):
+    return _fused_add(state, delta)
+
+
+def train_step(state, delta):
+    state = apply_delta(state, delta)   # rebind: the old buffer is gone
+    return state.sum()
+
+
+def report_then_step(state, delta):
+    norm = state.sum()                  # read BEFORE the donation is fine
+    return apply_delta(state, delta), norm

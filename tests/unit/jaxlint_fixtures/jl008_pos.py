@@ -1,0 +1,35 @@
+"""JL008 positives: donated buffers read after a donating HELPER call.
+
+JL005 covers the direct jitted call; these only donate one call away.
+"""
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _fused_add(state, delta):
+    return state + delta
+
+
+@partial(jax.jit, donate_argnames=("buf",))
+def _scatter_add(buf, updates):
+    return buf.at[0].add(updates)
+
+
+def apply_delta(state, delta):
+    return _fused_add(state, delta)
+
+
+def apply_scatter(buf, updates):
+    return _scatter_add(buf=buf, updates=updates)
+
+
+def train_step(state, delta):
+    new = apply_delta(state, delta)
+    return new, state.sum()           # JL008: `state` donated via helper
+
+
+def cache_step(buf, updates):
+    out = apply_scatter(buf, updates)
+    return out + buf.mean()           # JL008: `buf` donated via helper

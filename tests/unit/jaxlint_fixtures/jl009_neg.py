@@ -1,0 +1,30 @@
+"""JL009 negatives: split/fold_in discipline — every consumer gets a
+fresh key."""
+import jax
+
+
+def _draw(rng, shape):
+    return jax.random.normal(rng, shape)
+
+
+def split_then_draw(key):
+    k1, k2 = jax.random.split(key)
+    a = _draw(k1, (4,))
+    b = jax.random.uniform(k2, (4,))
+    return a, b
+
+
+def carry_loop(key, steps):
+    outs = []
+    for _ in range(steps):
+        key, sub = jax.random.split(key)    # re-derived every iteration
+        outs.append(jax.random.normal(sub, (2,)))
+    return outs
+
+
+def fold_loop(key, steps):
+    outs = []
+    for i in range(steps):
+        sub = jax.random.fold_in(key, i)    # counter derivation: sanctioned
+        outs.append(jax.random.normal(sub, (2,)))
+    return outs

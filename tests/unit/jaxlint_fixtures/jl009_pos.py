@@ -1,0 +1,36 @@
+"""JL009 positives: PRNG keys consumed twice, directly or one call away."""
+import jax
+
+
+def _draw(rng, shape):
+    return jax.random.normal(rng, shape)
+
+
+def _as_key(rng):
+    return rng
+
+
+def direct_reuse(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))       # JL009: `key` already consumed
+    return a, b
+
+
+def reuse_through_helper(key):
+    x = _draw(key, (4,))
+    y = jax.random.normal(key, (4,))        # JL009: `_draw` consumed it
+    return x, y
+
+
+def alias_reuse(key):
+    k2 = _as_key(key)                       # un-split alias, not a derive
+    a = jax.random.normal(key, (2,))
+    b = jax.random.normal(k2, (2,))         # JL009: alias of a spent key
+    return a, b
+
+
+def loop_reuse(key, steps):
+    outs = []
+    for _ in range(steps):
+        outs.append(jax.random.normal(key, (2,)))   # JL009: same draw/step
+    return outs

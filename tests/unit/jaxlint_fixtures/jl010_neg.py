@@ -1,0 +1,22 @@
+"""JL010 negatives: the idiomatic casts keep the int8 path clean."""
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.quantization import dequantize_kv, quantize_kv
+
+
+def add_bias(x, bias):
+    q, scale = quantize_kv(x)
+    y = q.astype(jnp.bfloat16) * scale      # explicit cast, then scale
+    return y + bias
+
+
+def roundtrip(x, bias):
+    q, scale = quantize_kv(x)
+    full = dequantize_kv(q, scale)
+    return full + bias
+
+
+def host_side(w, x):
+    q, scale = quantize_kv(x)
+    return np.matmul(w, q)                  # numpy matmul: not a jnp sink

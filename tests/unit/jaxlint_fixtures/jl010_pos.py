@@ -1,0 +1,25 @@
+"""JL010 positives: int8 codec outputs hitting arithmetic uncast."""
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.quantization import quantize_kv
+
+
+def _load_quant(cache):
+    q, scale = quantize_kv(cache)
+    return q, scale
+
+
+def add_bias(x, bias):
+    q, scale = quantize_kv(x)
+    y = q + bias                      # JL010: silent float32 promotion
+    return y * scale
+
+
+def project(w, cache):
+    qk, scale = quantize_kv(cache)
+    return jnp.matmul(w, qk)          # JL010: int8 into a jnp matmul
+
+
+def mix(cache, probe):
+    qk = _load_quant(cache)
+    return qk[0] * probe              # JL010: helper returns the int8 pair

@@ -1,0 +1,18 @@
+"""JL011 negatives: one spec per path, every axis mesh-backed."""
+from jax.sharding import Mesh, PartitionSpec
+
+MESH = Mesh((), ("data", "model"))
+
+SPECS = {
+    "transformer/wq": PartitionSpec("model", None),
+    "transformer/wo": PartitionSpec(None, "model"),
+}
+
+MIRROR = {
+    # same path, SAME spec: agreement is not a conflict
+    "transformer/wq": PartitionSpec("model", None),
+}
+
+
+def dynamic_spec(axes):
+    return PartitionSpec(*axes)     # computed specs are out of scope

@@ -1,0 +1,14 @@
+"""JL011 positives: conflicting spec registrations and mesh-less axes."""
+from jax.sharding import Mesh, PartitionSpec
+
+MESH = Mesh((), ("data", "model"))
+
+SPECS_V1 = {
+    "transformer/wq": PartitionSpec("model", None),
+}
+
+SPECS_V2 = {
+    "transformer/wq": PartitionSpec(None, "model"),   # JL011: conflicts
+}
+
+ROW_SPEC = PartitionSpec("rows", None)    # JL011: no mesh defines "rows"

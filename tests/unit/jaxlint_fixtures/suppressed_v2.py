@@ -1,0 +1,20 @@
+"""Suppression fixture for the interprocedural codes (JL007-JL011)."""
+import jax
+from jax import lax
+
+
+def vetted_axis(x):
+    return lax.psum(x, "experimental")  # jaxlint: disable=JL007(mesh is wired at runtime by the launcher)
+
+
+def vetted_reuse(key):
+    a = jax.random.normal(key, (2,))
+    # jaxlint: disable=JL009(deliberate common-random-numbers variance trick)
+    b = jax.random.normal(key, (2,))
+    return a, b
+
+
+def wrong_code_still_flagged(key):
+    a = jax.random.normal(key, (2,))
+    b = jax.random.normal(key, (2,))  # jaxlint: disable=JL007(wrong code: does not silence JL009)
+    return a, b

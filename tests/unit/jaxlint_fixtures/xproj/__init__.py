@@ -1,0 +1,4 @@
+"""Cross-file fixture package: the interprocedural rules (JL007-JL011)
+must resolve helpers, constants, and specs THROUGH the project graph —
+every positive in engine.py depends on a fact defined in a sibling
+module."""

@@ -1,0 +1,29 @@
+"""Helpers whose summaries carry the cross-file facts."""
+from functools import partial
+
+import jax
+from jax import lax
+
+from deepspeed_tpu.inference.quantization import quantize_kv
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _fused_add(state, delta):
+    return state + delta
+
+
+def apply_delta(state, delta):
+    return _fused_add(state, delta)     # donates `state` through
+
+
+def all_reduce(x, axis_name):
+    return lax.psum(x, axis_name)       # axis resolved at call sites
+
+
+def draw(rng, shape):
+    return jax.random.normal(rng, shape)   # consumes `rng`
+
+
+def load_quant(cache):
+    q, scale = quantize_kv(cache)
+    return q, scale                     # returns the int8 pair

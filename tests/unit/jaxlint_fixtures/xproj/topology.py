@@ -1,0 +1,11 @@
+"""Canonical axis constants, the mesh, and the spec registry."""
+from jax.sharding import Mesh, PartitionSpec
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+MESH = Mesh((), (DATA_AXIS, MODEL_AXIS))
+
+PARAM_SPECS = {
+    "block/attn/wq": PartitionSpec(MODEL_AXIS, None),
+}

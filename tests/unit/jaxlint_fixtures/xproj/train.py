@@ -1,0 +1,37 @@
+"""Cross-file positives: each finding needs a fact from a sibling file."""
+import jax
+from jax.sharding import PartitionSpec
+
+from .helpers import all_reduce, apply_delta, draw, load_quant
+from .topology import MODEL_AXIS
+
+
+def bad_axis(x):
+    return all_reduce(x, "batch")       # JL007: no mesh defines "batch"
+
+
+def raw_axis(x):
+    return all_reduce(x, "data")        # JL007: DATA_AXIS already names it
+
+
+def read_after_donate(state, delta):
+    new = apply_delta(state, delta)
+    return new, state.sum()             # JL008: donated through helpers.py
+
+
+def reuse(key):
+    x = draw(key, (2,))
+    y = jax.random.normal(key, (2,))    # JL009: draw() consumed it
+    return x, y
+
+
+def promote(cache, probe):
+    qk, scale = load_quant(cache)
+    return qk * probe                   # JL010: int8 through the helper
+
+
+CONFLICT_SPECS = {
+    "block/attn/wq": PartitionSpec(None, MODEL_AXIS),   # JL011: conflicts
+}
+
+ROW_SPEC = PartitionSpec("rows")        # JL011: no mesh defines "rows"

@@ -1,7 +1,10 @@
 """jaxlint tests: the fixture corpus (positive AND negative per rule),
 suppression semantics, fingerprint stability, baseline diffing, CLI exit
 codes, and the repo-wide gate (deepspeed_tpu/ + tools/ lint clean
-against the committed baseline, under the 30 s CI budget).
+against the committed baseline, under the 3 s CI budget).
+
+Cross-file behavior (the project graph, --diff mode, --explain, the
+summary cache) lives in test_jaxlint_v2.py.
 
 Everything here is AST-only — no jax import, so this file is one of the
 fastest in the suite.
@@ -41,6 +44,11 @@ POSITIVES = {
     "jl004_pos.py": ("JL004", 2),
     "jl005_pos.py": ("JL005", 2),
     "fp16_jl006_pos.py": ("JL006", 2),
+    "jl007_pos.py": ("JL007", 3),
+    "jl008_pos.py": ("JL008", 2),
+    "jl009_pos.py": ("JL009", 4),
+    "jl010_pos.py": ("JL010", 3),
+    "jl011_pos.py": ("JL011", 2),
 }
 NEGATIVES = {
     "JL001": "jl001_neg.py",
@@ -49,6 +57,11 @@ NEGATIVES = {
     "JL004": "jl004_neg.py",
     "JL005": "jl005_neg.py",
     "JL006": "fp16_jl006_neg.py",
+    "JL007": "jl007_neg.py",
+    "JL008": "jl008_neg.py",
+    "JL009": "jl009_neg.py",
+    "JL010": "jl010_neg.py",
+    "JL011": "jl011_neg.py",
 }
 
 
@@ -249,7 +262,9 @@ def test_cli_json_format(capsys):
 
 def test_repo_lints_clean_against_committed_baseline():
     """The CI gate, as a test: deepspeed_tpu/ + tools/ produce no
-    findings beyond the committed baseline, inside the 30 s budget."""
+    findings beyond the committed baseline, inside the 3 s budget the
+    two-pass analyzer is designed to (the summary cache makes the
+    second pass of a CI job parse-free)."""
     t0 = time.monotonic()
     findings, n_files = analyze_paths(
         [os.path.join(REPO_ROOT, "deepspeed_tpu"),
@@ -261,7 +276,7 @@ def test_repo_lints_clean_against_committed_baseline():
     assert new == [], "new jaxlint findings:\n" + "\n".join(
         f.render() for f in new)
     assert n_files > 100  # the walk really covered the package
-    assert elapsed < 30.0, f"lint took {elapsed:.1f}s (budget: 30s)"
+    assert elapsed < 3.0, f"lint took {elapsed:.1f}s (budget: 3s)"
 
 
 def test_ops_and_fp16_are_lint_clean_with_no_baseline():

@@ -1,0 +1,231 @@
+"""jaxlint v2 tests: the project graph (cross-file fixture package),
+summary-cache purity, --diff gating against a real git repo, and the
+--explain subcommand.
+
+Single-file rule semantics (fixture corpus, suppressions, baseline,
+exit codes) live in test_jaxlint.py.
+"""
+
+import collections
+import json
+import os
+import subprocess
+
+import pytest
+
+from tools.jaxlint import (
+    analyze_file,
+    analyze_paths,
+    analyze_project,
+    gate_findings,
+    parse_diff,
+)
+from tools.jaxlint.cli import main as jaxlint_main
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(os.path.dirname(HERE))
+XPROJ = os.path.join(HERE, "jaxlint_fixtures", "xproj")
+
+# every positive in xproj/train.py needs a fact from a sibling module
+XPROJ_EXPECTED = {"JL007": 2, "JL008": 1, "JL009": 1, "JL010": 1,
+                  "JL011": 2}
+
+
+# -- the project graph --------------------------------------------------------
+
+def test_xproj_cross_file_findings():
+    findings, n_files = analyze_paths([XPROJ], root=REPO_ROOT)
+    assert n_files == 4
+    counts = collections.Counter(f.code for f in findings)
+    assert dict(counts) == XPROJ_EXPECTED, \
+        "\n".join(f.render() for f in findings)
+    # the helper/constant/spec modules themselves are clean — every
+    # finding lands at the use site in train.py
+    assert {f.path for f in findings} == \
+        {"tests/unit/jaxlint_fixtures/xproj/train.py"}
+
+
+def test_xproj_alone_is_silent():
+    """The same file WITHOUT its siblings produces nothing: every rule
+    needs the graph (helper summaries, axis constants, mesh axes, the
+    spec registry) to fire. This is the cross-file-ness proof."""
+    findings = analyze_file(os.path.join(XPROJ, "train.py"),
+                            root=REPO_ROOT)
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_graph_facts_are_project_scoped():
+    """The summary cache must hand out pristine copies: running the full
+    package propagates facts (donated params, quant returns) into the
+    cached summaries' functions, and a later single-file run over the
+    SAME (cached) file must not inherit them."""
+    full1, _, _ = analyze_project([XPROJ], root=REPO_ROOT)
+    alone = analyze_file(os.path.join(XPROJ, "train.py"), root=REPO_ROOT)
+    full2, _, _ = analyze_project([XPROJ], root=REPO_ROOT)
+    assert alone == []
+    assert [f.fingerprint() for f in full1] == \
+        [f.fingerprint() for f in full2]
+
+
+def test_interprocedural_rules_see_same_file_helpers():
+    """analyze_source builds a one-file graph, so same-file helper
+    resolution works without a project walk."""
+    from tools.jaxlint import analyze_source
+    src = (
+        "import jax\n"
+        "def helper(rng):\n"
+        "    return jax.random.normal(rng, (2,))\n"
+        "def caller(key):\n"
+        "    a = helper(key)\n"
+        "    b = jax.random.uniform(key, (2,))\n"
+        "    return a, b\n"
+    )
+    findings = analyze_source(src, rel_path="m.py")
+    assert [f.code for f in findings] == ["JL009"]
+
+
+# -- diff parsing -------------------------------------------------------------
+
+def test_parse_diff_maps_new_side_lines():
+    diff = (
+        "diff --git a/pkg/mod.py b/pkg/mod.py\n"
+        "--- a/pkg/mod.py\n"
+        "+++ b/pkg/mod.py\n"
+        "@@ -10,0 +11,3 @@ def f():\n"
+        "+x = 1\n"
+        "+y = 2\n"
+        "+z = 3\n"
+        "@@ -40 +44 @@ def g():\n"
+        "+w = 4\n"
+        "diff --git a/pkg/gone.py b/pkg/gone.py\n"
+        "--- a/pkg/gone.py\n"
+        "+++ /dev/null\n"
+        "@@ -1,5 +0,0 @@\n"
+    )
+    changed = parse_diff(diff)
+    assert changed == {"pkg/mod.py": {11, 12, 13, 44}}
+
+
+def test_gate_findings_keeps_changed_lines_only():
+    findings, _ = analyze_paths([XPROJ], root=REPO_ROOT)
+    target = findings[0]
+    gated = gate_findings(findings, {target.path: {target.line}})
+    assert gated == [target]
+    assert gate_findings(findings, {}) == []
+
+
+# -- the --diff CI gate, against a real git repo ------------------------------
+
+BAD_FN = (
+    "import jax\n"
+    "\n"
+    "@jax.jit\n"
+    "def pre_existing(x):\n"
+    "    if x > 0:\n"
+    "        return x\n"
+    "    return -x\n"
+)
+
+CLEAN_FN = (
+    "\n"
+    "def unrelated(y):\n"
+    "    return y + 1\n"
+)
+
+
+def _git(repo, *args):
+    subprocess.run(
+        ["git", "-C", str(repo), "-c", "user.email=ci@example.com",
+         "-c", "user.name=ci", *args],
+        check=True, capture_output=True)
+
+
+@pytest.fixture
+def git_repo(tmp_path):
+    """A repo whose HEAD already contains one (baselined-in-spirit)
+    finding in mod.py."""
+    _git(tmp_path, "init", "-q")
+    (tmp_path / "mod.py").write_text(BAD_FN)
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-q", "-m", "base")
+    return tmp_path
+
+
+def test_diff_gates_new_finding_only(git_repo, capsys):
+    # seed a NEW finding on new lines; the pre-existing one is untouched
+    (git_repo / "mod.py").write_text(
+        BAD_FN + "\n\n@jax.jit\ndef fresh(x):\n"
+                 "    if x > 0:\n        return x\n    return -x\n")
+    rc = jaxlint_main([str(git_repo), "--root", str(git_repo),
+                       "--diff", "HEAD", "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["total_findings"] == 2
+    gating = payload["gating"]
+    assert len(gating) == 1 and gating[0]["symbol"] == "fresh"
+
+
+def test_diff_ignores_untouched_pre_existing_findings(git_repo, capsys):
+    # an unrelated clean edit: the repo still has a finding, but not on
+    # a changed line, so the diff gate passes
+    with open(git_repo / "mod.py", "a") as fh:
+        fh.write(CLEAN_FN)
+    rc = jaxlint_main([str(git_repo), "--root", str(git_repo),
+                       "--diff", "HEAD"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1 finding(s) total, 0 on changed lines" in out
+
+
+def test_diff_rename_does_not_resurrect_findings(git_repo, capsys):
+    # a pure rename adds no lines, so the old finding stays un-gated
+    _git(git_repo, "mv", "mod.py", "renamed.py")
+    rc = jaxlint_main([str(git_repo), "--root", str(git_repo),
+                       "--diff", "HEAD"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 on changed lines" in out
+
+
+def test_diff_bad_ref_is_usage_error(git_repo, capsys):
+    rc = jaxlint_main([str(git_repo), "--root", str(git_repo),
+                       "--diff", "no-such-ref"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+# -- --explain ----------------------------------------------------------------
+
+def test_explain_prints_rule_doc(capsys):
+    assert jaxlint_main(["--explain", "JL009"]) == 0
+    out = capsys.readouterr().out
+    assert "JL009" in out
+    assert "Example:" in out
+    assert "jaxlint: disable=JL009" in out
+
+
+def test_explain_every_code(capsys):
+    from tools.jaxlint import ALL_CODES
+    for code in ALL_CODES:
+        assert jaxlint_main(["--explain", code]) == 0
+    capsys.readouterr()
+
+
+def test_explain_unknown_code(capsys):
+    assert jaxlint_main(["--explain", "JL999"]) == 2
+    capsys.readouterr()
+
+
+def test_no_paths_without_explain_is_usage_error(capsys):
+    with pytest.raises(SystemExit):
+        jaxlint_main([])
+    capsys.readouterr()
+
+
+# -- suppressions for the new codes -------------------------------------------
+
+def test_v2_suppressions():
+    fixture = os.path.join(HERE, "jaxlint_fixtures", "suppressed_v2.py")
+    findings = analyze_file(fixture, root=REPO_ROOT)
+    assert [(f.code, f.symbol) for f in findings] == \
+        [("JL009", "wrong_code_still_flagged")]

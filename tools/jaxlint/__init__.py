@@ -1,16 +1,20 @@
 """jaxlint: static analysis for JAX hazards.
 
 AST-only (never imports jax): finds unintended-recompile, host-sync,
-leaked-tracer, donation and fp16-dtype hazards before they cost a step.
-See docs/static_analysis.md for every rule with bad/good examples, the
-suppression syntax, and the baseline workflow. The runtime complements
-(CompileSentinel, transfer_free) live in deepspeed_tpu/profiling/.
+leaked-tracer, donation and fp16-dtype hazards per function (JL001-006),
+and collective-axis, cross-call donation, RNG-key-reuse, quantized-dtype
+and PartitionSpec hazards interprocedurally (JL007-011) over a two-pass
+module graph (summaries.py + callgraph.py, summaries cached by content
+hash). See docs/static_analysis.md for every rule with bad/good
+examples, the suppression syntax, the baseline workflow, and the
+``--diff`` CI gate. The runtime complements (CompileSentinel,
+transfer_free) live in deepspeed_tpu/profiling/.
 """
 
 from tools.jaxlint.analyzer import (
-    Finding,
     analyze_file,
     analyze_paths,
+    analyze_project,
     analyze_source,
 )
 from tools.jaxlint.baseline import (
@@ -19,18 +23,29 @@ from tools.jaxlint.baseline import (
     load_baseline,
     write_baseline,
 )
+from tools.jaxlint.callgraph import ProjectGraph
+from tools.jaxlint.diffmode import changed_lines, gate_findings, parse_diff
+from tools.jaxlint.findings import Finding
 from tools.jaxlint.rules import ALL_CODES, HOT_LOOPS, RULES
+from tools.jaxlint.summaries import FileSummary, FunctionSummary
 
 __all__ = [
     "ALL_CODES",
+    "FileSummary",
     "Finding",
+    "FunctionSummary",
     "HOT_LOOPS",
+    "ProjectGraph",
     "RULES",
     "analyze_file",
     "analyze_paths",
+    "analyze_project",
     "analyze_source",
+    "changed_lines",
     "count_findings",
     "diff_against_baseline",
+    "gate_findings",
     "load_baseline",
+    "parse_diff",
     "write_baseline",
 ]

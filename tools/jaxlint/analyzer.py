@@ -1,37 +1,63 @@
-"""AST analysis pass behind jaxlint.
+"""The two-pass analysis driver behind jaxlint.
 
 Pure stdlib (``ast`` only — importing jax would drag device init into a
-lint step); one parse per file, all rules evaluated in a single walk
-over pre-computed per-file indexes:
+lint step). Pass 1 parses each file once into a ``_FileIndex`` (jit
+registry, hot-loop set, suppression map, qualnames) and distills it to a
+``FileSummary`` (summaries.py) cached by content hash; the summaries are
+wired into a ``ProjectGraph`` (callgraph.py) that resolves imports,
+aliases and one level of calls repo-wide. Pass 2 runs three rule rings
+over that structure:
 
-- the *jit registry*: every function the file jits, whether by
-  decorator (``@jax.jit``, ``@pjit``, ``@partial(jax.jit, ...)``) or by
-  binding (``f = jax.jit(g, ...)``), with its static/donated argument
-  positions and names;
-- the *hot-loop set*: functions named in rules.HOT_LOOPS plus any
-  ``def`` carrying a ``# jaxlint: hot`` marker;
-- the *suppression map*: ``# jaxlint: disable=JLxxx(reason)`` comments,
-  applying to their own line and the line below.
+- the six per-function checks below (JL001-JL006), unchanged from v1;
+- per-file interprocedural checks (JL007-JL010 in rules_collective /
+  rules_donation / rules_rng / rules_dtype), which look at one file's
+  AST but resolve helpers through the graph;
+- project-wide checks (JL007 duplicate axis constants, JL011 sharding
+  consistency in rules_sharding), which only see the graph.
 
 Heuristics are deliberately conservative-with-escape-hatch: a rule that
 cannot decide statically stays quiet, and a justified true positive is
-silenced inline with a reason rather than weakening the rule.
+silenced inline with a reason (``# jaxlint: disable=JLxxx(reason)``)
+rather than weakening the rule.
 """
 
 import ast
 import os
 import re
-from dataclasses import dataclass, field
 
+from tools.jaxlint.astutil import (
+    JitInfo,
+    as_index_set as _as_index_set,
+    as_name_set as _as_name_set,
+    call_name as _call_name,
+    decorator_jit_info as _decorator_jit_info,
+    enclosing_functions as _enclosing_functions,
+    expr_key as _expr_key,
+    is_jit_ref as _is_jit_ref,
+    jit_kwargs as _jit_kwargs,
+    literal as _literal,
+    stmt_reads as _stmt_reads,
+    stmt_rebinds as _stmt_rebinds,
+    target_keys as _target_keys,
+    walk_same_scope as _walk_same_scope,
+)
+from tools.jaxlint.callgraph import ProjectGraph
+from tools.jaxlint.findings import Finding
 from tools.jaxlint.rules import (
     FP16_PATH_FRAGMENTS,
     HOT_LOOPS,
     HOT_MARKER,
     RULES,
 )
+from tools.jaxlint.summaries import content_hash, summarize_index
+from tools.jaxlint import (
+    rules_collective,
+    rules_donation,
+    rules_dtype,
+    rules_rng,
+    rules_sharding,
+)
 
-_JIT_NAMES = {"jit", "pjit"}
-_PARTIAL_NAMES = {"partial"}
 _NP_MODULES = {"np", "numpy", "onp"}
 _SHAPE_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "weak_type",
                 "sharding"}
@@ -46,142 +72,6 @@ _JNP_CTORS_MIN_ARGS = {
 
 _SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([^#]*)")
 _CODE_RE = re.compile(r"(JL\d{3})(?:\(([^)]*)\))?")
-
-
-@dataclass
-class Finding:
-    path: str          # posix path relative to the scan root
-    line: int
-    code: str
-    symbol: str        # enclosing function qualname, or "<module>"
-    message: str
-    text: str          # stripped source line the finding anchors to
-
-    def fingerprint(self):
-        """Line-number-free identity so unrelated edits shifting a file
-        don't churn the baseline: path + code + symbol + the normalized
-        source text of the flagged line."""
-        norm = " ".join(self.text.split())
-        return f"{self.path}::{self.code}::{self.symbol}::{norm}"
-
-    def to_dict(self):
-        return {"path": self.path, "line": self.line, "code": self.code,
-                "symbol": self.symbol, "message": self.message,
-                "text": self.text}
-
-    def render(self):
-        return (f"{self.path}:{self.line}: {self.code} "
-                f"[{RULES[self.code].name if self.code in RULES else '?'}] "
-                f"in {self.symbol}: {self.message}\n    {self.text}")
-
-
-@dataclass
-class JitInfo:
-    """Static/donate geometry of one jitted callable."""
-    static_nums: frozenset = frozenset()
-    static_names: frozenset = frozenset()
-    donate_nums: frozenset = frozenset()
-    donate_names: frozenset = frozenset()
-    params: tuple = ()     # positional parameter names, when known
-
-    def static_params(self):
-        out = set(self.static_names)
-        for i in self.static_nums:
-            if 0 <= i < len(self.params):
-                out.add(self.params[i])
-        return out
-
-
-def _literal(node):
-    try:
-        return ast.literal_eval(node)
-    except (ValueError, SyntaxError, TypeError):
-        return None
-
-
-def _as_index_set(value):
-    if value is None:
-        return frozenset()
-    if isinstance(value, int):
-        return frozenset((value,))
-    if isinstance(value, (tuple, list)) and all(
-            isinstance(v, int) for v in value):
-        return frozenset(value)
-    return frozenset()
-
-
-def _as_name_set(value):
-    if value is None:
-        return frozenset()
-    if isinstance(value, str):
-        return frozenset((value,))
-    if isinstance(value, (tuple, list)) and all(
-            isinstance(v, str) for v in value):
-        return frozenset(value)
-    return frozenset()
-
-
-def _is_jit_ref(node):
-    """``jit`` / ``pjit`` / ``jax.jit`` / ``jax.experimental.pjit.pjit``."""
-    if isinstance(node, ast.Name):
-        return node.id in _JIT_NAMES
-    if isinstance(node, ast.Attribute):
-        return node.attr in _JIT_NAMES
-    return False
-
-
-def _jit_kwargs(call):
-    info = {}
-    for kw in call.keywords:
-        if kw.arg in ("static_argnums", "static_argnames",
-                      "donate_argnums", "donate_argnames"):
-            info[kw.arg] = _literal(kw.value)
-    return JitInfo(
-        static_nums=_as_index_set(info.get("static_argnums")),
-        static_names=_as_name_set(info.get("static_argnames")),
-        donate_nums=_as_index_set(info.get("donate_argnums")),
-        donate_names=_as_name_set(info.get("donate_argnames")),
-    )
-
-
-def _decorator_jit_info(dec):
-    """JitInfo when ``dec`` jits the function it decorates, else None."""
-    if _is_jit_ref(dec):
-        return JitInfo()
-    if isinstance(dec, ast.Call):
-        if _is_jit_ref(dec.func):
-            return _jit_kwargs(dec)
-        # partial(jax.jit, static_argnames=...) / functools.partial(...)
-        fname = (dec.func.id if isinstance(dec.func, ast.Name)
-                 else dec.func.attr if isinstance(dec.func, ast.Attribute)
-                 else None)
-        if fname in _PARTIAL_NAMES and dec.args and _is_jit_ref(dec.args[0]):
-            return _jit_kwargs(dec)
-    return None
-
-
-def _expr_key(node):
-    """Stable key for a simple lvalue-ish expression (Name or dotted
-    attribute chain); None for anything more complex."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def _target_keys(target):
-    """Every simple expression a statement's assignment target rebinds."""
-    out = []
-    for node in ast.walk(target):
-        if isinstance(node, (ast.Name, ast.Attribute)):
-            key = _expr_key(node)
-            if key is not None:
-                out.append(key)
-    return out
 
 
 class _FileIndex:
@@ -266,6 +156,9 @@ class _FileIndex:
                             info.donate_nums, info.donate_names, params)
 
     def jitted_defs(self):
+        cached = getattr(self, "_jitted_defs_cache", None)
+        if cached is not None:
+            return cached
         out = []
         for node in ast.walk(self.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -279,6 +172,7 @@ class _FileIndex:
                         info.static_nums, info.static_names,
                         info.donate_nums, info.donate_names, params)))
                     break
+        self._jitted_defs_cache = out
         return out
 
     def hot_defs(self):
@@ -424,15 +318,6 @@ def _check_leaked_tracer(index, findings):
                             index.line_text(node.lineno)))
 
 
-def _call_name(call):
-    f = call.func
-    if isinstance(f, ast.Name):
-        return f.id
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return None
-
-
 def _check_varying_static(index, findings):
     """JL004: jitted call in a loop with the loop variable at a static
     argument position."""
@@ -478,33 +363,6 @@ def _check_varying_static(index, findings):
                     f"variable at static {', '.join(offenders)} — one "
                     f"recompile per iteration; make it traced or hoist",
                     index.line_text(node.lineno)))
-
-
-def _enclosing_functions(index):
-    """(function node, qualname) pairs plus the module body itself."""
-    out = [(index.tree, "<module>")]
-    for node in ast.walk(index.tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            out.append((node, index.qualname.get(node, node.name)))
-    return out
-
-
-def _walk_same_scope(stmt):
-    """ast.walk that does NOT descend into nested function/class defs —
-    their bodies run at a different time against different bindings."""
-    scopes = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
-              ast.Lambda)
-    if isinstance(stmt, scopes):
-        yield stmt          # the def statement itself, not its body
-        return
-    stack = [stmt]
-    while stack:
-        node = stack.pop()
-        yield node
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, scopes):
-                continue
-            stack.append(child)
 
 
 def _check_donated_read(index, findings):
@@ -559,31 +417,6 @@ def _check_donated_read(index, findings):
                     live = still
 
 
-def _stmt_rebinds(stmt):
-    keys = set()
-    for node in _walk_same_scope(stmt):
-        targets = []
-        if isinstance(node, ast.Assign):
-            targets = node.targets
-        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
-            targets = [node.target]
-        elif isinstance(node, ast.For):
-            targets = [node.target]
-        for tgt in targets:
-            keys.update(_target_keys(tgt))
-    return keys
-
-
-def _stmt_reads(stmt, key):
-    for node in _walk_same_scope(stmt):
-        if isinstance(node, (ast.Name, ast.Attribute)):
-            if _expr_key(node) == key and isinstance(
-                    getattr(node, "ctx", None), ast.Load):
-                # attribute chains nest: only match the full chain root
-                return True
-    return False
-
-
 def _check_fp16_dtype(index, findings):
     """JL006: jnp constructors without an explicit dtype in fp16 paths."""
     posix = index.rel_path.replace(os.sep, "/")
@@ -620,16 +453,51 @@ _CHECKS = (
     _check_fp16_dtype,
 )
 
+# per-file checks that resolve helpers through the project graph:
+# check(index, file_summary, graph, findings)
+_INTERPROC_CHECKS = (
+    rules_collective.check,
+    rules_donation.check,
+    rules_rng.check,
+    rules_dtype.check,
+)
+
+# whole-project checks: check_project(graph, findings)
+_PROJECT_CHECKS = (
+    rules_collective.check_project,
+    rules_sharding.check_project,
+)
+
+
+def _run_checks(indexes, summaries, graph, extra_findings=()):
+    findings = list(extra_findings)
+    for rel in sorted(indexes):
+        index = indexes[rel]
+        for check in _CHECKS:
+            check(index, findings)
+        fsummary = summaries[rel]
+        for check in _INTERPROC_CHECKS:
+            check(index, fsummary, graph, findings)
+    for check in _PROJECT_CHECKS:
+        check(graph, findings)
+    out = []
+    for f in findings:
+        idx = indexes.get(f.path)
+        if idx is not None and idx.suppressed(f.line, f.code):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.code))
+    return out
+
 
 def analyze_source(source, rel_path="<string>", path=None):
-    """Findings for one python source string (suppressions applied)."""
+    """Findings for one python source string (suppressions applied).
+    The project graph contains just this file, so interprocedural rules
+    see its own helpers but nothing cross-file."""
     index = _FileIndex(path or rel_path, rel_path, source)
-    findings = []
-    for check in _CHECKS:
-        check(index, findings)
-    findings = [f for f in findings if not index.suppressed(f.line, f.code)]
-    findings.sort(key=lambda f: (f.line, f.code))
-    return findings
+    fsummary = summarize_index(index, content_hash(source))
+    graph = ProjectGraph({rel_path: fsummary})
+    return _run_checks({rel_path: index}, {rel_path: fsummary}, graph)
 
 
 def analyze_file(path, root):
@@ -658,10 +526,35 @@ def iter_python_files(paths):
                         yield os.path.join(dirpath, name)
 
 
-def analyze_paths(paths, root):
-    findings = []
+def analyze_project(paths, root):
+    """Two-pass analysis over every python file under ``paths``:
+    (findings, n_files, graph). Pass 1 parses + summarizes (summaries
+    cached by content hash), pass 2 runs the rule rings."""
+    indexes = {}
+    summaries = {}
+    parse_errors = []
     n_files = 0
     for path in iter_python_files(paths):
         n_files += 1
-        findings.extend(analyze_file(path, root))
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            index = _FileIndex(path, rel, source)
+        except SyntaxError as e:
+            parse_errors.append(Finding(
+                rel, e.lineno or 1, "JL000", "<module>",
+                f"file does not parse: {e.msg}", ""))
+            continue
+        indexes[rel] = index
+        summaries[rel] = summarize_index(index, content_hash(source))
+    graph = ProjectGraph(summaries)
+    findings = _run_checks(indexes, summaries, graph,
+                           extra_findings=parse_errors)
+    return findings, n_files, graph
+
+
+def analyze_paths(paths, root):
+    """(findings, n_files) — the CLI/test entry point."""
+    findings, n_files, _graph = analyze_project(paths, root)
     return findings, n_files

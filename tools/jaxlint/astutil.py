@@ -1,0 +1,212 @@
+"""Shared AST utilities for jaxlint's analysis passes.
+
+Everything here is pure-``ast`` bookkeeping used by both the per-file
+rule pass (analyzer.py) and the pass-1 summary builder (summaries.py):
+jit-decoration geometry, lvalue keys, statement-order rebind/read scans.
+Kept dependency-free so summaries can be built without importing the
+rule machinery (and vice versa).
+"""
+
+import ast
+from dataclasses import dataclass
+
+_JIT_NAMES = {"jit", "pjit"}
+_PARTIAL_NAMES = {"partial"}
+
+
+@dataclass
+class JitInfo:
+    """Static/donate geometry of one jitted callable."""
+    static_nums: frozenset = frozenset()
+    static_names: frozenset = frozenset()
+    donate_nums: frozenset = frozenset()
+    donate_names: frozenset = frozenset()
+    params: tuple = ()     # positional parameter names, when known
+
+    def static_params(self):
+        out = set(self.static_names)
+        for i in self.static_nums:
+            if 0 <= i < len(self.params):
+                out.add(self.params[i])
+        return out
+
+
+def literal(node):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError, TypeError):
+        return None
+
+
+def as_index_set(value):
+    if value is None:
+        return frozenset()
+    if isinstance(value, int):
+        return frozenset((value,))
+    if isinstance(value, (tuple, list)) and all(
+            isinstance(v, int) for v in value):
+        return frozenset(value)
+    return frozenset()
+
+
+def as_name_set(value):
+    if value is None:
+        return frozenset()
+    if isinstance(value, str):
+        return frozenset((value,))
+    if isinstance(value, (tuple, list)) and all(
+            isinstance(v, str) for v in value):
+        return frozenset(value)
+    return frozenset()
+
+
+def is_jit_ref(node):
+    """``jit`` / ``pjit`` / ``jax.jit`` / ``jax.experimental.pjit.pjit``."""
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    return False
+
+
+def jit_kwargs(call):
+    info = {}
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnames",
+                      "donate_argnums", "donate_argnames"):
+            info[kw.arg] = literal(kw.value)
+    return JitInfo(
+        static_nums=as_index_set(info.get("static_argnums")),
+        static_names=as_name_set(info.get("static_argnames")),
+        donate_nums=as_index_set(info.get("donate_argnums")),
+        donate_names=as_name_set(info.get("donate_argnames")),
+    )
+
+
+def decorator_jit_info(dec):
+    """JitInfo when ``dec`` jits the function it decorates, else None."""
+    if is_jit_ref(dec):
+        return JitInfo()
+    if isinstance(dec, ast.Call):
+        if is_jit_ref(dec.func):
+            return jit_kwargs(dec)
+        # partial(jax.jit, static_argnames=...) / functools.partial(...)
+        fname = (dec.func.id if isinstance(dec.func, ast.Name)
+                 else dec.func.attr if isinstance(dec.func, ast.Attribute)
+                 else None)
+        if fname in _PARTIAL_NAMES and dec.args and is_jit_ref(dec.args[0]):
+            return jit_kwargs(dec)
+    return None
+
+
+def expr_key(node):
+    """Stable key for a simple lvalue-ish expression (Name or dotted
+    attribute chain); None for anything more complex."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def target_keys(target):
+    """Every simple expression a statement's assignment target rebinds."""
+    out = []
+    for node in ast.walk(target):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            key = expr_key(node)
+            if key is not None:
+                out.append(key)
+    return out
+
+
+def call_name(call):
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def walk_same_scope(stmt):
+    """ast.walk that does NOT descend into nested function/class defs —
+    their bodies run at a different time against different bindings."""
+    scopes = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+              ast.Lambda)
+    if isinstance(stmt, scopes):
+        yield stmt          # the def statement itself, not its body
+        return
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, scopes):
+                continue
+            stack.append(child)
+
+
+def stmt_rebinds(stmt):
+    keys = set()
+    for node in walk_same_scope(stmt):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.For):
+            targets = [node.target]
+        for tgt in targets:
+            keys.update(target_keys(tgt))
+    return keys
+
+
+def stmt_reads(stmt, key):
+    for node in walk_same_scope(stmt):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            if expr_key(node) == key and isinstance(
+                    getattr(node, "ctx", None), ast.Load):
+                # attribute chains nest: only match the full chain root
+                return True
+    return False
+
+
+def enclosing_functions(index):
+    """(scope node, qualname) pairs: the module body plus every def.
+    Memoized on the index — several rule families iterate scopes."""
+    cached = getattr(index, "_enclosing_cache", None)
+    if cached is not None:
+        return cached
+    out = [(index.tree, "<module>")]
+    for node in ast.walk(index.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append((node, index.qualname.get(node, node.name)))
+    index._enclosing_cache = out
+    return out
+
+
+def body_lists(fn_or_module):
+    """Every statement suite (list of statements executed in order) under
+    ``fn_or_module`` WITHOUT descending into nested function/class defs:
+    the body itself plus each if/else/for/while/with/try block's suite.
+    Statement-order rules run over each suite independently."""
+    scopes = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+              ast.Lambda)
+    out = []
+    stack = [fn_or_module]
+    while stack:
+        node = stack.pop()
+        for name in ("body", "orelse", "finalbody"):
+            suite = getattr(node, name, None)
+            if isinstance(suite, list) and suite:
+                out.append(suite)
+                for child in suite:
+                    if not isinstance(child, scopes):
+                        stack.append(child)
+        for handler in getattr(node, "handlers", ()):
+            stack.append(handler)
+    return out

@@ -1,0 +1,339 @@
+"""Pass-1.5: the repo-wide symbol table and call graph over FileSummaries.
+
+Resolution model (deliberately one level of indirection, matching the
+rule families' "through one call" contract):
+
+- a module name is derived from the file's path relative to the scan
+  root, so ``deepspeed_tpu/parallel/mesh.py`` is importable as
+  ``deepspeed_tpu.parallel.mesh`` by any scanned file;
+- a dotted reference is resolved through the using file's import table
+  (``from a.b import f as g`` makes ``g`` mean ``a.b.f``), then looked
+  up in the defining file's summary (functions, jit registry, string
+  constants);
+- ``self.method(...)`` resolves within the caller's own class.
+
+On top of resolution the graph aggregates the global registries the
+rule families check against:
+
+- ``defined_axes``: every axis name BOUND anywhere — mesh axis tuples,
+  ``pmap(axis_name=...)``, ``axis_name=`` parameter defaults, and the
+  values of *axis constants* (module-level string constants that some
+  scanned file uses in an axis position);
+- ``axis_constants``: value -> [(path, NAME, line, text)] for those
+  constants — the registry behind the duplicate-definition and
+  raw-literal-shadowing checks;
+- ``mesh_axes``: axis names appearing in an actual Mesh construction
+  (the PartitionSpec validity domain);
+- ``spec_registry``: param-tree path -> {resolved spec signature ->
+  [(path, line, qualname, text)]} harvested from dict-literal spec maps.
+
+One propagation sweep pushes per-function facts a single call level:
+key-consuming params, quantized returns, donated-through params.
+"""
+
+from tools.jaxlint.summaries import FileSummary  # noqa: F401 (typing aid)
+
+
+class ProjectGraph:
+    def __init__(self, summaries):
+        """``summaries``: {rel_path: FileSummary}."""
+        self.files = dict(summaries)
+        self.modules = {}
+        self._fn_memo = {}
+        for rel, fs in self.files.items():
+            self.modules[fs.module] = fs
+        self._build_axis_registries()
+        self._build_spec_registry()
+        self._propagate()
+
+    # -- name resolution ----------------------------------------------------
+
+    def resolve(self, file_summary, dotted):
+        """Resolve a dotted reference used in ``file_summary`` to
+        ``(defining FileSummary, symbol name)`` or None.
+
+        The symbol name may itself be dotted (e.g. ``Class.method``)."""
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        head = parts[0]
+        # local definition wins
+        if head in file_summary.functions or head in file_summary.constants \
+                or head in file_summary.jit_registry:
+            return (file_summary, dotted)
+        target = None
+        for i in range(len(parts), 0, -1):
+            prefix = ".".join(parts[:i])
+            imported = file_summary.imports.get(prefix)
+            if imported:
+                rest = parts[i:]
+                target = ".".join([imported] + rest) if rest else imported
+                break
+        if target is None:
+            return None
+        # longest module prefix of the absolute target
+        tparts = target.split(".")
+        for i in range(len(tparts) - 1, 0, -1):
+            mod = ".".join(tparts[:i])
+            fs = self.modules.get(mod)
+            if fs is not None:
+                return (fs, ".".join(tparts[i:]))
+        fs = self.modules.get(target)
+        if fs is not None:
+            return (fs, "")
+        return None
+
+    def resolve_function(self, file_summary, dotted, caller_qualname=""):
+        """FunctionSummary for a call-site callee, or None. Handles
+        ``self.method`` within the caller's class."""
+        if dotted and dotted.startswith(("self.", "cls.")):
+            method = dotted.split(".", 1)[1]
+            if "." not in method and "." in caller_qualname:
+                cls = caller_qualname.rsplit(".", 1)[0]
+                return file_summary.functions.get(f"{cls}.{method}")
+            return None
+        memo_key = (file_summary.rel_path, dotted)
+        if memo_key in self._fn_memo:
+            return self._fn_memo[memo_key]
+        hit = self.resolve(file_summary, dotted)
+        out = None
+        if hit is not None:
+            fs, symbol = hit
+            out = fs.functions.get(symbol) if symbol else None
+        self._fn_memo[memo_key] = out
+        return out
+
+    def resolve_jit(self, file_summary, dotted):
+        """Cross-file JitInfo for a callee bound via ``jax.jit`` in its
+        defining module, or None."""
+        hit = self.resolve(file_summary, dotted)
+        if hit is None:
+            return None
+        fs, symbol = hit
+        if not symbol or "." in symbol:
+            return None
+        return fs.jit_registry.get(symbol)
+
+    def resolve_axis_value(self, file_summary, key):
+        """String value of an axis-name expression key: a module-level
+        string constant in this file or an imported one."""
+        if not key:
+            return None
+        hit = self.resolve(file_summary, key)
+        if hit is None:
+            return None
+        fs, symbol = hit
+        if symbol and "." not in symbol:
+            const = fs.constants.get(symbol)
+            if const:
+                return const[0]
+        return None
+
+    # -- global registries --------------------------------------------------
+
+    def _build_axis_registries(self):
+        # which (file, NAME) constants are used in an axis position
+        used_constants = set()
+        for fs in self.files.values():
+            for site in fs.axis_sites:
+                if site.key and not site.param:
+                    hit = self.resolve(fs, site.key)
+                    if hit is not None:
+                        dfs, symbol = hit
+                        if symbol and "." not in symbol \
+                                and symbol in dfs.constants:
+                            used_constants.add((dfs.rel_path, symbol))
+            for elems, _line in fs.mesh_defs:
+                for elem in elems:
+                    if elem[0] == "key":
+                        hit = self.resolve(fs, elem[1])
+                        if hit is not None:
+                            dfs, symbol = hit
+                            if symbol and "." not in symbol \
+                                    and symbol in dfs.constants:
+                                used_constants.add((dfs.rel_path, symbol))
+
+        self.axis_constants = {}   # value -> [(path, NAME, line, text)]
+        for rel, name in sorted(used_constants):
+            fs = self.files[rel]
+            value, line, text = fs.constants[name]
+            self.axis_constants.setdefault(value, []).append(
+                (rel, name, line, text))
+
+        self.mesh_axes = set()
+        self.defined_axes = set()
+        for fs in self.files.values():
+            for elems, _line in fs.mesh_defs:
+                for elem in elems:
+                    if elem[0] == "lit":
+                        self.mesh_axes.add(elem[1])
+                    elif elem[0] == "key":
+                        val = self.resolve_axis_value(fs, elem[1])
+                        if val:
+                            self.mesh_axes.add(val)
+            self.defined_axes.update(fs.pmap_axes)
+        self.defined_axes.update(self.mesh_axes)
+        self.defined_axes.update(self.axis_constants)
+
+    def _build_spec_registry(self):
+        self.spec_registry = {}   # tree path -> {signature: [sites]}
+        for rel in sorted(self.files):
+            fs = self.files[rel]
+            for path_key, elems, line, qual, text in fs.spec_entries:
+                sig = self._resolve_spec_signature(fs, elems)
+                if sig is None:
+                    continue
+                self.spec_registry.setdefault(path_key, {}).setdefault(
+                    sig, []).append((rel, line, qual, text))
+
+    def _resolve_spec_signature(self, fs, elems):
+        """Tuple of axis names/None, or None when any element is
+        unresolvable (starred/computed specs never conflict)."""
+        sig = []
+        for elem in elems:
+            if elem[0] == "lit":
+                sig.append(elem[1])
+            elif elem[0] == "none":
+                sig.append(None)
+            elif elem[0] == "key":
+                val = self.resolve_axis_value(fs, elem[1])
+                if val is None:
+                    return None
+                sig.append(val)
+            else:
+                return None
+        return tuple(sig)
+
+    # -- one-level propagation ----------------------------------------------
+
+    def _propagate(self):
+        """Push per-function facts one call level up/down:
+        - a param passed into a callee's key-consuming param is itself
+          key-consuming (JL009 through one call);
+        - a function returning a returns_quant callee's result directly
+          is returns_quant (JL010 through one call);
+        - a param passed at a donated position of a cross-file jitted
+          callee (or into a callee's donated-through param) donates
+          (JL008 through one call)."""
+        for fs in self.files.values():
+            for fn in fs.functions.values():
+                for name in fn.returns_calls:
+                    callee = self.resolve_function(fs, name, fn.qualname)
+                    if callee is not None and callee.returns_quant:
+                        fn.returns_quant = True
+                for site in fn.calls:
+                    callee = self.resolve_function(fs, site.name,
+                                                   fn.qualname)
+                    jit = None
+                    if callee is None:
+                        jit = self.resolve_jit(fs, site.name)
+                    # key params through one call
+                    if callee is not None and callee.key_params_used:
+                        for i, key in enumerate(site.arg_keys):
+                            if key in fn.params and \
+                                    i < len(callee.params) and \
+                                    callee.params[i] in \
+                                    callee.key_params_used:
+                                fn.key_params_used.add(key)
+                        for kwname, key in site.kwarg_keys:
+                            if key in fn.params and \
+                                    kwname in callee.key_params_used:
+                                fn.key_params_used.add(key)
+                    # donation through one call
+                    donate_positions = ()
+                    donate_names = ()
+                    if jit is not None and (jit.donate_nums
+                                            or jit.donate_names):
+                        donate_positions = tuple(
+                            i for i in range(len(site.arg_keys))
+                            if i in jit.donate_nums
+                            or (i < len(jit.params)
+                                and jit.params[i] in jit.donate_names))
+                        donate_names = tuple(jit.donate_names)
+                    elif callee is not None and callee.donates_params:
+                        donate_positions = tuple(
+                            i for i, p in enumerate(callee.params)
+                            if p in callee.donates_params
+                            and i < len(site.arg_keys))
+                        donate_names = tuple(callee.donates_params)
+                    if donate_positions or donate_names:
+                        for i in donate_positions:
+                            key = site.arg_keys[i]
+                            if key in fn.params:
+                                fn.donates_params.setdefault(
+                                    key, (site.name, site.line))
+                        for kwname, key in site.kwarg_keys:
+                            if key in fn.params and kwname in donate_names:
+                                fn.donates_params.setdefault(
+                                    key, (site.name, site.line))
+
+        # quant-tainted params: a call site passing an int8-tainted value
+        # marks the callee's receiving param (JL010's cross-function seed)
+        for fs in self.files.values():
+            for fn in fs.functions.values():
+                for site in fn.calls:
+                    if not site.quant_args and not site.quant_kwargs:
+                        continue
+                    callee = self.resolve_function(fs, site.name,
+                                                   fn.qualname)
+                    if callee is None:
+                        continue
+                    qp = getattr(callee, "quant_params", None)
+                    if qp is None:
+                        qp = set()
+                        callee.quant_params = qp
+                    for i in site.quant_args:
+                        if i < len(callee.params):
+                            qp.add(callee.params[i])
+                    for kwname in site.quant_kwargs:
+                        if kwname in callee.params:
+                            qp.add(kwname)
+
+    def quant_params(self, fn_summary):
+        return getattr(fn_summary, "quant_params", None) or set()
+
+    # -- relevance gates (cheap pre-checks the rule families use to skip
+    # -- whole files before any AST walk) -----------------------------------
+
+    def donor_names(self):
+        """Bare names that donate a buffer when called: jitted bindings
+        with donate geometry, plus helpers that donate a parameter
+        through (post-propagation)."""
+        names = getattr(self, "_donor_names", None)
+        if names is None:
+            names = set()
+            for fs in self.files.values():
+                for name, jit in fs.jit_registry.items():
+                    if jit.donate_nums or jit.donate_names:
+                        names.add(name)
+                for fn in fs.functions.values():
+                    if fn.donates_params:
+                        names.add(fn.name)
+            self._donor_names = names
+        return names
+
+    def rng_relevant(self, fsummary):
+        """Could JL009 possibly fire in this file?"""
+        if fsummary.uses_rng:
+            return True
+        for fn in fsummary.functions.values():
+            for site in fn.calls:
+                callee = self.resolve_function(fsummary, site.name,
+                                               fn.qualname)
+                if callee is not None and callee.key_params_used:
+                    return True
+        return False
+
+    def quant_relevant(self, fsummary):
+        """Could JL010 possibly fire in this file?"""
+        if fsummary.uses_quant:
+            return True
+        for fn in fsummary.functions.values():
+            if self.quant_params(fn):
+                return True
+            for site in fn.calls:
+                callee = self.resolve_function(fsummary, site.name,
+                                               fn.qualname)
+                if callee is not None and callee.returns_quant:
+                    return True
+        return False

@@ -3,10 +3,13 @@
     python -m tools.jaxlint deepspeed_tpu --baseline jaxlint_baseline.json
     python -m tools.jaxlint deepspeed_tpu --baseline jaxlint_baseline.json \
         --write-baseline
+    python -m tools.jaxlint deepspeed_tpu tools --diff origin/main
+    python -m tools.jaxlint --explain JL009
 
-Exit codes: 0 = clean (or only baselined findings), 1 = new findings,
-2 = usage/baseline error. No jax import anywhere on this path — the
-whole run is AST-only and finishes in seconds (< 30 s CI budget).
+Exit codes: 0 = clean (or only baselined findings), 1 = new findings
+(in ``--diff`` mode: findings on changed lines), 2 = usage/baseline
+error. No jax import anywhere on this path — the whole run is AST-only;
+the two-pass analyzer finishes the full repo well inside its 3 s budget.
 """
 
 import argparse
@@ -16,6 +19,7 @@ import sys
 import time
 
 from tools.jaxlint import baseline as baseline_mod
+from tools.jaxlint import diffmode
 from tools.jaxlint.analyzer import analyze_paths
 from tools.jaxlint.rules import RULES
 
@@ -27,27 +31,64 @@ def _summarize(findings):
     return by_code
 
 
+def _explain(code):
+    rule = RULES.get(code)
+    if rule is None:
+        print(f"jaxlint: unknown rule code: {code} "
+              f"(known: {', '.join(sorted(RULES))})", file=sys.stderr)
+        return 2
+    print(f"{rule.code} [{rule.name}]")
+    print()
+    print(rule.summary)
+    if rule.doc:
+        print()
+        print(rule.doc)
+    if rule.example:
+        print()
+        print("Example:")
+        for line in rule.example.splitlines():
+            print(f"    {line}")
+    print()
+    print(f"Suppress inline with: # jaxlint: disable={rule.code}(reason)")
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="jaxlint",
         description="Static JAX hazard analyzer (recompiles, host syncs, "
-                    "leaked tracers, donation bugs, fp16 dtype drift).")
-    parser.add_argument("paths", nargs="+",
+                    "leaked tracers, donation bugs, dtype drift, "
+                    "collective-axis/RNG/sharding consistency).")
+    parser.add_argument("paths", nargs="*",
                         help="files or directories to analyze")
     parser.add_argument("--root", default=os.getcwd(),
                         help="paths in findings are relative to this "
                              "(default: cwd)")
     parser.add_argument("--baseline", default=None,
                         help="baseline JSON; findings in it don't fail the "
-                             "run, new ones do")
+                             "run, new ones do (ignored in --diff mode)")
     parser.add_argument("--write-baseline", action="store_true",
                         help="regenerate --baseline from the current "
                              "findings and exit 0")
+    parser.add_argument("--diff", metavar="BASE_REF", default=None,
+                        help="gate only findings on lines changed vs this "
+                             "git ref (e.g. origin/main); pre-existing "
+                             "findings on untouched lines never fail the "
+                             "run")
+    parser.add_argument("--explain", metavar="JLxxx", default=None,
+                        help="print the rule's documentation and a minimal "
+                             "repro snippet, then exit")
     parser.add_argument("--format", choices=("text", "json"), default="text")
     parser.add_argument("--select", default=None,
                         help="comma-separated rule codes to run (default: "
                              "all)")
     args = parser.parse_args(argv)
+
+    if args.explain:
+        return _explain(args.explain)
+
+    if not args.paths:
+        parser.error("at least one path is required (or use --explain)")
 
     for p in args.paths:
         if not os.path.exists(p):
@@ -76,6 +117,31 @@ def main(argv=None):
               f"finding(s) across {len(counts)} fingerprint(s) "
               f"({n_files} files, {elapsed:.2f}s)")
         return 0
+
+    if args.diff is not None:
+        try:
+            changed = diffmode.changed_lines(args.diff, args.root)
+        except RuntimeError as e:
+            print(f"jaxlint: {e}", file=sys.stderr)
+            return 2
+        gating = diffmode.gate_findings(findings, changed)
+        if args.format == "json":
+            print(json.dumps({
+                "files": n_files,
+                "elapsed_s": round(elapsed, 3),
+                "base_ref": args.diff,
+                "changed_files": len(changed),
+                "total_findings": len(findings),
+                "gating": [f.to_dict() for f in gating],
+            }, indent=2))
+        else:
+            for f in gating:
+                print(f.render())
+            status = "FAILED" if gating else "ok"
+            print(f"jaxlint --diff {args.diff} {status}: {n_files} files "
+                  f"in {elapsed:.2f}s — {len(findings)} finding(s) total, "
+                  f"{len(gating)} on changed lines")
+        return 1 if gating else 0
 
     baseline_counts = {}
     if args.baseline:

@@ -1,0 +1,64 @@
+"""--diff support: gate findings on changed lines only.
+
+``git diff --unified=0 --find-renames BASE -- '*.py'`` is parsed into a
+map of NEW-side path -> set of added/modified line numbers. A finding
+gates iff its file appears in the map and its line is in the changed
+set, so:
+
+- pre-existing findings on untouched lines never gate (the whole-repo
+  baseline mechanism still owns those);
+- a pure rename contributes no added lines (rename detection keeps the
+  hunks empty), so renamed files don't resurrect stale findings;
+- the diff is tree-vs-worktree (``git diff BASE``), so it works on a
+  shallow CI checkout with only BASE fetched — no merge-base history
+  needed.
+"""
+
+import re
+import subprocess
+
+_HUNK_RE = re.compile(r"^@@ -\d+(?:,\d+)? \+(\d+)(?:,(\d+))? @@")
+
+
+def changed_lines(base_ref, root):
+    """{posix rel path: set of changed line numbers} vs ``base_ref``."""
+    proc = subprocess.run(
+        ["git", "-C", root, "diff", "--unified=0", "--find-renames",
+         "--no-color", base_ref, "--", "*.py"],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"git diff against '{base_ref}' failed: "
+            f"{proc.stderr.strip() or proc.stdout.strip()}")
+    return parse_diff(proc.stdout)
+
+
+def parse_diff(diff_text):
+    changed = {}
+    current = None
+    for line in diff_text.splitlines():
+        if line.startswith("+++ "):
+            target = line[4:].split("\t")[0]
+            if target == "/dev/null":
+                current = None
+            else:
+                current = target[2:] if target.startswith("b/") else target
+            continue
+        m = _HUNK_RE.match(line)
+        if m and current is not None:
+            start = int(m.group(1))
+            count = 1 if m.group(2) is None else int(m.group(2))
+            if count:
+                changed.setdefault(current, set()).update(
+                    range(start, start + count))
+    return changed
+
+
+def gate_findings(findings, changed):
+    """The subset of findings landing on changed lines."""
+    out = []
+    for f in findings:
+        lines = changed.get(f.path)
+        if lines and f.line in lines:
+            out.append(f)
+    return out

@@ -1,0 +1,37 @@
+"""The Finding record shared by every analysis pass.
+
+Lives in its own module so the rule-family modules (rules_*.py) and the
+two-pass driver (analyzer.py) can both construct findings without a
+circular import.
+"""
+
+from dataclasses import dataclass
+
+from tools.jaxlint.rules import RULES
+
+
+@dataclass
+class Finding:
+    path: str          # posix path relative to the scan root
+    line: int
+    code: str
+    symbol: str        # enclosing function qualname, or "<module>"
+    message: str
+    text: str          # stripped source line the finding anchors to
+
+    def fingerprint(self):
+        """Line-number-free identity so unrelated edits shifting a file
+        don't churn the baseline: path + code + symbol + the normalized
+        source text of the flagged line."""
+        norm = " ".join(self.text.split())
+        return f"{self.path}::{self.code}::{self.symbol}::{norm}"
+
+    def to_dict(self):
+        return {"path": self.path, "line": self.line, "code": self.code,
+                "symbol": self.symbol, "message": self.message,
+                "text": self.text}
+
+    def render(self):
+        return (f"{self.path}:{self.line}: {self.code} "
+                f"[{RULES[self.code].name if self.code in RULES else '?'}] "
+                f"in {self.symbol}: {self.message}\n    {self.text}")

@@ -1,0 +1,138 @@
+"""JL007: collective-axis consistency over the project graph.
+
+Three failure shapes, all variations of "the axis name string drifted
+from what the topology actually binds":
+
+(a) a collective's axis argument resolves to a name that no Mesh axis
+    tuple, ``pmap(axis_name=...)`` binding, axis constant, or
+    ``axis_name=`` default anywhere in the scanned project defines —
+    checked at the collective itself for literals/constants, and at the
+    CALLER's call site when the collective's axis is a helper parameter
+    (one level of interprocedural resolution);
+(b) the same axis string is defined as a module-level constant in more
+    than one file: the definitions can drift independently, so all but
+    the first (path-sorted) definition are flagged;
+(c) a raw axis string literal is used where a named constant with that
+    value already exists (in a collective, Mesh tuple, PartitionSpec,
+    pmap binding, or axis_name default): hand-typed duplicates are how
+    (b) starts.
+"""
+
+from tools.jaxlint.findings import Finding
+
+
+def _known(graph):
+    return ", ".join(sorted(graph.defined_axes)) or "none"
+
+
+def _flag_undefined(graph, rel, value, line, qual, text, where, findings):
+    findings.append(Finding(
+        rel, line, "JL007", qual,
+        f"{where} uses axis '{value}' which no mesh/pmap/shard_map "
+        f"defines (known axes: {_known(graph)}) — the collective cannot "
+        f"resolve the axis at trace time", text))
+
+
+def _flag_duplicate_literal(graph, rel, value, line, qual, text, where,
+                            findings):
+    crel, cname, _line, _text = graph.axis_constants[value][0]
+    findings.append(Finding(
+        rel, line, "JL007", qual,
+        f"{where} spells axis '{value}' as a raw string literal but the "
+        f"named constant {cname} in {crel} already defines it — import "
+        f"the constant so the axis name cannot drift", text))
+
+
+def _check_axis_value(graph, rel, value, line, qual, text, where,
+                      findings):
+    """(a), else (c), for one resolved axis string at one site."""
+    if value not in graph.defined_axes:
+        _flag_undefined(graph, rel, value, line, qual, text, where,
+                        findings)
+    elif value in graph.axis_constants:
+        _flag_duplicate_literal(graph, rel, value, line, qual, text,
+                                where, findings)
+
+
+def check(index, fsummary, graph, findings):
+    rel = fsummary.rel_path
+
+    # (a)/(c) at the axis-use sites recorded by pass 1
+    for site in fsummary.axis_sites:
+        if site.param:
+            continue       # helper parameter: resolved at call sites below
+        if site.value:
+            if site.collective:
+                _check_axis_value(graph, rel, site.value, site.line,
+                                  site.qualname, site.text, site.op,
+                                  findings)
+            elif site.value in graph.axis_constants:
+                # non-collective axis positions (Mesh tuples, specs, pmap
+                # bindings, defaults) only drift-check raw literals
+                _flag_duplicate_literal(graph, rel, site.value, site.line,
+                                        site.qualname, site.text, site.op,
+                                        findings)
+        elif site.key and site.collective:
+            value = graph.resolve_axis_value(fsummary, site.key)
+            if value is not None and value not in graph.defined_axes:
+                _flag_undefined(graph, rel, value, site.line,
+                                site.qualname, site.text,
+                                f"{site.op} (via {site.key})", findings)
+
+    # (a)/(c) at call sites whose callee uses a parameter as an axis
+    for fn in fsummary.functions.values():
+        for site in fn.calls:
+            callee = graph.resolve_function(fsummary, site.name,
+                                            fn.qualname)
+            if callee is None or not callee.axis_params:
+                continue
+            for i, lit in enumerate(site.arg_literals):
+                if i >= len(callee.params) or \
+                        callee.params[i] not in callee.axis_params:
+                    continue
+                where = (f"call to '{callee.name}' (axis parameter "
+                         f"'{callee.params[i]}')")
+                if lit is not None:
+                    _check_axis_value(graph, rel, lit, site.line,
+                                      site.qualname, site.text, where,
+                                      findings)
+                elif site.arg_keys[i]:
+                    value = graph.resolve_axis_value(fsummary,
+                                                     site.arg_keys[i])
+                    if value is not None and \
+                            value not in graph.defined_axes:
+                        _flag_undefined(
+                            graph, rel, value, site.line, site.qualname,
+                            site.text,
+                            f"{where} via {site.arg_keys[i]}", findings)
+            for (kwname, lit), (_kn, key) in zip(site.kwarg_literals,
+                                                 site.kwarg_keys):
+                if kwname not in callee.axis_params:
+                    continue
+                where = (f"call to '{callee.name}' (axis parameter "
+                         f"'{kwname}')")
+                if lit is not None:
+                    _check_axis_value(graph, rel, lit, site.line,
+                                      site.qualname, site.text, where,
+                                      findings)
+                elif key:
+                    value = graph.resolve_axis_value(fsummary, key)
+                    if value is not None and \
+                            value not in graph.defined_axes:
+                        _flag_undefined(graph, rel, value, site.line,
+                                        site.qualname, site.text,
+                                        f"{where} via {key}", findings)
+
+
+def check_project(graph, findings):
+    """(b): every axis string must have exactly one constant definition."""
+    for value, sites in sorted(graph.axis_constants.items()):
+        if len(sites) < 2:
+            continue
+        rel0, name0, _l0, _t0 = sites[0]
+        for rel, name, line, text in sites[1:]:
+            findings.append(Finding(
+                rel, line, "JL007", "<module>",
+                f"axis constant {name} = '{value}' duplicates {name0} "
+                f"defined in {rel0} — import the canonical constant so "
+                f"the definitions cannot drift apart", text))

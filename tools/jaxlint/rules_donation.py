@@ -1,0 +1,116 @@
+"""JL008: donated-buffer reads across one call boundary.
+
+JL005 catches ``x = step(buf); buf.mean()`` when ``step`` is jitted *in
+the same file*. This family generalizes it through the graph: pass 1
+records, for every function, which of its parameters it forwards to a
+donated position of a jitted callee (``donates_params``), including
+cross-file jits resolved through imports. A caller that passes a buffer
+into such a helper and reads the buffer after the call is reading
+invalidated memory, same as JL005 — it just can't see the donation
+locally.
+
+Callees that are jitted bindings of the CALLER's own file are skipped:
+that is exactly JL005's domain and is already flagged there.
+"""
+
+import ast
+
+from tools.jaxlint.astutil import (
+    call_name,
+    enclosing_functions,
+    expr_key,
+    stmt_reads,
+    stmt_rebinds,
+    walk_same_scope,
+)
+from tools.jaxlint.findings import Finding
+
+
+def _donated_arg_keys(call, callee, jit):
+    """(key, description) for every argument this call donates, resolved
+    either through a helper summary or a cross-file JitInfo."""
+    out = []
+    if callee is not None and callee.donates_params:
+        for i, param in enumerate(callee.params):
+            if param in callee.donates_params and i < len(call.args):
+                key = expr_key(call.args[i])
+                if key is not None:
+                    inner, _line = callee.donates_params[param]
+                    out.append((key, f"helper '{callee.name}' (which "
+                                     f"donates it to jitted '{inner}')"))
+        for kw in call.keywords:
+            if kw.arg in callee.donates_params:
+                key = expr_key(kw.value)
+                if key is not None:
+                    inner, _line = callee.donates_params[kw.arg]
+                    out.append((key, f"helper '{callee.name}' (which "
+                                     f"donates it to jitted '{inner}')"))
+    elif jit is not None and (jit.donate_nums or jit.donate_names):
+        for i, arg in enumerate(call.args):
+            if i in jit.donate_nums or (
+                    i < len(jit.params)
+                    and jit.params[i] in jit.donate_names):
+                key = expr_key(arg)
+                if key is not None:
+                    out.append((key, f"jitted '{call_name(call)}'"))
+        for kw in call.keywords:
+            if kw.arg in jit.donate_names:
+                key = expr_key(kw.value)
+                if key is not None:
+                    out.append((key, f"jitted '{call_name(call)}'"))
+    return out
+
+
+def check(index, fsummary, graph, findings):
+    donors = graph.donor_names()
+    if not donors:
+        return
+    source = "\n".join(index.lines)
+    donors = {d for d in donors if d in source}
+    if not donors:
+        return
+    for scope, qual in enclosing_functions(index):
+        body = getattr(scope, "body", [])
+        rebind_cache = {}
+
+        def rebinds(stmt):
+            got = rebind_cache.get(id(stmt))
+            if got is None:
+                got = rebind_cache[id(stmt)] = stmt_rebinds(stmt)
+            return got
+
+        for si, stmt in enumerate(body):
+            for call in walk_same_scope(stmt):
+                if not isinstance(call, ast.Call):
+                    continue
+                dotted = expr_key(call.func)
+                if dotted is None or dotted.split(".")[-1] not in donors:
+                    continue
+                if dotted.split(".")[-1] in index.jit_registry:
+                    continue       # same-file jit: JL005's domain
+                callee = graph.resolve_function(fsummary, dotted, qual)
+                jit = None
+                if callee is None or not callee.donates_params:
+                    jit = graph.resolve_jit(fsummary, dotted)
+                donated = _donated_arg_keys(call, callee, jit)
+                if not donated:
+                    continue
+                live = [(k, how) for k, how in donated
+                        if k not in rebinds(stmt)]
+                for later in body[si + 1:]:
+                    if not live:
+                        break
+                    still = []
+                    for key, how in live:
+                        if stmt_reads(later, key):
+                            findings.append(Finding(
+                                index.rel_path, later.lineno, "JL008",
+                                qual,
+                                f"'{key}' was donated on line "
+                                f"{call.lineno} through {how} and is read "
+                                f"here — the buffer is invalidated; "
+                                f"rebind the helper's result first",
+                                index.line_text(later.lineno)))
+                        elif key not in rebinds(later):
+                            still.append((key, how))
+                    live = still

@@ -1,0 +1,177 @@
+"""JL010: dtype-promotion drift on int8 quantized values.
+
+Seeded from the quantization codecs' call graph: a value produced by
+``quantize_kv``/``quantize_tensor`` (directly, through a helper whose
+summary says it returns a quantized value, or received as a parameter
+that some call site feeds from a quantized argument) is int8 with an
+out-of-band scale. Mixing it into ``+ - * /`` or a jnp matmul without an
+explicit cast makes XLA silently promote the whole expression to
+float32 — numerically "working", but the int8 path now pays fp32
+bandwidth and the scale multiplies garbage.
+
+The taint is statement-ordered and deliberately shallow: subscripts
+keep it (``qk[0] * x`` is still int8), while ``astype``/``asarray``/
+``dequantize_*`` calls break it — so the idiomatic fix
+(``qk.astype(jnp.bfloat16) * scale``) is naturally clean.
+"""
+
+import ast
+
+from tools.jaxlint.astutil import call_name, enclosing_functions, expr_key
+from tools.jaxlint.findings import Finding
+from tools.jaxlint.summaries import (
+    QUANT_CLEANSERS,
+    QUANT_SOURCES,
+    _expr_tainted,
+    _local_dotted,
+)
+
+_MATMUL = frozenset(("dot", "matmul", "einsum", "tensordot", "vdot"))
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _scope_stmts(scope):
+    """Every statement in this scope (not nested defs'), source order."""
+    out = []
+    stack = list(getattr(scope, "body", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _SCOPES):
+            continue
+        if isinstance(node, ast.stmt):
+            out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.stmt, ast.excepthandler)):
+                stack.append(child)
+    out.sort(key=lambda n: (n.lineno, n.col_offset))
+    return out
+
+
+def _stmt_exprs(stmt):
+    """Expression nodes directly attached to this statement (child
+    statements are visited on their own turn)."""
+    for _field, value in ast.iter_fields(stmt):
+        values = value if isinstance(value, list) else [value]
+        for v in values:
+            if isinstance(v, ast.expr):
+                yield from ast.walk(v)
+
+
+def _is_matmul(fsummary, call):
+    name = call_name(call)
+    if name not in _MATMUL:
+        return False
+    key = expr_key(call.func)
+    if key is None or "." not in key:
+        return False
+    base = key.rsplit(".", 1)[0]
+    if base == "jnp" or base.endswith("numpy"):
+        resolved = _local_dotted(fsummary, base) or base
+        return not resolved.startswith(("np", "numpy", "onp"))
+    return False
+
+
+def _value_taints(fsummary, graph, qual, value, taint):
+    """Does assigning from ``value`` propagate the int8 taint?"""
+    if isinstance(value, ast.Call):
+        name = call_name(value)
+        if name in QUANT_SOURCES:
+            return True
+        if name in QUANT_CLEANSERS:
+            return False
+        dotted = expr_key(value.func)
+        if dotted is not None:
+            callee = graph.resolve_function(fsummary, dotted, qual)
+            return bool(callee is not None and callee.returns_quant)
+        return False
+    return _expr_tainted(value, taint)
+
+
+def _apply_assign(fsummary, graph, qual, stmt, taint):
+    tainted = _value_taints(fsummary, graph, qual, stmt.value, taint)
+    for tgt in stmt.targets:
+        if isinstance(tgt, (ast.Tuple, ast.List)) and tgt.elts:
+            # (q, scale) = quantize_kv(...): the first element is int8
+            first_key = expr_key(tgt.elts[0])
+            if first_key:
+                (taint.add if tainted else taint.discard)(first_key)
+            for rest in tgt.elts[1:]:
+                key = expr_key(rest)
+                if key:
+                    taint.discard(key)
+        else:
+            key = expr_key(tgt)
+            if key:
+                (taint.add if tainted else taint.discard)(key)
+
+
+def _operand_key(node):
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    return expr_key(node)
+
+
+def check(index, fsummary, graph, findings):
+    if not graph.quant_relevant(fsummary):
+        return
+    for scope, qual in enclosing_functions(index):
+        fn = fsummary.functions.get(qual)
+        taint = set(graph.quant_params(fn)) if fn is not None else set()
+        for stmt in _scope_stmts(scope):
+            # sinks first: the statement's own expressions see the taint
+            # as it stood BEFORE this statement's assignments
+            flagged_lines = set()
+            for node in _stmt_exprs(stmt):
+                if isinstance(node, ast.BinOp):
+                    for side in (node.left, node.right):
+                        if _expr_tainted(side, taint):
+                            key = _operand_key(side)
+                            if node.lineno in flagged_lines:
+                                break
+                            flagged_lines.add(node.lineno)
+                            findings.append(Finding(
+                                index.rel_path, node.lineno, "JL010",
+                                qual,
+                                f"int8 value '{key}' from the "
+                                f"quantization codecs is used in "
+                                f"arithmetic without an explicit cast — "
+                                f"the expression silently promotes to "
+                                f"float32; .astype(...) (then scale) or "
+                                f"dequantize first",
+                                index.line_text(node.lineno)))
+                            break
+                elif isinstance(node, ast.Call) and \
+                        _is_matmul(fsummary, node):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Starred):
+                            continue
+                        if _expr_tainted(arg, taint):
+                            key = _operand_key(arg)
+                            if node.lineno in flagged_lines:
+                                break
+                            flagged_lines.add(node.lineno)
+                            findings.append(Finding(
+                                index.rel_path, node.lineno, "JL010",
+                                qual,
+                                f"int8 value '{key}' from the "
+                                f"quantization codecs feeds "
+                                f"jnp.{call_name(node)} without an "
+                                f"explicit cast — the matmul silently "
+                                f"promotes to float32; .astype(...) or "
+                                f"dequantize first",
+                                index.line_text(node.lineno)))
+                            break
+            if isinstance(stmt, ast.Assign):
+                _apply_assign(fsummary, graph, qual, stmt, taint)
+            elif isinstance(stmt, ast.AugAssign):
+                key = expr_key(stmt.target)
+                if key is not None and (
+                        _expr_tainted(stmt.value, taint) or key in taint):
+                    findings.append(Finding(
+                        index.rel_path, stmt.lineno, "JL010", qual,
+                        f"augmented assignment mixes int8 value into "
+                        f"'{key}' without an explicit cast — silent "
+                        f"float32 promotion; .astype(...) or dequantize "
+                        f"first", index.line_text(stmt.lineno)))
+                    taint.discard(key)

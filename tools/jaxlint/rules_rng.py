@@ -1,0 +1,168 @@
+"""JL009: PRNG key reuse, through one level of call.
+
+jax.random keys are single-use: a key may feed exactly one consuming
+call (normal/categorical/...) or one split; after that the name must be
+rebound from ``split``/``fold_in`` before it touches jax.random again.
+The check walks each statement suite in source order and tracks spends:
+
+- a direct ``jax.random.<consumer>(key, ...)`` or ``split(key)`` marks
+  the key spent; a second spend of the same key flags;
+- a call into a helper whose summary says it consumes/splits its key
+  parameter spends the caller's key too (``key_params_used``, resolved
+  through the graph, so the helper can live in another file);
+- ``k2 = identity_helper(k)`` where the helper returns its key param
+  un-split makes ``k2`` an alias of ``k`` — spending both flags;
+- a consuming call inside a for/while whose body never rebinds the key
+  flags: every iteration draws identical randomness;
+- rebinding a key (``rng, sub = jax.random.split(rng)``) clears it;
+  ``fold_in`` is counter-based derivation and deliberately NOT a spend
+  (``sub = fold_in(rng, i)`` per step is the repo's sanctioned idiom).
+"""
+
+import ast
+
+from tools.jaxlint.astutil import (
+    body_lists,
+    call_name,
+    enclosing_functions,
+    expr_key,
+    stmt_rebinds,
+    walk_same_scope,
+)
+from tools.jaxlint.findings import Finding
+from tools.jaxlint.summaries import _rng_call_kind
+
+
+def _stmt_calls(stmt):
+    calls = [n for n in walk_same_scope(stmt) if isinstance(n, ast.Call)]
+    calls.sort(key=lambda n: (n.lineno, n.col_offset))
+    return calls
+
+
+def _spends(fsummary, graph, qual, call):
+    """[(key expr, description)] for every key this call spends."""
+    out = []
+    kind = _rng_call_kind(fsummary, call)
+    if kind is not None:
+        if kind[0] == "spend" and kind[1]:
+            out.append((kind[1], f"jax.random.{call_name(call)}"))
+        return out
+    dotted = expr_key(call.func)
+    if dotted is None:
+        return out
+    callee = graph.resolve_function(fsummary, dotted, qual)
+    if callee is None or not callee.key_params_used:
+        return out
+    for i, arg in enumerate(call.args):
+        if i < len(callee.params) and \
+                callee.params[i] in callee.key_params_used:
+            key = expr_key(arg)
+            if key:
+                out.append((key, f"helper '{callee.name}' (which "
+                                 f"consumes its '{callee.params[i]}')"))
+    for kw in call.keywords:
+        if kw.arg in callee.key_params_used:
+            key = expr_key(kw.value)
+            if key:
+                out.append((key, f"helper '{callee.name}' (which "
+                                 f"consumes its '{kw.arg}')"))
+    return out
+
+
+def _alias_from_assign(fsummary, graph, qual, stmt):
+    """(target, source key) when ``stmt`` is ``k2 = helper(k)`` and the
+    helper returns its key parameter un-split."""
+    if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+        return None
+    tgt = stmt.targets[0]
+    if not isinstance(tgt, ast.Name):
+        return None
+    value = stmt.value
+    if not isinstance(value, ast.Call):
+        return None
+    dotted = expr_key(value.func)
+    if dotted is None:
+        return None
+    callee = graph.resolve_function(fsummary, dotted, qual)
+    if callee is None or not callee.returns_params:
+        return None
+    for i, arg in enumerate(value.args):
+        if i < len(callee.params) and \
+                callee.params[i] in callee.returns_params:
+            key = expr_key(arg)
+            if key:
+                return (tgt.id, key, callee.name)
+    for kw in value.keywords:
+        if kw.arg in callee.returns_params:
+            key = expr_key(kw.value)
+            if key:
+                return (tgt.id, key, callee.name)
+    return None
+
+
+def _root(alias, key):
+    seen = set()
+    while key in alias and key not in seen:
+        seen.add(key)
+        key = alias[key]
+    return key
+
+
+def check(index, fsummary, graph, findings):
+    if not graph.rng_relevant(fsummary):
+        return
+    for scope, qual in enclosing_functions(index):
+        for suite in body_lists(scope):
+            spent = {}    # root key -> (line, description)
+            alias = {}    # alias -> source key
+            for stmt in suite:
+                if isinstance(stmt, (ast.For, ast.While)):
+                    rebinds = stmt_rebinds(stmt)
+                    for call in _stmt_calls(stmt):
+                        for key, how in _spends(fsummary, graph, qual,
+                                                call):
+                            root = _root(alias, key)
+                            if key in rebinds or root in rebinds:
+                                continue
+                            findings.append(Finding(
+                                index.rel_path, call.lineno, "JL009",
+                                qual,
+                                f"'{key}' is consumed by {how} inside a "
+                                f"loop that never re-derives it — every "
+                                f"iteration draws identical randomness; "
+                                f"split or fold_in the key per "
+                                f"iteration",
+                                index.line_text(call.lineno)))
+                            spent.setdefault(root, (call.lineno, how))
+                    for key in rebinds:
+                        spent.pop(key, None)
+                        alias.pop(key, None)
+                    continue
+
+                for call in _stmt_calls(stmt):
+                    for key, how in _spends(fsummary, graph, qual, call):
+                        root = _root(alias, key)
+                        prior = spent.get(root)
+                        if prior is not None:
+                            pline, phow = prior
+                            via = "" if root == key else \
+                                f" (an un-split alias of '{root}')"
+                            findings.append(Finding(
+                                index.rel_path, call.lineno, "JL009",
+                                qual,
+                                f"'{key}'{via} was already consumed by "
+                                f"{phow} on line {pline} and feeds {how} "
+                                f"here — split the key instead of "
+                                f"reusing it",
+                                index.line_text(call.lineno)))
+                        else:
+                            spent[root] = (call.lineno, how)
+
+                aliased = _alias_from_assign(fsummary, graph, qual, stmt)
+                for key in stmt_rebinds(stmt):
+                    spent.pop(key, None)
+                    alias.pop(key, None)
+                if aliased is not None:
+                    target, source, _helper = aliased
+                    if target != source:
+                        alias[target] = source

@@ -1,0 +1,67 @@
+"""JL011: PartitionSpec / sharding consistency over the project graph.
+
+Two failure shapes, both pre-flight checks for the ROADMAP-1 sharding
+registry:
+
+(a) two dict-literal spec registrations for the same param-tree path
+    resolve to different specs: whichever module imports last wins and
+    every consumer reshards — the first (path, line)-ordered
+    registration is canonical, later disagreeing ones are flagged;
+(b) a PartitionSpec element names an axis no Mesh in the scanned
+    project defines: the spec raises only when it first meets a real
+    mesh, usually on the multi-host job. Elements are resolved through
+    module constants; starred/computed elements and the no-mesh-at-all
+    case stay silent (a library of specs without topology code is not a
+    bug).
+"""
+
+from tools.jaxlint.findings import Finding
+
+
+def _render_sig(sig):
+    return "P(" + ", ".join("None" if v is None else repr(v)
+                            for v in sig) + ")"
+
+
+def check_project(graph, findings):
+    # (a) conflicting registrations per param-tree path
+    for path_key in sorted(graph.spec_registry):
+        sigs = graph.spec_registry[path_key]
+        if len(sigs) < 2:
+            continue
+        entries = []   # (rel, line, qual, text, sig)
+        for sig, sites in sigs.items():
+            for rel, line, qual, text in sites:
+                entries.append((rel, line, qual, text, sig))
+        entries.sort(key=lambda e: (e[0], e[1]))
+        rel0, line0, _q0, _t0, sig0 = entries[0]
+        for rel, line, qual, text, sig in entries[1:]:
+            if sig == sig0:
+                continue
+            findings.append(Finding(
+                rel, line, "JL011", qual,
+                f"PartitionSpec for param-tree path '{path_key}' is "
+                f"{_render_sig(sig)} here but {_render_sig(sig0)} at "
+                f"{rel0}:{line0} — conflicting registrations silently "
+                f"reshard every consumer; keep one canonical spec", text))
+
+    # (b) spec elements naming axes no Mesh defines
+    if not graph.mesh_axes:
+        return
+    known = ", ".join(sorted(graph.mesh_axes))
+    for rel in sorted(graph.files):
+        fs = graph.files[rel]
+        for elems, line, qual, text in fs.spec_sites:
+            for elem in elems:
+                value = None
+                if elem[0] == "lit":
+                    value = elem[1]
+                elif elem[0] == "key":
+                    value = graph.resolve_axis_value(fs, elem[1])
+                if value is not None and value not in graph.mesh_axes:
+                    findings.append(Finding(
+                        rel, line, "JL011", qual,
+                        f"PartitionSpec names axis '{value}' but no Mesh "
+                        f"defines it (mesh axes: {known}) — the spec "
+                        f"will fail when it first meets a mesh", text))
+                    break   # one finding per spec construction

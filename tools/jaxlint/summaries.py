@@ -1,0 +1,690 @@
+"""Pass 1 of the two-pass analyzer: per-file symbol tables and function
+summaries, cached by content hash.
+
+One walk per file extracts everything the interprocedural rule families
+(JL007-JL011) need to reason ACROSS files without ever re-parsing:
+
+- module facts: imports (absolute + relative, resolved to dotted
+  targets), string/tuple constants, ``P = PartitionSpec``-style aliases,
+  the jit registry;
+- axis facts: every axis-name *use site* (collective axis argument, Mesh
+  axis tuple element, ``pmap(axis_name=...)``, ``axis_name=`` parameter
+  default, PartitionSpec element) with its literal value or expression
+  key;
+- sharding facts: every PartitionSpec construction, plus dict-literal
+  spec registries mapping a param-tree path to a spec;
+- per-function summaries: positional params, params used as collective
+  axes, params consumed as PRNG keys, params returned un-split, params
+  donated through to a jitted callee, whether the function returns an
+  int8-quantized value, and every call site with argument keys/literals.
+
+Summaries are pure data (no AST references), so the module-level cache
+keyed by ``(rel_path, sha1(content))`` makes repeat runs — the common
+case for the CI gate plus the diff gate in one job — parse-free.
+"""
+
+import ast
+import copy
+import hashlib
+from dataclasses import dataclass, field
+
+from tools.jaxlint.astutil import (
+    JitInfo,
+    call_name,
+    decorator_jit_info,
+    expr_key,
+    is_jit_ref,
+    jit_kwargs,
+    literal,
+    walk_same_scope,
+)
+
+# collective -> positional index of the axis-name argument
+COLLECTIVE_AXIS_POS = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "ppermute": 1,
+    "all_gather": 1, "psum_scatter": 1, "all_to_all": 1, "pshuffle": 1,
+    "pcast": 1, "axis_index": 0, "axis_size": 0,
+}
+
+# jax.random calls that CONSUME a key (using the same key twice is the
+# JL009 hazard) vs calls that derive/construct without consuming.
+RNG_CONSUMING = frozenset((
+    "normal", "uniform", "categorical", "bernoulli", "gumbel", "randint",
+    "truncated_normal", "permutation", "choice", "shuffle", "exponential",
+    "gamma", "beta", "dirichlet", "laplace", "logistic", "poisson",
+    "rademacher", "ball", "orthogonal", "bits", "cauchy", "maxwell",
+    "multivariate_normal", "pareto", "t", "weibull_min", "loggamma",
+))
+# split marks the key spent too (using a key after splitting it is a
+# reuse); fold_in is counter-based derivation and deliberately is NOT
+# spending — fold_in(rng, i) with varying data is the repo's idiom.
+RNG_SPENDING = RNG_CONSUMING | {"split"}
+
+# int8 taint sources: the quantization codecs by name (the rule family is
+# seeded from the quantize_kv/dequantize_kv call graph, so name-match is
+# the authoritative signal even when the import can't be resolved).
+QUANT_SOURCES = frozenset((
+    "quantize_kv", "quantize_kv_np", "requantize_kv", "quantize_tensor",
+))
+# calls that yield an explicitly-cast (clean) value
+QUANT_CLEANSERS = frozenset((
+    "dequantize_kv", "dequantize_kv_np", "dequantize_tensor", "astype",
+    "asarray", "array", "float32", "bfloat16", "float16", "maybe_dequant",
+))
+
+_AXIS_PARAM_NAMES = ("axis_name",)
+
+
+@dataclass
+class AxisSite:
+    """One place an axis name is used (or bound as a default)."""
+    op: str              # "psum" / "Mesh" / "PartitionSpec" / "pmap" / "default"
+    value: str           # literal axis string, or "" when not a literal
+    key: str             # dotted expr key when not a literal, else ""
+    param: str           # enclosing-fn param name when key IS a bare param
+    line: int
+    qualname: str
+    text: str
+    collective: bool
+
+
+@dataclass
+class CallSite:
+    name: str            # dotted callee key as written ("helper", "m.f", "self.g")
+    line: int
+    qualname: str        # enclosing function qualname ("<module>" at top level)
+    arg_keys: tuple      # expr key per positional arg (None when complex)
+    arg_literals: tuple  # literal string per positional arg (None otherwise)
+    kwarg_keys: tuple    # (kwname, expr key) pairs
+    kwarg_literals: tuple  # (kwname, literal string) pairs
+    quant_args: tuple    # positional indexes receiving an int8-tainted value
+    quant_kwargs: tuple  # kwarg names receiving an int8-tainted value
+    text: str
+
+
+@dataclass
+class FunctionSummary:
+    qualname: str
+    name: str            # last path component (method name for methods)
+    params: tuple
+    lineno: int
+    axis_params: dict = field(default_factory=dict)   # param -> [(op, line)]
+    key_params_used: set = field(default_factory=set)
+    returns_params: set = field(default_factory=set)  # params returned bare
+    returns_quant: bool = False
+    returns_calls: tuple = ()   # callee names whose result is returned directly
+    donates_params: dict = field(default_factory=dict)  # param -> (callee, line)
+    calls: tuple = ()
+
+
+@dataclass
+class FileSummary:
+    rel_path: str
+    module: str
+    content_hash: str
+    imports: dict = field(default_factory=dict)       # alias -> dotted target
+    constants: dict = field(default_factory=dict)     # NAME -> (str, line, text)
+    tuple_constants: dict = field(default_factory=dict)  # NAME -> tuple[str]
+    aliases: dict = field(default_factory=dict)       # NAME -> dotted target
+    jit_registry: dict = field(default_factory=dict)  # name -> JitInfo
+    functions: dict = field(default_factory=dict)     # qualname -> FunctionSummary
+    axis_sites: list = field(default_factory=list)    # [AxisSite]
+    mesh_defs: list = field(default_factory=list)     # [(elements, line)]
+    pmap_axes: list = field(default_factory=list)     # [str]
+    spec_entries: list = field(default_factory=list)  # [(key, elems, line, qual, text)]
+    spec_sites: list = field(default_factory=list)    # [(elems, line, qual, text)]
+    uses_rng: bool = False      # any jax.random spend/derive in this file
+    uses_quant: bool = False    # any quantization-codec call in this file
+
+    def function_by_name(self, name):
+        """Top-level function summary by bare name (methods need the
+        Class.method qualname)."""
+        return self.functions.get(name)
+
+
+_SUMMARY_CACHE = {}
+
+
+def content_hash(source):
+    return hashlib.sha1(source.encode("utf-8", "replace")).hexdigest()
+
+
+def module_name(rel_path):
+    posix = rel_path.replace("\\", "/")
+    if posix.endswith("/__init__.py"):
+        posix = posix[: -len("/__init__.py")]
+    elif posix.endswith(".py"):
+        posix = posix[:-3]
+    return posix.replace("/", ".")
+
+
+def cache_stats():
+    return len(_SUMMARY_CACHE)
+
+
+def summarize_index(index, source_hash=None):
+    """Build (or fetch from cache) the FileSummary for a parsed file.
+
+    The cache holds a PRISTINE copy: the project graph's propagation
+    sweep mutates the per-function summaries (key params, donated
+    params, quant facts), and those facts depend on which other files
+    are in the graph — a cached summary must not carry them over into a
+    different project composition."""
+    key = (index.rel_path, source_hash)
+    if source_hash is not None:
+        hit = _SUMMARY_CACHE.get(key)
+        if hit is not None:
+            return copy.deepcopy(hit)
+    summary = _build_summary(index, source_hash or "")
+    if source_hash is not None:
+        _SUMMARY_CACHE[key] = copy.deepcopy(summary)
+    return summary
+
+
+# -- builder -----------------------------------------------------------------
+
+def _scope_statements(owner):
+    """Nodes of ``owner``'s own suite(s), not nested defs', in source
+    order: pre-order DFS over iter_child_nodes, whose field order
+    matches source order for every node the scans below care about
+    (the taint/return bookkeeping is order-sensitive)."""
+    scope_types = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                   ast.Lambda)
+    out = []
+
+    def visit(node):
+        out.append(node)
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, scope_types):
+                visit(child)
+
+    for stmt in getattr(owner, "body", ()):
+        if isinstance(stmt, scope_types):
+            out.append(stmt)
+        else:
+            visit(stmt)
+    return out
+
+
+def _resolve_import_target(module, is_package, node_module, level, name):
+    """Absolute dotted target of ``from <module> import <name>`` with the
+    given relative ``level``, from inside module ``module``."""
+    if level == 0:
+        base = node_module or ""
+    else:
+        parts = module.split(".")
+        # level 1 = current package: a plain module's package is its
+        # parent; a package __init__ IS its package.
+        if not is_package:
+            parts = parts[:-1]
+        cut = len(parts) - (level - 1)
+        if cut < 0:
+            return None
+        base = ".".join(parts[:cut])
+        if node_module:
+            base = f"{base}.{node_module}" if base else node_module
+    if not base:
+        return name
+    return f"{base}.{name}"
+
+
+def _collect_imports(summary, tree, is_package):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    summary.imports[alias.asname] = alias.name
+                else:
+                    # ``import a.b`` binds ``a``; record the root so the
+                    # dotted use ``a.b.f`` resolves through it.
+                    root = alias.name.split(".")[0]
+                    summary.imports.setdefault(root, root)
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                target = _resolve_import_target(
+                    summary.module, is_package, node.module, node.level,
+                    alias.name)
+                if target:
+                    summary.imports[alias.asname or alias.name] = target
+
+
+def _local_dotted(summary, key):
+    """Resolve a dotted key through this file's imports/aliases to an
+    absolute dotted name where possible ("P" -> "jax.sharding.PartitionSpec",
+    "random.normal" -> "jax.random.normal"). Unresolvable keys return
+    the key unchanged."""
+    if key is None:
+        return None
+    parts = key.split(".")
+    for i in range(len(parts), 0, -1):
+        prefix = ".".join(parts[:i])
+        target = summary.imports.get(prefix) or summary.aliases.get(prefix)
+        if target:
+            rest = parts[i:]
+            return ".".join([target] + rest) if rest else target
+    return key
+
+
+def _is_ctor(summary, func_node, ctor):
+    """Does this call expression construct ``ctor`` (PartitionSpec/Mesh/
+    NamedSharding), directly, via alias, or via import-as?"""
+    key = expr_key(func_node)
+    if key is None:
+        return False
+    if key.split(".")[-1] == ctor:
+        return True
+    resolved = _local_dotted(summary, key)
+    return resolved is not None and resolved.split(".")[-1] == ctor
+
+
+def _is_lax_collective(summary, call):
+    name = call_name(call)
+    if name not in COLLECTIVE_AXIS_POS:
+        return None
+    key = expr_key(call.func)
+    if key is None:
+        return None
+    base = key.rsplit(".", 1)[0] if "." in key else ""
+    if base == "lax" or base.endswith(".lax"):
+        return name
+    if "." not in key:
+        resolved = _local_dotted(summary, key)
+        if resolved and resolved.startswith("jax.lax."):
+            return name
+    return None
+
+
+def _rng_call_kind(summary, call):
+    """("spend"|"derive", key expr) for a jax.random call, else None."""
+    name = call_name(call)
+    if name is None:
+        return None
+    key = expr_key(call.func)
+    if key is None:
+        return None
+    base = key.rsplit(".", 1)[0] if "." in key else ""
+    from_random = (base.endswith("random") and base != "np.random"
+                   and not base.startswith("np.")
+                   and not base.startswith("numpy"))
+    if not from_random and "." not in key:
+        resolved = _local_dotted(summary, key)
+        from_random = bool(resolved) and resolved.startswith("jax.random.")
+    if not from_random:
+        return None
+    if name in RNG_SPENDING:
+        arg = None
+        if call.args:
+            arg = expr_key(call.args[0])
+        else:
+            for kw in call.keywords:
+                if kw.arg == "key":
+                    arg = expr_key(kw.value)
+        return ("spend", arg)
+    if name == "fold_in":
+        arg = expr_key(call.args[0]) if call.args else None
+        return ("derive", arg)
+    return None
+
+
+def _axis_elements(node):
+    """Flatten an axis argument into element nodes (tuples/lists of axis
+    names appear in pcast/axis_names positions)."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            out.extend(_axis_elements(elt))
+        return out
+    return [node]
+
+
+def _spec_elements(summary, call):
+    """PartitionSpec(...) arguments as resolvable elements:
+    ("lit", value) / ("none",) / ("key", dotted) / ("?",)."""
+    elems = []
+    for arg in call.args:
+        if isinstance(arg, ast.Starred):
+            elems.append(("?",))
+            continue
+        for node in _axis_elements(arg):
+            val = literal(node)
+            if isinstance(val, str):
+                elems.append(("lit", val))
+            elif val is None and isinstance(node, ast.Constant):
+                elems.append(("none",))
+            else:
+                key = expr_key(node)
+                elems.append(("key", key) if key else ("?",))
+    return tuple(elems)
+
+
+def _build_summary(index, source_hash):
+    tree = index.tree
+    summary = FileSummary(
+        rel_path=index.rel_path,
+        module=module_name(index.rel_path),
+        content_hash=source_hash,
+    )
+    _collect_imports(summary, tree,
+                     index.rel_path.endswith("__init__.py"))
+    summary.jit_registry = dict(index.jit_registry)
+
+    # module-level constants and ctor aliases
+    for stmt in tree.body:
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            continue
+        tgt = stmt.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        val = literal(stmt.value)
+        if isinstance(val, str):
+            summary.constants[tgt.id] = (
+                val, stmt.lineno, index.line_text(stmt.lineno))
+        elif isinstance(val, tuple) and val and all(
+                isinstance(v, str) for v in val):
+            summary.tuple_constants[tgt.id] = val
+        elif isinstance(stmt.value, (ast.Name, ast.Attribute)):
+            key = expr_key(stmt.value)
+            if key:
+                summary.aliases[tgt.id] = _local_dotted(summary, key) or key
+
+    scopes = [(tree, "<module>", ())]
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = index.qualname.get(node, node.name)
+            params = tuple(a.arg for a in node.args.posonlyargs
+                           + node.args.args)
+            scopes.append((node, qual, params))
+            summary.functions[qual] = FunctionSummary(
+                qualname=qual, name=node.name, params=params,
+                lineno=node.lineno)
+            _collect_def_extras(summary, index, node, qual, params)
+
+    for owner, qual, params in scopes:
+        _scan_scope(summary, index, owner, qual, params)
+
+    return summary
+
+
+def _collect_def_extras(summary, index, node, qual, params):
+    """Decorator-borne facts: pmap axis bindings and axis_name defaults."""
+    for dec in node.decorator_list:
+        _record_pmap(summary, index, dec, qual)
+    # ``axis_name="data"``-style defaults both bind an axis and (when a
+    # shared constant exists) duplicate it — record as a "default" site.
+    args = node.args
+    pos = list(args.posonlyargs) + list(args.args)
+    defaults = list(args.defaults)
+    for arg, default in zip(pos[len(pos) - len(defaults):], defaults):
+        _record_axis_default(summary, index, arg, default, qual)
+    for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+        if default is not None:
+            _record_axis_default(summary, index, arg, default, qual)
+
+
+def _record_axis_default(summary, index, arg, default, qual):
+    if arg.arg not in _AXIS_PARAM_NAMES:
+        return
+    val = literal(default)
+    if isinstance(val, str):
+        summary.pmap_axes.append(val)
+        summary.axis_sites.append(AxisSite(
+            "default", val, "", "", default.lineno, qual,
+            index.line_text(default.lineno), False))
+
+
+def _record_pmap(summary, index, node, qual):
+    """pmap(...) in decorator or binding position: harvest axis_name."""
+    call = node
+    if isinstance(call, ast.Call):
+        fname = call_name(call)
+        if fname == "partial" and call.args and isinstance(
+                call.args[0], (ast.Name, ast.Attribute)):
+            inner_key = expr_key(call.args[0]) or ""
+            if inner_key.split(".")[-1] != "pmap":
+                return
+        elif fname != "pmap":
+            return
+        for kw in call.keywords:
+            if kw.arg == "axis_name":
+                val = literal(kw.value)
+                if isinstance(val, str):
+                    summary.pmap_axes.append(val)
+                    summary.axis_sites.append(AxisSite(
+                        "pmap", val, "", "", kw.value.lineno, qual,
+                        index.line_text(kw.value.lineno), False))
+
+
+def _scan_scope(summary, index, owner, qual, params):
+    fn_summary = summary.functions.get(qual)
+    quant_taint = set()
+    calls = []
+
+    def record_axis_use(op, node, line, collective):
+        for elem in _axis_elements(node):
+            val = literal(elem)
+            if isinstance(val, str):
+                summary.axis_sites.append(AxisSite(
+                    op, val, "", "", line, qual,
+                    index.line_text(line), collective))
+            else:
+                key = expr_key(elem)
+                if key is None:
+                    continue
+                param = key if key in params else ""
+                summary.axis_sites.append(AxisSite(
+                    op, "", key, param, line, qual,
+                    index.line_text(line), collective))
+                if param and collective and fn_summary is not None:
+                    fn_summary.axis_params.setdefault(param, []).append(
+                        (op, line))
+
+    for node in _scope_statements(owner):
+        if isinstance(node, ast.Return) and fn_summary is not None:
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id in params:
+                fn_summary.returns_params.add(node.value.id)
+            if _expr_tainted(node.value, quant_taint):
+                fn_summary.returns_quant = True
+            if isinstance(node.value, ast.Call):
+                name = call_name(node.value)
+                if name in QUANT_SOURCES:
+                    fn_summary.returns_quant = True
+                elif name:
+                    fn_summary.returns_calls += (
+                        expr_key(node.value.func) or name,)
+            elif isinstance(node.value, ast.Tuple) and node.value.elts:
+                first = node.value.elts[0]
+                if _expr_tainted(first, quant_taint) or (
+                        isinstance(first, ast.Call)
+                        and call_name(first) in QUANT_SOURCES):
+                    fn_summary.returns_quant = True
+
+        if isinstance(node, ast.Assign):
+            _track_quant_assign(node, quant_taint)
+
+        if isinstance(node, ast.Dict):
+            # dict-literal spec registries: {"tree/path": PartitionSpec(...)}
+            for k, v in zip(node.keys, node.values):
+                path_key = literal(k) if k is not None else None
+                if not isinstance(path_key, str):
+                    continue
+                if isinstance(v, ast.Call) and _is_ctor(
+                        summary, v.func, "PartitionSpec"):
+                    summary.spec_entries.append((
+                        path_key, _spec_elements(summary, v), v.lineno,
+                        qual, index.line_text(v.lineno)))
+
+        if not isinstance(node, ast.Call):
+            continue
+
+        # relevance flags: which rule families need this file at all
+        cname = call_name(node)
+        if not summary.uses_rng and (cname in RNG_SPENDING
+                                     or cname == "fold_in"):
+            if _rng_call_kind(summary, node) is not None:
+                summary.uses_rng = True
+        if cname in QUANT_SOURCES:
+            summary.uses_quant = True
+
+        # collectives
+        op = _is_lax_collective(summary, node)
+        if op is not None:
+            pos = COLLECTIVE_AXIS_POS[op]
+            axis_arg = None
+            if len(node.args) > pos:
+                axis_arg = node.args[pos]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        axis_arg = kw.value
+            if axis_arg is not None:
+                record_axis_use(op, axis_arg, node.lineno, True)
+
+        # pmap bindings at call position (g = jax.pmap(f, axis_name=...))
+        if call_name(node) == "pmap":
+            _record_pmap(summary, index, node, qual)
+
+        # Mesh / make_mesh axis_names
+        if _is_ctor(summary, node.func, "Mesh") or \
+                call_name(node) == "make_mesh":
+            axes_arg = node.args[1] if len(node.args) > 1 else None
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    axes_arg = kw.value
+            elems = _mesh_elements(summary, axes_arg)
+            if elems:
+                summary.mesh_defs.append((elems, node.lineno))
+                record_axis_use("Mesh", axes_arg, node.lineno, False)
+
+        # PartitionSpec constructions
+        if _is_ctor(summary, node.func, "PartitionSpec"):
+            elems = _spec_elements(summary, node)
+            summary.spec_sites.append(
+                (elems, node.lineno, qual, index.line_text(node.lineno)))
+            for arg in node.args:
+                if not isinstance(arg, ast.Starred):
+                    record_axis_use("PartitionSpec", arg, node.lineno,
+                                    False)
+
+        # generic call site bookkeeping for the graph
+        callee = expr_key(node.func)
+        if callee is not None and fn_summary is not None:
+            arg_keys, arg_lits, q_args = [], [], []
+            for i, arg in enumerate(node.args):
+                arg_keys.append(expr_key(arg))
+                val = literal(arg)
+                arg_lits.append(val if isinstance(val, str) else None)
+                if _expr_tainted(arg, quant_taint):
+                    q_args.append(i)
+            kw_keys, kw_lits, q_kws = [], [], []
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                kw_keys.append((kw.arg, expr_key(kw.value)))
+                val = literal(kw.value)
+                kw_lits.append(
+                    (kw.arg, val if isinstance(val, str) else None))
+                if _expr_tainted(kw.value, quant_taint):
+                    q_kws.append(kw.arg)
+            site = CallSite(
+                callee, node.lineno, qual, tuple(arg_keys),
+                tuple(arg_lits), tuple(kw_keys), tuple(kw_lits),
+                tuple(q_args), tuple(q_kws),
+                index.line_text(node.lineno))
+            calls.append(site)
+
+            # donation-through: param passed at a donated position of a
+            # local jitted callee
+            if fn_summary is not None:
+                jit = summary.jit_registry.get(call_name(node))
+                if jit is not None and (jit.donate_nums or jit.donate_names):
+                    for i, key in enumerate(site.arg_keys):
+                        if key in params and (
+                                i in jit.donate_nums
+                                or (i < len(jit.params)
+                                    and jit.params[i] in jit.donate_names)):
+                            fn_summary.donates_params.setdefault(
+                                key, (call_name(node), node.lineno))
+                    for kwname, key in site.kwarg_keys:
+                        if key in params and kwname in jit.donate_names:
+                            fn_summary.donates_params.setdefault(
+                                key, (call_name(node), node.lineno))
+
+            # RNG key params
+            if fn_summary is not None:
+                kind = _rng_call_kind(summary, node)
+                if kind is not None and kind[0] == "spend" and \
+                        kind[1] in params:
+                    fn_summary.key_params_used.add(kind[1])
+
+    if fn_summary is not None:
+        fn_summary.calls = tuple(calls)
+
+
+def _mesh_elements(summary, node):
+    """Axis-name elements of a Mesh(...) axis_names argument."""
+    if node is None:
+        return ()
+    if isinstance(node, ast.Name) and node.id in summary.tuple_constants:
+        return tuple(("lit", v) for v in summary.tuple_constants[node.id])
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            val = literal(elt)
+            if isinstance(val, str):
+                out.append(("lit", val))
+            else:
+                key = expr_key(elt)
+                out.append(("key", key) if key else ("?",))
+        return tuple(out)
+    val = literal(node)
+    if isinstance(val, str):
+        return (("lit", val),)
+    if isinstance(val, tuple) and all(isinstance(v, str) for v in val):
+        return tuple(("lit", v) for v in val)
+    return ()
+
+
+def _expr_tainted(node, taint):
+    """Is this expression an int8-tainted value, read WITHOUT an explicit
+    cast? Subscripts keep taint; astype()/asarray()/dequantize break it."""
+    if node is None or not taint:
+        return False
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    key = expr_key(node)
+    return key is not None and key in taint
+
+
+def _track_quant_assign(node, taint):
+    """Forward the int8 taint through simple assignments."""
+    value = node.value
+    tainted = False
+    if isinstance(value, ast.Call):
+        name = call_name(value)
+        if name in QUANT_SOURCES:
+            tainted = True
+        elif name in QUANT_CLEANSERS:
+            tainted = False
+        else:
+            tainted = False
+    elif _expr_tainted(value, taint):
+        tainted = True
+
+    for tgt in node.targets:
+        if isinstance(tgt, (ast.Tuple, ast.List)) and tgt.elts:
+            # (q, scale) = quantize_kv(...): the first element is int8
+            first = tgt.elts[0]
+            key = expr_key(first)
+            rest = [expr_key(t) for t in tgt.elts[1:]]
+            if key:
+                (taint.add if tainted else taint.discard)(key)
+            for r in rest:
+                if r:
+                    taint.discard(r)
+        else:
+            key = expr_key(tgt)
+            if key:
+                (taint.add if tainted else taint.discard)(key)
