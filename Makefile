@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving trace-smoke
+.PHONY: test test-fast test-faults test-cluster test-serving lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving trace-smoke bench-gate
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -71,3 +71,13 @@ bench-serving:
 # Benchmark on the real TPU chip (default platform).
 bench:
 	python bench.py
+
+# Perf-regression gate: run the CPU serving bench into a scratch file
+# (BENCH_SERVE_OUT keeps the committed baseline untouched), then diff it
+# against SERVING_BENCH_CPU.json under per-key tolerance bands
+# (tools/bench_gate.py). Nonzero exit on regression. Tune with
+# BENCH_GATE_SCALE (e.g. 2.0 on a loaded machine).
+bench-gate:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=serving \
+		BENCH_SERVE_OUT=/tmp/bench_gate_serving.json python bench.py --child
+	python -m tools.bench_gate compare /tmp/bench_gate_serving.json SERVING_BENCH_CPU.json

@@ -401,8 +401,12 @@ def serving_child_main():
         "complete": True,
     }
     suffix = "" if platform == "tpu" else f"_{platform.upper()}"
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       f"SERVING_BENCH{suffix}.json")
+    # BENCH_SERVE_OUT redirects the artifact (tools/bench_gate.py runs a
+    # fresh bench to a temp path and diffs it against the committed JSON —
+    # the committed baseline must not be clobbered by the comparison run)
+    out = os.environ.get("BENCH_SERVE_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"SERVING_BENCH{suffix}.json")
     previous = None
     if os.path.exists(out):
         try:

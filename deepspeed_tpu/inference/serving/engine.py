@@ -316,7 +316,7 @@ class ServingEngine:
 
     def __init__(self, params, model_config, serving_config=None,
                  monitor=None, injector=None, sentinel_config=None,
-                 telemetry_config=None):
+                 telemetry_config=None, rank=None):
         cfg = serving_config or ServingConfig()
         self.params = params
         self.model_config = model_config
@@ -435,17 +435,30 @@ class ServingEngine:
 
         # telemetry: an explicit block arms the process-global tracer and
         # registry; an absent block leaves them untouched. Hot-path guard
-        # is one attribute read (self._tracer.enabled).
-        telemetry.configure_from_config(telemetry_config)
+        # is one attribute read (self._tracer.enabled). rank/role become
+        # the trace's process identity (the fleet collector's merge key);
+        # rank=None falls back to the launcher-exported RANK env var.
+        telemetry.configure_from_config(telemetry_config, rank=rank,
+                                        role="serve")
         self._tracer = telemetry.get_tracer()
         self._trace_file = None
         self.telemetry_server = None
+        self.slo = None
         if telemetry_config is not None and telemetry_config.enabled:
             self._trace_file = telemetry_config.trace_file
             self.metrics.export_to(telemetry.get_registry())
-            if telemetry_config.http_port is not None:
+            # explicit http_port wins; a supervised worker with a null
+            # port inherits DSTPU_TELEMETRY_PORT so the fleet collector
+            # can scrape it without per-worker config edits
+            http_port = telemetry.resolve_http_port(telemetry_config)
+            if http_port is not None:
                 self.telemetry_server = self._build_telemetry_server(
-                    telemetry_config.http_port)
+                    http_port)
+            self.slo = telemetry.SloEngine.from_config(
+                telemetry_config, tracer=self._tracer,
+                registry=telemetry.get_registry())
+            if self.slo is not None and self.telemetry_server is not None:
+                self.slo.attach(self.telemetry_server)
 
     def _build_telemetry_server(self, port):
         srv = telemetry.TelemetryServer(
@@ -481,7 +494,8 @@ class ServingEngine:
                    monitor=monitor_from_config(ds_config, rank),
                    injector=injector,
                    sentinel_config=ds_config.sentinel_config,
-                   telemetry_config=ds_config.telemetry_config)
+                   telemetry_config=ds_config.telemetry_config,
+                   rank=rank)
 
     # -- request intake -------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=None, eos_token_id=None,
@@ -649,7 +663,24 @@ class ServingEngine:
                     active_slots=n_active, max_slots=self.pool.max_slots,
                     tokens_this_step=n_active, step_s=step_s)
         self._step_count += 1
+        if self.slo is not None:
+            # host-only snapshot + pushed gauges; under policy="fail" a
+            # firing rule raises SloViolationError out of step()
+            self.slo.evaluate(self._slo_values())
         return stats
+
+    def _slo_values(self):
+        """SLO inputs: the live serving snapshot under ``Serving/*`` plus
+        pushed registry metrics. Pull gauges are skipped — the snapshot is
+        already here, and re-polling every callback each step would double
+        the work for no fresher data."""
+        vals = {k: v
+                for k, v in telemetry.get_registry().as_dict(pulled=False).items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        for k, v in self.metrics.snapshot().items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                vals[f"Serving/{k}"] = v
+        return vals
 
     def _upload_lane_state(self):
         """Lane churn: ONE explicit upload of the lane vectors (and the

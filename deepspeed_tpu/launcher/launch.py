@@ -48,6 +48,17 @@ def parse_args():
                         help="Serve /healthz, /metrics, /snapshot and /trace from "
                              "the supervisor on this port (0 = ephemeral; omit to "
                              "disable)")
+    parser.add_argument("--worker_telemetry_port", default=None, type=int,
+                        help="Fixed port for the WORKER's telemetry endpoint "
+                             "(exported as DSTPU_TELEMETRY_PORT; survives "
+                             "restarts so the fleet collector can keep scraping)")
+    parser.add_argument("--collector_port", default=None, type=int,
+                        help="Run a FleetCollector next to the supervisor, "
+                             "serving /fleet/metrics, /fleet/trace and "
+                             "/fleet/snapshot on this port (0 = ephemeral). "
+                             "Scrapes the worker endpoint (requires "
+                             "--worker_telemetry_port) and merges the "
+                             "supervisor's own restart instants")
     parser.add_argument("training_script", type=str)
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return parser.parse_args()
@@ -110,9 +121,41 @@ def main():
         backoff_s=args.restart_backoff_s,
         heartbeat_timeout_s=args.heartbeat_timeout_s,
         http_port=args.telemetry_port,
+        worker_port=args.worker_telemetry_port,
         log=lambda msg: logger.warning(f"launch[{node_rank}]: {msg}"),
     )
-    sys.exit(supervisor.run())
+
+    collector = None
+    if args.collector_port is not None:
+        # stdlib-only import chain: the launcher process still never loads jax
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.telemetry import FleetCollector
+
+        collector = FleetCollector()
+        if supervisor.worker_endpoint is not None:
+            collector.add_endpoint(rank=node_rank,
+                                   url=supervisor.worker_endpoint)
+        else:
+            logger.warning(
+                "launch: --collector_port without --worker_telemetry_port: "
+                "the collector has no worker endpoint to scrape (serving "
+                "supervisor-side telemetry only)")
+        # arm the launcher-side tracer so supervisor lifecycle instants
+        # (worker/restart, worker/exit) are recorded, then merge them
+        # (and the liveness gauges) into the fleet view
+        telemetry.configure(True)
+        telemetry.get_tracer().set_process_info(rank=-1, role="supervisor")
+        supervisor.export_gauges(telemetry.get_registry())
+        collector.attach_local(telemetry.get_tracer(), telemetry.get_registry())
+        srv = collector.serve(port=args.collector_port)
+        logger.info(f"launch: fleet collector at {srv.url}/fleet/metrics")
+
+    try:
+        rc = supervisor.run()
+    finally:
+        if collector is not None:
+            collector.stop()
+    sys.exit(rc)
 
 
 if __name__ == "__main__":

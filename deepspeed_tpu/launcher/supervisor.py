@@ -53,6 +53,11 @@ EXIT_PREEMPTED = 99
 HEARTBEAT_FILE_ENV = "DSTPU_HEARTBEAT_FILE"
 PREEMPTION_ENV = "DSTPU_PREEMPTION"
 PREEMPT_SAVE_DIR_ENV = "DSTPU_PREEMPT_SAVE_DIR"
+# Worker-side telemetry endpoint port (duplicated in telemetry/config.py —
+# neither package may import the other eagerly): a worker whose telemetry
+# block leaves http_port null binds this port instead, so the fleet
+# collector knows where to scrape it.
+TELEMETRY_PORT_ENV = "DSTPU_TELEMETRY_PORT"
 
 # Exit classes (WorkerSupervisor.exit_history entries).
 CLASS_CLEAN = "clean"
@@ -85,7 +90,8 @@ class WorkerSupervisor:
     def __init__(self, cmd, env=None, max_restarts=0, backoff_s=1.0,
                  max_backoff_s=30.0, heartbeat_timeout_s=0.0,
                  heartbeat_file=None, poll_interval_s=0.05, term_grace_s=5.0,
-                 fatal_exit_codes=(EXIT_POISONED,), log=None, http_port=None):
+                 fatal_exit_codes=(EXIT_POISONED,), log=None, http_port=None,
+                 worker_port=None):
         self.cmd = list(cmd)
         self.env = dict(env if env is not None else os.environ)
         self.max_restarts = int(max_restarts)
@@ -105,6 +111,11 @@ class WorkerSupervisor:
             self.env[HEARTBEAT_FILE_ENV] = self.heartbeat_file
         # children auto-install the engine PreemptionHandler under a supervisor
         self.env.setdefault(PREEMPTION_ENV, "1")
+        # a fixed worker telemetry port makes the worker scrapable by the
+        # fleet collector across restarts (an ephemeral port would move)
+        self.worker_port = worker_port
+        if worker_port is not None:
+            self.env[TELEMETRY_PORT_ENV] = str(int(worker_port))
 
         self.child = None
         self.restarts = 0
@@ -253,12 +264,40 @@ class WorkerSupervisor:
                               port=int(self.http_port))
         srv.add_health_provider("worker", self._worker_health)
         srv.add_snapshot_provider("supervisor", self._snapshot)
-        telemetry.get_registry().gauge_fn(
-            "Supervisor/restarts", lambda: float(self.restarts),
-            help="worker restarts performed so far")
+        self.export_gauges(telemetry.get_registry())
         self.telemetry_server = srv.start()
         self._log(f"telemetry endpoint at {srv.url}")
         return srv
+
+    @property
+    def worker_endpoint(self):
+        """The worker's telemetry URL (for a fleet collector), or None
+        when no fixed ``worker_port`` was configured."""
+        if self.worker_port is None:
+            return None
+        return f"http://127.0.0.1:{int(self.worker_port)}"
+
+    def export_gauges(self, registry):
+        """Register the supervisor's liveness as pull ``gauge_fn``s: a
+        ``/fleet/metrics`` scrape sees restart counts, heartbeat age and
+        child liveness without parsing trace events. Idempotent
+        (re-registration overwrites), callable without a server too."""
+
+        def _liveness():
+            out = {"restarts": float(self.restarts),
+                   "worker_alive": float(
+                       self.child is not None and self.child.poll() is None)}
+            if self.heartbeat_file is not None and self._spawned_at > 0:
+                now = time.monotonic()
+                out["heartbeat_age_s"] = max(0.0, now - self._last_beat(now))
+            return out
+
+        # kept for dashboard compatibility with the PR 7 name
+        registry.gauge_fn("Supervisor/restarts", lambda: float(self.restarts),
+                          help="worker restarts performed so far")
+        registry.gauge_fn("Supervisor/worker", _liveness,
+                          help="supervised worker liveness")
+        return registry
 
     def _worker_health(self):
         alive = self.child is not None and self.child.poll() is None

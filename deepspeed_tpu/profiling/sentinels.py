@@ -99,6 +99,13 @@ class CompileSentinel:
                 "jax/recompile", cat="lifecycle",
                 args={"name": self.name, "compiles": compiles,
                       "budget": self.budget})
+            # registry counterpart of the instant: an SLO rule like
+            # {"metric": "Jax/recompiles_total", "max": N} turns the
+            # sentinel budget into a fleet-visible alert
+            telemetry.get_registry().counter(
+                "Jax/recompiles_total",
+                help="recompiles observed by CompileSentinel.check").inc(
+                compiles - self._last_seen)
             self._last_seen = compiles
         if compiles > self.budget:
             raise CompileBudgetExceededError(self.name, compiles, self.budget)

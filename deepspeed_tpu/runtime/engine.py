@@ -331,11 +331,43 @@ class DeepSpeedEngine:
         # scalar also lands on the introspection endpoint's /metrics
         from deepspeed_tpu import telemetry
 
-        telemetry.configure_from_config(self._config.telemetry_config)
+        telemetry.configure_from_config(self._config.telemetry_config,
+                                        rank=self.global_rank, role="train")
         self._tracer = telemetry.get_tracer()
         from deepspeed_tpu.monitor import monitor_from_config
 
         self.monitor = monitor_from_config(self._config, self.global_rank)
+
+        # telemetry endpoint + SLO engine (None unless the telemetry block
+        # enables them): the endpoint binds the explicit http_port or the
+        # supervisor-injected DSTPU_TELEMETRY_PORT so a supervised trainer
+        # is scrapable by the fleet collector; SLO rules (e.g. an mfu
+        # floor or a recompile budget) are checked once per train_batch
+        self.telemetry_server = None
+        self._slo = None
+        tel_cfg = self._config.telemetry_config
+        if tel_cfg is not None and tel_cfg.enabled:
+            http_port = telemetry.resolve_http_port(tel_cfg)
+            if http_port is not None:
+                srv = telemetry.TelemetryServer(
+                    registry=telemetry.get_registry(), tracer=self._tracer,
+                    port=http_port)
+                srv.add_health_provider(
+                    "train_loop",
+                    lambda: {"healthy": True, "steps": self.global_steps,
+                             "skipped": self.skipped_steps})
+                srv.add_snapshot_provider(
+                    "train",
+                    lambda: {"global_steps": self.global_steps,
+                             "global_samples": self.global_samples,
+                             "skipped_steps": self.skipped_steps})
+                self.telemetry_server = srv.start()
+            self._slo = telemetry.SloEngine.from_config(
+                tel_cfg, tracer=self._tracer,
+                registry=telemetry.get_registry())
+            if self._slo is not None and self.telemetry_server is not None:
+                self._slo.attach(self.telemetry_server)
+        self._slo_registry = telemetry.get_registry()
 
         # step-level resilience: divergence guard + watchdog + auto-rollback
         # recovery (None unless the config has a `resilience` block)
@@ -1537,12 +1569,20 @@ class DeepSpeedEngine:
         self._cluster.step_boundary()
         gas = self.gradient_accumulation_steps()
         if self.resilience is not None:
-            return self.resilience.train_batch(data_iter, self._train_batch_now, gas)
-        with (self._tracer.span("train/batch_fetch", cat="train",
-                                args={"step": self.global_steps, "gas": gas})
-              if self._tracer.enabled else _NULL_SPAN):
-            micro = [next(data_iter) for _ in range(gas)]
-        return self._train_batch_now(micro)
+            loss = self.resilience.train_batch(
+                data_iter, self._train_batch_now, gas)
+        else:
+            with (self._tracer.span("train/batch_fetch", cat="train",
+                                    args={"step": self.global_steps, "gas": gas})
+                  if self._tracer.enabled else _NULL_SPAN):
+                micro = [next(data_iter) for _ in range(gas)]
+            loss = self._train_batch_now(micro)
+        if self._slo is not None:
+            # pushed gauges only (Train/Samples/* via the MonitorBridge,
+            # Jax/recompiles_total from the sentinels) — host-only work;
+            # under policy="fail" a firing rule raises SloViolationError
+            self._slo.evaluate(self._slo_registry.as_dict(pulled=False))
+        return loss
 
     def _train_batch_now(self, micro):
         """One full optimizer step over already-fetched microbatches (the

@@ -221,7 +221,8 @@ class PipelineEngine:
         # below bridges Train/* scalars into the registry when armed
         from deepspeed_tpu import telemetry
 
-        telemetry.configure_from_config(self._config.telemetry_config)
+        telemetry.configure_from_config(self._config.telemetry_config,
+                                        rank=dist.get_rank(), role="train")
         self._tracer = telemetry.get_tracer()
         # per-stage wall time of the LAST interpreted step (seconds),
         # accumulated by _dispatch; exported as Train/Pipe/stage*_time_ms

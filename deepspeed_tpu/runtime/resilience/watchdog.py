@@ -24,6 +24,7 @@ Two subtleties make this safe:
 import queue
 import threading
 
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.runtime.resilience.errors import StepTimeoutError
 
 
@@ -46,6 +47,8 @@ def timed_call(fn, timeout_s, what="call"):
     try:
         kind, val = out.get(timeout=timeout_s)
     except queue.Empty:
+        telemetry.instant("resilience/watchdog_timeout", cat="resilience",
+                          args={"what": what, "timeout_s": timeout_s})
         raise StepTimeoutError(what=what, timeout_s=timeout_s, thread=t) from None
     if kind == "err":
         raise val
@@ -88,6 +91,9 @@ class TimedFetcher:
             kind, val = out.get(timeout=timeout_s)
         except queue.Empty:
             self._pending = out
+            telemetry.instant("resilience/watchdog_timeout", cat="resilience",
+                              args={"what": "data fetch",
+                                    "timeout_s": timeout_s})
             raise StepTimeoutError(what="data fetch", timeout_s=timeout_s) from None
         if kind == "err":
             raise val

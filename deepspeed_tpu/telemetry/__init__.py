@@ -15,6 +15,8 @@ touching the clock — nothing is recorded and nothing is allocated.
 Stdlib-only (no jax/numpy): importable from the launcher supervisor.
 """
 
+import os
+
 from deepspeed_tpu.telemetry.trace import NULL_SPAN, Tracer  # noqa: F401
 from deepspeed_tpu.telemetry.registry import (  # noqa: F401
     DEFAULT_BUCKETS,
@@ -24,9 +26,22 @@ from deepspeed_tpu.telemetry.registry import (  # noqa: F401
     prom_name,
 )
 from deepspeed_tpu.telemetry.server import TelemetryServer  # noqa: F401
+from deepspeed_tpu.telemetry.slo import (  # noqa: F401
+    SloEngine,
+    SloRule,
+    SloViolationError,
+    validate_slo_rule,
+)
+from deepspeed_tpu.telemetry.anomaly import (  # noqa: F401
+    STEP_SPAN_NAMES,
+    StragglerDetector,
+)
+from deepspeed_tpu.telemetry.collector import FleetCollector  # noqa: F401
 from deepspeed_tpu.telemetry.config import (  # noqa: F401
     DeepSpeedTelemetryConfig,
     TELEMETRY,
+    TELEMETRY_PORT_ENV,
+    resolve_http_port,
 )
 
 _tracer = Tracer(enabled=False)
@@ -57,14 +72,28 @@ def configure(enabled, trace_max_events=None):
     return _tracer, _registry
 
 
-def configure_from_config(telemetry_config):
+def configure_from_config(telemetry_config, rank=None, role=None):
     """Apply a :class:`DeepSpeedTelemetryConfig`. A config whose
     ``telemetry`` block was absent (``configured=False``) is a no-op —
-    only an explicit block changes global state."""
+    only an explicit block changes global state.
+
+    ``rank``/``role`` stamp process identity onto the trace (Chrome ``M``
+    metadata -> named Perfetto lanes, and the key the fleet collector
+    merges on). Callers that don't know their rank (scripts, serving
+    without a launcher) inherit it from the ``RANK`` env var the launcher
+    exports."""
     if telemetry_config is None or not telemetry_config.configured:
         return _tracer, _registry
     _tracer.configure(telemetry_config.enabled,
                       max_events=telemetry_config.trace_max_events)
+    if telemetry_config.enabled:
+        if rank is None:
+            env_rank = os.environ.get("RANK", "").strip()
+            try:
+                rank = int(env_rank) if env_rank else 0
+            except ValueError:
+                rank = 0
+        _tracer.set_process_info(rank=rank, role=role or "worker")
     return _tracer, _registry
 
 
@@ -73,4 +102,7 @@ __all__ = [
     "TelemetryServer", "DeepSpeedTelemetryConfig", "DEFAULT_BUCKETS",
     "HISTOGRAM_TAGS", "prom_name", "get_tracer", "get_registry", "span",
     "instant", "configure", "configure_from_config",
+    "FleetCollector", "StragglerDetector", "STEP_SPAN_NAMES",
+    "SloEngine", "SloRule", "SloViolationError", "validate_slo_rule",
+    "TELEMETRY_PORT_ENV", "resolve_http_port",
 ]

@@ -1,0 +1,174 @@
+"""Straggler and step-time-spike detection over per-rank step durations.
+
+Fed from the step spans the engines already emit
+(``train/fwd_bwd_opt_step``, ``serving/decode_step``,
+``pipe/compiled_step``), normally by the fleet collector as it merges
+scraped traces — the hot loops themselves never call into this module.
+
+Two complementary detectors, keyed per ``(span name, rank)`` so train and
+serve distributions never mix:
+
+- **Cross-rank straggler** (:meth:`StragglerDetector.update`): compares
+  each rank's rolling mean step time against the fleet. A rank is the
+  straggler when its mean exceeds the median of the other ranks by
+  ``skew_threshold``× (robust at any fleet size, including 2 workers,
+  where a z-score is degenerate — every rank sits exactly 1σ from the
+  mean) OR, with >= 3 ranks, when its z-score over the per-rank means
+  exceeds ``z_threshold``.
+- **Per-rank spike** (:meth:`StragglerDetector.observe`): a single step
+  ``spike_factor``× slower than that rank's own rolling median — a
+  transient stall (GC pause, preemption signal, page fault storm) rather
+  than a sustained skew. A rank that is *consistently* slow stops
+  spiking (its own median catches up) and shows up as the straggler
+  instead.
+
+Detected anomalies are drained by :meth:`update` as event dicts (the
+collector turns them into ``fleet/straggler`` / ``fleet/step_spike``
+instants on the merged timeline) and summarized as gauges
+(``Fleet/straggler_rank``, ``Fleet/step_time_skew``).
+
+Stdlib-only (see ``telemetry/trace.py``).
+"""
+
+import statistics
+import threading
+from collections import deque
+
+from deepspeed_tpu.telemetry.trace import PH_COMPLETE
+
+# Span names treated as "one step" for straggler accounting.
+STEP_SPAN_NAMES = frozenset({
+    "train/fwd_bwd_opt_step",
+    "train/forward_backward",
+    "serving/decode_step",
+    "pipe/compiled_step",
+})
+
+
+class StragglerDetector:
+    """Rolling per-(span, rank) step-duration stats with anomaly events."""
+
+    def __init__(self, window=64, min_samples=4, z_threshold=3.0,
+                 skew_threshold=2.0, spike_factor=8.0, min_spike_s=0.001,
+                 span_names=STEP_SPAN_NAMES):
+        self.window = int(window)
+        self.min_samples = int(min_samples)
+        self.z_threshold = float(z_threshold)
+        self.skew_threshold = float(skew_threshold)
+        self.spike_factor = float(spike_factor)
+        self.min_spike_s = float(min_spike_s)
+        # None accepts every span name (caller pre-filters)
+        self.span_names = (frozenset(span_names)
+                           if span_names is not None else None)
+        self._lock = threading.Lock()
+        self._durs = {}          # (span name, rank) -> deque of seconds
+        self._pending = []       # anomaly events awaiting update()
+        self.straggler_rank = -1
+        self.step_time_skew = 1.0
+        self.spikes_total = 0
+        self.stragglers_total = 0
+
+    # -- feeding --------------------------------------------------------
+    def observe(self, rank, name, dur_s):
+        """Record one step duration (seconds) for ``rank``."""
+        if self.span_names is not None and name not in self.span_names:
+            return
+        key = (name, int(rank))
+        dur_s = float(dur_s)
+        with self._lock:
+            d = self._durs.get(key)
+            if d is None:
+                d = self._durs[key] = deque(maxlen=self.window)
+            # spike test against the rank's OWN history, before appending
+            if len(d) >= self.min_samples:
+                med = statistics.median(d)
+                if med > 0 and dur_s > self.spike_factor * med \
+                        and dur_s > self.min_spike_s:
+                    self.spikes_total += 1
+                    self._pending.append(
+                        {"type": "step_spike", "rank": key[1], "span": name,
+                         "dur_s": dur_s, "median_s": med,
+                         "factor": dur_s / med})
+            d.append(dur_s)
+
+    def observe_events(self, rank, events):
+        """Feed Chrome trace event dicts (complete spans whose name is a
+        step span); returns how many were consumed."""
+        n = 0
+        for ev in events:
+            if ev.get("ph") != PH_COMPLETE:
+                continue
+            name = ev.get("name")
+            if self.span_names is not None and name not in self.span_names:
+                continue
+            self.observe(rank, name, float(ev.get("dur", 0.0)) / 1e6)
+            n += 1
+        return n
+
+    # -- detection ------------------------------------------------------
+    def update(self):
+        """Recompute cross-rank stats; returns (and drains) the pending
+        anomaly events. Straggler events are edge-triggered — emitted when
+        the straggler rank appears or changes, not every pass — while the
+        ``straggler_rank``/``step_time_skew`` gauges track continuously."""
+        with self._lock:
+            by_name = {}     # span name -> {rank: rolling mean}
+            for (name, rank), d in self._durs.items():
+                if len(d) >= self.min_samples:
+                    by_name.setdefault(name, {})[rank] = statistics.fmean(d)
+            worst = None     # (skew, rank, span name, z)
+            for name, means in by_name.items():
+                if len(means) < 2:
+                    continue
+                ranks = sorted(means, key=means.get)
+                slow, slow_mean = ranks[-1], means[ranks[-1]]
+                ref = statistics.median([means[r] for r in ranks[:-1]])
+                if ref > 0:
+                    skew = slow_mean / ref
+                elif slow_mean > 0:
+                    skew = float("inf")
+                else:
+                    skew = 1.0
+                z = 0.0
+                if len(means) >= 3:
+                    sd = statistics.pstdev(means.values())
+                    if sd > 0:
+                        z = (slow_mean - statistics.fmean(means.values())) / sd
+                if worst is None or skew > worst[0]:
+                    worst = (skew, slow, name, z)
+            prev = self.straggler_rank
+            if worst is None:
+                self.straggler_rank = -1
+                self.step_time_skew = 1.0
+            else:
+                skew, rank, name, z = worst
+                self.step_time_skew = skew
+                is_straggler = (skew >= self.skew_threshold
+                                or z >= self.z_threshold)
+                self.straggler_rank = rank if is_straggler else -1
+                if is_straggler and rank != prev:
+                    self.stragglers_total += 1
+                    self._pending.append(
+                        {"type": "straggler", "rank": rank, "span": name,
+                         "skew": skew, "z": z})
+            out, self._pending = self._pending, []
+            return out
+
+    def gauges(self):
+        """Flat summary for ``/fleet/metrics`` rollups."""
+        with self._lock:
+            return {
+                "straggler_rank": float(self.straggler_rank),
+                "step_time_skew": float(self.step_time_skew),
+                "step_spikes_total": float(self.spikes_total),
+                "stragglers_total": float(self.stragglers_total),
+            }
+
+    def reset(self):
+        with self._lock:
+            self._durs.clear()
+            self._pending.clear()
+            self.straggler_rank = -1
+            self.step_time_skew = 1.0
+            self.spikes_total = 0
+            self.stragglers_total = 0

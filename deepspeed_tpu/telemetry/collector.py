@@ -1,0 +1,374 @@
+"""Fleet collector: scrape per-worker telemetry, merge into one view.
+
+PR 7's telemetry is strictly per-process — each worker owns a tracer ring
+buffer, a registry and a ``/metrics`` endpoint. :class:`FleetCollector`
+is the fleet layer on top: it scrapes every worker's ``/registry`` (raw
+slash-tag metrics JSON), ``/snapshot`` and ``/trace`` endpoints and
+produces
+
+- **one merged Chrome trace**: every scraped event is rewritten onto
+  ``pid = rank`` (named lanes via ``process_name`` metadata, synthesized
+  when a worker didn't stamp its own) and rebased onto the collector's
+  wall-clock epoch using each trace's ``metadata.epoch_unix`` — so spans
+  recorded by processes with unrelated ``perf_counter`` epochs line up on
+  a single Perfetto timeline. Supervisor lifecycle instants
+  (``worker/restart``, ``resilience/*``) land in the same timeline via
+  :meth:`attach_local`.
+- **rank-labelled metrics + fleet rollups**: every numeric worker metric
+  becomes ``Fleet/rank<r>/<tag>``, plus ``Fleet/<tag>/min|max|mean``
+  across ranks, a liveness gauge per rank, and the straggler gauges
+  (``Fleet/straggler_rank`` — the lagging-rank index — and
+  ``Fleet/step_time_skew``) from :class:`StragglerDetector`, which is fed
+  the step spans found in each scraped trace.
+- **gap markers**: an unreachable worker degrades to a partial merge —
+  its lane gets a ``fleet/scrape_gap`` instant at the outage edge, its
+  ``Fleet/rank<r>/up`` gauge drops to 0, and everyone else's data still
+  merges.
+
+``serve()`` exposes it all on a :class:`TelemetryServer`:
+``/fleet/metrics`` (Prometheus text), ``/fleet/trace`` (merged Chrome
+JSON — load directly into Perfetto), ``/fleet/snapshot`` (per-rank
+status + snapshots + rollups), and ``/alerts`` when an
+:class:`~deepspeed_tpu.telemetry.slo.SloEngine` is attached (evaluated
+against the fleet rollups on every scrape).
+
+Scrapes default to ``drain=True`` so each worker event is merged (and
+counted by the straggler detector) exactly once; peeking scrapes
+(``drain=False``) skip the detector to avoid double counting.
+
+Stdlib-only (see ``telemetry/trace.py``): the launcher embeds this next
+to the supervisor without dragging jax into its process.
+"""
+
+import json
+import threading
+import time
+from collections import deque
+from urllib.request import urlopen
+
+from deepspeed_tpu.telemetry.anomaly import StragglerDetector
+from deepspeed_tpu.telemetry.registry import prom_name
+from deepspeed_tpu.telemetry.server import TelemetryServer
+from deepspeed_tpu.telemetry.trace import PH_INSTANT, PH_METADATA
+
+_DEFAULT_MAX_EVENTS = 262144
+
+# pid lane for events merged via attach_local (supervisor/launcher side)
+LOCAL_RANK = -1
+
+
+class FleetCollector:
+    """Scrapes worker telemetry endpoints; merges traces and metrics."""
+
+    def __init__(self, endpoints=None, timeout_s=2.0,
+                 max_events=_DEFAULT_MAX_EVENTS, detector=None, slo=None):
+        self.timeout_s = float(timeout_s)
+        self.detector = detector if detector is not None \
+            else StragglerDetector()
+        self.slo = slo
+        self._lock = threading.RLock()       # state (events/metrics/status)
+        self._scrape_lock = threading.Lock()  # serializes whole scrapes
+        self._endpoints = {}                 # rank -> {"url", "role"}
+        self._locals = []                    # (rank, role, tracer, registry)
+        self._events = deque(maxlen=int(max_events))
+        self._events_dropped = 0
+        self._seen_pids = set()              # ranks with process_name merged
+        self._rank_metrics = {}              # rank -> {tag: float}
+        self._rank_snapshots = {}            # rank -> /snapshot doc
+        self._status = {}                    # rank -> scrape status dict
+        self._epoch_unix = time.time()       # merged-timeline zero
+        self._server = None
+        self._thread = None
+        self._stop = threading.Event()
+        for ep in endpoints or ():
+            self.add_endpoint(**ep)
+
+    # -- wiring ---------------------------------------------------------
+    def add_endpoint(self, rank, url, role="worker"):
+        """Register one worker endpoint (e.g. from the supervisor's
+        ``worker_endpoint`` or an explicit ``host:port`` list)."""
+        url = str(url).rstrip("/")
+        if "://" not in url:
+            url = "http://" + url
+        with self._lock:
+            self._endpoints[int(rank)] = {"url": url, "role": str(role)}
+        return self
+
+    def attach_local(self, tracer, registry=None, rank=LOCAL_RANK,
+                     role="supervisor"):
+        """Merge an in-process tracer/registry (no HTTP hop) — how the
+        launcher's supervisor instants (``worker/restart`` etc.) join the
+        merged timeline."""
+        with self._lock:
+            self._locals.append((int(rank), str(role), tracer, registry))
+        return self
+
+    # -- scraping -------------------------------------------------------
+    def _fetch_json(self, url):
+        with urlopen(url, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def _now_rel_us(self):
+        return (time.time() - self._epoch_unix) * 1e6
+
+    def _append_event(self, ev):
+        if len(self._events) == self._events.maxlen:
+            self._events_dropped += 1
+        self._events.append(ev)
+
+    def scrape(self, drain=True):
+        """One scrape pass over every endpoint + attached local source.
+        Network failures degrade to a partial merge (gap marker + ``up=0``
+        for the dead rank). Returns a summary dict."""
+        with self._scrape_lock:
+            return self._scrape_locked(drain)
+
+    def _scrape_locked(self, drain):
+        summary = {"up": [], "down": [], "events_merged": 0}
+        with self._lock:
+            endpoints = sorted(self._endpoints.items())
+            locals_ = list(self._locals)
+        q = "1" if drain else "0"
+        for rank, ep in endpoints:
+            try:
+                reg = self._fetch_json(ep["url"] + "/registry")
+                snap = self._fetch_json(ep["url"] + "/snapshot")
+                trace = self._fetch_json(ep["url"] + f"/trace?drain={q}")
+            except Exception as e:  # URLError/timeout/bad JSON: rank is down
+                self._mark_down(rank, ep, e)
+                summary["down"].append(rank)
+                continue
+            n = self._merge_source(rank, ep["role"], reg, snap, trace,
+                                   drained=drain, url=ep["url"])
+            summary["up"].append(rank)
+            summary["events_merged"] += n
+        for rank, role, tracer, registry in locals_:
+            try:
+                trace = tracer.to_chrome_trace(drain=drain)
+                reg = registry.as_dict() if registry is not None else {}
+            except Exception:
+                continue
+            summary["events_merged"] += self._merge_source(
+                rank, role, reg, None, trace, drained=drain, url=None)
+        self._emit_anomalies()
+        if self.slo is not None:
+            self.slo.evaluate(self.fleet_metrics())
+        return summary
+
+    def _mark_down(self, rank, ep, err):
+        with self._lock:
+            st = self._status.setdefault(rank, {})
+            was_up = st.get("up")    # None on first contact: also an edge
+            st.update(up=False, url=ep["url"], role=ep["role"],
+                      error=str(err)[:200], gaps=st.get("gaps", 0) + 1,
+                      scrapes=st.get("scrapes", 0),
+                      last_scrape_unix=time.time())
+            if was_up is not True:
+                return
+            # outage edge: one gap marker on the dead rank's lane
+            self._append_event(
+                {"ph": PH_INSTANT, "name": "fleet/scrape_gap", "cat": "fleet",
+                 "ts": self._now_rel_us(), "pid": rank, "tid": 0, "s": "p",
+                 "args": {"rank": rank, "error": str(err)[:200]}})
+
+    def _merge_source(self, rank, role, reg, snap, trace_doc, drained, url):
+        events = trace_doc.get("traceEvents") or []
+        meta = trace_doc.get("metadata") or {}
+        src_epoch = meta.get("epoch_unix")
+        offset_us = ((src_epoch - self._epoch_unix) * 1e6
+                     if isinstance(src_epoch, (int, float))
+                     and not isinstance(src_epoch, bool) else 0.0)
+        with self._lock:
+            st = self._status.setdefault(rank, {})
+            st.update(up=True, url=url, role=role, error=None,
+                      gaps=st.get("gaps", 0),
+                      scrapes=st.get("scrapes", 0) + 1,
+                      last_scrape_unix=time.time())
+            self._rank_metrics[rank] = {
+                k: float(v) for k, v in reg.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)}
+            if snap is not None:
+                self._rank_snapshots[rank] = snap
+            have_meta = rank in self._seen_pids
+            n = 0
+            for ev in events:
+                ev = dict(ev)
+                ev["pid"] = rank
+                if ev.get("ph") == PH_METADATA:
+                    if have_meta:
+                        continue    # metadata re-renders on every scrape
+                    if ev.get("name") == "process_name":
+                        self._seen_pids.add(rank)
+                else:
+                    ev["ts"] = float(ev.get("ts", 0.0)) + offset_us
+                self._append_event(ev)
+                n += 1
+            if rank not in self._seen_pids:
+                # worker didn't stamp identity: synthesize the lane name
+                for mev in (
+                        {"ph": PH_METADATA, "name": "process_name",
+                         "cat": "__metadata", "ts": 0, "pid": rank, "tid": 0,
+                         "args": {"name": f"{role} rank{rank}",
+                                  "rank": rank, "role": role}},
+                        {"ph": PH_METADATA, "name": "process_sort_index",
+                         "cat": "__metadata", "ts": 0, "pid": rank, "tid": 0,
+                         "args": {"sort_index": max(rank, 0)}}):
+                    self._append_event(mev)
+                    n += 1
+                self._seen_pids.add(rank)
+        if drained:
+            # drained events are seen exactly once -> safe to count steps
+            self.detector.observe_events(rank, events)
+        return n
+
+    def _emit_anomalies(self):
+        for a in self.detector.update():
+            name = ("fleet/straggler" if a.get("type") == "straggler"
+                    else "fleet/step_spike")
+            with self._lock:
+                self._append_event(
+                    {"ph": PH_INSTANT, "name": name, "cat": "fleet",
+                     "ts": self._now_rel_us(), "pid": a.get("rank", LOCAL_RANK),
+                     "tid": 0, "s": "p", "args": a})
+
+    # -- aggregated views -----------------------------------------------
+    def fleet_metrics(self):
+        """Rank-labelled series + min/max/mean rollups + straggler and
+        liveness gauges, as a flat ``{tag: float}`` dict."""
+        with self._lock:
+            out = {}
+            per_tag = {}
+            for rank in sorted(self._rank_metrics):
+                for tag, v in self._rank_metrics[rank].items():
+                    out[f"Fleet/rank{rank}/{tag}"] = v
+                    per_tag.setdefault(tag, []).append(v)
+            for tag, vals in per_tag.items():
+                out[f"Fleet/{tag}/min"] = min(vals)
+                out[f"Fleet/{tag}/max"] = max(vals)
+                out[f"Fleet/{tag}/mean"] = sum(vals) / len(vals)
+            alive = 0
+            for rank in sorted(self._status):
+                st = self._status[rank]
+                up = 1.0 if st.get("up") else 0.0
+                alive += int(up)
+                out[f"Fleet/rank{rank}/up"] = up
+                out[f"Fleet/rank{rank}/scrape_gaps_total"] = \
+                    float(st.get("gaps", 0))
+            out["Fleet/alive_ranks"] = float(alive)
+            out["Fleet/ranks_total"] = float(len(self._status))
+        for k, v in self.detector.gauges().items():
+            out[f"Fleet/{k}"] = v
+        return out
+
+    def render_prometheus(self):
+        """``/fleet/metrics`` body (text exposition 0.0.4)."""
+        lines = []
+        for tag, v in self.fleet_metrics().items():
+            pname = prom_name(tag)
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {v}")
+        return "\n".join(lines) + "\n"
+
+    def merged_trace(self):
+        """The accumulated multi-process Chrome trace document."""
+        with self._lock:
+            meta = {"epoch_unix": self._epoch_unix,
+                    "ranks": sorted(self._status),
+                    "straggler_rank": self.detector.straggler_rank}
+            if self._events_dropped:
+                meta["dropped_events"] = self._events_dropped
+            return {"traceEvents": list(self._events),
+                    "displayTimeUnit": "ms",
+                    "metadata": meta}
+
+    def fleet_snapshot(self):
+        """``/fleet/snapshot`` body: per-rank status + latest snapshots,
+        plus the straggler summary."""
+        with self._lock:
+            ranks = {str(r): {"status": dict(self._status.get(r, {})),
+                              "snapshot": self._rank_snapshots.get(r)}
+                     for r in sorted(set(self._status)
+                                     | set(self._rank_snapshots))}
+            buffered = len(self._events)
+        doc = {"ranks": ranks,
+               "straggler": self.detector.gauges(),
+               "events_buffered": buffered}
+        if self.slo is not None:
+            doc["alerts"] = self.slo.alerts_doc()[1]
+        return doc
+
+    def write_merged_trace(self, path):
+        with open(path, "w") as f:
+            json.dump(self.merged_trace(), f)
+        return path
+
+    # -- serving + background scraping ----------------------------------
+    def serve(self, port=0, host="127.0.0.1", scrape_on_request=True):
+        """Expose ``/fleet/metrics``, ``/fleet/trace``, ``/fleet/snapshot``
+        (and ``/alerts`` when an SLO engine is attached) on a background
+        :class:`TelemetryServer`. With ``scrape_on_request`` every request
+        triggers a fresh scrape first — no background thread needed for
+        on-demand use; combine with :meth:`start` for a fixed cadence."""
+        srv = TelemetryServer(host=host, port=port)
+
+        def _maybe_scrape():
+            if scrape_on_request:
+                self.scrape()
+
+        def _metrics():
+            _maybe_scrape()
+            return self.render_prometheus()
+
+        def _trace():
+            _maybe_scrape()
+            return self.merged_trace()
+
+        def _snapshot():
+            _maybe_scrape()
+            return self.fleet_snapshot()
+
+        srv.add_text_route("/fleet/metrics", _metrics,
+                           "text/plain; version=0.0.4; charset=utf-8")
+        srv.add_json_route("/fleet/trace", _trace)
+        srv.add_json_route("/fleet/snapshot", _snapshot)
+        srv.add_health_provider(
+            "collector",
+            lambda: {"healthy": True,
+                     "endpoints": len(self._endpoints),
+                     "ranks_seen": len(self._status)})
+        if self.slo is not None:
+            self.slo.attach(srv)
+        self._server = srv.start()
+        return srv
+
+    @property
+    def server(self):
+        return self._server
+
+    def start(self, interval_s=5.0):
+        """Scrape on a fixed cadence from a daemon thread."""
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def _loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.scrape()
+                except Exception:
+                    pass    # a failed pass must not kill the cadence
+
+        self._thread = threading.Thread(
+            target=_loop, name="fleet-collector", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Stop the scrape cadence and the server (if any)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None

@@ -9,12 +9,19 @@ Config::
     "telemetry": {"enabled": true,
                   "trace_max_events": 65536,   # ring-buffer bound
                   "http_port": 0,              # null: no server; 0: ephemeral
-                  "trace_file": "trace.json"}  # written on engine close (optional)
+                  "trace_file": "trace.json",  # written on engine close (optional)
+                  "slo": [                     # declarative SLO rules (telemetry/slo.py)
+                      {"metric": "Serving/ttft_p95_s", "max": 0.5, "for_s": 30}],
+                  "slo_policy": "warn"}        # or "fail": raise SloViolationError
 
 Kept free of ``runtime/`` imports so the telemetry package stays
 importable without the training stack (the stdlib-only supervisor
 serves /healthz too).
 """
+
+import os
+
+from deepspeed_tpu.telemetry.slo import SLO_POLICIES, validate_slo_rule
 
 TELEMETRY = "telemetry"
 
@@ -31,6 +38,31 @@ TELEMETRY_HTTP_PORT_DEFAULT = None
 
 TELEMETRY_TRACE_FILE = "trace_file"
 TELEMETRY_TRACE_FILE_DEFAULT = None
+
+TELEMETRY_SLO = "slo"
+TELEMETRY_SLO_POLICY = "slo_policy"
+TELEMETRY_SLO_POLICY_DEFAULT = "warn"
+
+# Supervisor -> worker port contract: the launcher's WorkerSupervisor
+# exports this env var so a worker whose config leaves http_port null
+# still binds the port the fleet collector was told to scrape. Duplicated
+# (not imported) in launcher/supervisor.py: the telemetry package must
+# not import the launcher and vice versa stays lazy.
+TELEMETRY_PORT_ENV = "DSTPU_TELEMETRY_PORT"
+
+
+def resolve_http_port(telemetry_config):
+    """Effective telemetry HTTP port: an explicit ``http_port`` wins, else
+    the supervisor-injected ``DSTPU_TELEMETRY_PORT``, else None (no server)."""
+    if telemetry_config is not None and telemetry_config.http_port is not None:
+        return telemetry_config.http_port
+    raw = os.environ.get(TELEMETRY_PORT_ENV, "").strip()
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            return None
+    return None
 
 
 class DeepSpeedTelemetryConfig:
@@ -72,6 +104,20 @@ class DeepSpeedTelemetryConfig:
             raise ValueError(
                 f"'{TELEMETRY}.{TELEMETRY_TRACE_FILE}' must be null or a "
                 f"string path, got {self.trace_file!r}")
+        raw_slo = tel_dict.get(TELEMETRY_SLO, [])
+        if not isinstance(raw_slo, (list, tuple)):
+            raise ValueError(
+                f"'{TELEMETRY}.{TELEMETRY_SLO}' must be a list of rule "
+                f"dicts, got {raw_slo!r}")
+        self.slo_rules = [
+            validate_slo_rule(r, where=f"{TELEMETRY}.{TELEMETRY_SLO}[{i}]")
+            for i, r in enumerate(raw_slo)]
+        self.slo_policy = tel_dict.get(TELEMETRY_SLO_POLICY,
+                                       TELEMETRY_SLO_POLICY_DEFAULT)
+        if self.slo_policy not in SLO_POLICIES:
+            raise ValueError(
+                f"'{TELEMETRY}.{TELEMETRY_SLO_POLICY}' must be one of "
+                f"{SLO_POLICIES}, got {self.slo_policy!r}")
 
     def repr(self):
         return self.__dict__

@@ -200,8 +200,11 @@ class MetricsRegistry:
             lines.append(f"{pname} {value}")
         return "\n".join(lines) + "\n"
 
-    def as_dict(self):
-        """JSON-friendly snapshot of everything (raw slash names)."""
+    def as_dict(self, pulled=True):
+        """JSON-friendly snapshot of everything (raw slash names).
+        ``pulled=False`` skips the callback gauges — for callers that
+        evaluate every step and already hold the live values (the serving
+        engine's SLO pass)."""
         with self._lock:
             metrics = list(self._metrics.values())
         out = {}
@@ -211,8 +214,9 @@ class MetricsRegistry:
                                "buckets": dict(m.cumulative())}
             else:
                 out[m.name] = m.value
-        for name, _help, value in self._pulled():
-            out[name] = value
+        if pulled:
+            for name, _help, value in self._pulled():
+                out[name] = value
         return out
 
 

@@ -20,6 +20,14 @@ Routes:
 ``/trace``
     Drains the tracer ring buffer as Chrome trace JSON (load the response
     body straight into Perfetto). ``?drain=0`` peeks without draining.
+``/registry``
+    The registry as raw slash-tag JSON (``MetricsRegistry.as_dict``) —
+    what the fleet collector scrapes, since Prometheus-text sanitization
+    would destroy the ``Train/*``/``Serving/*`` tag structure.
+
+Custom routes can be added with :meth:`add_json_route` /
+:meth:`add_text_route` (the SLO engine's ``/alerts``, the collector's
+``/fleet/*`` family).
 
 The server runs on a daemon thread (``ThreadingHTTPServer``), binds
 127.0.0.1 by default, and ``port=0`` picks an ephemeral port (tests).
@@ -45,8 +53,23 @@ class TelemetryServer:
         self._thread = None
         self._snapshot_providers = {}
         self._health_providers = {}
+        self._json_routes = {}
+        self._text_routes = {}
 
     # -- wiring ---------------------------------------------------------
+    def add_json_route(self, path, fn):
+        """Serve ``fn()`` as JSON at ``path``. ``fn`` may return either a
+        document (sent with 200) or a ``(status, document)`` pair — the
+        latter gives routes ``/healthz``-style status semantics (the SLO
+        engine's ``/alerts`` answers 503 while any rule is firing)."""
+        self._json_routes[path.rstrip("/") or "/"] = fn
+        return self
+
+    def add_text_route(self, path, fn,
+                       content_type="text/plain; charset=utf-8"):
+        """Serve ``fn()`` (a string, or ``(status, string)``) at ``path``."""
+        self._text_routes[path.rstrip("/") or "/"] = (fn, content_type)
+        return self
     def add_snapshot_provider(self, name, fn):
         """``fn()`` → JSON-serializable value, merged into ``/snapshot``
         under ``name``. A raising provider reports its error string."""
@@ -120,10 +143,28 @@ class TelemetryServer:
                        if self.tracer is not None
                        else {"traceEvents": []})
                 self._send_json(handler, 200, doc)
+            elif route == "/registry":
+                # raw slash-tag JSON view of the registry: what the fleet
+                # collector scrapes (parsing Prometheus text would lose
+                # the Train/*, Serving/* tag structure to sanitization)
+                doc = (self.registry.as_dict()
+                       if self.registry is not None else {})
+                self._send_json(handler, 200, doc)
+            elif route in self._json_routes:
+                res = self._json_routes[route]()
+                status, doc = res if isinstance(res, tuple) else (200, res)
+                self._send_json(handler, status, doc)
+            elif route in self._text_routes:
+                fn, ctype = self._text_routes[route]
+                res = fn()
+                status, body = res if isinstance(res, tuple) else (200, res)
+                self._send(handler, status, body, ctype)
             else:
+                routes = ["/metrics", "/healthz", "/snapshot", "/trace",
+                          "/registry"]
+                routes += sorted(set(self._json_routes) | set(self._text_routes))
                 self._send_json(handler, 404, {"error": f"no route {route}",
-                                               "routes": ["/metrics", "/healthz",
-                                                          "/snapshot", "/trace"]})
+                                               "routes": routes})
         except Exception as e:   # a broken provider must not kill the thread
             self._send_json(handler, 500, {"error": repr(e)})
 

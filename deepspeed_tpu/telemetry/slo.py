@@ -1,0 +1,236 @@
+"""Declarative SLO engine evaluated over registry/snapshot values.
+
+Rules live in the ``telemetry`` ds_config block::
+
+    "telemetry": {"enabled": true,
+                  "slo": [{"metric": "Serving/ttft_p95_s", "max": 0.5, "for_s": 30},
+                          {"metric": "Serving/accept_rate", "min": 0.3},
+                          {"metric": "Train/Samples/mfu",   "min": 0.2, "for_s": 60},
+                          {"metric": "Jax/recompiles_total", "max": 8}],
+                  "slo_policy": "warn"}      # or "fail"
+
+Evaluation is pull-based and cheap: the caller hands :meth:`SloEngine.evaluate`
+a flat ``{tag: value}`` mapping (a registry ``as_dict()``, a serving
+snapshot prefixed with ``Serving/``, or the collector's fleet rollups) —
+no rule ever runs inside a jit'd region or forces a device sync. A breach
+must persist ``for_s`` seconds before the rule *fires* (hysteresis against
+single-step blips); recovery resets both the clock and the firing state.
+
+Firing emits one ``slo/alert`` instant into the trace timeline and bumps
+``Slo/alerts_total``. Under ``policy="fail"`` it also raises
+:class:`SloViolationError`, so a worker process dies nonzero and the
+supervisor's exit-code contract (restart/quarantine) takes over; the
+default ``"warn"`` only logs/exposes. ``/alerts`` (attach via
+:meth:`SloEngine.attach`) mirrors ``/healthz``: HTTP 200 while quiet,
+503 while any rule is firing, per-rule detail either way.
+
+Metric lookup resolves aliases so rules read naturally: ``Serving/<k>``
+also matches the pull-gauge name ``Serving/Snapshot/<k>``, and at the
+fleet level a rule matches its worst-case rollup (``Fleet/<metric>/max``
+for ceilings, ``Fleet/<metric>/min`` for floors).
+
+Stdlib-only (see ``telemetry/trace.py``).
+"""
+
+import threading
+import time
+
+SLO_POLICIES = ("warn", "fail")
+
+_RULE_KEYS = frozenset({"metric", "min", "max", "for_s"})
+
+
+def validate_slo_rule(raw, where="telemetry.slo"):
+    """Validate one raw rule dict; returns a normalized copy. The single
+    source of truth — ``DeepSpeedTelemetryConfig`` calls this too."""
+    if not isinstance(raw, dict):
+        raise ValueError(f"{where}: each rule must be a dict, got {raw!r}")
+    unknown = set(raw) - _RULE_KEYS
+    if unknown:
+        raise ValueError(
+            f"{where}: unknown rule key(s) {sorted(unknown)} in {raw!r} "
+            f"(allowed: {sorted(_RULE_KEYS)})")
+    metric = raw.get("metric")
+    if not isinstance(metric, str) or not metric:
+        raise ValueError(f"{where}: 'metric' must be a non-empty string, "
+                         f"got {metric!r}")
+    out = {"metric": metric, "min": None, "max": None, "for_s": 0.0}
+    for bound in ("min", "max"):
+        v = raw.get(bound)
+        if v is None:
+            continue
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise ValueError(f"{where}: '{bound}' must be a number, got {v!r}")
+        out[bound] = float(v)
+    if out["min"] is None and out["max"] is None:
+        raise ValueError(f"{where}: rule for '{metric}' needs 'min' and/or "
+                         f"'max'")
+    for_s = raw.get("for_s", 0.0)
+    if isinstance(for_s, bool) or not isinstance(for_s, (int, float)) \
+            or for_s < 0:
+        raise ValueError(f"{where}: 'for_s' must be a number >= 0, "
+                         f"got {for_s!r}")
+    out["for_s"] = float(for_s)
+    return out
+
+
+class SloViolationError(RuntimeError):
+    """Raised by ``policy="fail"`` when a rule fires."""
+
+    def __init__(self, metric, value, bound_kind, bound, for_s):
+        self.metric = metric
+        self.value = value
+        self.bound_kind = bound_kind
+        self.bound = bound
+        self.for_s = for_s
+        super().__init__(
+            f"SLO violated: {metric}={value:.6g} breached "
+            f"{bound_kind}={bound:.6g} (sustained >= {for_s:.6g}s)")
+
+
+class SloRule:
+    """One validated rule: a metric with a floor and/or ceiling and a
+    persistence requirement."""
+
+    __slots__ = ("metric", "min", "max", "for_s")
+
+    def __init__(self, metric, min=None, max=None, for_s=0.0):
+        norm = validate_slo_rule(
+            {"metric": metric, "min": min, "max": max, "for_s": for_s})
+        self.metric = norm["metric"]
+        self.min = norm["min"]
+        self.max = norm["max"]
+        self.for_s = norm["for_s"]
+
+    def breached(self, value):
+        return (self.max is not None and value > self.max) or \
+               (self.min is not None and value < self.min)
+
+    def as_dict(self):
+        return {"metric": self.metric, "min": self.min, "max": self.max,
+                "for_s": self.for_s}
+
+
+class SloEngine:
+    """Evaluates rules against value snapshots with ``for_s`` hysteresis."""
+
+    def __init__(self, rules, policy="warn", tracer=None, registry=None,
+                 clock=time.monotonic):
+        if policy not in SLO_POLICIES:
+            raise ValueError(f"slo_policy must be one of {SLO_POLICIES}, "
+                             f"got {policy!r}")
+        self.rules = [r if isinstance(r, SloRule)
+                      else SloRule(**validate_slo_rule(r)) for r in rules]
+        self.policy = policy
+        self._tracer = tracer
+        self._registry = registry
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = [{"breach_since": None, "firing": False,
+                        "fired_count": 0, "last_value": None}
+                       for _ in self.rules]
+
+    @classmethod
+    def from_config(cls, telemetry_config, tracer=None, registry=None,
+                    clock=time.monotonic):
+        """Build from a :class:`DeepSpeedTelemetryConfig`; None when the
+        block declares no rules."""
+        if telemetry_config is None or not telemetry_config.slo_rules:
+            return None
+        return cls(telemetry_config.slo_rules,
+                   policy=telemetry_config.slo_policy,
+                   tracer=tracer, registry=registry, clock=clock)
+
+    # -- evaluation -----------------------------------------------------
+    @staticmethod
+    def _lookup(values, rule):
+        """Resolve a rule's metric against a value mapping via aliases
+        (docstring above). Non-numeric / absent → None (rule is skipped
+        and its breach clock resets: missing data is not a breach)."""
+        candidates = [rule.metric]
+        if rule.metric.startswith("Serving/"):
+            candidates.append("Serving/Snapshot/" + rule.metric[len("Serving/"):])
+        worst = "max" if rule.max is not None else "min"
+        candidates += [f"Fleet/{c}/{worst}" for c in list(candidates)]
+        for c in candidates:
+            v = values.get(c)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                return float(v)
+        return None
+
+    def evaluate(self, values, now=None):
+        """One evaluation pass. Returns the rules that NEWLY fired this
+        pass (already-firing rules are not re-reported); raises
+        :class:`SloViolationError` for the first of them under
+        ``policy="fail"``."""
+        if now is None:
+            now = self._clock()
+        newly = []
+        with self._lock:
+            for rule, st in zip(self.rules, self._state):
+                v = self._lookup(values, rule)
+                st["last_value"] = v
+                if v is None or not rule.breached(v):
+                    st["breach_since"] = None
+                    st["firing"] = False
+                    continue
+                if st["breach_since"] is None:
+                    st["breach_since"] = now
+                if not st["firing"] and now - st["breach_since"] >= rule.for_s:
+                    st["firing"] = True
+                    st["fired_count"] += 1
+                    newly.append((rule, v))
+            firing_now = sum(1 for st in self._state if st["firing"])
+        # instants/counters outside the lock: tracer/registry have their own
+        for rule, v in newly:
+            if self._tracer is not None:
+                self._tracer.instant(
+                    "slo/alert", cat="slo",
+                    args={"metric": rule.metric, "value": v,
+                          "min": rule.min, "max": rule.max,
+                          "for_s": rule.for_s})
+            if self._registry is not None:
+                self._registry.counter(
+                    "Slo/alerts_total",
+                    help="SLO rule firing transitions").inc()
+        if self._registry is not None:
+            self._registry.gauge(
+                "Slo/firing", help="SLO rules currently firing").set(
+                float(firing_now))
+        if newly and self.policy == "fail":
+            rule, v = newly[0]
+            kind, bound = (("max", rule.max) if rule.max is not None
+                           and v > rule.max else ("min", rule.min))
+            raise SloViolationError(rule.metric, v, kind, bound, rule.for_s)
+        return [rule for rule, _ in newly]
+
+    # -- exposition -----------------------------------------------------
+    def firing(self):
+        """Rules currently firing, as dicts."""
+        with self._lock:
+            return [rule.as_dict()
+                    for rule, st in zip(self.rules, self._state)
+                    if st["firing"]]
+
+    def alerts_doc(self):
+        """``(status, doc)`` for ``/alerts``: 503 while anything fires."""
+        now = self._clock()
+        rules = []
+        firing = 0
+        with self._lock:
+            for rule, st in zip(self.rules, self._state):
+                firing += bool(st["firing"])
+                entry = dict(rule.as_dict(), firing=bool(st["firing"]),
+                             fired_count=st["fired_count"],
+                             last_value=st["last_value"])
+                if st["breach_since"] is not None:
+                    entry["breach_for_s"] = max(0.0, now - st["breach_since"])
+                rules.append(entry)
+        doc = {"status": "alerting" if firing else "ok",
+               "firing": firing, "policy": self.policy, "rules": rules}
+        return (503 if firing else 200), doc
+
+    def attach(self, server):
+        """Register ``/alerts`` on a :class:`TelemetryServer`."""
+        server.add_json_route("/alerts", self.alerts_doc)
+        return server

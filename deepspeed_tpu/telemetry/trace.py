@@ -35,6 +35,7 @@ from collections import deque
 
 PH_COMPLETE = "X"
 PH_INSTANT = "i"
+PH_METADATA = "M"
 
 _DEFAULT_MAX_EVENTS = 65536
 
@@ -86,7 +87,13 @@ class Tracer:
         self.enabled = bool(enabled)
         self._events = deque(maxlen=int(max_events))
         self._dropped = 0
+        # Two clocks sampled back-to-back: ts values are rendered relative
+        # to the perf_counter epoch (monotonic, sub-us), while epoch_unix
+        # pins that epoch to wall-clock time so a fleet collector can
+        # rebase traces from different processes onto one timeline.
         self._epoch = time.perf_counter()
+        self.epoch_unix = time.time()
+        self._process_info = None
         self._lock = threading.Lock()   # drain/render only; appends rely on GIL
 
     # -- configuration --------------------------------------------------
@@ -103,6 +110,29 @@ class Tracer:
     @property
     def max_events(self):
         return self._events.maxlen
+
+    def set_process_info(self, rank=None, role=None, label=None,
+                         sort_index=None):
+        """Stamp process identity onto the trace. Rendered as Chrome ``M``
+        (metadata) records — ``process_name``/``process_sort_index`` — so a
+        single-process trace opens in Perfetto with a named lane and a
+        multi-rank merge needs no guesswork. ``None`` fields leave any
+        previously-set value alone; repeated calls merge."""
+        info = dict(self._process_info or {})
+        if rank is not None:
+            info["rank"] = int(rank)
+        if role is not None:
+            info["role"] = str(role)
+        if label is not None:
+            info["label"] = str(label)
+        if sort_index is not None:
+            info["sort_index"] = int(sort_index)
+        self._process_info = info or None
+        return self
+
+    @property
+    def process_info(self):
+        return dict(self._process_info) if self._process_info else None
 
     # -- hot path -------------------------------------------------------
     def span(self, name, cat="train", args=None):
@@ -152,7 +182,7 @@ class Tracer:
             else:
                 recs = list(self._events)
         pid = os.getpid()
-        out = []
+        out = self._metadata_events(pid)
         for ph, name, cat, t0, dur, tid, args in recs:
             ev = {
                 "ph": ph,
@@ -171,13 +201,46 @@ class Tracer:
             out.append(ev)
         return out
 
+    def _metadata_events(self, pid):
+        """Chrome ``M`` records for process identity (empty when unset).
+        Synthesized at render time so they survive ``drain=True`` and ring
+        overflow; ``ts``/``tid`` are zero by Chrome convention but present
+        so every emitted event carries the same required keys."""
+        info = self._process_info
+        if not info:
+            return []
+        rank = info.get("rank")
+        role = info.get("role")
+        label = info.get("label")
+        if label is None:
+            parts = ([str(role)] if role is not None else []) \
+                + ([f"rank{rank}"] if rank is not None else [])
+            label = " ".join(parts) or f"pid{pid}"
+        sort_index = info.get(
+            "sort_index", rank if isinstance(rank, int) and rank >= 0 else 0)
+        name_args = {"name": label, "os_pid": pid}
+        if rank is not None:
+            name_args["rank"] = rank
+        if role is not None:
+            name_args["role"] = role
+        return [
+            {"ph": PH_METADATA, "name": "process_name", "cat": "__metadata",
+             "ts": 0, "pid": pid, "tid": 0, "args": name_args},
+            {"ph": PH_METADATA, "name": "process_sort_index",
+             "cat": "__metadata", "ts": 0, "pid": pid, "tid": 0,
+             "args": {"sort_index": sort_index}},
+        ]
+
     def to_chrome_trace(self, drain=False):
         """The full JSON-object trace form Perfetto/chrome://tracing load."""
-        doc = {"traceEvents": self.events(drain=drain),
-               "displayTimeUnit": "ms"}
+        meta = {"epoch_unix": self.epoch_unix}
+        if self._process_info:
+            meta.update(self._process_info)
         if self._dropped:
-            doc["metadata"] = {"dropped_events": self._dropped}
-        return doc
+            meta["dropped_events"] = self._dropped
+        return {"traceEvents": self.events(drain=drain),
+                "displayTimeUnit": "ms",
+                "metadata": meta}
 
     def write(self, path, drain=False):
         doc = self.to_chrome_trace(drain=drain)

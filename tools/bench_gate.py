@@ -1,0 +1,283 @@
+#!/usr/bin/env python3
+"""Perf-regression gate over bench.py JSON artifacts.
+
+Two modes:
+
+``--check-schema [files...]``
+    Validate that bench artifacts are structurally sound (required keys,
+    numeric types, ``complete: true``). Defaults to the committed
+    baselines (``SERVING_BENCH_CPU.json`` + ``BENCH_r05.json``). This is
+    the CI step: it needs no jax and takes milliseconds.
+
+``compare FRESH BASELINE``
+    Diff a fresh bench run against a committed baseline under per-key
+    tolerance bands and exit nonzero on regression. Run locally via
+    ``make bench-gate`` (which produces FRESH with ``BENCH_SERVE_OUT`` so
+    the committed artifact is never clobbered).
+
+Artifact kinds are auto-detected: a dict with a ``parsed`` key is a
+driver wrapper (``BENCH_r05.json``) and is unwrapped; ``tokens_per_sec``
+marks a serving artifact; ``metric`` marks a train artifact. Contexts
+must match before numbers are compared — platform, model and workload
+knobs for serving; the metric string for train — otherwise the compare
+is skipped with exit 0 (a CPU artifact is not a regression signal for a
+TPU baseline) unless ``--require-comparable`` makes that an error.
+
+Tolerances are deliberately generous: bench.py numbers on a shared CPU
+runner are noisy, and the gate's job is catching real regressions (a
+2x TTFT blowup, halved decode throughput), not 5% jitter. Override
+per key with ``--tolerance key=frac`` or scale all bands with
+``--tolerance-scale`` / ``BENCH_GATE_SCALE``.
+
+Exit codes: 0 ok / skipped-not-comparable, 1 regression or schema
+violation, 2 usage / unreadable input.
+
+Stdlib-only: importable and runnable anywhere the repo checks out.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_ARTIFACTS = ("SERVING_BENCH_CPU.json", "BENCH_r05.json")
+
+# -- tolerance profiles -------------------------------------------------
+# key -> (direction, rel_tol). direction "higher" means bigger is better:
+# fail when fresh < baseline * (1 - tol). direction "lower" means smaller
+# is better: fail when fresh > baseline * (1 + tol).
+SERVING_TOLERANCES = {
+    "tokens_per_sec":                ("higher", 0.50),
+    "decode_tokens_per_sec":         ("higher", 0.50),
+    "decode_tokens_per_sec_spec_off": ("higher", 0.50),
+    "prefill_tokens_per_sec":        ("higher", 0.50),
+    "accept_rate":                   ("higher", 0.30),
+    "tokens_per_step":               ("higher", 0.30),
+    "prefix_hit_rate":               ("higher", 0.30),
+    "avg_ttft_s":                    ("lower", 2.00),
+    "ttft_p50_s":                    ("lower", 3.00),
+    "ttft_p95_s":                    ("lower", 3.00),
+    "max_ttft_s":                    ("lower", 4.00),
+}
+
+TRAIN_TOLERANCES = {
+    "value":           ("higher", 0.25),
+    "tflops_per_chip": ("higher", 0.25),
+    "mfu":             ("higher", 0.25),
+    "vs_baseline":     ("higher", 0.25),
+    "step_ms":         ("lower", 0.35),
+}
+
+# context keys that must match exactly for numbers to be comparable
+SERVING_CONTEXT = ("platform", "model", "requests", "max_slots",
+                   "max_new_tokens", "speculative_k", "kv_cache_dtype",
+                   "prefill_chunk_tokens")
+TRAIN_CONTEXT = ("metric", "device_kind", "n_devices", "global_batch")
+
+# -- schema -------------------------------------------------------------
+SERVING_REQUIRED = {
+    "platform": str, "model": str, "requests": int, "max_slots": int,
+    "max_new_tokens": int, "tokens_per_sec": (int, float),
+    "decode_tokens_per_sec": (int, float),
+    "prefill_tokens_per_sec": (int, float), "avg_ttft_s": (int, float),
+    "ttft_p50_s": (int, float), "ttft_p95_s": (int, float),
+    "decode_steps": int, "complete": bool,
+}
+TRAIN_REQUIRED = {
+    "metric": str, "value": (int, float), "unit": str,
+}
+
+
+def load_artifact(path):
+    """Read + unwrap one artifact; returns (kind, payload).
+    kind is "serving" or "train"."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: artifact must be a JSON object")
+    if "parsed" in doc and isinstance(doc["parsed"], dict):
+        doc = doc["parsed"]       # driver wrapper (BENCH_r05.json shape)
+    if "tokens_per_sec" in doc:
+        return "serving", doc
+    if "metric" in doc:
+        return "train", doc
+    raise ValueError(
+        f"{path}: unrecognized artifact (no 'tokens_per_sec' or 'metric' "
+        f"key; top-level keys: {sorted(doc)[:8]})")
+
+
+def check_schema(path):
+    """Returns a list of problem strings (empty = valid)."""
+    problems = []
+    try:
+        kind, doc = load_artifact(path)
+    except (OSError, ValueError) as e:
+        return [str(e)]
+    required = SERVING_REQUIRED if kind == "serving" else TRAIN_REQUIRED
+    for key, types in required.items():
+        if key not in doc:
+            problems.append(f"{path}: missing required key '{key}' ({kind})")
+            continue
+        v = doc[key]
+        if isinstance(v, bool) and types is not bool:
+            problems.append(f"{path}: '{key}' must be {types}, got bool")
+        elif not isinstance(v, types):
+            problems.append(
+                f"{path}: '{key}' must be {types}, got {type(v).__name__}")
+    if kind == "serving":
+        if doc.get("complete") is not True:
+            problems.append(f"{path}: 'complete' is not true — a partial "
+                            f"bench run must not be committed as a baseline")
+        for key in ("tokens_per_sec", "decode_tokens_per_sec",
+                    "prefill_tokens_per_sec"):
+            v = doc.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v <= 0:
+                problems.append(f"{path}: '{key}' must be > 0, got {v}")
+    else:
+        v = doc.get("value")
+        if isinstance(v, (int, float)) and not isinstance(v, bool) and v <= 0:
+            problems.append(f"{path}: 'value' must be > 0, got {v}")
+    return problems
+
+
+def comparable(kind, fresh, base):
+    """Returns a list of context mismatches (empty = comparable)."""
+    keys = SERVING_CONTEXT if kind == "serving" else TRAIN_CONTEXT
+    out = []
+    for key in keys:
+        fv, bv = fresh.get(key), base.get(key)
+        if fv is not None and bv is not None and fv != bv:
+            out.append(f"{key}: fresh={fv!r} baseline={bv!r}")
+    return out
+
+
+def compare(kind, fresh, base, tolerances, scale=1.0):
+    """Returns (regressions, checked) where regressions is a list of
+    problem strings and checked counts the keys actually compared."""
+    regressions, checked = [], 0
+    for key, (direction, tol) in sorted(tolerances.items()):
+        fv, bv = fresh.get(key), base.get(key)
+        if not all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                   for v in (fv, bv)):
+            continue
+        tol = tol * scale
+        checked += 1
+        if direction == "higher":
+            floor = bv * (1.0 - tol)
+            if fv < floor:
+                regressions.append(
+                    f"{key}: {fv:.6g} < {floor:.6g} "
+                    f"(baseline {bv:.6g}, tol -{tol:.0%})")
+        else:
+            ceil = bv * (1.0 + tol)
+            if fv > ceil:
+                regressions.append(
+                    f"{key}: {fv:.6g} > {ceil:.6g} "
+                    f"(baseline {bv:.6g}, tol +{tol:.0%})")
+    return regressions, checked
+
+
+def parse_tolerance_overrides(pairs):
+    out = {}
+    for pair in pairs or ():
+        key, _, frac = pair.partition("=")
+        if not key or not frac:
+            raise ValueError(f"--tolerance wants key=frac, got {pair!r}")
+        out[key] = float(frac)
+    return out
+
+
+def run_check_schema(paths):
+    paths = list(paths) or [os.path.join(REPO_ROOT, p)
+                            for p in DEFAULT_ARTIFACTS]
+    rc = 0
+    for path in paths:
+        problems = check_schema(path)
+        if problems:
+            rc = 1
+            for p in problems:
+                print(f"bench-gate: SCHEMA FAIL {p}", file=sys.stderr)
+        else:
+            print(f"bench-gate: schema ok {path}")
+    return rc
+
+
+def run_compare(args):
+    try:
+        fkind, fresh = load_artifact(args.fresh)
+        bkind, base = load_artifact(args.baseline)
+    except (OSError, ValueError) as e:
+        print(f"bench-gate: {e}", file=sys.stderr)
+        return 2
+    if fkind != bkind:
+        print(f"bench-gate: artifact kinds differ (fresh={fkind}, "
+              f"baseline={bkind})", file=sys.stderr)
+        return 2
+    mismatches = comparable(fkind, fresh, base)
+    if mismatches:
+        msg = (f"bench-gate: contexts differ, numbers not comparable: "
+               f"{'; '.join(mismatches)}")
+        if args.require_comparable:
+            print(msg, file=sys.stderr)
+            return 2
+        print(msg + " — SKIP")
+        return 0
+    tolerances = dict(SERVING_TOLERANCES if fkind == "serving"
+                      else TRAIN_TOLERANCES)
+    for key, frac in parse_tolerance_overrides(args.tolerance).items():
+        direction = tolerances.get(key, ("higher", 0.0))[0]
+        tolerances[key] = (direction, frac)
+    scale = args.tolerance_scale
+    if scale is None:
+        scale = float(os.environ.get("BENCH_GATE_SCALE", "1.0"))
+    regressions, checked = compare(fkind, fresh, base, tolerances,
+                                   scale=scale)
+    if checked == 0:
+        print("bench-gate: no overlapping numeric keys to compare",
+              file=sys.stderr)
+        return 2
+    if regressions:
+        for r in regressions:
+            print(f"bench-gate: REGRESSION {r}", file=sys.stderr)
+        print(f"bench-gate: FAIL ({len(regressions)}/{checked} keys "
+              f"regressed vs {args.baseline})", file=sys.stderr)
+        return 1
+    print(f"bench-gate: ok ({checked} keys within tolerance vs "
+          f"{args.baseline})")
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="bench_gate", description=__doc__.splitlines()[0])
+    parser.add_argument("--check-schema", nargs="*", default=None,
+                        metavar="FILE",
+                        help="validate artifact schema(s); defaults to the "
+                             "committed SERVING_BENCH_CPU.json + BENCH_r05.json")
+    parser.add_argument("mode", nargs="?", choices=["compare"],
+                        help="compare FRESH BASELINE under tolerance bands")
+    parser.add_argument("fresh", nargs="?", help="fresh bench JSON")
+    parser.add_argument("baseline", nargs="?", help="committed baseline JSON")
+    parser.add_argument("--tolerance", action="append", metavar="KEY=FRAC",
+                        help="override one key's relative tolerance")
+    parser.add_argument("--tolerance-scale", type=float, default=None,
+                        help="multiply every tolerance band (also "
+                             "BENCH_GATE_SCALE env)")
+    parser.add_argument("--require-comparable", action="store_true",
+                        help="exit 2 instead of skipping when contexts differ")
+    args = parser.parse_args(argv)
+
+    if args.check_schema is not None:
+        return run_check_schema(args.check_schema)
+    if args.mode == "compare":
+        if not args.fresh or not args.baseline:
+            parser.error("compare needs FRESH and BASELINE paths")
+        return run_compare(args)
+    parser.error("nothing to do: use --check-schema or compare FRESH BASELINE")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
