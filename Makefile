@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving trace-smoke bench-gate
+.PHONY: test test-fast test-faults test-cluster test-serving lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc trace-smoke bench-gate
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -68,6 +68,16 @@ ops:
 bench-serving:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=serving python bench.py --child
 
+# Long-document serving leg: two shared-prefix 16k prompts mixed with
+# short chat, served with the 16384 bucket on dense then sparse_xla
+# over the paged KV pool. Writes LONGDOC_BENCH_CPU.json with per-backend
+# tokens/sec + TTFT, the sparse-vs-dense speedup, and the paged-vs-
+# contiguous footprint ratio; the bitwise generate() oracle is asserted
+# in-run (see docs/serving.md). Takes a few minutes on CPU — the dense
+# 16k prefills ARE the story.
+bench-longdoc:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=longdoc python bench.py --child
+
 # Benchmark on the real TPU chip (default platform).
 bench:
 	python bench.py
@@ -81,3 +91,6 @@ bench-gate:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=serving \
 		BENCH_SERVE_OUT=/tmp/bench_gate_serving.json python bench.py --child
 	python -m tools.bench_gate compare /tmp/bench_gate_serving.json SERVING_BENCH_CPU.json
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=longdoc \
+		BENCH_LONGDOC_OUT=/tmp/bench_gate_longdoc.json python bench.py --child
+	python -m tools.bench_gate compare /tmp/bench_gate_longdoc.json LONGDOC_BENCH_CPU.json

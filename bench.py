@@ -132,6 +132,8 @@ def child_main():
         return gpt2_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "serving":
         return serving_child_main()
+    if os.environ.get("BENCH_MODEL", "bert") == "longdoc":
+        return longdoc_child_main()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -452,6 +454,168 @@ def serving_child_main():
     return 0
 
 
+def longdoc_child_main():
+    """Long-document serving leg: paged KV pool + per-bucket attention
+    backends at the 16k prompt bucket.
+
+    Serves the same workload twice — the 16384 bucket on the dense
+    backend, then on ``sparse_xla`` (short/mid buckets stay dense in
+    both legs, as a real ladder would run them) — and reports per
+    backend: 16k-bucket-only end-to-end tokens/sec (phase A, the
+    speedup attribution number), mixed-traffic tokens/sec with two
+    shared-prefix 16k documents alongside short chat requests (phase
+    B), and TTFT stats. The paged pool runs at a ~28% budget of the
+    contiguous ``MaxSlots x S_max`` footprint, which the artifact
+    records (``pool_vs_contiguous``) — the 16k ladder is only servable
+    BECAUSE of paging. Output parity is asserted in-run: every dense
+    lane bitwise vs dense ``generate()`` (the 16k dense lanes are
+    pinned through the same program at the 2048 bucket — a one-shot
+    dense 16k reference would materialize a [1, nh, 16k, 16k] score
+    tensor), sparse 16k lanes bitwise vs sparse ``generate()``.
+    Writes LONGDOC_BENCH[_CPU].json (BENCH_LONGDOC_OUT redirects, as
+    the bench gate does). Knobs: BENCH_LONGDOC_NEW (new tokens per
+    16k document, default 32)."""
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference import generate
+    from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2
+
+    def progress(msg):
+        print(f"# longdoc: {msg}", file=sys.stderr, flush=True)
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    new_long = int(os.environ.get("BENCH_LONGDOC_NEW", "32"))
+    new_short = 24
+    page_tokens = 128
+    max_seq_len = 16640            # 130 pages: 16384 prompt + headroom
+    pool_tokens = 37376            # 292 pages, ~28% of 8 x 16640 contiguous
+    buckets = (128, 2048, 16384)
+
+    cfg = GPT2Config(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=max_seq_len,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    _, params = init_gpt2(cfg, batch_size=1, seq_len=8, seed=0)
+
+    rng = np.random.RandomState(0)
+    shared = rng.randint(0, cfg.vocab_size, (8192,)).tolist()
+    longdocs = [shared + rng.randint(0, cfg.vocab_size, (8192,)).tolist()
+                for _ in range(2)]
+    middoc = rng.randint(0, cfg.vocab_size, (1800,)).tolist()
+    chats = [rng.randint(0, cfg.vocab_size, (n,)).tolist()
+             for n in (16, 33, 64, 100)]
+
+    def make_engine(impl):
+        return ServingEngine(params, cfg, ServingConfig(
+            max_slots=8, max_queue=16, max_seq_len=max_seq_len,
+            prompt_buckets=buckets, prefill_chunk_tokens=2048,
+            kv_page_tokens=page_tokens, kv_pool_tokens=pool_tokens,
+            attention_impl={"default": "dense", 16384: impl}))
+
+    def serve(eng, jobs):
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=n) for p, n in jobs]
+        eng.drain(max_steps=200000)
+        outs = [f.result(timeout=60) for f in futs]
+        return outs, time.perf_counter() - t0, eng.metrics.snapshot()
+
+    def oneshot(prompt, n_new, impl):
+        out = generate(params, cfg, np.asarray([prompt], np.int32), n_new,
+                       attn_impl=impl, kv_page_tokens=page_tokens)
+        return np.asarray(out)[0].tolist()
+
+    # references (short/mid lanes run dense under BOTH legs)
+    progress("building generate() references")
+    want_mid = oneshot(middoc, new_short, "dense")
+    want_chats = [oneshot(c, new_short, "dense") for c in chats]
+    want_long_sparse = oneshot(longdocs[0], new_long, "sparse_xla")
+
+    flat = {}
+    pool_bytes = contiguous = None
+    for impl in ("dense", "sparse_xla"):
+        # warmup engine: pay every compile for this leg (prefill at each
+        # bucket + both decode program classes) before the clock starts;
+        # one concurrent drain so warmup wall ~= the slowest document
+        progress(f"{impl}: warmup (all buckets, one concurrent serve)")
+        warm = make_engine(impl)
+        serve(warm, [(chats[0], new_short), (middoc, new_short),
+                     (longdocs[0], new_long)])
+        pool_bytes = warm.pool.nbytes()
+        contiguous = warm.pool.contiguous_equiv_bytes()
+        del warm
+
+        # phase A: the 16k bucket alone — the speedup attribution number
+        progress(f"{impl}: phase A (2 x 16k documents)")
+        outs_a, wall_a, _ = serve(make_engine(impl),
+                                  [(p, new_long) for p in longdocs])
+        tokens_a = sum(len(o) for o in outs_a)
+
+        # phase B: shared-prefix 16k documents mixed with chat traffic
+        progress(f"{impl}: phase B (mixed 16k + chat traffic)")
+        jobs = ([(p, new_long) for p in longdocs] + [(middoc, new_short)]
+                + [(c, new_short) for c in chats])
+        outs_b, wall_b, snap = serve(make_engine(impl), jobs)
+        tokens_b = sum(len(o) for o in outs_b)
+        progress(f"{impl}: phase A {wall_a:.1f}s, phase B {wall_b:.1f}s")
+
+        oracle_ok = (outs_b[2] == want_mid
+                     and all(o == w for o, w in zip(outs_b[3:], want_chats)))
+        if impl == "sparse_xla":
+            oracle_ok = (oracle_ok and outs_a[0] == want_long_sparse
+                         and outs_b[0] == want_long_sparse)
+        assert oracle_ok, f"{impl}: serving diverged from generate()"
+        key = "sparse" if impl == "sparse_xla" else impl
+        flat.update({
+            f"{key}_longdoc_tokens_per_sec": round(tokens_a / wall_a, 2),
+            f"{key}_mixed_tokens_per_sec": round(tokens_b / wall_b, 2),
+            f"{key}_avg_ttft_s": round(snap["avg_ttft_s"], 4),
+            f"{key}_ttft_p50_s": round(snap["ttft_p50_s"], 4),
+            f"{key}_ttft_p95_s": round(snap["ttft_p95_s"], 4),
+            f"{key}_oracle_ok": bool(oracle_ok),
+        })
+
+    speedup = (flat["sparse_longdoc_tokens_per_sec"]
+               / flat["dense_longdoc_tokens_per_sec"])
+    result = {
+        "platform": platform,
+        "model": "gpt2-tiny(L2,H64)",
+        "max_slots": 8,
+        "page_tokens": page_tokens,
+        "kv_pool_tokens": pool_tokens,
+        "prompt_buckets": list(buckets),
+        "longdoc_prompt_len": len(longdocs[0]),
+        "longdoc_new_tokens": new_long,
+        "shared_prefix_len": len(shared),
+        "requests_mixed": 2 + 1 + len(chats),
+        **flat,
+        "speedup_sparse_vs_dense_16k": round(speedup, 2),
+        "pool_bytes": pool_bytes,
+        "contiguous_equiv_bytes": contiguous,
+        "pool_vs_contiguous": round(pool_bytes / contiguous, 3),
+        "complete": True,
+    }
+    suffix = "" if platform == "tpu" else f"_{platform.upper()}"
+    out = os.environ.get("BENCH_LONGDOC_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"LONGDOC_BENCH{suffix}.json")
+    with open(out, "w") as f:
+        f.write(json.dumps(result, indent=1) + "\n")
+    print(json.dumps({
+        "metric": f"16k-bucket sparse-vs-dense serving speedup ({platform})",
+        "value": result["speedup_sparse_vs_dense_16k"],
+        "unit": "x dense end-to-end tokens/sec",
+        "vs_baseline": None,
+        **{k: result[k] for k in (
+            "dense_longdoc_tokens_per_sec", "sparse_longdoc_tokens_per_sec",
+            "dense_mixed_tokens_per_sec", "sparse_mixed_tokens_per_sec",
+            "dense_avg_ttft_s", "sparse_avg_ttft_s", "pool_vs_contiguous")},
+    }))
+    return 0
+
+
 def _attn_impl_label(on_tpu):
     """Which attention core actually ran (shared by every bench leg): "xla"
     (env-forced einsum chain), "pallas" (the TPU default), or "reference"
@@ -644,6 +808,10 @@ def main():
         label = "continuous-batching serving tokens/sec"
         seq = os.environ.get("BENCH_SERVE_NEW_TOKENS", "32")
         unit = "tokens/sec"
+    elif os.environ.get("BENCH_MODEL", "bert") == "longdoc":
+        label = "16k-bucket sparse-vs-dense serving speedup"
+        seq = "16384"
+        unit = "x dense end-to-end tokens/sec"
     else:
         label = "bert-large pretrain samples/sec/chip"
         seq = os.environ.get("BENCH_SEQ", "128")

@@ -17,6 +17,7 @@ x + attn(LN(x)), x + ffn(LN(x)), fused qkv GEMM) — asserted equal to the
 full forward in ``tests/unit/test_generation.py``.
 """
 
+import math
 from functools import partial
 
 import jax
@@ -28,6 +29,39 @@ from deepspeed_tpu.inference.quantization import (
     maybe_dequant,
     vocab_size,
 )
+
+# Pluggable attention backends. "dense" is the exact causal forward and
+# the parity oracle; "flash" computes the SAME math blockwise with an
+# online softmax (allclose to dense, bitwise-stable against cache-length
+# changes); "sparse_xla" is the banded block-sparse composition from
+# ops/sparse_attention (per-query window of SPARSE_BAND+1 pages plus the
+# global anchor page 0 — the layout tests/perf/longseq_bench.py measures
+# at 65x dense for seq 16384).
+ATTENTION_IMPLS = ("dense", "flash", "sparse_xla")
+
+# Page granularity shared by the sparse window, the flash key blocks,
+# and the serving KV pool's pages (kv_pool.py) — one constant so a
+# sparse window is always a whole number of pool pages.
+DEFAULT_PAGE_TOKENS = 128
+
+# Banded width of the sparse window in pages: a query attends its own
+# page, SPARSE_BAND pages below it, and the anchor page 0.
+SPARSE_BAND = 1
+
+
+def _round_up(n, m):
+    return -(-int(n) // int(m)) * int(m)
+
+
+def resolve_page_tokens(page_tokens, max_seq_len):
+    """The EFFECTIVE page size for a given cache length: never larger
+    than the cache, and always dividing it (falling back to the gcd), so
+    a lane is a whole number of pages and a paged gather reassembles the
+    exact contiguous layout."""
+    pt = min(int(page_tokens or DEFAULT_PAGE_TOKENS), int(max_seq_len))
+    if max_seq_len % pt:
+        pt = math.gcd(pt, int(max_seq_len))
+    return max(pt, 1)
 
 
 def _layer_tree(params):
@@ -117,6 +151,201 @@ def _step(params, nh, caches, token, pos):
     h = _ln(h, tr["ln_f"])
     logits = h @ logits_table(tr["wte"], h.dtype).T
     return logits, caches
+
+
+# -- pluggable attention backends --------------------------------------------
+#
+# The sparse backend's ONE attention primitive: every sparse path
+# (full prefill, chunked prefill, speculative verify, decode — in both
+# generate() and the serving engine) computes each query with
+# `_attend_window_one` at identical shapes, so the continuous-batching
+# greedy oracle holds bitwise per backend by construction instead of by
+# numerical accident.
+
+def _window_base(pos, pt):
+    """First token of a query's canonical sparse window: SPARSE_BAND
+    pages below the query's own page, clamped at 0."""
+    return jnp.maximum(pos // pt - SPARSE_BAND, 0) * pt
+
+
+def _window_slice_one(cache_k, cache_v, base, pt):
+    """One lane's window slice: cache [nh, S, hd] -> window pair
+    [nh, (SPARSE_BAND+1)*pt, hd] starting at token ``base`` plus the
+    anchor page pair [nh, pt, hd] (tokens [0, pt))."""
+    W = (SPARSE_BAND + 1) * pt
+    k_win = jax.lax.dynamic_slice_in_dim(cache_k, base, W, axis=1)
+    v_win = jax.lax.dynamic_slice_in_dim(cache_v, base, W, axis=1)
+    return k_win, v_win, cache_k[:, :pt], cache_v[:, :pt]
+
+
+def _attend_window_one(q, k_win, v_win, k_sink, v_sink, pos, base, dtype):
+    """One query's banded block-sparse attention: q [nh, hd] against its
+    window slice ([nh, W, hd], tokens [base, base+W)) plus the anchor
+    page ([nh, pt, hd], tokens [0, pt) — the global block the longseq
+    bench's sparse_xla layout keeps). Window keys are valid iff their
+    token index <= pos; anchor keys iff strictly below ``base`` (when
+    base == 0 the window already covers them, so nothing double-counts).
+    Masked -1e30 scores underflow to exact-zero probability under the
+    fp32 softmax — the same exact-zero argument the dense oracle rests
+    on. For pos < (SPARSE_BAND+1)*pt the window covers every cached
+    token, so short sequences are exactly full attention."""
+    hd = q.shape[-1]
+    W = k_win.shape[1]
+    pt = k_sink.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, dtype))
+    s_win = jnp.einsum("nd,nwd->nw", q, k_win) * scale           # [nh, W]
+    kpos_w = base + jnp.arange(W)
+    s_win = jnp.where((kpos_w <= pos)[None, :], s_win,
+                      jnp.asarray(-1e30, s_win.dtype))
+    s_sink = jnp.einsum("nd,nsd->ns", q, k_sink) * scale         # [nh, pt]
+    s_sink = jnp.where((jnp.arange(pt) < base)[None, :], s_sink,
+                       jnp.asarray(-1e30, s_sink.dtype))
+    s = jnp.concatenate([s_sink, s_win], axis=-1)                # [nh, pt+W]
+    probs = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(dtype)
+    v_all = jnp.concatenate([v_sink, v_win], axis=-2)
+    return jnp.einsum("ns,nsd->nd", probs, v_all)                # [nh, hd]
+
+
+def _window_qkv(layer_p, h, nh):
+    """The decode step's fused qkv projection for one token per lane —
+    the head of `_decode_one`, shared with the sparse window programs
+    (here and in the serving engine's paged decode)."""
+    B, H = h.shape
+    hd = H // nh
+    a_in = _ln(h, layer_p["ln_attn"])
+    qkv = a_in @ maybe_dequant(layer_p["qkv"]) + layer_p["qkv"]["bias"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    return q.reshape(B, nh, hd), k.reshape(B, nh, hd), v.reshape(B, nh, hd)
+
+
+def _window_finish(layer_p, h, ctx):
+    """attn_out projection + residual + FFN — the tail of `_decode_one`,
+    shared with the serving engine's paged window decode."""
+    B, H = h.shape
+    a = (ctx.reshape(B, H) @ maybe_dequant(layer_p["attn_out"])
+         + layer_p["attn_out"]["bias"])
+    h = h + a
+    f_in = _ln(h, layer_p["ln_ffn"])
+    f = f_in @ maybe_dequant(layer_p["ff1"]) + layer_p["ff1"]["bias"]
+    f = jax.nn.gelu(f, approximate=False)
+    f = f @ maybe_dequant(layer_p["ff2"]) + layer_p["ff2"]["bias"]
+    return h + f
+
+
+def _decode_one_window(layer_p, h, cache_k, cache_v, pos, nh, pt):
+    """One token through one layer with banded-sparse attention: the
+    same qkv/residual/FFN math as `_decode_one`, but each lane attends
+    only its canonical window plus the anchor page — O(pt) keys per
+    token instead of O(S)."""
+    q, k, v = _window_qkv(layer_p, h, nh)
+    cache_k = jax.lax.dynamic_update_index_in_dim(cache_k, k, pos, axis=2)
+    cache_v = jax.lax.dynamic_update_index_in_dim(cache_v, v, pos, axis=2)
+    base = _window_base(pos, pt)
+
+    def lane(qi, ck, cv):
+        k_win, v_win, k_sink, v_sink = _window_slice_one(ck, cv, base, pt)
+        return _attend_window_one(qi, k_win, v_win, k_sink, v_sink,
+                                  pos, base, h.dtype)
+
+    ctx = jax.vmap(lane)(q, cache_k, cache_v)                    # [B, nh, hd]
+    return _window_finish(layer_p, h, ctx), cache_k, cache_v
+
+
+def _step_window(params, nh, caches, token, pos, pt):
+    """`_step` with the sparse backend's windowed per-token attention."""
+    tr = params["params"]["transformer"]
+    wpe = tr["wpe"]["embedding"]
+    layer_p = _layer_tree(params)
+    h = embed_rows(tr["wte"], token) + wpe[pos]
+
+    def layer_body(h, inputs):
+        lp, ck_l, cv_l = inputs
+        h, ck_l, cv_l = _decode_one_window(lp, h, ck_l, cv_l, pos, nh, pt)
+        return h, (ck_l, cv_l)
+
+    h, caches = jax.lax.scan(layer_body, h, (layer_p,) + tuple(caches))
+    h = _ln(h, tr["ln_f"])
+    logits = h @ logits_table(tr["wte"], h.dtype).T
+    return logits, caches
+
+
+def _chunk_attend_window(q, cache_k, cache_v, qpos, pt, dtype):
+    """Canonical windowed attention for a whole chunk of queries: q
+    [B, C, nh, hd] at per-query positions ``qpos`` [B, C] against the
+    already-written caches [B, nh, S, hd]. C must be a multiple of
+    ``pt``. Queries run in blocks of pt under a lax.scan (bounding the
+    materialized window slices to one block), but each query slices its
+    OWN canonical window — so the per-query math is bit-identical to the
+    decode step's no matter how callers chunk, bucket, or pad the
+    sequence."""
+    B, C, nh, hd = q.shape
+    assert C % pt == 0, f"chunk width {C} is not a multiple of page {pt}"
+    nb = C // pt
+
+    def one(qi, p, ck, cv):
+        base = _window_base(p, pt)
+        k_win, v_win, k_sink, v_sink = _window_slice_one(ck, cv, base, pt)
+        return _attend_window_one(qi, k_win, v_win, k_sink, v_sink,
+                                  p, base, dtype)
+
+    q_b = jnp.moveaxis(q.reshape(B, nb, pt, nh, hd), 1, 0)       # [nb,B,pt,..]
+    p_b = jnp.moveaxis(qpos.reshape(B, nb, pt), 1, 0)            # [nb,B,pt]
+
+    def block(_, xs):
+        qb, pb = xs
+        ctx = jax.vmap(                                          # over lanes
+            lambda qrow, prow, ck, cv: jax.vmap(                 # over queries
+                lambda qi, p: one(qi, p, ck, cv))(qrow, prow))(
+            qb, pb, cache_k, cache_v)
+        return None, ctx                                         # [B,pt,nh,hd]
+
+    _, ctx_b = jax.lax.scan(block, None, (q_b, p_b))
+    return jnp.moveaxis(ctx_b, 0, 1).reshape(B, C, nh, hd)
+
+
+def _flash_attend(q, cache_k, cache_v, qpos, pt, dtype):
+    """Blockwise online-softmax causal attention (the flash recipe): q
+    [B, C, nh, hd] at positions ``qpos`` [B, C] over caches
+    [B, nh, S, hd] with S a multiple of ``pt``. Never materializes the
+    [C, S] score matrix; accumulates a running (max, denominator,
+    numerator) triple in fp32 across key blocks. Math-equal to dense
+    (allclose — the fp summation order differs) and BITWISE invariant to
+    extra fully-masked key blocks: a masked block contributes zero
+    probability, leaves the running max unchanged, and scales the
+    accumulators by exp(0) == 1 — so serving (S_max-long cache) and
+    generate() (total-length cache) emit identical tokens."""
+    B, C, nh, hd = q.shape
+    S = cache_k.shape[2]
+    assert S % pt == 0, f"cache length {S} is not a multiple of page {pt}"
+    nbc = S // pt
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, dtype))
+    k_b = jnp.moveaxis(cache_k.reshape(B, nh, nbc, pt, hd), 2, 0)
+    v_b = jnp.moveaxis(cache_v.reshape(B, nh, nbc, pt, hd), 2, 0)
+    koff = jnp.arange(nbc) * pt
+
+    m0 = jnp.full((B, nh, C), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, nh, C), jnp.float32)
+    a0 = jnp.zeros((B, nh, C, hd), jnp.float32)
+
+    def block(carry, xs):
+        m, l, acc = carry
+        kb, vb, off = xs
+        s = jnp.einsum("bqnd,bnsd->bnqs", q, kb) * scale         # [B,nh,C,pt]
+        valid = ((off + jnp.arange(pt))[None, None, None, :]
+                 <= qpos[:, None, :, None])
+        s = jnp.where(valid, s.astype(jnp.float32),
+                      jnp.asarray(-1e30, jnp.float32))
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None]) * valid                # masked -> 0
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bnqs,bnsd->bnqd", p, vb.astype(jnp.float32))
+        return (m_new, l, acc), None
+
+    (_, l, acc), _ = jax.lax.scan(block, (m0, l0, a0), (k_b, v_b, koff))
+    ctx = (acc / jnp.maximum(l, 1e-30)[..., None]).astype(dtype)
+    return jnp.moveaxis(ctx, 2, 1)                               # [B,C,nh,hd]
 
 
 def filter_logits(logits, top_k=0, top_p=1.0):
@@ -226,14 +455,72 @@ def _chunk_layer(layer_p, h, cache_k, cache_v, starts, nh):
     return h + f, cache_k, cache_v
 
 
-def _forward_chunk(params, n_heads, caches, ids, starts):
+def _chunk_layer_with(layer_p, h, cache_k, cache_v, starts, nh, attend):
+    """`_chunk_layer`'s qkv/write/residual/FFN shell around a pluggable
+    ``attend(q, cache_k, cache_v, qpos)`` (window or flash). The dense
+    path stays in `_chunk_layer` untouched — it is the bitwise parity
+    oracle and must not move."""
+    B, C, H = h.shape
+    hd = H // nh
+
+    a_in = _ln(h, layer_p["ln_attn"])
+    qkv = a_in @ maybe_dequant(layer_p["qkv"]) + layer_p["qkv"]["bias"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, C, nh, hd)
+    k = jnp.moveaxis(k.reshape(B, C, nh, hd), 1, 2)          # [B, nh, C, hd]
+    v = jnp.moveaxis(v.reshape(B, C, nh, hd), 1, 2)
+
+    def put(cache, new, s):
+        return cache.at[:, s + jnp.arange(C), :].set(new, mode="drop")
+
+    cache_k = jax.vmap(put)(cache_k, k, starts)
+    cache_v = jax.vmap(put)(cache_v, v, starts)
+
+    qpos = starts[:, None] + jnp.arange(C)[None, :]              # [B, C]
+    ctx = attend(q, cache_k, cache_v, qpos).reshape(B, C, H)
+    a = ctx @ maybe_dequant(layer_p["attn_out"]) + layer_p["attn_out"]["bias"]
+    h = h + a
+
+    f_in = _ln(h, layer_p["ln_ffn"])
+    f = f_in @ maybe_dequant(layer_p["ff1"]) + layer_p["ff1"]["bias"]
+    f = jax.nn.gelu(f, approximate=False)
+    f = f @ maybe_dequant(layer_p["ff2"]) + layer_p["ff2"]["bias"]
+    return h + f, cache_k, cache_v
+
+
+def _chunk_layer_window(layer_p, h, cache_k, cache_v, starts, nh, pt):
+    """`_chunk_layer` with the banded-sparse backend: identical qkv
+    projection and cache writes, then every query attends only its
+    canonical window + anchor — O(C·pt) attention per layer instead of
+    O(C·S). Requires the chunk width to be a multiple of ``pt``
+    (callers pad)."""
+    return _chunk_layer_with(
+        layer_p, h, cache_k, cache_v, starts, nh,
+        lambda q, ck, cv, qpos: _chunk_attend_window(q, ck, cv, qpos, pt,
+                                                     h.dtype))
+
+
+def _chunk_layer_flash(layer_p, h, cache_k, cache_v, starts, nh, pt):
+    """`_chunk_layer` with the flash backend: identical qkv projection
+    and cache writes, attention via the blockwise online softmax —
+    no [C, S] score matrix is ever materialized. Requires the cache
+    length to be a multiple of ``pt`` (callers allocate so)."""
+    return _chunk_layer_with(
+        layer_p, h, cache_k, cache_v, starts, nh,
+        lambda q, ck, cv, qpos: _flash_attend(q, ck, cv, qpos, pt, h.dtype))
+
+
+def _forward_chunk(params, n_heads, caches, ids, starts, attn_impl="dense",
+                   page_tokens=DEFAULT_PAGE_TOKENS):
     """Single-pass causal forward of ``ids`` [B, C] written into
     ``caches`` ([L, B, nh, S_cache, hd]) at per-lane offsets ``starts``
     [B]. Returns (hidden states [B, C, H] BEFORE the final LN, updated
     caches). The shared core under full-sequence prefill, chunked
     prefill, and prefix-cache-seeded prefill: ``starts`` and the cache
     contents are traced operands, so one compiled program per (B, C,
-    S_cache) covers all of them."""
+    S_cache) covers all of them. ``attn_impl``/``page_tokens`` are
+    static: they pick the per-layer attention program (dense stays the
+    default and is byte-for-byte the original path)."""
     tr = params["params"]["transformer"]
     layer_p = _layer_tree(params)
     C = ids.shape[1]
@@ -242,7 +529,14 @@ def _forward_chunk(params, n_heads, caches, ids, starts):
 
     def layer_body(h, inputs):
         lp, ck_l, cv_l = inputs
-        h, ck_l, cv_l = _chunk_layer(lp, h, ck_l, cv_l, starts, n_heads)
+        if attn_impl == "sparse_xla":
+            h, ck_l, cv_l = _chunk_layer_window(lp, h, ck_l, cv_l, starts,
+                                                n_heads, page_tokens)
+        elif attn_impl == "flash":
+            h, ck_l, cv_l = _chunk_layer_flash(lp, h, ck_l, cv_l, starts,
+                                               n_heads, page_tokens)
+        else:
+            h, ck_l, cv_l = _chunk_layer(lp, h, ck_l, cv_l, starts, n_heads)
         return h, (ck_l, cv_l)
 
     h, caches = jax.lax.scan(layer_body, h, (layer_p,) + tuple(caches))
@@ -312,22 +606,40 @@ def _speculative_verify(params, n_heads, caches, tokens, drafts, positions):
     return oracle, accepted, caches
 
 
-def _forward_full(params, ids, true_len, n_layers, n_heads, head_dim, total):
+def _forward_full(params, ids, true_len, n_layers, n_heads, head_dim, total,
+                  attn_impl="dense", page_tokens=DEFAULT_PAGE_TOKENS):
     """Single-pass full-sequence causal prefill: every K/V for the
     (padded) prompt ``ids`` [B, S] computed in ONE batched forward into a
     fresh ``total``-long cache, with the logits selected at the true last
     prompt position (``true_len`` — scalar or [B], traced) so padding is
     invisible to the emitted token. Replaces the sequential scan prefill
     (``_prefill``, kept as the parity reference) on every live path:
-    ``generate()``, ``beam_search()``, and the serving engine."""
+    ``generate()``, ``beam_search()``, and the serving engine.
+
+    Non-dense backends need page-aligned shapes: sparse pads the prompt
+    to a whole number of pages (pad queries write KV past ``true_len``
+    that decode overwrites in order before it can ever be attended) and
+    allocates at least one full window of cache so the window slice
+    always fits; flash rounds the cache length up so it splits into
+    whole key blocks. Logit selection at ``true_len - 1`` keeps all of
+    it invisible to the emitted token."""
     B, S = ids.shape
     tr = params["params"]["transformer"]
     dtype = _cache_dtype(params)
-    shape = (n_layers, B, n_heads, total, head_dim)
+    cache_len = total
+    if attn_impl == "sparse_xla":
+        pt = int(page_tokens)
+        cache_len = max(_round_up(total, pt), (SPARSE_BAND + 1) * pt)
+        ids = jnp.pad(ids, ((0, 0), (0, _round_up(S, pt) - S)))
+    elif attn_impl == "flash":
+        pt = int(page_tokens)
+        cache_len = max(_round_up(total, pt), pt)
+    shape = (n_layers, B, n_heads, cache_len, head_dim)
     caches = (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
     h, caches = _forward_chunk(params, n_heads, caches, ids,
-                               jnp.zeros((B,), jnp.int32))
+                               jnp.zeros((B,), jnp.int32),
+                               attn_impl=attn_impl, page_tokens=page_tokens)
     idx = jnp.clip(jnp.broadcast_to(
         jnp.asarray(true_len, jnp.int32) - 1, (B,)), 0, S - 1)
     h_last = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
@@ -337,14 +649,17 @@ def _forward_full(params, ids, true_len, n_layers, n_heads, head_dim, total):
 
 
 @partial(jax.jit, static_argnames=("n_layers", "n_heads", "head_dim",
-                                   "max_new_tokens", "greedy", "filtered"))
+                                   "max_new_tokens", "greedy", "filtered",
+                                   "attn_impl", "page_tokens"))
 def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
                   max_new_tokens, greedy, filtered, temperature, top_k,
-                  top_p, rng):
+                  top_p, rng, attn_impl="dense",
+                  page_tokens=DEFAULT_PAGE_TOKENS):
     B, S = prompt_ids.shape
     total = S + max_new_tokens
     caches, last_logits = _forward_full(
-        params, prompt_ids, S, n_layers, n_heads, head_dim, total)
+        params, prompt_ids, S, n_layers, n_heads, head_dim, total,
+        attn_impl=attn_impl, page_tokens=page_tokens)
 
     def decode_body(carry, pos):
         caches, logits, rng = carry
@@ -362,7 +677,14 @@ def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
                 # distribution actually sampled (HF warper order)
                 scaled = filter_logits(scaled, top_k, top_p)
             token = jax.random.categorical(sub, scaled, axis=-1)
-        logits, caches = _step(params, n_heads, caches, token, pos)
+        if attn_impl == "sparse_xla":
+            logits, caches = _step_window(params, n_heads, caches, token,
+                                          pos, page_tokens)
+        else:
+            # flash decode IS dense decode: a single query against the
+            # whole cache has no blockwise savings, and the dense step
+            # is already one fused einsum
+            logits, caches = _step(params, n_heads, caches, token, pos)
         return (caches, logits, rng), token
 
     (_, _, _), tokens = jax.lax.scan(
@@ -371,7 +693,8 @@ def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
 
 
 def generate(params, config, prompt_ids, max_new_tokens, temperature=0.0,
-             rng=None, top_k=0, top_p=1.0):
+             rng=None, top_k=0, top_p=1.0, attn_impl="dense",
+             kv_page_tokens=None):
     """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, S].
 
     ``temperature=0`` -> greedy argmax; otherwise categorical sampling
@@ -391,6 +714,14 @@ def generate(params, config, prompt_ids, max_new_tokens, temperature=0.0,
                          f"got {top_k}")
     if not (0.0 < top_p <= 1.0):
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if attn_impl not in ATTENTION_IMPLS:
+        raise ValueError(
+            f"attn_impl must be one of {ATTENTION_IMPLS}, got {attn_impl!r}")
+    if kv_page_tokens is not None and (
+            isinstance(kv_page_tokens, bool)
+            or not isinstance(kv_page_tokens, int) or kv_page_tokens < 1):
+        raise ValueError(
+            f"kv_page_tokens must be an int >= 1, got {kv_page_tokens!r}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
     if max_new_tokens < 1:
@@ -415,7 +746,9 @@ def generate(params, config, prompt_ids, max_new_tokens, temperature=0.0,
         top_k > 0 or top_p < 1.0,
         jnp.asarray(max(temperature, 1e-8), jnp.float32),
         jnp.asarray(int(top_k), jnp.int32),
-        jnp.asarray(float(top_p), jnp.float32), rng)
+        jnp.asarray(float(top_p), jnp.float32), rng,
+        attn_impl=attn_impl,
+        page_tokens=int(kv_page_tokens or DEFAULT_PAGE_TOKENS))
 
 
 def greedy_generate(params, config, prompt_ids, max_new_tokens):

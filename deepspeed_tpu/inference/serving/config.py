@@ -56,3 +56,20 @@ class ServingConfig:
     # Serving/step/I-O fault-injection spec (tests only): see
     # serving/fault_injection.py for the accepted points.
     fault_injection: dict = field(default=None)
+    # Attention backend selection: None/"dense" (bitwise oracle path),
+    # "flash" (online-softmax, math-equal dense), "sparse_xla" (banded
+    # block-sparse window — the long-context backend), or a
+    # {bucket: impl} dict with an optional "default" key so e.g. only
+    # the 16k bucket goes sparse. Validated in engine.py against the
+    # bucket ladder.
+    attention_impl: object = None
+    # Tokens per KV page. None = 128 (clamped/adjusted to divide
+    # max_seq_len — see resolve_page_tokens). Smaller pages = finer
+    # allocation granularity + smaller sparse windows.
+    kv_page_tokens: int = None
+    # Total KV-pool token budget shared by all lanes. None =
+    # max_slots * max_seq_len (the contiguous-equivalent footprint);
+    # set LOWER to serve a 16k-bucket ladder without paying
+    # MaxSlots × S_max bytes — admission backpressures when pages
+    # run out instead of over-allocating.
+    kv_pool_tokens: int = None

@@ -67,6 +67,26 @@ the pool narrower and dequantize at use inside the decode/verify reads
 kv_pool.py). Quantized modes trade a threshold-based parity oracle
 (token-match rate, allclose attention outputs) for 2-4x more KV slots
 per byte.
+
+Paged KV pool (this file + kv_pool.py): KV lives in fixed-size pages
+under one shared token budget; lanes hold page TABLES, not contiguous
+stripes. The jitted programs gather a lane's pages back into the exact
+contiguous layout (bitwise — gather/scatter move bits, never values)
+and scatter back only freshly-written rows, so short chat requests and
+16k-token documents share the pool without ``MaxSlots × S_max`` blowup.
+Page tables ride the same churn-only upload as the lane masks.
+
+Attention backends (``serving.attention_impl``): per-prompt-bucket
+selection of dense | flash | sparse_xla, threaded through prefill,
+decode, and the speculative verify. Dense remains the bitwise parity
+oracle. Flash is math-equal dense (online softmax) and shares the
+dense decode program — its lanes are "full-gather class". sparse_xla
+lanes decode through a windowed program that touches only
+O(page_tokens) KV per token (window + anchor pages) — the long-context
+speedup — and hold the bitwise oracle against sparse ``generate()``.
+Requests are grouped at admission by (bucket, backend); the two lane
+classes run as (at most) two jitted calls per step sharing the
+token/position/pool operands, still with ONE host read per step.
 """
 
 import threading
@@ -80,18 +100,31 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.inference.generation import (
+    ATTENTION_IMPLS,
+    DEFAULT_PAGE_TOKENS,
+    SPARSE_BAND,
+    _attend_window_one,
     _cache_dtype,
+    _chunk_layer_with,
     _forward_chunk,
+    _layer_tree,
     _ln,
     _ngram_draft,
+    _round_up,
     _speculative_verify,
     _step,
+    _window_base,
+    _window_finish,
+    _window_qkv,
+    _window_slice_one,
+    resolve_page_tokens,
 )
 from deepspeed_tpu.profiling.sentinels import CompileSentinel, transfer_free
 from deepspeed_tpu import telemetry
 from deepspeed_tpu.inference.quantization import (
     dequantize_kv,
     dequantize_kv_np,
+    embed_rows,
     logits_table,
     quantize_kv_np,
     requantize_kv,
@@ -99,7 +132,11 @@ from deepspeed_tpu.inference.quantization import (
 )
 from deepspeed_tpu.inference.serving.config import ServingConfig
 from deepspeed_tpu.inference.serving.fault_injection import ServingFaultInjector
-from deepspeed_tpu.inference.serving.kv_pool import KV_CACHE_DTYPES, KVCachePool
+from deepspeed_tpu.inference.serving.kv_pool import (
+    KV_CACHE_DTYPES,
+    KVCachePool,
+    PoolExhaustedError,
+)
 from deepspeed_tpu.inference.serving.metrics import ServingMetrics
 from deepspeed_tpu.inference.serving.prefix_cache import PrefixKVCache
 from deepspeed_tpu.inference.serving.scheduler import (
@@ -108,6 +145,106 @@ from deepspeed_tpu.inference.serving.scheduler import (
     bucket_for,
     default_buckets,
 )
+
+
+def _parse_attention_impl(spec, buckets):
+    """Validate ``serving.attention_impl``: None / a backend name (every
+    bucket) / a ``{bucket: impl}`` dict with an optional ``"default"``
+    key. Returns ``(default_impl, {bucket: impl})``."""
+    if spec is None:
+        return "dense", {}
+    if isinstance(spec, str):
+        if spec not in ATTENTION_IMPLS:
+            raise ValueError(
+                f"serving.attention_impl must be one of {ATTENTION_IMPLS}, "
+                f"got {spec!r}")
+        return spec, {}
+    if not isinstance(spec, dict):
+        raise ValueError(
+            f"serving.attention_impl must be one of {ATTENTION_IMPLS} or a "
+            f"{{bucket: impl}} dict, got {spec!r}")
+    default = "dense"
+    table = {}
+    for key, impl in spec.items():
+        if impl not in ATTENTION_IMPLS:
+            raise ValueError(
+                f"serving.attention_impl[{key!r}] must be one of "
+                f"{ATTENTION_IMPLS}, got {impl!r}")
+        if key == "default":
+            default = impl
+            continue
+        if isinstance(key, bool) or not isinstance(key, int):
+            raise ValueError(
+                f"serving.attention_impl keys must be prompt-bucket ints "
+                f"or 'default', got {key!r}")
+        if key not in tuple(buckets):
+            raise ValueError(
+                f"serving.attention_impl bucket {key} is not in the prompt "
+                f"bucket ladder {tuple(buckets)}")
+        table[int(key)] = impl
+    return default, table
+
+
+# -- paged-pool index plumbing ------------------------------------------
+# The pool stores KV as fixed-size pages ([L, n_pages, nh, pt, hd]) with
+# per-lane page tables ([MaxSlots, mp], physical page 0 reserved as the
+# null/garbage sink — see kv_pool.py). The decode programs below never
+# see a contiguous [S_max] lane; they gather the pages a lane actually
+# owns and scatter back only the rows they wrote.
+
+def _gather_lanes(pool_side, page_tables):
+    """Reassemble every lane's contiguous [nh, S_max, hd] KV stripe from
+    its pages: pool [L, P, nh, pt, hd] + tables [B, mp] ->
+    [L, B, nh, mp*pt, hd]. Unmapped logical pages read the null page;
+    those positions are either beyond the lane's position counter
+    (masked to exact-zero probability by the causal mask) or belong to
+    inactive lanes (outputs discarded) — the same invisible-garbage
+    argument the contiguous layout relied on."""
+    L, _, nh, pt, hd = pool_side.shape
+    B, mp = page_tables.shape
+    g = pool_side[:, page_tables]                    # [L, B, mp, nh, pt, hd]
+    return jnp.moveaxis(g, 2, 3).reshape(L, B, nh, mp * pt, hd)
+
+
+def _row_pages(page_tables, tok, active, page_tokens):
+    """Physical destination page for per-lane token indices ``tok``
+    ([B] or [B, n]): the lane's mapped page, or the null page 0 for
+    inactive lanes and out-of-range indices — bad writes are DROPPED
+    into the sink, never clipped onto a live row."""
+    B, mp = page_tables.shape
+    tok2 = tok if tok.ndim == 2 else tok[:, None]
+    logical = jnp.clip(tok2 // page_tokens, 0, mp - 1)
+    phys = jnp.take_along_axis(page_tables, logical, axis=1)
+    ok = active[:, None] & (tok2 >= 0) & (tok2 < mp * page_tokens)
+    phys = jnp.where(ok, phys, 0)
+    return phys if tok.ndim == 2 else phys[:, 0]
+
+
+def _lane_rows(lanes, tok):
+    """Extract each lane's row(s) at token indices ``tok`` from gathered
+    [L, B, nh, S, hd] stripes -> [L, B, nh, hd] (or [L, B, n, nh, hd]
+    for ``tok`` [B, n]): the freshly-written KV the pool needs back.
+    Reads clip (the scatter drops the same indices, so a clipped read
+    is never stored anywhere that matters)."""
+    S = lanes.shape[3]
+    tok2 = tok if tok.ndim == 2 else tok[:, None]
+    idx = jnp.clip(tok2, 0, S - 1)
+    out = jnp.take_along_axis(
+        lanes, idx[None, :, None, :, None], axis=3)  # [L, B, nh, n, hd]
+    out = jnp.moveaxis(out, 3, 2)                    # [L, B, n, nh, hd]
+    return out[:, :, 0] if tok.ndim == 1 else out
+
+
+def _scatter_rows(pool_side, page_tables, rows, tok, active, page_tokens):
+    """Write per-lane rows back into their pages. ``rows`` is
+    [L, B, nh, hd] (``tok`` [B]) or [L, B, n, nh, hd] (``tok`` [B, n]);
+    writes from inactive lanes or beyond a lane's mapped pages land on
+    the null page. Advanced indices at non-adjacent axes put the batch
+    dims FIRST, hence the moveaxis."""
+    dp = _row_pages(page_tables, tok, active, page_tokens)
+    off = tok % page_tokens
+    vals = jnp.moveaxis(rows, 0, 1 if tok.ndim == 1 else 2)
+    return pool_side.at[:, dp, :, off].set(vals.astype(pool_side.dtype))
 
 
 @partial(jax.jit, static_argnames=("n_heads",), donate_argnums=(1, 2))
@@ -137,27 +274,81 @@ def _prefill_batch_jit(params, init_k, init_v, padded_ids, starts, true_lens,
     return k, v, first
 
 
-@partial(jax.jit, static_argnames=("n_heads",), donate_argnums=(1, 2, 3, 4))
-def _decode_step_jit(params, pool_k, pool_v, tokens, positions, active, *,
-                     n_heads):
+def _prefill_tail(params, h, starts, true_lens):
+    """Shared logits tail of every prefill program: select each lane's
+    true last prompt position, final LN, greedy first token."""
+    Sb = h.shape[1]
+    tr = params["params"]["transformer"]
+    idx = jnp.clip(true_lens - 1 - starts, 0, Sb - 1)
+    h_sel = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
+    h_sel = _ln(h_sel, tr["ln_f"])
+    logits = h_sel @ logits_table(tr["wte"], h_sel.dtype).T
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("n_heads", "page_tokens"),
+         donate_argnums=(1, 2))
+def _prefill_batch_flash_jit(params, init_k, init_v, padded_ids, starts,
+                             true_lens, *, n_heads, page_tokens):
+    """``_prefill_batch_jit`` with the flash (online-softmax) backend:
+    same contract, never materializes the [Sb, S_max] score matrix.
+    Math-equal to dense (allclose, not bitwise); the cache length is a
+    page multiple by construction (``resolve_page_tokens``)."""
+    h, (k, v) = _forward_chunk(params, n_heads, (init_k, init_v),
+                               padded_ids, starts, attn_impl="flash",
+                               page_tokens=page_tokens)
+    return k, v, _prefill_tail(params, h, starts, true_lens)
+
+
+@partial(jax.jit, static_argnames=("n_heads", "page_tokens"),
+         donate_argnums=(1, 2))
+def _prefill_batch_window_jit(params, init_k, init_v, padded_ids, starts,
+                              true_lens, *, n_heads, page_tokens):
+    """``_prefill_batch_jit`` with the banded block-sparse backend:
+    every query attends only its canonical window + anchor page —
+    O(Sb*pt) attention instead of O(Sb*S_max), which is what makes 16k+
+    prompts admissible at interactive TTFT. Callers pad ``padded_ids``
+    to a page-multiple width; pad queries write garbage KV past the true
+    length, which decode overwrites in order before it is ever
+    attendable (the same write-before-attend argument dense prefill
+    uses for its pad region)."""
+    h, (k, v) = _forward_chunk(params, n_heads, (init_k, init_v),
+                               padded_ids, starts, attn_impl="sparse_xla",
+                               page_tokens=page_tokens)
+    return k, v, _prefill_tail(params, h, starts, true_lens)
+
+
+@partial(jax.jit, static_argnames=("n_heads",), donate_argnums=(1, 2, 4, 5))
+def _decode_step_jit(params, pool_k, pool_v, page_tables, tokens, positions,
+                     active, *, n_heads):
     """One masked batched decode step over every pool lane.
 
-    Each lane feeds its last token at its own position through the
-    one-shot path's ``_step`` (vmapped as a B=1 lane). Inactive lanes
-    compute garbage into their own (dead) lane and keep their token via
-    the ``active`` mask; pool buffers, tokens and positions are donated —
-    the step is an in-place update of device-resident serving state, and
-    active lanes advance their position counter HERE, so steady-state
-    decode needs no per-step host->device upload at all."""
+    Each lane's pages are gathered into the EXACT contiguous stripe the
+    old layout stored (unmapped pages read masked-invisible garbage),
+    its last token runs through the one-shot path's ``_step`` (vmapped
+    as a B=1 lane), and only the freshly-written row is scattered back
+    by page index — untouched positions keep their bits, so the step is
+    bitwise the contiguous step. Inactive lanes compute garbage routed
+    to the null page and keep their token via the ``active`` mask; pool
+    buffers, tokens and positions are donated, page tables and the mask
+    are NOT (they live on device across steps), so steady-state decode
+    still needs no per-step host->device upload at all."""
+    pt = pool_k.shape[3]
+    lanes_k = _gather_lanes(pool_k, page_tables)
+    lanes_v = _gather_lanes(pool_v, page_tables)
 
     def lane(ck, cv, tok, pos):
         logits, (ck2, cv2) = _step(params, n_heads, (ck[:, None], cv[:, None]),
                                    tok[None], pos)
         return logits[0], ck2[:, 0], cv2[:, 0]
 
-    logits, pool_k, pool_v = jax.vmap(
+    logits, lanes_k, lanes_v = jax.vmap(
         lane, in_axes=(1, 1, 0, 0), out_axes=(0, 1, 1))(
-        pool_k, pool_v, tokens, positions)
+        lanes_k, lanes_v, tokens, positions)
+    pool_k = _scatter_rows(pool_k, page_tables, _lane_rows(lanes_k, positions),
+                           positions, active, pt)
+    pool_v = _scatter_rows(pool_v, page_tables, _lane_rows(lanes_v, positions),
+                           positions, active, pt)
     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     tokens = jnp.where(active, nxt, tokens)
     positions = jnp.where(active, positions + 1, positions)
@@ -165,18 +356,23 @@ def _decode_step_jit(params, pool_k, pool_v, tokens, positions, active, *,
 
 
 @partial(jax.jit, static_argnames=("n_heads", "qmode"),
-         donate_argnums=(1, 2, 5, 6))
-def _decode_step_quant_jit(params, pool_k, pool_v, k_scale, v_scale, tokens,
-                           positions, active, *, n_heads, qmode):
-    """``_decode_step_jit`` over a QUANTIZED pool: each lane dequantizes
-    its KV at use (int8 * per-head scale, or a bf16 cast), runs the same
-    vmapped ``_step``, and re-stores against its FIXED install-time
-    scales — idempotent on untouched positions (see ``requantize_kv``),
-    so the step still only logically appends one token per lane. Scales
-    are NOT donated: they are returned unchanged and the host keeps its
-    reference. ``qmode`` is static — one program per storage mode, no
-    traced branching (for "bf16" the scale operands are None)."""
+         donate_argnums=(1, 2, 6, 7))
+def _decode_step_quant_jit(params, pool_k, pool_v, k_scale, v_scale,
+                           page_tables, tokens, positions, active, *,
+                           n_heads, qmode):
+    """``_decode_step_jit`` over a QUANTIZED paged pool: each lane's
+    gathered stripe dequantizes at use (int8 * per-head scale, or a
+    bf16 cast), runs the same vmapped ``_step``, and the written row is
+    re-stored against its FIXED install-time scales — idempotent on
+    untouched positions (see ``requantize_kv``), so the step still only
+    logically appends one token per lane. Scales are NOT donated: they
+    are returned unchanged and the host keeps its reference. ``qmode``
+    is static — one program per storage mode, no traced branching (for
+    "bf16" the scale operands are None)."""
     dtype = _cache_dtype(params)
+    pt = pool_k.shape[3]
+    lanes_k = _gather_lanes(pool_k, page_tables)
+    lanes_v = _gather_lanes(pool_v, page_tables)
 
     if qmode == "int8":
         def lane(ck, cv, sk, sv, tok, pos):
@@ -188,9 +384,9 @@ def _decode_step_quant_jit(params, pool_k, pool_v, k_scale, v_scale, tokens,
             return (logits[0], requantize_kv(ck2[:, 0], sk),
                     requantize_kv(cv2[:, 0], sv))
 
-        logits, pool_k, pool_v = jax.vmap(
+        logits, lanes_k, lanes_v = jax.vmap(
             lane, in_axes=(1, 1, 1, 1, 0, 0), out_axes=(0, 1, 1))(
-            pool_k, pool_v, k_scale, v_scale, tokens, positions)
+            lanes_k, lanes_v, k_scale, v_scale, tokens, positions)
     else:
         def lane(ck, cv, tok, pos):
             logits, (ck2, cv2) = _step(
@@ -200,17 +396,159 @@ def _decode_step_quant_jit(params, pool_k, pool_v, k_scale, v_scale, tokens,
             return (logits[0], ck2[:, 0].astype(jnp.bfloat16),
                     cv2[:, 0].astype(jnp.bfloat16))
 
-        logits, pool_k, pool_v = jax.vmap(
+        logits, lanes_k, lanes_v = jax.vmap(
             lane, in_axes=(1, 1, 0, 0), out_axes=(0, 1, 1))(
-            pool_k, pool_v, tokens, positions)
+            lanes_k, lanes_v, tokens, positions)
+    pool_k = _scatter_rows(pool_k, page_tables, _lane_rows(lanes_k, positions),
+                           positions, active, pt)
+    pool_v = _scatter_rows(pool_v, page_tables, _lane_rows(lanes_v, positions),
+                           positions, active, pt)
     nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     tokens = jnp.where(active, nxt, tokens)
     positions = jnp.where(active, positions + 1, positions)
     return tokens, positions, pool_k, pool_v
 
 
+@partial(jax.jit, static_argnames=("n_heads", "page_tokens", "qmode"),
+         donate_argnums=(1, 2, 6, 7))
+def _decode_step_window_jit(params, pool_k, pool_v, k_scale, v_scale,
+                            page_tables, tokens, positions, active, *,
+                            n_heads, page_tokens, qmode):
+    """Banded block-sparse decode over the paged pool. Unlike the dense
+    step, it never reassembles whole lanes: each lane touches only its
+    canonical window pages (SPARSE_BAND+1 pages ending at the query)
+    plus the anchor page — O(page_tokens) KV traffic per token per lane
+    instead of O(S_max), which is where the 16k-bucket speedup lives.
+    Per layer: project qkv, store the written row into its page, gather
+    the window/anchor pages, attend with the SAME ``_attend_window_one``
+    the one-shot sparse ``generate()`` path uses (write-then-attend,
+    matching ``_decode_one_window``) — the per-lane key set is identical
+    by construction, so fp32 storage keeps the bitwise oracle. Window
+    lanes use their own ``active`` mask; the pool and the token/position
+    vectors are threaded through both class programs each step."""
+    dtype = _cache_dtype(params)
+    pt = page_tokens
+    B, mp = page_tables.shape
+    tr = params["params"]["transformer"]
+    layer_p = _layer_tree(params)
+
+    h = embed_rows(tr["wte"], tokens) + tr["wpe"]["embedding"][positions]
+
+    pp = jnp.clip(positions // pt, 0, mp - 1)          # each query's page
+    lo = jnp.maximum(pp - SPARSE_BAND, 0)              # window's first page
+    base = lo * pt
+    win_logical = jnp.clip(
+        lo[:, None] + jnp.arange(SPARSE_BAND + 1)[None, :], 0, mp - 1)
+    win_phys = jnp.take_along_axis(page_tables, win_logical, axis=1)
+    sink_phys = page_tables[:, 0]
+    dp = _row_pages(page_tables, positions, active, pt)
+    off = positions % pt
+
+    def layer_body(h, inputs):
+        lp, pk_l, pv_l, sk_l, sv_l = inputs
+        q, kk, vv = _window_qkv(lp, h, n_heads)        # each [B, nh, hd]
+        if qmode == "int8":
+            krow = requantize_kv(kk[:, :, None, :], sk_l)[:, :, 0]
+            vrow = requantize_kv(vv[:, :, None, :], sv_l)[:, :, 0]
+        elif qmode == "bf16":
+            krow, vrow = kk.astype(jnp.bfloat16), vv.astype(jnp.bfloat16)
+        else:
+            krow, vrow = kk, vv
+        pk_l = pk_l.at[dp, :, off].set(krow)
+        pv_l = pv_l.at[dp, :, off].set(vrow)
+
+        def stripe(buf, scale):
+            def dq(x):
+                if qmode == "int8":
+                    return dequantize_kv(x, scale, dtype)
+                if qmode == "bf16":
+                    return x.astype(dtype)
+                return x
+            win = jnp.moveaxis(buf[win_phys], 1, 2)    # [B, nh, bw, pt, hd]
+            win = win.reshape(B, n_heads, (SPARSE_BAND + 1) * pt, -1)
+            return dq(win), dq(buf[sink_phys])
+
+        k_win, k_sink = stripe(pk_l, sk_l)
+        v_win, v_sink = stripe(pv_l, sv_l)
+        ctx = jax.vmap(_attend_window_one,
+                       in_axes=(0, 0, 0, 0, 0, 0, 0, None))(
+            q, k_win, v_win, k_sink, v_sink, positions, base, dtype)
+        h = _window_finish(lp, h, ctx)
+        return h, (pk_l, pv_l)
+
+    h, (pool_k, pool_v) = jax.lax.scan(
+        layer_body, h, (layer_p, pool_k, pool_v, k_scale, v_scale))
+    h = _ln(h, tr["ln_f"])
+    logits = h @ logits_table(tr["wte"], h.dtype).T
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    tokens = jnp.where(active, nxt, tokens)
+    positions = jnp.where(active, positions + 1, positions)
+    return tokens, positions, pool_k, pool_v
+
+
+def _attend_window_chunk(q, cache_k, cache_v, qpos, pt, dtype):
+    """Per-query canonical window attention for a SMALL chunk of queries
+    (the k+1-wide speculative verify): no page-multiple chunk-width
+    requirement — each query dynamic-slices its own window from the full
+    lane stripe and attends with the same ``_attend_window_one`` every
+    other sparse path uses, so the per-query key set (and hence the
+    fp32 result, bitwise) matches the blocked prefill formulation."""
+    def one(qi, p, ck, cv):
+        b = _window_base(p, pt)
+        k_win, v_win, k_sink, v_sink = _window_slice_one(ck, cv, b, pt)
+        return _attend_window_one(qi, k_win, v_win, k_sink, v_sink, p, b,
+                                  dtype)
+
+    return jax.vmap(lambda qrow, prow, ck, cv: jax.vmap(
+        lambda qi, p: one(qi, p, ck, cv))(qrow, prow))(
+        q, qpos, cache_k, cache_v)
+
+
+def _forward_chunk_window(params, n_heads, caches, ids, starts, pt):
+    """The sparse-backend twin of ``_forward_chunk`` for the speculative
+    verify: same embed/scan shell and cache writes, attention via
+    ``_attend_window_chunk`` (verify chunks are k+1 wide — not a page
+    multiple, so the blocked ``_chunk_attend_window`` cannot be used)."""
+    tr = params["params"]["transformer"]
+    layer_p = _layer_tree(params)
+    C = ids.shape[1]
+    pos = starts[:, None] + jnp.arange(C)[None, :]
+    h = embed_rows(tr["wte"], ids) + tr["wpe"]["embedding"][pos]
+
+    def layer_body(h, inputs):
+        lp, ck_l, cv_l = inputs
+        h, ck_l, cv_l = _chunk_layer_with(
+            lp, h, ck_l, cv_l, starts, n_heads,
+            lambda q, ck, cv, qpos: _attend_window_chunk(q, ck, cv, qpos,
+                                                         pt, h.dtype))
+        return h, (ck_l, cv_l)
+
+    h, caches = jax.lax.scan(layer_body, h, (layer_p,) + tuple(caches))
+    return h, caches
+
+
+def _speculative_verify_window(params, n_heads, caches, tokens, drafts,
+                               positions, pt):
+    """``_speculative_verify`` with windowed attention: identical
+    draft/oracle/acceptance logic, the one-forward verify runs the
+    sparse key set. See ``_speculative_verify`` for the rollback-free
+    stale-KV argument (it is backend-independent: the stale range sits
+    inside the next step's write window either way)."""
+    tr = params["params"]["transformer"]
+    k = drafts.shape[1]
+    ids = jnp.concatenate([tokens[:, None], drafts], axis=1)     # [B, k+1]
+    h, caches = _forward_chunk_window(params, n_heads, caches, ids,
+                                      positions, pt)
+    h = _ln(h, tr["ln_f"])
+    logits = h @ logits_table(tr["wte"], h.dtype).T
+    oracle = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [B, k+1]
+    ok = (drafts == oracle[:, :k]).astype(jnp.int32)
+    accepted = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)          # [B]
+    return oracle, accepted, caches
+
+
 def _spec_core(params, n_heads, caches, history, tokens, positions, active,
-               draft_noise, k):
+               draft_noise, k, window_pt=None):
     """Shared body of the speculative step programs: draft -> (optional
     noise) -> one-forward verify -> advance. Operates on COMPUTE-dtype
     caches; the quantized wrapper handles storage conversion."""
@@ -222,8 +560,12 @@ def _spec_core(params, n_heads, caches, history, tokens, positions, active,
     # nonzero values without changing shapes, so scrambling never
     # recompiles
     drafts = (drafts + draft_noise) % V
-    oracle, accepted, caches = _speculative_verify(
-        params, n_heads, caches, tokens, drafts, positions)
+    if window_pt is None:
+        oracle, accepted, caches = _speculative_verify(
+            params, n_heads, caches, tokens, drafts, positions)
+    else:
+        oracle, accepted, caches = _speculative_verify_window(
+            params, n_heads, caches, tokens, drafts, positions, window_pt)
     # append all k+1 oracle tokens to the history at the lane's write
     # window; positions past the accepted point hold speculative
     # continuations the next step overwrites — the drafter's bigram scan
@@ -243,50 +585,110 @@ def _spec_core(params, n_heads, caches, history, tokens, positions, active,
 
 
 @partial(jax.jit, static_argnames=("n_heads", "k"),
-         donate_argnums=(1, 2, 3, 4, 5))
-def _spec_step_jit(params, pool_k, pool_v, history, tokens, positions,
-                   active, draft_noise, *, n_heads, k):
+         donate_argnums=(1, 2, 4, 5, 6))
+def _spec_step_jit(params, pool_k, pool_v, page_tables, history, tokens,
+                   positions, active, draft_noise, *, n_heads, k):
     """One SPECULATIVE masked batched decode step over every pool lane.
 
-    Per lane: draft ``k`` tokens (n-gram lookup over ``history``), feed
-    pending-token + drafts through ONE k+1-wide causal forward against
-    the pool (``_forward_chunk`` — the pool IS the chunk cache, no per
-    lane re-batching), accept the longest draft prefix the greedy oracle
-    confirms, and advance position by accepted+1. ``k`` and the lane
-    count are static; drafts/acceptance/noise are traced operands, so
-    acceptance variation and slot churn reuse one compiled program.
-    Returns the full oracle [B, k+1] and per-lane accepted counts so the
-    host emit loop can hand out between 1 and k+1 tokens per lane."""
-    tokens, positions, (pool_k, pool_v), history, oracle, accepted = \
-        _spec_core(params, n_heads, (pool_k, pool_v), history, tokens,
+    Per lane: gather the lane's pages into its contiguous stripe, draft
+    ``k`` tokens (n-gram lookup over ``history``), feed pending-token +
+    drafts through ONE k+1-wide causal forward against the stripes
+    (``_forward_chunk`` — the gathered pool IS the chunk cache), accept
+    the longest draft prefix the greedy oracle confirms, advance
+    position by accepted+1, and scatter the k+1 written rows back by
+    page index (overflow past a lane's pages drops to the null sink —
+    only reachable after the request's retirement point, see
+    ``_alloc_tokens``). ``k`` and the lane count are static; drafts,
+    acceptance and noise are traced, so acceptance variation and slot
+    churn reuse one compiled program. Returns the full oracle [B, k+1]
+    and per-lane accepted counts for the host emit loop."""
+    pt = pool_k.shape[3]
+    lanes = (_gather_lanes(pool_k, page_tables),
+             _gather_lanes(pool_v, page_tables))
+    written = positions[:, None] + jnp.arange(k + 1)[None, :]
+    tokens, positions, (lk, lv), history, oracle, accepted = \
+        _spec_core(params, n_heads, lanes, history, tokens,
                    positions, active, draft_noise, k)
+    pool_k = _scatter_rows(pool_k, page_tables, _lane_rows(lk, written),
+                           written, active, pt)
+    pool_v = _scatter_rows(pool_v, page_tables, _lane_rows(lv, written),
+                           written, active, pt)
     return tokens, positions, pool_k, pool_v, history, oracle, accepted
 
 
 @partial(jax.jit, static_argnames=("n_heads", "k", "qmode"),
-         donate_argnums=(1, 2, 5, 6, 7))
-def _spec_step_quant_jit(params, pool_k, pool_v, k_scale, v_scale, history,
-                         tokens, positions, active, draft_noise, *,
-                         n_heads, k, qmode):
-    """Speculative step over a quantized pool: dequantize the pool at
-    use, run the same draft/verify core in the compute dtype, then
-    requantize against the FIXED per-(slot, head) install scales (or a
-    bf16 cast). Untouched positions round-trip bitwise (idempotent
-    requant), so only the k+1 freshly-written rows actually change."""
+         donate_argnums=(1, 2, 6, 7, 8))
+def _spec_step_quant_jit(params, pool_k, pool_v, k_scale, v_scale,
+                         page_tables, history, tokens, positions, active,
+                         draft_noise, *, n_heads, k, qmode):
+    """Speculative step over a quantized paged pool: dequantize the
+    gathered stripes at use, run the same draft/verify core in the
+    compute dtype, then requantize against the FIXED per-(slot, head)
+    install scales (or a bf16 cast) and scatter back the k+1 written
+    rows. Untouched positions round-trip bitwise (idempotent requant),
+    so only the freshly-written rows actually change."""
     dtype = _cache_dtype(params)
+    pt = pool_k.shape[3]
+    lk = _gather_lanes(pool_k, page_tables)
+    lv = _gather_lanes(pool_v, page_tables)
     if qmode == "int8":
-        kf = dequantize_kv(pool_k, k_scale, dtype)
-        vf = dequantize_kv(pool_v, v_scale, dtype)
+        kf = dequantize_kv(lk, k_scale, dtype)
+        vf = dequantize_kv(lv, v_scale, dtype)
     else:
-        kf, vf = pool_k.astype(dtype), pool_v.astype(dtype)
+        kf, vf = lk.astype(dtype), lv.astype(dtype)
+    written = positions[:, None] + jnp.arange(k + 1)[None, :]
     tokens, positions, (kf, vf), history, oracle, accepted = _spec_core(
         params, n_heads, (kf, vf), history, tokens, positions, active,
         draft_noise, k)
     if qmode == "int8":
-        pool_k = requantize_kv(kf, k_scale)
-        pool_v = requantize_kv(vf, v_scale)
+        rows_k = _lane_rows(requantize_kv(kf, k_scale), written)
+        rows_v = _lane_rows(requantize_kv(vf, v_scale), written)
     else:
-        pool_k, pool_v = kf.astype(jnp.bfloat16), vf.astype(jnp.bfloat16)
+        rows_k = _lane_rows(kf, written).astype(jnp.bfloat16)
+        rows_v = _lane_rows(vf, written).astype(jnp.bfloat16)
+    pool_k = _scatter_rows(pool_k, page_tables, rows_k, written, active, pt)
+    pool_v = _scatter_rows(pool_v, page_tables, rows_v, written, active, pt)
+    return tokens, positions, pool_k, pool_v, history, oracle, accepted
+
+
+@partial(jax.jit, static_argnames=("n_heads", "k", "page_tokens", "qmode"),
+         donate_argnums=(1, 2, 6, 7, 8))
+def _spec_step_window_jit(params, pool_k, pool_v, k_scale, v_scale,
+                          page_tables, history, tokens, positions, active,
+                          draft_noise, *, n_heads, k, page_tokens, qmode):
+    """Speculative step for sparse-backend lanes: same draft/accept core,
+    with the k+1-wide verify forward attending the windowed key set
+    (``_speculative_verify_window``). The verify gathers full lane
+    stripes like the dense spec step — speculation is a latency
+    trade-off knob, not the steady-state path the windowed decode
+    optimizes — and scatters the k+1 written rows back by page index.
+    ``qmode`` is static; scale operands are None unless int8."""
+    dtype = _cache_dtype(params)
+    pt = pool_k.shape[3]
+    lk = _gather_lanes(pool_k, page_tables)
+    lv = _gather_lanes(pool_v, page_tables)
+    if qmode == "int8":
+        kf = dequantize_kv(lk, k_scale, dtype)
+        vf = dequantize_kv(lv, v_scale, dtype)
+    elif qmode == "bf16":
+        kf, vf = lk.astype(dtype), lv.astype(dtype)
+    else:
+        kf, vf = lk, lv
+    written = positions[:, None] + jnp.arange(k + 1)[None, :]
+    tokens, positions, (kf, vf), history, oracle, accepted = _spec_core(
+        params, n_heads, (kf, vf), history, tokens, positions, active,
+        draft_noise, k, window_pt=page_tokens)
+    if qmode == "int8":
+        rows_k = _lane_rows(requantize_kv(kf, k_scale), written)
+        rows_v = _lane_rows(requantize_kv(vf, v_scale), written)
+    elif qmode == "bf16":
+        rows_k = _lane_rows(kf, written).astype(jnp.bfloat16)
+        rows_v = _lane_rows(vf, written).astype(jnp.bfloat16)
+    else:
+        rows_k = _lane_rows(kf, written)
+        rows_v = _lane_rows(vf, written)
+    pool_k = _scatter_rows(pool_k, page_tables, rows_k, written, active, pt)
+    pool_v = _scatter_rows(pool_v, page_tables, rows_v, written, active, pt)
     return tokens, positions, pool_k, pool_v, history, oracle, accepted
 
 
@@ -359,11 +761,42 @@ class ServingEngine:
             raise ValueError(
                 f"serving.kv_cache_dtype must be one of {KV_CACHE_DTYPES}, "
                 f"got {cfg.kv_cache_dtype!r}")
+        if cfg.kv_page_tokens is not None and (
+                isinstance(cfg.kv_page_tokens, bool)
+                or not isinstance(cfg.kv_page_tokens, int)
+                or cfg.kv_page_tokens < 1):
+            raise ValueError(
+                f"serving.kv_page_tokens must be an int >= 1 "
+                f"(None = {DEFAULT_PAGE_TOKENS}), got {cfg.kv_page_tokens!r}")
+        if cfg.kv_pool_tokens is not None and (
+                isinstance(cfg.kv_pool_tokens, bool)
+                or not isinstance(cfg.kv_pool_tokens, int)
+                or cfg.kv_pool_tokens < 1):
+            raise ValueError(
+                f"serving.kv_pool_tokens must be an int >= 1 (None = "
+                f"max_slots * max_seq_len, the contiguous-equivalent "
+                f"budget), got {cfg.kv_pool_tokens!r}")
+        self._impl_default, self._impl_map = _parse_attention_impl(
+            cfg.attention_impl, buckets)
+        impls = set(self._impl_map.values())
+        impls.add(self._impl_default)
+        self._any_window = "sparse_xla" in impls
+        self._any_flash = "flash" in impls
+        page_tokens = resolve_page_tokens(
+            cfg.kv_page_tokens or DEFAULT_PAGE_TOKENS, self.max_seq_len)
+        if self._any_window and self.max_seq_len < (SPARSE_BAND + 1) * page_tokens:
+            raise ValueError(
+                f"serving.attention_impl='sparse_xla' needs at least "
+                f"{SPARSE_BAND + 1} pages per lane: max_seq_len="
+                f"{self.max_seq_len} < {(SPARSE_BAND + 1) * page_tokens} "
+                f"(kv_page_tokens={page_tokens})")
 
         dtype = _cache_dtype(params)
         self.pool = KVCachePool(self.n_layers, cfg.max_slots, self.n_heads,
                                 self.max_seq_len, self.head_dim, dtype=dtype,
-                                kv_cache_dtype=cfg.kv_cache_dtype)
+                                kv_cache_dtype=cfg.kv_cache_dtype,
+                                page_tokens=cfg.kv_page_tokens,
+                                pool_tokens=cfg.kv_pool_tokens)
         # _qmode: storage<->compute conversion the decode programs need.
         # "fp32" stores the compute dtype directly, and "bf16" on a bf16
         # checkpoint is ALSO storage==compute — both take the plain
@@ -391,12 +824,20 @@ class ServingEngine:
         self._active = {}                                   # slot -> Request
         self._lane_tokens = np.zeros(cfg.max_slots, np.int32)
         self._lane_active = np.zeros(cfg.max_slots, bool)
+        # which active lanes run the windowed (sparse) decode program;
+        # the complement runs the full-gather (dense/flash) program.
+        # Each program masks with its own class vector, so threading the
+        # shared token/position/pool operands through both leaves every
+        # lane with exactly its own class's result.
+        self._lane_impl_window = np.zeros(cfg.max_slots, bool)
         # device-resident decode operands: uploaded ONLY on lane churn
         # (_lane_dirty), advanced in-jit otherwise — steady-state decode
         # performs exactly one explicit transfer per step (the EOS read)
         self._dev_tokens = None
         self._dev_positions = None
         self._dev_active = None
+        self._dev_active_win = None
+        self._dev_page_tables = None
         self._lane_dirty = True
         # speculative state: per-lane token-by-position history feeding
         # the n-gram drafter (host mirror for churn re-upload, device
@@ -420,10 +861,29 @@ class ServingEngine:
                 decode_prog, budget, name="serving decode step")
             self.prefill_sentinel = CompileSentinel(
                 _prefill_batch_jit, budget, name="serving batched prefill")
+            # backend programs get their own pins only when armed — an
+            # all-dense config keeps the exact legacy sentinel set
+            self.decode_window_sentinel = (
+                CompileSentinel(
+                    _spec_step_window_jit if self._spec_k > 0
+                    else _decode_step_window_jit,
+                    budget, name="serving window decode step")
+                if self._any_window else None)
+            self.prefill_window_sentinel = (
+                CompileSentinel(_prefill_batch_window_jit, budget,
+                                name="serving window prefill")
+                if self._any_window else None)
+            self.prefill_flash_sentinel = (
+                CompileSentinel(_prefill_batch_flash_jit, budget,
+                                name="serving flash prefill")
+                if self._any_flash else None)
             self._transfer_guard = bool(sentinel_config.transfer_guard)
         else:
             self.decode_sentinel = None
             self.prefill_sentinel = None
+            self.decode_window_sentinel = None
+            self.prefill_window_sentinel = None
+            self.prefill_flash_sentinel = None
             self._transfer_guard = False
         # batched prefill always runs at the pool width: the batch dim is
         # STATIC, so any admission-group size shares one program per bucket
@@ -576,18 +1036,38 @@ class ServingEngine:
             if self._lane_dirty:
                 self._upload_lane_state()
             guard = transfer_free() if self._transfer_guard else nullcontext()
+            # host-side np masks: np.bool_ drives the dispatch branches
+            # directly (a bool() cast here reads as a device sync to JL002)
+            full_any = np.any(self._lane_active & ~self._lane_impl_window)
+            win_any = np.any(self._lane_active & self._lane_impl_window)
             if self._spec_k > 0:
                 self._maybe_update_noise()
                 with guard:
-                    (self._dev_tokens, self._dev_positions, self.pool.k,
-                     self.pool.v, self._dev_history, oracle_dev,
-                     accepted_dev) = self._call_spec_step()
+                    got = []
+                    if full_any:
+                        (self._dev_tokens, self._dev_positions, self.pool.k,
+                         self.pool.v, self._dev_history, oracle_dev,
+                         accepted_dev) = self._call_spec_step()
+                        got.append((oracle_dev, accepted_dev))
+                    if win_any:
+                        (self._dev_tokens, self._dev_positions, self.pool.k,
+                         self.pool.v, self._dev_history, oracle_dev,
+                         accepted_dev) = self._call_spec_step_window()
+                        got.append((oracle_dev, accepted_dev))
                 if self.decode_sentinel is not None:
                     self.decode_sentinel.check()
+                if self.decode_window_sentinel is not None:
+                    self.decode_window_sentinel.check()
                 # the step's single deliberate sync: the emit loop needs
-                # the oracle tokens and per-lane acceptance counts
-                oracle, accepted = jax.device_get(  # jaxlint: disable=JL002(one explicit host read per step)
-                    (oracle_dev, accepted_dev))
+                # the oracle tokens and per-lane acceptance counts (one
+                # tuple read even when both class programs ran)
+                host = jax.device_get(tuple(got))  # jaxlint: disable=JL002(one explicit host read per step)
+                if full_any and win_any:
+                    wm = self._lane_impl_window
+                    oracle = np.where(wm[:, None], host[1][0], host[0][0])
+                    accepted = np.where(wm, host[1][1], host[0][1])
+                else:
+                    oracle, accepted = host[0]
                 step_s = time.monotonic() - t0
                 oracle = oracle.tolist()        # host numpy -> python ints
                 accepted = accepted.tolist()
@@ -618,30 +1098,48 @@ class ServingEngine:
                             # non-speculative server would have stopped
                             stats["retired"] += 1
                             break
+                occ = self.pool.occupancy()
                 self.metrics.record_step(
                     queue_depth=self.scheduler.queue_depth(),
                     active_slots=n_active, max_slots=self.pool.max_slots,
                     tokens_this_step=stats["decoded"] - decoded_before,
                     step_s=step_s, accepted_tokens=acc_total,
-                    proposed_tokens=self._spec_k * n_active)
+                    proposed_tokens=self._spec_k * n_active,
+                    pages_in_use=occ["pages_in_use"],
+                    page_fragmentation=occ["page_fragmentation"])
             else:
                 with guard:
-                    if self._qmode is not None:
+                    if full_any:
+                        if self._qmode is not None:
+                            (self._dev_tokens, self._dev_positions,
+                             self.pool.k, self.pool.v) = \
+                                _decode_step_quant_jit(
+                                    self.params, self.pool.k, self.pool.v,
+                                    self.pool.k_scale, self.pool.v_scale,
+                                    self._dev_page_tables, self._dev_tokens,
+                                    self._dev_positions, self._dev_active,
+                                    n_heads=self.n_heads, qmode=self._qmode)
+                        else:
+                            (self._dev_tokens, self._dev_positions,
+                             self.pool.k, self.pool.v) = _decode_step_jit(
+                                self.params, self.pool.k, self.pool.v,
+                                self._dev_page_tables, self._dev_tokens,
+                                self._dev_positions, self._dev_active,
+                                n_heads=self.n_heads)
+                    if win_any:
                         (self._dev_tokens, self._dev_positions, self.pool.k,
-                         self.pool.v) = _decode_step_quant_jit(
+                         self.pool.v) = _decode_step_window_jit(
                             self.params, self.pool.k, self.pool.v,
                             self.pool.k_scale, self.pool.v_scale,
-                            self._dev_tokens, self._dev_positions,
-                            self._dev_active, n_heads=self.n_heads,
+                            self._dev_page_tables, self._dev_tokens,
+                            self._dev_positions, self._dev_active_win,
+                            n_heads=self.n_heads,
+                            page_tokens=self.pool.page_tokens,
                             qmode=self._qmode)
-                    else:
-                        (self._dev_tokens, self._dev_positions,
-                         self.pool.k, self.pool.v) = _decode_step_jit(
-                            self.params, self.pool.k, self.pool.v,
-                            self._dev_tokens, self._dev_positions,
-                            self._dev_active, n_heads=self.n_heads)
                 if self.decode_sentinel is not None:
                     self.decode_sentinel.check()
+                if self.decode_window_sentinel is not None:
+                    self.decode_window_sentinel.check()
                 # the step's single deliberate sync: EOS checks need the
                 # tokens
                 host_tokens = jax.device_get(self._dev_tokens)  # jaxlint: disable=JL002(one explicit host read per step)
@@ -658,10 +1156,13 @@ class ServingEngine:
                     stats["decoded"] += 1
                     stats["retired"] += self._maybe_retire(req, toks[slot],
                                                            now)
+                occ = self.pool.occupancy()
                 self.metrics.record_step(
                     queue_depth=self.scheduler.queue_depth(),
                     active_slots=n_active, max_slots=self.pool.max_slots,
-                    tokens_this_step=n_active, step_s=step_s)
+                    tokens_this_step=n_active, step_s=step_s,
+                    pages_in_use=occ["pages_in_use"],
+                    page_fragmentation=occ["page_fragmentation"])
         self._step_count += 1
         if self.slo is not None:
             # host-only snapshot + pushed gauges; under policy="fail" a
@@ -683,38 +1184,60 @@ class ServingEngine:
         return vals
 
     def _upload_lane_state(self):
-        """Lane churn: ONE explicit upload of the lane vectors (and the
-        drafter history when speculation is armed); between churn events
-        they live on device and never move."""
+        """Lane churn: ONE explicit upload of the lane vectors, both
+        per-class active masks, the page tables, and the drafter history
+        when speculation is armed; between churn events they live on
+        device and never move. Page-table churn rides the same dirty
+        flag lane churn already sets (allocate/free happen exactly
+        there), so paging adds no extra steady-state transfers."""
         pos = np.ascontiguousarray(self.pool.positions, dtype=np.int32)
+        full = self._lane_active & ~self._lane_impl_window
+        win = self._lane_active & self._lane_impl_window
+        tables = np.ascontiguousarray(self.pool.page_tables)
         if self._spec_k > 0:
             (self._dev_tokens, self._dev_positions, self._dev_active,
+             self._dev_active_win, self._dev_page_tables,
              self._dev_history) = jax.device_put(
-                (self._lane_tokens, pos, self._lane_active,
+                (self._lane_tokens, pos, full, win, tables,
                  self._lane_history))
             if self._dev_noise is None:
                 self._dev_noise = jax.device_put(
                     np.zeros((self.pool.max_slots, self._spec_k), np.int32))
         else:
-            self._dev_tokens, self._dev_positions, self._dev_active = \
-                jax.device_put((self._lane_tokens, pos, self._lane_active))
+            (self._dev_tokens, self._dev_positions, self._dev_active,
+             self._dev_active_win, self._dev_page_tables) = jax.device_put(
+                (self._lane_tokens, pos, full, win, tables))
         self._lane_dirty = False
 
     def _call_spec_step(self):
-        """Dispatch the speculative step program for the pool's storage
-        mode. Both return (tokens, positions, k, v, history, oracle,
-        accepted)."""
+        """Dispatch the full-gather speculative step program (dense and
+        flash lanes) for the pool's storage mode. Both return (tokens,
+        positions, k, v, history, oracle, accepted)."""
         if self._qmode is not None:
             return _spec_step_quant_jit(
                 self.params, self.pool.k, self.pool.v,
-                self.pool.k_scale, self.pool.v_scale, self._dev_history,
+                self.pool.k_scale, self.pool.v_scale,
+                self._dev_page_tables, self._dev_history,
                 self._dev_tokens, self._dev_positions, self._dev_active,
                 self._dev_noise, n_heads=self.n_heads, k=self._spec_k,
                 qmode=self._qmode)
         return _spec_step_jit(  # jaxlint: disable=JL005(exclusive branch: the quant dispatch above never ran)
-            self.params, self.pool.k, self.pool.v, self._dev_history,
-            self._dev_tokens, self._dev_positions, self._dev_active,
-            self._dev_noise, n_heads=self.n_heads, k=self._spec_k)
+            self.params, self.pool.k, self.pool.v, self._dev_page_tables,
+            self._dev_history, self._dev_tokens, self._dev_positions,
+            self._dev_active, self._dev_noise, n_heads=self.n_heads,
+            k=self._spec_k)
+
+    def _call_spec_step_window(self):
+        """Dispatch the windowed speculative step program (sparse lanes;
+        one program handles every storage mode via the static qmode —
+        scale operands are None unless int8)."""
+        return _spec_step_window_jit(
+            self.params, self.pool.k, self.pool.v,
+            self.pool.k_scale, self.pool.v_scale, self._dev_page_tables,
+            self._dev_history, self._dev_tokens, self._dev_positions,
+            self._dev_active_win, self._dev_noise,
+            n_heads=self.n_heads, k=self._spec_k,
+            page_tokens=self.pool.page_tokens, qmode=self._qmode)
 
     def _maybe_update_noise(self):
         """Swap the device-resident draft-noise operand when the
@@ -795,35 +1318,65 @@ class ServingEngine:
         else:
             self._admit_from_queue_now(stats)
 
+    def _impl_for_len(self, prompt_len):
+        """Attention backend for a request, selected by its FULL prompt
+        length's bucket (not the prefix-adjusted suffix bucket — the
+        prefix lookup itself is backend-filtered, so selection must not
+        depend on it)."""
+        return self._impl_map.get(
+            bucket_for(prompt_len, self.scheduler.buckets),
+            self._impl_default)
+
+    def _alloc_tokens(self, req):
+        """Page budget claimed for a request at admission: the exact
+        prompt + generation span (rounded up to whole pages by the
+        allocator). Under fault injection, stuck/runaway lanes may
+        decode past their natural length, so claim the full lane."""
+        if self.injector is not None:
+            return None
+        return min(len(req.prompt) + req.max_new_tokens, self.max_seq_len)
+
     def _admit_from_queue_now(self, stats):
         while self.pool.free_slots > 0:
             head = self.scheduler.pop_next()
             if head is None:
                 return
-            if self._needs_chunking(head):
-                if self._chunking is None:
-                    self._start_chunked(head)
-                    stats["admitted"] += 1
-                    continue
-                self.scheduler.requeue_front(head)   # chunk lane is busy
+            if not self.pool.can_allocate(self._alloc_tokens(head)):
+                # page-pool backpressure: FIFO head waits for frees
+                self.scheduler.requeue_front(head)
                 return
+            if self._needs_chunking(head):
+                if self._chunking is not None:
+                    self.scheduler.requeue_front(head)   # chunk lane is busy
+                    return
+                if not self._start_chunked(head):
+                    return                   # pages raced away (requeued)
+                stats["admitted"] += 1
+                continue
             bucket = bucket_for(self._suffix_len(head), self.scheduler.buckets)
+            impl = self._impl_for_len(len(head.prompt))
             group = [head]
             room = min(self.pool.free_slots - 1, self._prefill_batch - 1)
             if room > 0:
                 group += self.scheduler.pop_matching(
                     lambda r: (not self._needs_chunking(r)
+                               and self._impl_for_len(len(r.prompt)) == impl
                                and bucket_for(self._suffix_len(r),
                                               self.scheduler.buckets)
                                == bucket),
                     room)
-            stats["admitted"] += len(group)
-            stats["retired"] += self._admit_batch(group, bucket)
+            admitted, retired = self._admit_batch(group, bucket, impl)
+            stats["admitted"] += admitted
+            stats["retired"] += retired
+            if admitted < len(group):
+                return                       # pages ran out mid-group
 
-    def _admit_batch(self, group, bucket):
-        """Prefill ``group`` (same bucket) as one [MaxSlots, bucket] call
-        and install each lane into its slot. Returns how many requests
-        retired on their very first token."""
+    def _admit_batch(self, group, bucket, impl):
+        """Prefill ``group`` (same bucket AND attention backend) as one
+        [MaxSlots, Sb] call and install each lane into its slot. Slots
+        and pages are claimed FIRST: members the page pool cannot hold
+        are requeued in FIFO order before any compute runs. Returns
+        (admitted, retired-on-their-very-first-token) counts."""
         pspan = (self._tracer.span(
                      "serving/prefill_batch", cat="serving",
                      args={"request_ids": [r.id for r in group],
@@ -831,19 +1384,36 @@ class ServingEngine:
                  if self._tracer.enabled else telemetry.NULL_SPAN)
         pspan.__enter__()
         B, total = self._prefill_batch, self.max_seq_len
-        ids = np.zeros((B, bucket), np.int32)
+        pt = self.pool.page_tokens
+        # the sparse prefill's blocked attention needs a page-multiple
+        # chunk width; pad queries are invisible (outputs discarded,
+        # their garbage KV is overwritten by decode before attendable)
+        Sb = _round_up(bucket, pt) if impl == "sparse_xla" else bucket
+        ids = np.zeros((B, Sb), np.int32)
         starts = np.zeros(B, np.int32)
         lens = np.ones(B, np.int32)        # dummy lanes: 1-token no-ops
         plan = []
         any_hit = False
-        for i, req in enumerate(group):
+        for req in group:
+            try:
+                slot = self.pool.allocate(self._alloc_tokens(req))
+            except PoolExhaustedError:
+                break
+            i = len(plan)
+            req.attn_impl = impl
             reuse, entry = self._acquire_prefix(req)
             suffix = req.prompt[reuse:]
             ids[i, :len(suffix)] = suffix
             starts[i] = reuse
             lens[i] = len(req.prompt)
-            plan.append((req, reuse, entry))
+            plan.append((req, reuse, entry, slot))
             any_hit = any_hit or reuse > 0
+            self.metrics.record_admission(bucket, len(req.prompt))
+        for req in reversed(group[len(plan):]):
+            self.scheduler.requeue_front(req)    # pages exhausted mid-group
+        if not plan:
+            pspan.__exit__(None, None, None)
+            return 0, 0
         # prefill runs in the COMPUTE dtype regardless of pool storage:
         # the quantize happens once, at lane install
         shape = (self.n_layers, B, self.n_heads, total, self.head_dim)
@@ -852,7 +1422,7 @@ class ServingEngine:
             # seed hit lanes from host-resident prefix KV; one transfer
             init_k = np.zeros(shape, cdtype)
             init_v = np.zeros(shape, cdtype)
-            for i, (req, reuse, entry) in enumerate(plan):
+            for i, (req, reuse, entry, _slot) in enumerate(plan):
                 if reuse > 0:
                     ek, ev = self._entry_prefix_kv(entry, reuse)
                     init_k[:, i, :, :reuse] = ek
@@ -863,23 +1433,20 @@ class ServingEngine:
             init_v = jnp.zeros(shape, cdtype)
 
         t0 = time.monotonic()
-        k, v, first = _prefill_batch_jit(
-            self.params, init_k, init_v, jnp.asarray(ids),
-            jnp.asarray(starts), jnp.asarray(lens), n_heads=self.n_heads)
-        if self.prefill_sentinel is not None:
-            self.prefill_sentinel.check()
+        k, v, first = self._run_prefill(impl, init_k, init_v,
+                                        jnp.asarray(ids), jnp.asarray(starts),
+                                        jnp.asarray(lens))
         first_host = np.asarray(first)             # sync: TTFT endpoint
         prefill_s = time.monotonic() - t0
         self.metrics.record_prefill(
-            tokens=sum(len(r.prompt) - re for r, re, _ in plan),
-            reused_tokens=sum(re for _, re, _ in plan),
-            requests=len(group), prefill_s=prefill_s)
+            tokens=sum(len(r.prompt) - re for r, re, _, _ in plan),
+            reused_tokens=sum(re for _, re, _, _ in plan),
+            requests=len(plan), prefill_s=prefill_s)
 
         now = time.monotonic()
         retired = 0
-        for i, (req, reuse, entry) in enumerate(plan):
+        for i, (req, reuse, entry, slot) in enumerate(plan):
             self._maybe_insert_prefix(req, reuse, k, v, lane=i)
-            slot = self.pool.allocate()
             self.pool.install_lane(k, v, lane=i, slot=slot,
                                    position=len(req.prompt))
             req.prefix_entry = entry
@@ -892,7 +1459,29 @@ class ServingEngine:
         # measured latency
         self.pool.k.block_until_ready()
         pspan.__exit__(None, None, None)
-        return retired
+        return len(plan), retired
+
+    def _run_prefill(self, impl, init_k, init_v, ids, starts, lens):
+        """Dispatch the per-backend batched prefill program (each with
+        its own CompileSentinel pin when armed)."""
+        if impl == "sparse_xla":
+            out = _prefill_batch_window_jit(
+                self.params, init_k, init_v, ids, starts, lens,
+                n_heads=self.n_heads, page_tokens=self.pool.page_tokens)
+            sentinel = self.prefill_window_sentinel
+        elif impl == "flash":
+            out = _prefill_batch_flash_jit(
+                self.params, init_k, init_v, ids, starts, lens,
+                n_heads=self.n_heads, page_tokens=self.pool.page_tokens)
+            sentinel = self.prefill_flash_sentinel
+        else:
+            out = _prefill_batch_jit(
+                self.params, init_k, init_v, ids, starts, lens,
+                n_heads=self.n_heads)
+            sentinel = self.prefill_sentinel
+        if sentinel is not None:
+            sentinel.check()
+        return out
 
     # -- chunked prefill ------------------------------------------------
     def _needs_chunking(self, req):
@@ -900,11 +1489,24 @@ class ServingEngine:
         return chunk > 0 and self._suffix_len(req) > chunk
 
     def _start_chunked(self, req):
-        """Reserve a slot and a private cache for ``req`` and let
-        ``_advance_chunk`` feed it one chunk per engine step."""
+        """Reserve a slot+pages and a private cache for ``req`` and let
+        ``_advance_chunk`` feed it one chunk per engine step. Returns
+        False (request requeued) if the page pool cannot hold it."""
+        req.attn_impl = self._impl_for_len(len(req.prompt))
         reuse, entry = self._acquire_prefix(req)
         req.prefix_entry = entry
-        slot = self.pool.allocate()       # reserved: completion can't stall
+        try:
+            # reserved up front: completion can't stall on a full pool
+            slot = self.pool.allocate(self._alloc_tokens(req))
+        except PoolExhaustedError:
+            if entry is not None and self.prefix_cache is not None:
+                self.prefix_cache.release(entry)
+                req.prefix_entry = None
+            self.scheduler.requeue_front(req)
+            return False
+        self.metrics.record_admission(
+            bucket_for(self._suffix_len(req), self.scheduler.buckets),
+            len(req.prompt))
         shape = (self.n_layers, 1, self.n_heads, self.max_seq_len,
                  self.head_dim)
         cdtype = self.pool.compute_dtype
@@ -920,6 +1522,7 @@ class ServingEngine:
             v0 = jnp.zeros(shape, cdtype)
         self._chunking = _ChunkedPrefill(req, k0, v0, pos=reuse, reuse=reuse,
                                          slot=slot)
+        return True
 
     def _advance_chunk(self, stats):
         """Run the next chunk of the in-flight chunked prefill (same
@@ -935,9 +1538,17 @@ class ServingEngine:
             self._chunking = None
             stats["retired"] += 1
             return
+        impl = getattr(req, "attn_impl", "dense")
         chunk_len = self.config.prefill_chunk_tokens
+        # sparse chunks pad to a page multiple (blocked attention width
+        # constraint); a chunk's pad garbage is overwritten by the next
+        # chunk's real writes before it is ever attendable, and the
+        # final chunk's by decode — same write-before-attend argument
+        # as batched prefill padding
+        cw = (_round_up(chunk_len, self.pool.page_tokens)
+              if impl == "sparse_xla" else chunk_len)
         chunk = req.prompt[st.pos:st.pos + chunk_len]
-        ids = np.zeros((1, chunk_len), np.int32)
+        ids = np.zeros((1, cw), np.int32)
         ids[0, :len(chunk)] = chunk
         cspan = (self._tracer.span("serving/prefill_chunk", cat="serving",
                                    args={"request_id": req.id, "pos": st.pos,
@@ -945,13 +1556,10 @@ class ServingEngine:
                  if self._tracer.enabled else telemetry.NULL_SPAN)
         t0 = time.monotonic()
         with cspan:
-            st.k, st.v, first = _prefill_batch_jit(
-                self.params, st.k, st.v, jnp.asarray(ids),
+            st.k, st.v, first = self._run_prefill(
+                impl, st.k, st.v, jnp.asarray(ids),
                 jnp.asarray([st.pos], jnp.int32),
-                jnp.asarray([len(req.prompt)], jnp.int32),
-                n_heads=self.n_heads)
-            if self.prefill_sentinel is not None:
-                self.prefill_sentinel.check()
+                jnp.asarray([len(req.prompt)], jnp.int32))
         st.pos += len(chunk)
         stats["prefill_chunks"] += 1
         if st.pos < len(req.prompt):
@@ -978,7 +1586,8 @@ class ServingEngine:
         recomputed to produce the first token's logits)."""
         if self.prefix_cache is None:
             return len(req.prompt)
-        length, _ = self.prefix_cache.match(req.prompt)
+        length, _ = self.prefix_cache.match(
+            req.prompt, impl=self._impl_for_len(len(req.prompt)))
         return len(req.prompt) - min(length, len(req.prompt) - 1)
 
     def _acquire_prefix(self, req):
@@ -987,7 +1596,8 @@ class ServingEngine:
         request's retirement (any path)."""
         if self.prefix_cache is None:
             return 0, None
-        length, entry = self.prefix_cache.acquire(req.prompt)
+        length, entry = self.prefix_cache.acquire(
+            req.prompt, impl=getattr(req, "attn_impl", "dense"))
         reuse = min(length, len(req.prompt) - 1)
         if entry is not None and reuse <= 0:
             self.prefix_cache.release(entry)
@@ -1007,15 +1617,21 @@ class ServingEngine:
         n = len(req.prompt)
         if reuse >= n - 1:
             return
+        # entries are tagged with the backend that produced them: for
+        # L >= 2 layers the backends' hidden states (hence deep-layer
+        # KV) differ in low bits, so cross-backend seeding would break
+        # the per-backend bitwise oracle
+        impl = getattr(req, "attn_impl", "dense")
         pk = np.asarray(k[:, lane, :, :n])
         pv = np.asarray(v[:, lane, :, :n])
         if self.pool.kv_cache_dtype == "int8":
             pk, k_scale = quantize_kv_np(pk)
             pv, v_scale = quantize_kv_np(pv)
             self.prefix_cache.insert(req.prompt, pk, pv,
-                                     k_scale=k_scale, v_scale=v_scale)
+                                     k_scale=k_scale, v_scale=v_scale,
+                                     impl=impl)
             return
-        self.prefix_cache.insert(req.prompt, pk, pv)
+        self.prefix_cache.insert(req.prompt, pk, pv, impl=impl)
 
     def _entry_prefix_kv(self, entry, reuse):
         """A prefix entry's first ``reuse`` positions in the pool's
@@ -1035,6 +1651,8 @@ class ServingEngine:
         self._active[slot] = req
         self._lane_tokens[slot] = first_tok
         self._lane_active[slot] = True
+        self._lane_impl_window[slot] = (
+            getattr(req, "attn_impl", "dense") == "sparse_xla")
         if self._lane_history is not None:
             # seed the drafter: prompt tokens by position, then the
             # PENDING first generated token at position len(prompt)
@@ -1086,6 +1704,7 @@ class ServingEngine:
     def _release_slot(self, req):
         if req.slot is not None:
             self._lane_active[req.slot] = False
+            self._lane_impl_window[req.slot] = False
             self._lane_dirty = True
             self._active.pop(req.slot, None)
             self.pool.free(req.slot)

@@ -1,36 +1,43 @@
-"""Slot-based KV-cache pool for continuous-batching inference.
+"""Paged KV-cache pool for continuous-batching inference.
 
-One device-resident cache pair shaped ``[L, MaxSlots, nh, S_max, hd]``
-holds every in-flight request's keys/values; a *slot* is one lane of the
-MaxSlots axis. The pool is the reason admission never recompiles: the
-arrays' shapes are fixed at construction, so a request joining or
-retiring only changes *which* lanes the (single compiled) decode step
-treats as active — never the program.
+The pool stores keys/values as FIXED-SIZE PAGES of ``page_tokens``
+positions each — ``[L, n_pages, nh, page_tokens, hd]`` — instead of one
+contiguous ``S_max`` stripe per slot. A *slot* is still one admission
+lane (the engine's compiled programs are shaped by ``max_slots``), but a
+lane's tokens now live wherever its PAGE TABLE points: ``page_tables``
+is a host-side ``[max_slots, pages_per_lane]`` int32 map from a lane's
+logical page index to a physical page, uploaded to the device only when
+lane membership changes. The jitted decode/prefill programs gather and
+scatter BY PAGE INDEX, so:
 
-Slot hygiene contract (relied on by the engine, proved in
-``tests/unit/test_serving.py``):
+- the bucket ladder extends into 16k–64k without paying
+  ``MaxSlots x S_max`` bytes up front — short requests claim few pages,
+  long requests claim many, all against ONE shared ``pool_tokens``
+  budget (the ZeRO-Infinity tiering shape: fixed-size units under a
+  single budget, no fragmentation classes);
+- slot churn moves host integers around, never recompiles (shapes are
+  fixed at construction, exactly as before).
 
-- installing a prefilled request overwrites the ENTIRE lane
-  (``[L, nh, S_max, hd]``), so whatever a previous occupant left behind
-  can never be read by the new one;
-- while a slot is inactive, the masked decode step may keep writing
-  garbage k/v at the lane's stale position — harmless, because lanes are
-  computed independently (vmap) and the causal mask hides positions
-  beyond any reader's own counter.
+Physical page 0 is the NULL page: it is never allocated, page-table
+rows are zeroed on free, and every jitted scatter routes inactive /
+out-of-range writes to it. A freed lane's masked decode step may keep
+writing garbage — it lands on the null page, so a page reallocated to a
+new request can never be corrupted by its previous owner. That plus
+install overwriting every mapped page preserves the old slot-hygiene
+contract verbatim.
 
-Host-side bookkeeping (free list, per-slot position counters, occupancy
-stats) is plain Python/numpy: it runs once per scheduler iteration, not
-per token-lane.
+``page_tokens`` always DIVIDES ``max_seq_len`` (``resolve_page_tokens``
+falls back to the gcd), so a full lane is exactly ``pages_per_lane``
+pages and gathering a lane's pages back-to-back reproduces the old
+contiguous ``[nh, S_max, hd]`` stripe bit-for-bit — which is how the
+dense decode programs stay bitwise-identical to the contiguous pool.
 
-Storage dtype (``kv_cache_dtype``): the pool can hold its lanes in the
-model's compute dtype ("fp32", the default — bitwise-transparent), in
-bfloat16 ("bf16" — half the bytes, cast at use), or in int8 with
-per-(slot, head) symmetric fp32 scales ("int8" — quarter the bytes,
-dequantized at use inside the decode/verify reads). Scales are set once
-at install time from the prefilled lane's amax and kept FIXED while the
-lane decodes (new tokens clip into the install range), so re-storing an
-untouched lane is a bitwise no-op and the engine's requantize step never
-perturbs prior tokens.
+Storage dtype (``kv_cache_dtype``): "fp32" stores the compute dtype,
+"bf16" halves the bytes, "int8" quarters them with per-(layer, slot,
+head) symmetric fp32 scales. Scales stay PER-LANE (pages are never
+shared between lanes), set once at install from the prefilled lane's
+amax and fixed while the lane decodes — re-storing an untouched row is
+a bitwise no-op, as before.
 """
 
 import numpy as np
@@ -38,58 +45,80 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..generation import DEFAULT_PAGE_TOKENS, resolve_page_tokens
 from ..quantization import quantize_kv
 
 KV_CACHE_DTYPES = ("fp32", "bf16", "int8")
 
 
 class PoolExhaustedError(RuntimeError):
-    """allocate() found no free slot. The scheduler treats this as "keep
-    the request queued", never as a hard failure — it is an error type so
-    direct pool users cannot mistake -1 style sentinels for a slot id."""
+    """allocate() found no free slot or not enough free pages. The
+    scheduler treats this as "keep the request queued", never as a hard
+    failure — it is an error type so direct pool users cannot mistake
+    -1 style sentinels for a slot id."""
 
 
-def _install_slot(pool_k, pool_v, new_k, new_v, slot):
-    """Copy a prefilled single-request cache ([L, 1, nh, S_max, hd]) into
-    lane ``slot`` of the pool. ``slot`` is a traced scalar: installing
-    into different slots reuses one compiled program. The cast covers the
-    "bf16" storage mode and is a no-op (elided by XLA) when the incoming
-    dtype already matches the pool's."""
-    pool_k = jax.lax.dynamic_update_index_in_dim(
-        pool_k, new_k[:, 0].astype(pool_k.dtype), slot, axis=1)
-    pool_v = jax.lax.dynamic_update_index_in_dim(
-        pool_v, new_v[:, 0].astype(pool_v.dtype), slot, axis=1)
+def _install_pages(pool_k, pool_v, new_k, new_v, dest_pages, page_tokens):
+    """Scatter a prefilled single-request cache ([L, 1, nh, S, hd],
+    S >= pages_per_lane * page_tokens) into the pool's pages at
+    ``dest_pages`` [pages_per_lane] (traced — any page assignment reuses
+    one compiled program). Unallocated logical pages carry dest 0 and
+    land harmlessly on the null page. The cast covers the "bf16" storage
+    mode and is a no-op when dtypes already match."""
+    L, _, nh, _, hd = new_k.shape
+    mp = dest_pages.shape[0]
+    span = mp * page_tokens
+
+    def paged(buf):
+        lane = buf[:, 0, :, :span]                       # [L, nh, span, hd]
+        pages = lane.reshape(L, nh, mp, page_tokens, hd)
+        return jnp.moveaxis(pages, 2, 1)                 # [L, mp, nh, pt, hd]
+
+    pool_k = pool_k.at[:, dest_pages].set(paged(new_k).astype(pool_k.dtype))
+    pool_v = pool_v.at[:, dest_pages].set(paged(new_v).astype(pool_v.dtype))
     return pool_k, pool_v
 
 
-def _install_slot_int8(pool_k, pool_v, k_scale, v_scale, new_k, new_v, slot):
-    """int8-mode install: quantize the prefilled lane ([L, nh, S_max, hd])
-    with fresh per-(layer, head) scales and overwrite both the lane and
-    its scale rows — a reallocated slot never inherits the previous
-    occupant's scale range."""
-    qk, sk = quantize_kv(new_k[:, 0])
-    qv, sv = quantize_kv(new_v[:, 0])
-    pool_k = jax.lax.dynamic_update_index_in_dim(pool_k, qk, slot, axis=1)
-    pool_v = jax.lax.dynamic_update_index_in_dim(pool_v, qv, slot, axis=1)
+def _install_pages_int8(pool_k, pool_v, k_scale, v_scale, new_k, new_v,
+                        dest_pages, slot, page_tokens):
+    """int8-mode install: quantize the prefilled lane with fresh
+    per-(layer, head) scales, page it, and overwrite both the mapped
+    pages and the lane's scale rows — a reallocated slot never inherits
+    the previous occupant's scale range."""
+    L, _, nh, _, hd = new_k.shape
+    mp = dest_pages.shape[0]
+    span = mp * page_tokens
+
+    def quant_paged(buf):
+        q, s = quantize_kv(buf[:, 0, :, :span])          # [L, nh, span, hd]
+        pages = q.reshape(L, nh, mp, page_tokens, hd)
+        return jnp.moveaxis(pages, 2, 1), s
+
+    qk, sk = quant_paged(new_k)
+    qv, sv = quant_paged(new_v)
+    pool_k = pool_k.at[:, dest_pages].set(qk)
+    pool_v = pool_v.at[:, dest_pages].set(qv)
     k_scale = jax.lax.dynamic_update_index_in_dim(k_scale, sk, slot, axis=1)
     v_scale = jax.lax.dynamic_update_index_in_dim(v_scale, sv, slot, axis=1)
     return pool_k, pool_v, k_scale, v_scale
 
 
-# Donate the pool buffers: the install is an in-place lane overwrite, the
+# Donate the pool buffers: the install is an in-place page overwrite, the
 # old pool is dead the moment the new one exists. (Scales are donated too
-# in the int8 path — the install REPLACES the slot's scale rows, so the
-# old scale array is equally dead.)
-_install_slot_jit = jax.jit(_install_slot, donate_argnums=(0, 1))
-_install_slot_int8_jit = jax.jit(_install_slot_int8,
-                                 donate_argnums=(0, 1, 2, 3))
+# in the int8 path — the install REPLACES the slot's scale rows.)
+_install_pages_jit = jax.jit(_install_pages, donate_argnums=(0, 1),
+                             static_argnums=(5,))
+_install_pages_int8_jit = jax.jit(_install_pages_int8,
+                                  donate_argnums=(0, 1, 2, 3),
+                                  static_argnums=(8,))
 
 
 class KVCachePool:
-    """Fixed-capacity KV-cache slots plus their host-side bookkeeping."""
+    """Fixed-capacity paged KV storage plus its host-side allocator."""
 
     def __init__(self, n_layers, max_slots, n_heads, max_seq_len, head_dim,
-                 dtype=jnp.float32, kv_cache_dtype="fp32"):
+                 dtype=jnp.float32, kv_cache_dtype="fp32",
+                 page_tokens=None, pool_tokens=None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if max_seq_len < 2:
@@ -107,29 +136,55 @@ class KVCachePool:
         # directly); quantized modes store narrower and dequant at use.
         self.compute_dtype = dtype
         self.kv_cache_dtype = kv_cache_dtype
-        shape = (self.n_layers, self.max_slots, self.n_heads,
-                 self.max_seq_len, self.head_dim)
+        self.page_tokens = resolve_page_tokens(
+            page_tokens or DEFAULT_PAGE_TOKENS, self.max_seq_len)
+        self.pages_per_lane = self.max_seq_len // self.page_tokens
+        # Shared token budget across all lanes. The default keeps the old
+        # every-lane-can-be-full capacity; a smaller budget is where the
+        # paged layout beats the contiguous MaxSlots x S_max footprint
+        # (long and short requests share it instead of each reserving
+        # S_max). Floor of one full lane so a single max-length request
+        # always fits.
+        if pool_tokens is None:
+            pool_tokens = self.max_slots * self.max_seq_len
+        if int(pool_tokens) < 1:
+            raise ValueError(f"pool_tokens must be >= 1, got {pool_tokens}")
+        self.pool_tokens = max(int(pool_tokens),
+                               self.pages_per_lane * self.page_tokens)
+        self.n_data_pages = self.pool_tokens // self.page_tokens
+        n_pages = self.n_data_pages + 1                  # + null page 0
+        shape = (self.n_layers, n_pages, self.n_heads,
+                 self.page_tokens, self.head_dim)
         storage = {"fp32": dtype, "bf16": jnp.bfloat16,
                    "int8": jnp.int8}[kv_cache_dtype]
         self.k = jnp.zeros(shape, storage)
         self.v = jnp.zeros(shape, storage)
         if kv_cache_dtype == "int8":
-            # one symmetric scale per (layer, slot, head); keepdims shape
-            # broadcasts directly against the lane in dequantize_kv
+            # one symmetric scale per (layer, slot, head) — per LANE, not
+            # per page: pages are never shared across lanes, and keeping
+            # the old shape keeps dequantize_kv broadcasting unchanged
             sshape = (self.n_layers, self.max_slots, self.n_heads, 1, 1)
             self.k_scale = jnp.ones(sshape, jnp.float32)
             self.v_scale = jnp.ones(sshape, jnp.float32)
         else:
             self.k_scale = None
             self.v_scale = None
-        # lowest-index-first allocation keeps slot assignment deterministic
-        # for a given arrival order (the oracle tests replay schedules)
+        # lowest-index-first allocation keeps slot/page assignment
+        # deterministic for a given arrival order (oracle tests replay
+        # schedules)
         self._free = sorted(range(self.max_slots), reverse=True)
+        self._free_pages = sorted(range(1, n_pages), reverse=True)
+        # logical->physical page map per lane; 0 (the null page) means
+        # unmapped. The engine mirrors this to the device only on churn.
+        self.page_tables = np.zeros((self.max_slots, self.pages_per_lane),
+                                    np.int32)
+        self._lane_pages = [[] for _ in range(self.max_slots)]
         # per-slot NEXT write/read position (== tokens cached so far)
         self.positions = np.zeros(self.max_slots, np.int32)
         self.allocations = 0
         self.frees = 0
         self.peak_in_use = 0
+        self.peak_pages_in_use = 0
 
     # -- slot lifecycle -------------------------------------------------
     @property
@@ -140,14 +195,48 @@ class KVCachePool:
     def free_slots(self):
         return len(self._free)
 
-    def allocate(self):
-        """Claim the lowest free slot; PoolExhaustedError when full."""
+    @property
+    def pages_in_use(self):
+        return self.n_data_pages - len(self._free_pages)
+
+    @property
+    def free_pages(self):
+        return len(self._free_pages)
+
+    def _pages_needed(self, n_tokens):
+        if n_tokens is None:
+            n_tokens = self.max_seq_len
+        n_tokens = min(max(int(n_tokens), 1), self.max_seq_len)
+        return -(-n_tokens // self.page_tokens)
+
+    def can_allocate(self, n_tokens=None):
+        """True iff allocate(n_tokens) would succeed right now."""
+        return (bool(self._free)
+                and self._pages_needed(n_tokens) <= len(self._free_pages))
+
+    def allocate(self, n_tokens=None):
+        """Claim the lowest free slot plus enough pages for ``n_tokens``
+        positions (default: a full ``max_seq_len`` lane — the contiguous
+        pool's behavior). PoolExhaustedError when out of slots or pages;
+        the pool is untouched on failure, so callers can requeue."""
         if not self._free:
             raise PoolExhaustedError(
                 f"all {self.max_slots} KV-cache slots are in use")
+        need = self._pages_needed(n_tokens)
+        if need > len(self._free_pages):
+            raise PoolExhaustedError(
+                f"KV page pool exhausted: need {need} pages, "
+                f"{len(self._free_pages)} of {self.n_data_pages} free "
+                f"({self.page_tokens} tokens/page)")
         slot = self._free.pop()
+        pages = [self._free_pages.pop() for _ in range(need)]
+        self.page_tables[slot] = 0
+        self.page_tables[slot, :need] = pages
+        self._lane_pages[slot] = pages
         self.allocations += 1
         self.peak_in_use = max(self.peak_in_use, self.slots_in_use)
+        self.peak_pages_in_use = max(self.peak_pages_in_use,
+                                     self.pages_in_use)
         self.positions[slot] = 0
         return slot
 
@@ -158,30 +247,44 @@ class KVCachePool:
             raise ValueError(f"slot {slot} is already free (double free)")
         self.frees += 1
         self.positions[slot] = 0
+        # zero the table row BEFORE returning pages: the freed lane's
+        # masked decode writes must route to the null page from the next
+        # uploaded table on, never to a page someone else now owns
+        self.page_tables[slot] = 0
+        self._free_pages.extend(self._lane_pages[slot])
+        self._free_pages.sort(reverse=True)
+        self._lane_pages[slot] = []
         self._free.append(slot)
         self._free.sort(reverse=True)
 
+    def lane_tokens(self, slot):
+        """Token capacity actually backed by this lane's pages."""
+        return len(self._lane_pages[slot]) * self.page_tokens
+
     def install(self, new_k, new_v, slot, position):
-        """Install a prefilled request cache into ``slot`` and set its
-        position counter (= prompt length: the next decode write index)."""
+        """Install a prefilled request cache ([L, 1, nh, S, hd] with
+        S >= max_seq_len) into ``slot``'s pages and set its position
+        counter (= prompt length: the next decode write index)."""
         if not 0 <= position < self.max_seq_len:
             raise ValueError(
                 f"position {position} outside [0, {self.max_seq_len})")
+        dest = jnp.asarray(self.page_tables[slot], jnp.int32)
         if self.kv_cache_dtype == "int8":
             (self.k, self.v, self.k_scale,
-             self.v_scale) = _install_slot_int8_jit(
+             self.v_scale) = _install_pages_int8_jit(
                 self.k, self.v, self.k_scale, self.v_scale,
-                new_k, new_v, slot)
+                new_k, new_v, dest, slot, self.page_tokens)
         else:
-            self.k, self.v = _install_slot_jit(
-                self.k, self.v, new_k, new_v, slot)
+            self.k, self.v = _install_pages_jit(
+                self.k, self.v, new_k, new_v, dest, self.page_tokens)
         self.positions[slot] = position
 
     def install_lane(self, batch_k, batch_v, lane, slot, position):
         """Install lane ``lane`` of a BATCHED prefill result
-        ([L, B, nh, S_max, hd]) into ``slot``. Reuses the single-lane
-        install program (the lane slice is a static index, the slot stays
-        traced), so batched admission adds no install compiles."""
+        ([L, B, nh, S, hd]) into ``slot``. Reuses the single-lane
+        install program (the lane slice is a static index; the dest
+        pages and slot stay traced), so batched admission adds no
+        install compiles."""
         self.install(batch_k[:, lane:lane + 1], batch_v[:, lane:lane + 1],
                      slot, position)
 
@@ -189,7 +292,7 @@ class KVCachePool:
         """Bump a slot's position after a decode step wrote its token.
         Clamped at the last cache index: a (injected-fault) runaway
         request keeps overwriting the final position instead of relying
-        on XLA's silent OOB-scatter clamping."""
+        on silent OOB-scatter behavior."""
         self.positions[slot] = min(self.positions[slot] + 1,
                                    self.max_seq_len - 1)
 
@@ -203,9 +306,24 @@ class KVCachePool:
             total += self.k_scale.nbytes + self.v_scale.nbytes
         return int(total)
 
+    def contiguous_equiv_bytes(self):
+        """Bytes the OLD contiguous layout ([L, MaxSlots, nh, S_max, hd]
+        per cache side, same storage dtype) would spend for the same
+        slot count — the footprint the paged pool beats when
+        ``pool_tokens`` undercuts ``max_slots * max_seq_len``."""
+        itemsize = {"fp32": jnp.dtype(self.compute_dtype).itemsize,
+                    "bf16": 2, "int8": 1}[self.kv_cache_dtype]
+        elems = (self.n_layers * self.max_slots * self.n_heads
+                 * self.max_seq_len * self.head_dim)
+        total = 2 * elems * itemsize
+        if self.k_scale is not None:
+            total += self.k_scale.nbytes + self.v_scale.nbytes
+        return int(total)
+
     def occupancy(self):
         """Occupancy snapshot for metrics/debugging."""
         in_use = self.slots_in_use
+        covered = self.pages_in_use * self.page_tokens
         return {
             "max_slots": self.max_slots,
             "in_use": in_use,
@@ -217,4 +335,13 @@ class KVCachePool:
             "cached_tokens": int(self.positions.sum()),
             "kv_cache_dtype": self.kv_cache_dtype,
             "pool_bytes": self.nbytes(),
+            "page_tokens": self.page_tokens,
+            "pages_total": self.n_data_pages,
+            "pages_in_use": self.pages_in_use,
+            "pages_free": self.free_pages,
+            "peak_pages_in_use": self.peak_pages_in_use,
+            # tokens reserved by claimed pages but not (yet) cached —
+            # internal fragmentation of the page granularity
+            "page_fragmentation": ((covered - int(self.positions.sum()))
+                                   / max(covered, 1)),
         }

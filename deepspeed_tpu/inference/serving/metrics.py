@@ -55,6 +55,12 @@ class ServingMetrics:
         self.draft_proposed = 0
         self.draft_accepted = 0
         self.kv_pool_bytes = 0
+        # paged KV pool: last-seen page occupancy/fragmentation gauges
+        # and a per-bucket histogram of admitted prompt lengths
+        # {bucket: [count, token_sum, min_len, max_len]}
+        self.pages_in_use = 0
+        self.page_fragmentation = 0.0
+        self._admitted_by_bucket = {}
         # TTFT: time from submit() to the request's first token
         self._ttft_sum = 0.0
         self._ttft_count = 0
@@ -94,6 +100,22 @@ class ServingMetrics:
         self._record("Serving/PrefixHitRate",
                      self.prefix_hits / lookups, lookups)
 
+    def record_admission(self, bucket, prompt_len):
+        """One admitted prompt: tally its TRUE length (not the padded
+        bucket width) under the bucket it was admitted to, building the
+        per-bucket admitted-prompt-length histogram."""
+        h = self._admitted_by_bucket.get(bucket)
+        if h is None:
+            self._admitted_by_bucket[bucket] = [
+                1, prompt_len, prompt_len, prompt_len]
+        else:
+            h[0] += 1
+            h[1] += prompt_len
+            h[2] = min(h[2], prompt_len)
+            h[3] = max(h[3], prompt_len)
+        self._record(f"Serving/admitted_prompt_len_bucket_{bucket}",
+                     prompt_len, self._admitted_by_bucket[bucket][0])
+
     def record_completion(self):
         self.requests_completed += 1
 
@@ -102,16 +124,23 @@ class ServingMetrics:
 
     def record_step(self, queue_depth, active_slots, max_slots,
                     tokens_this_step, step_s, accepted_tokens=0,
-                    proposed_tokens=0):
+                    proposed_tokens=0, pages_in_use=0,
+                    page_fragmentation=0.0):
         """One decode step. With speculation armed, ``proposed_tokens``
         is k * active lanes and ``accepted_tokens`` how many drafts the
         oracle confirmed — tokens_this_step then exceeds the lane count
-        by exactly the accepted drafts (minus early retirements)."""
+        by exactly the accepted drafts (minus early retirements).
+        ``pages_in_use``/``page_fragmentation`` come from the paged
+        pool's ``occupancy()`` — last-value gauges, not counters."""
         self.decode_steps += 1
         self.tokens_emitted += tokens_this_step
         self.decode_time_s += step_s
+        self.pages_in_use = pages_in_use
+        self.page_fragmentation = page_fragmentation
         step = self.decode_steps
         self._record("Serving/queue_depth", queue_depth, step)
+        self._record("Serving/pages_in_use", pages_in_use, step)
+        self._record("Serving/page_fragmentation", page_fragmentation, step)
         self._record("Serving/batch_occupancy",
                      active_slots / max_slots if max_slots else 0.0, step)
         if step_s > 0:
@@ -175,7 +204,7 @@ class ServingMetrics:
 
     def snapshot(self):
         p50, p95 = self.ttft_percentiles()
-        return {
+        snap = {
             "decode_steps": self.decode_steps,
             "tokens_emitted": self.tokens_emitted,
             "requests_completed": self.requests_completed,
@@ -199,8 +228,19 @@ class ServingMetrics:
             "draft_proposed": self.draft_proposed,
             "draft_accepted": self.draft_accepted,
             "kv_pool_bytes": self.kv_pool_bytes,
+            "pages_in_use": self.pages_in_use,
+            "page_fragmentation": self.page_fragmentation,
             "uptime_s": time.monotonic() - self._started,
         }
+        # flattened per-bucket admitted-prompt-length histogram: numeric
+        # keys so export_to's gauge filter picks them up unchanged
+        for bucket in sorted(self._admitted_by_bucket):
+            count, total, lo, hi = self._admitted_by_bucket[bucket]
+            snap[f"admitted_prompts_bucket_{bucket}"] = count
+            snap[f"admitted_prompt_len_mean_bucket_{bucket}"] = total / count
+            snap[f"admitted_prompt_len_min_bucket_{bucket}"] = lo
+            snap[f"admitted_prompt_len_max_bucket_{bucket}"] = hi
+        return snap
 
     def export_to(self, registry, name="Serving/Snapshot"):
         """Expose the numeric ``snapshot()`` fields as pull gauges on a

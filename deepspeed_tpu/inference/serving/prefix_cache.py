@@ -37,15 +37,22 @@ class PrefixEntry:
     (``k_scale``/``v_scale``, None otherwise) — quartering the bytes an
     entry charges against the budget, dequantized at seed time."""
 
-    __slots__ = ("tokens", "k", "v", "k_scale", "v_scale", "nbytes",
-                 "refs", "last_used")
+    __slots__ = ("tokens", "k", "v", "k_scale", "v_scale", "impl",
+                 "nbytes", "refs", "last_used")
 
-    def __init__(self, tokens, k, v, k_scale=None, v_scale=None):
+    def __init__(self, tokens, k, v, k_scale=None, v_scale=None,
+                 impl="dense"):
         self.tokens = tokens                    # tuple[int]
         self.k = k                              # np [L, nh, P, hd]
         self.v = v
         self.k_scale = k_scale                  # np [L, nh, 1, 1] | None
         self.v_scale = v_scale
+        # Attention backend that produced this KV. Flash is math-equal to
+        # dense but layers >= 2 see low-bit hidden-state drift, and the
+        # sparse window attends to different keys outright — seeding one
+        # backend's lane from another's entry would break the per-backend
+        # bitwise oracle, so lookups are segregated by impl.
+        self.impl = impl
         self.nbytes = int(k.nbytes) + int(v.nbytes)
         if k_scale is not None:
             self.nbytes += int(k_scale.nbytes) + int(v_scale.nbytes)
@@ -80,32 +87,34 @@ class PrefixKVCache:
         self.insert_rejections = 0
 
     # -- lookup ----------------------------------------------------------
-    def match(self, tokens):
-        """Longest stored prefix of ``tokens``: (match_len, entry) or
-        (0, None). Pure — no counters, no refs (grouping decisions call
-        this; ``acquire`` is the counted path)."""
+    def match(self, tokens, impl="dense"):
+        """Longest stored prefix of ``tokens`` produced by ``impl``:
+        (match_len, entry) or (0, None). Pure — no counters, no refs
+        (grouping decisions call this; ``acquire`` is the counted
+        path)."""
         with self._lock:
-            return self._match_locked(tokens)
+            return self._match_locked(tokens, impl)
 
-    def _match_locked(self, tokens):
+    def _match_locked(self, tokens, impl):
         node, depth, best = self._root, 0, (0, None)
         for tok in tokens:
             node = node.children.get(int(tok))
             if node is None:
                 break
             depth += 1
-            if node.covering:
+            here = [e for e in node.covering if e.impl == impl]
+            if here:
                 # MRU entry covering this depth (any of them has
                 # identical KV for positions < depth)
-                best = (depth, max(node.covering, key=lambda e: e.last_used))
+                best = (depth, max(here, key=lambda e: e.last_used))
         return best
 
-    def acquire(self, tokens):
+    def acquire(self, tokens, impl="dense"):
         """Counted lookup: returns (match_len, entry) and takes a ref on
         the entry so eviction cannot reclaim it while the requester is in
         flight. Release with ``release(entry)``."""
         with self._lock:
-            length, entry = self._match_locked(tokens)
+            length, entry = self._match_locked(tokens, impl)
             if entry is None:
                 self.misses += 1
                 return 0, None
@@ -121,21 +130,25 @@ class PrefixKVCache:
             entry.refs -= 1
 
     # -- insert / evict --------------------------------------------------
-    def insert(self, tokens, k, v, k_scale=None, v_scale=None):
+    def insert(self, tokens, k, v, k_scale=None, v_scale=None,
+               impl="dense"):
         """Store ``tokens``' KV ([L, nh, len(tokens), hd] numpy pair,
-        optionally int8 + per-head scales — see PrefixEntry). Returns the
-        entry, the existing entry when the exact prompt is already
-        stored, or None when it cannot fit even after evicting every
-        unreferenced entry."""
+        optionally int8 + per-head scales — see PrefixEntry). Entries are
+        keyed by (impl, tokens): the same prompt served under two
+        backends stores two entries. Returns the entry, the existing
+        entry when the exact (impl, prompt) is already stored, or None
+        when it cannot fit even after evicting every unreferenced
+        entry."""
         key = tuple(int(t) for t in tokens)
         if not key:
             raise ValueError("cannot insert an empty prefix")
         with self._lock:
-            existing = self._by_key.get(key)
+            existing = self._by_key.get((impl,) + key)
             if existing is not None:
                 self._touch(existing)
                 return existing
-            entry = PrefixEntry(key, k, v, k_scale=k_scale, v_scale=v_scale)
+            entry = PrefixEntry(key, k, v, k_scale=k_scale, v_scale=v_scale,
+                                impl=impl)
             if entry.nbytes > self.budget_bytes:
                 self.insert_rejections += 1
                 return None
@@ -146,7 +159,7 @@ class PrefixKVCache:
             for tok in key:
                 node = node.children.setdefault(tok, _Node())
                 node.covering.add(entry)
-            self._by_key[key] = entry
+            self._by_key[(impl,) + key] = entry
             self.total_bytes += entry.nbytes
             self._touch(entry)
             return entry
@@ -161,7 +174,7 @@ class PrefixKVCache:
         return True
 
     def _evict_locked(self, entry):
-        del self._by_key[entry.tokens]
+        del self._by_key[(entry.impl,) + entry.tokens]
         self.total_bytes -= entry.nbytes
         node, path = self._root, []
         for tok in entry.tokens:

@@ -124,6 +124,7 @@ class Request:
         self.slot = None
         self.emitted = 0
         self.prefix_entry = None                # held prefix-cache ref
+        self.attn_impl = "dense"                # set by engine at admission
 
     def deadline_exceeded(self, now):
         return (self.timeout_s is not None

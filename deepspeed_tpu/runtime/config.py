@@ -568,6 +568,59 @@ def get_serving_config(param_dict):
             f"serving.{SERVING_FAULT_INJECTION} must be a dict of "
             f"fault-point specs, got {type(fault_injection).__name__}"
         )
+    attention_impl = params.get(SERVING_ATTENTION_IMPL, SERVING_ATTENTION_IMPL_DEFAULT)
+    if attention_impl is not None:
+        if isinstance(attention_impl, str):
+            if attention_impl not in SERVING_ATTENTION_IMPLS:
+                raise ValueError(
+                    f"serving.{SERVING_ATTENTION_IMPL} must be one of "
+                    f"{SERVING_ATTENTION_IMPLS}, got {attention_impl!r}"
+                )
+        elif isinstance(attention_impl, dict):
+            # JSON object keys are strings; bucket keys arrive as "16384"
+            # — coerce digit strings back to ints for the engine, which
+            # validates each key against the bucket ladder.
+            coerced = {}
+            for key, impl in attention_impl.items():
+                if isinstance(key, str) and key.isdigit():
+                    key = int(key)
+                elif not isinstance(key, int) and key != "default":
+                    raise ValueError(
+                        f"serving.{SERVING_ATTENTION_IMPL} keys must be "
+                        f"prompt buckets (ints) or 'default', got {key!r}"
+                    )
+                if impl not in SERVING_ATTENTION_IMPLS:
+                    raise ValueError(
+                        f"serving.{SERVING_ATTENTION_IMPL}[{key!r}] must be "
+                        f"one of {SERVING_ATTENTION_IMPLS}, got {impl!r}"
+                    )
+                coerced[key] = impl
+            attention_impl = coerced
+        else:
+            raise ValueError(
+                f"serving.{SERVING_ATTENTION_IMPL} must be an impl name, a "
+                f"{{bucket: impl}} dict, or absent, got {attention_impl!r}"
+            )
+    kv_page_tokens = get_scalar_param(
+        params, SERVING_KV_PAGE_TOKENS, SERVING_KV_PAGE_TOKENS_DEFAULT
+    )
+    if kv_page_tokens is not None and (
+            not isinstance(kv_page_tokens, int)
+            or isinstance(kv_page_tokens, bool) or kv_page_tokens < 1):
+        raise ValueError(
+            f"serving.{SERVING_KV_PAGE_TOKENS} must be an int >= 1 "
+            f"(tokens per KV page) or absent, got {kv_page_tokens!r}"
+        )
+    kv_pool_tokens = get_scalar_param(
+        params, SERVING_KV_POOL_TOKENS, SERVING_KV_POOL_TOKENS_DEFAULT
+    )
+    if kv_pool_tokens is not None and (
+            not isinstance(kv_pool_tokens, int)
+            or isinstance(kv_pool_tokens, bool) or kv_pool_tokens < 1):
+        raise ValueError(
+            f"serving.{SERVING_KV_POOL_TOKENS} must be an int >= 1 "
+            f"(shared KV-pool token budget) or absent, got {kv_pool_tokens!r}"
+        )
     return ServingConfig(
         enabled=enabled,
         max_slots=max_slots,
@@ -581,6 +634,9 @@ def get_serving_config(param_dict):
         speculative_k=speculative_k,
         kv_cache_dtype=kv_cache_dtype,
         fault_injection=fault_injection,
+        attention_impl=attention_impl,
+        kv_page_tokens=kv_page_tokens,
+        kv_pool_tokens=kv_pool_tokens,
     )
 
 

@@ -230,6 +230,13 @@ SERVING_KV_CACHE_DTYPE = "kv_cache_dtype"
 SERVING_KV_CACHE_DTYPE_DEFAULT = "fp32"  # model compute dtype (bitwise)
 SERVING_KV_CACHE_DTYPES = ("fp32", "bf16", "int8")
 SERVING_FAULT_INJECTION = "fault_injection"
+SERVING_ATTENTION_IMPL = "attention_impl"
+SERVING_ATTENTION_IMPL_DEFAULT = None  # None = dense everywhere
+SERVING_ATTENTION_IMPLS = ("dense", "flash", "sparse_xla")
+SERVING_KV_PAGE_TOKENS = "kv_page_tokens"
+SERVING_KV_PAGE_TOKENS_DEFAULT = None  # None = 128 (resolve_page_tokens)
+SERVING_KV_POOL_TOKENS = "kv_pool_tokens"
+SERVING_KV_POOL_TOKENS_DEFAULT = None  # None = max_slots * max_seq_len
 
 #############################################
 # Sparse attention

@@ -315,3 +315,66 @@ def test_full_prefill_greedy_generation_bitwise():
         toks.append(jnp.argmax(logits, axis=-1).astype(jnp.int32))
     want = np.stack([np.asarray(t) for t in toks], axis=1)
     np.testing.assert_array_equal(got, want)
+
+
+# -- attention backends (flash / sparse_xla) vs the dense oracle -------------
+
+@pytest.mark.parametrize("impl", ["flash", "sparse_xla"])
+def test_backend_forward_full_parity_within_window(impl):
+    """With the whole prompt inside the sparse coverage (sink page +
+    SPARSE_BAND window pages) both non-dense backends see the full
+    context, so _forward_full must match dense: allclose KV at every
+    real position and the BITWISE-identical greedy token — across odd
+    lengths."""
+    from deepspeed_tpu.inference.generation import SPARSE_BAND, _forward_full
+
+    cfg = _tiny_config()
+    _, params = init_gpt2(cfg, batch_size=1, seq_len=4, seed=3)
+    n_layers, n_heads = cfg.num_hidden_layers, cfg.num_attention_heads
+    head_dim = cfg.hidden_size // n_heads
+    pt = 8
+    cover = (SPARSE_BAND + 1) * pt                 # 16: window spans it all
+    rng = np.random.RandomState(11)
+    for length in (1, 3, 7, 9, 13, cover - 1, cover):
+        ids = jnp.asarray(
+            rng.randint(0, cfg.vocab_size, (1, length)), jnp.int32)
+        c_ref, l_ref = _forward_full(
+            params, ids, length, n_layers, n_heads, head_dim, cover)
+        c_got, l_got = _forward_full(
+            params, ids, length, n_layers, n_heads, head_dim, cover,
+            attn_impl=impl, page_tokens=pt)
+        np.testing.assert_allclose(
+            np.asarray(l_got), np.asarray(l_ref), rtol=1e-5, atol=1e-6,
+            err_msg=f"{impl} S={length} logits")
+        for ref, got in zip(c_ref, c_got):
+            np.testing.assert_allclose(
+                np.asarray(got)[:, :, :, :length],
+                np.asarray(ref)[:, :, :, :length], rtol=1e-5, atol=1e-6,
+                err_msg=f"{impl} S={length} KV")
+        assert (int(jnp.argmax(l_got, -1)[0])
+                == int(jnp.argmax(l_ref, -1)[0])), (impl, length)
+
+
+@pytest.mark.parametrize("impl", ["flash", "sparse_xla"])
+def test_backend_greedy_generation_matches_dense_within_window(impl):
+    """End-to-end generate() under each backend equals dense generate()
+    bitwise while prompt + new tokens stay inside the window coverage."""
+    cfg = _tiny_config()
+    _, params = init_gpt2(cfg, batch_size=1, seq_len=4, seed=4)
+    rng = np.random.RandomState(2)
+    for length, n_new in ((2, 5), (5, 5), (9, 6), (11, 5)):
+        prompt = jnp.asarray(
+            rng.randint(0, cfg.vocab_size, (1, length)), jnp.int32)
+        want = np.asarray(generate(params, cfg, prompt, n_new))
+        got = np.asarray(generate(params, cfg, prompt, n_new,
+                                  attn_impl=impl, kv_page_tokens=8))
+        np.testing.assert_array_equal(got, want,
+                                      err_msg=f"{impl} S={length}")
+
+
+def test_generate_backend_validation():
+    cfg = _tiny_config()
+    _, params = init_gpt2(cfg, batch_size=1, seq_len=4, seed=0)
+    prompt = jnp.zeros((1, 3), jnp.int32)
+    with pytest.raises(ValueError, match="attn_impl"):
+        generate(params, cfg, prompt, 2, attn_impl="bogus")

@@ -61,7 +61,9 @@ def test_lru_eviction_under_byte_budget():
     c.release(c.acquire((1, 1))[1])               # touch a: b becomes LRU
     # (match() is deliberately pure — only acquire/insert refresh recency)
     c.insert((4, 4), *_kv(2))                     # must evict b
-    assert b.tokens not in c._by_key and a.tokens in c._by_key
+    # entries key by (impl, tokens) so backends never cross-seed
+    assert ("dense",) + b.tokens not in c._by_key
+    assert ("dense",) + a.tokens in c._by_key
     assert c.evictions == 1
     assert c.match((2, 2)) == (0, None)           # trie pruned with it
     assert c.total_bytes <= c.budget_bytes
@@ -73,8 +75,8 @@ def test_referenced_entries_survive_eviction():
     _, held = c.acquire((1, 1))
     c.insert((2, 2), *_kv(2))
     got = c.insert((3, 3), *_kv(2))               # room only via evicting (2,2)
-    assert got is not None and (2, 2) not in c._by_key
-    assert (1, 1) in c._by_key                    # the held ref was skipped
+    assert got is not None and ("dense", 2, 2) not in c._by_key
+    assert ("dense", 1, 1) in c._by_key           # the held ref was skipped
     # now NOTHING is evictable: the insert must be rejected, not deadlock
     _, h2 = c.acquire((3, 3))
     assert c.insert((4, 4), *_kv(2)) is None
@@ -101,7 +103,7 @@ def test_evict_unreferenced_spares_held_entries():
     c.insert((2, 2), *_kv(2))
     _, held = c.acquire((2, 2))
     assert c.evict_unreferenced() == 1            # only (1,1) dropped
-    assert (2, 2) in c._by_key and len(c) == 1
+    assert ("dense", 2, 2) in c._by_key and len(c) == 1
     c.release(held)
     assert c.evict_unreferenced() == 1
     assert len(c) == 0 and not c._root.children   # trie fully pruned

@@ -731,3 +731,325 @@ def test_metrics_snapshot_prefill_keys(model):
     assert snap["prefill_calls"] >= 1
     assert snap["prefill_tokens_per_sec"] > 0
     assert snap["prefix_hit_rate"] is not None
+
+
+# -- attention backends & paged KV pool --------------------------------------
+
+def _backend_oneshot(cfg, params, prompt, n_new, impl, pt=8):
+    """Per-request greedy reference under a specific backend — the
+    per-backend oracle the continuous engine must match bitwise."""
+    out = generate(params, cfg, jnp.asarray([prompt], jnp.int32), n_new,
+                   attn_impl=impl, kv_page_tokens=pt)
+    return np.asarray(out)[0].tolist()
+
+
+@pytest.mark.parametrize("impl", ["flash", "sparse_xla"])
+def test_backend_oracle_uniform(model, impl):
+    """The tentpole contract per backend: continuous-batched greedy
+    output equals one-shot generate() under the SAME backend, bitwise,
+    with queueing and slot churn."""
+    cfg, params = model
+    eng = _engine(cfg, params, max_slots=2, attention_impl=impl,
+                  kv_page_tokens=8)
+    prompts = _prompts(5)
+    wants = [_backend_oneshot(cfg, params, p, 6, impl) for p in prompts]
+    futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.drain(max_steps=200)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+    assert eng.occupancy()["in_use"] == 0
+
+
+def test_backend_oracle_per_bucket_mixed(model):
+    """A {bucket: impl} ladder routes each prompt to its bucket's
+    backend; every request must match ITS backend's generate() bitwise
+    even while dense and sparse lanes decode in the same step."""
+    cfg, params = model
+    eng = _engine(cfg, params, kv_page_tokens=8,
+                  attention_impl={4: "dense", 8: "sparse_xla"})
+    prompts = _prompts(6, lengths=(3, 7, 4, 8, 2, 6))
+    impls = [("dense" if bucket_for(len(p), (4, 8)) == 4 else "sparse_xla")
+             for p in prompts]
+    wants = [_backend_oneshot(cfg, params, p, 5, i)
+             for p, i in zip(prompts, impls)]
+    futs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+    eng.drain(max_steps=300)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+
+
+def test_backend_oracle_mid_decode_admission_sparse(model):
+    """Sparse lanes joining mid-decode must not perturb in-flight sparse
+    lanes (the window program is one batched step over all of them)."""
+    cfg, params = model
+    eng = _engine(cfg, params, attention_impl="sparse_xla",
+                  kv_page_tokens=8)
+    prompts = _prompts(5)
+    wants = [_backend_oneshot(cfg, params, p, 6, "sparse_xla")
+             for p in prompts]
+    futs = [eng.submit(p, max_new_tokens=6) for p in prompts[:3]]
+    eng.step()
+    eng.step()
+    assert any(not f.done() for f in futs)
+    futs += [eng.submit(p, max_new_tokens=6) for p in prompts[3:]]
+    eng.drain(max_steps=200)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+
+
+def test_backend_oracle_chunked_prefill_sparse(model):
+    """Chunked prefill under the sparse backend: chunks are padded up to
+    whole pages, which must stay invisible to the output."""
+    cfg, params = model
+    eng = _engine(cfg, params, attention_impl="sparse_xla",
+                  kv_page_tokens=8, prefill_chunk_tokens=4)
+    prompts = _prompts(3, lengths=(7, 8, 6))
+    wants = [_backend_oneshot(cfg, params, p, 5, "sparse_xla")
+             for p in prompts]
+    futs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+    eng.drain(max_steps=300)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+
+
+def test_backend_oracle_speculative_sparse(model):
+    """speculative_k=4 under the sparse backend: the windowed verify
+    program must accept/reject drafts exactly like the k=0 oracle."""
+    cfg, params = model
+    eng = _engine(cfg, params, attention_impl="sparse_xla",
+                  kv_page_tokens=8, speculative_k=4)
+    prompts = _prompts(4)
+    wants = [_backend_oneshot(cfg, params, p, 8, "sparse_xla")
+             for p in prompts]
+    futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.drain(max_steps=200)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+
+
+def test_backend_oracle_int8_threshold(model):
+    """int8 KV under the sparse backend: requantization noise breaks
+    bitwise equality by design, so parity is threshold-based like the
+    dense int8 path."""
+    cfg, params = model
+    eng = _engine(cfg, params, attention_impl="sparse_xla",
+                  kv_page_tokens=8, kv_cache_dtype="int8")
+    prompts = _prompts(4)
+    wants = [_backend_oneshot(cfg, params, p, 6, "sparse_xla")
+             for p in prompts]
+    futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.drain(max_steps=200)
+    matches = total = 0
+    for f, want in zip(futs, wants):
+        got = f.result(timeout=1)
+        assert len(got) == len(want)
+        matches += sum(g == w for g, w in zip(got, want))
+        total += len(want)
+    assert matches / total >= 0.9
+
+
+def test_backend_oracle_prefix_cache_sparse(model):
+    """Prefix-cache hits under the sparse backend stay bitwise-invisible
+    — entries are tagged by impl so a sparse lane only ever seeds from
+    sparse-produced KV."""
+    cfg, params = model
+    eng = _engine(cfg, params, attention_impl="sparse_xla",
+                  kv_page_tokens=8, prefix_cache_mb=4.0)
+    prompts = _shared_prefix_prompts(4)
+    wants = [_backend_oneshot(cfg, params, p, 5, "sparse_xla")
+             for p in prompts]
+    futs = []
+    for p in prompts:                       # serialize to guarantee hits
+        futs.append(eng.submit(p, max_new_tokens=5))
+        eng.drain(max_steps=100)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+    assert eng.prefix_cache.hits >= 1
+
+
+def test_prefix_cache_entries_segregated_by_impl():
+    """Direct container check: the same token prefix stored under two
+    backends is two entries, and lookups never cross impls."""
+    from deepspeed_tpu.inference.serving import PrefixKVCache
+    c = PrefixKVCache(budget_bytes=1 << 20)
+    k = np.zeros((2, 2, 3, 4), np.float32)
+    c.insert((1, 2, 3), k, k.copy())                       # dense
+    assert c.match((1, 2, 3))[0] == 3
+    assert c.match((1, 2, 3), impl="sparse_xla") == (0, None)
+    c.insert((1, 2, 3), k.copy(), k.copy(), impl="sparse_xla")
+    n, e = c.match((1, 2, 3), impl="sparse_xla")
+    assert n == 3 and e.impl == "sparse_xla" and len(c) == 2
+
+
+def test_backend_and_page_churn_recompile_pin(model):
+    """The perf contract: page-table churn (alloc/free reshuffling
+    physical pages) and per-bucket backend switching never recompile
+    steady-state decode — one compile per decode program CLASS, total."""
+    cfg, params = model
+    eng = _engine(cfg, params, max_slots=2, kv_page_tokens=8,
+                  attention_impl={4: "dense", 8: "sparse_xla"})
+    full_sent = _decode_sentinel(budget=1)
+    win_sent = CompileSentinel(serving_engine_mod._decode_step_window_jit,
+                               1, name="window decode step")
+    prompts = _prompts(6, lengths=(3, 7, 4, 8, 2, 6))
+    impls = [("dense" if bucket_for(len(p), (4, 8)) == 4 else "sparse_xla")
+             for p in prompts]
+    wants = [_backend_oneshot(cfg, params, p, 4, i)
+             for p, i in zip(prompts, impls)]
+    futs = [eng.submit(p, max_new_tokens=4) for p in prompts[:3]]
+    eng.step()
+    futs += [eng.submit(p, max_new_tokens=4) for p in prompts[3:]]
+    eng.drain(max_steps=300)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+    assert full_sent.check() <= 1
+    assert win_sent.check() <= 1
+
+
+def test_armed_window_sentinels_via_config(model):
+    """jax_sentinels wiring for the window programs: an engine with the
+    block enabled and a sparse bucket builds the window decode/prefill
+    sentinels and serves bitwise under their budgets."""
+    cfg, params = model
+    sent_cfg = DeepSpeedSentinelConfig({"jax_sentinels": {
+        "enabled": True, "compile_budget": 8, "transfer_guard": True}})
+    eng = _engine(cfg, params, attention_impl="sparse_xla",
+                  kv_page_tokens=8, sentinel_config=sent_cfg)
+    assert eng.decode_window_sentinel is not None
+    assert eng.prefill_window_sentinel is not None
+    prompts = _prompts(3)
+    wants = [_backend_oneshot(cfg, params, p, 4, "sparse_xla")
+             for p in prompts]
+    futs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.drain(max_steps=200)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+    assert eng.decode_window_sentinel.check() <= 8
+
+
+def test_steady_state_transfer_free_sparse(model):
+    """transfer_free() holds with the sparse backend armed: the window
+    gather/scatter runs entirely on device off the uploaded page
+    tables."""
+    cfg, params = model
+    eng = _engine(cfg, params, attention_impl="sparse_xla",
+                  kv_page_tokens=8)
+    prompts = _prompts(2, lengths=(3, 4))
+    wants = [_backend_oneshot(cfg, params, p, 8, "sparse_xla")
+             for p in prompts]
+    futs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.step()
+    eng.step()
+    assert eng._lane_dirty is False and len(eng._active) == 2
+    with transfer_free():
+        for _ in range(4):
+            stats = eng.step()
+            assert stats["decoded"] == 2
+    eng.drain(max_steps=100)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+
+
+def test_page_allocator_alloc_free_reuse():
+    """Page accounting: partial-lane allocation claims ceil(n/pt) pages,
+    free returns them (lowest-first reuse), and the freed lane's table
+    row is zeroed so stale mappings can never leak."""
+    pool = KVCachePool(n_layers=2, max_slots=4, n_heads=2, max_seq_len=16,
+                       head_dim=8, page_tokens=4, pool_tokens=32)
+    assert pool.n_data_pages == 8 and pool.pages_per_lane == 4
+    a = pool.allocate(6)                                   # 2 pages
+    assert pool.pages_in_use == 2 and pool.lane_tokens(a) == 8
+    assert list(pool.page_tables[a]) == [1, 2, 0, 0]
+    b = pool.allocate()                                    # full lane
+    assert pool.pages_in_use == 6 and pool.lane_tokens(b) == 16
+    pool.free(a)
+    assert pool.pages_in_use == 4
+    assert not pool.page_tables[a].any()                   # row zeroed
+    c = pool.allocate(16)                                  # reuses 1, 2
+    assert 1 in pool.page_tables[c] and 2 in pool.page_tables[c]
+    occ = pool.occupancy()
+    assert occ["pages_total"] == 8 and occ["pages_in_use"] == 8
+    assert occ["peak_pages_in_use"] == 8 and occ["pages_free"] == 0
+
+
+def test_page_allocator_exhaustion_message():
+    """Running out of pages (not slots) raises PoolExhaustedError with
+    the page counts in the message, and leaves the pool untouched."""
+    pool = KVCachePool(n_layers=2, max_slots=4, n_heads=2, max_seq_len=16,
+                       head_dim=8, page_tokens=4, pool_tokens=16)
+    assert pool.n_data_pages == 4
+    pool.allocate(16)                                      # all 4 pages
+    assert not pool.can_allocate(1)
+    with pytest.raises(PoolExhaustedError,
+                       match=r"need 1 page.*0 of 4 free"):
+        pool.allocate(1)
+    assert pool.slots_in_use == 1                          # untouched
+    pool.free(0)
+    assert pool.can_allocate(16)
+
+
+def test_paged_pool_undercuts_contiguous_footprint():
+    """The memory win the paged layout exists for: a sub-contiguous
+    pool_tokens budget makes pool bytes strictly smaller than the
+    MaxSlots x S_max contiguous layout at equal slot count."""
+    pool = KVCachePool(n_layers=2, max_slots=8, n_heads=2,
+                       max_seq_len=1024, head_dim=8, page_tokens=128,
+                       pool_tokens=2048)
+    assert pool.nbytes() < pool.contiguous_equiv_bytes()
+    full = KVCachePool(n_layers=2, max_slots=8, n_heads=2,
+                       max_seq_len=1024, head_dim=8, page_tokens=128)
+    # default budget == contiguous capacity: one extra (null) page only
+    assert full.n_data_pages * full.page_tokens == 8 * 1024
+
+
+def test_page_backpressure_requeues_until_pages_free(model):
+    """Admission backpressure on PAGES, not just slots: with budget for
+    one in-flight request, the second waits in the queue and is admitted
+    (bitwise-correct) after the first retires."""
+    cfg, params = model
+    eng = _engine(cfg, params, max_slots=3, kv_page_tokens=8,
+                  kv_pool_tokens=32)                       # 4 data pages
+    prompts = _prompts(2, lengths=(4, 5))
+    wants = [_oneshot(cfg, params, p, 13) for p in prompts]
+    futs = [eng.submit(p, max_new_tokens=13) for p in prompts]
+    eng.drain(max_steps=400)
+    for f, want in zip(futs, wants):
+        assert f.result(timeout=1) == want
+    occ = eng.occupancy()
+    assert occ["in_use"] == 0 and occ["peak_pages_in_use"] <= 4
+
+
+def test_metrics_pages_and_admitted_histogram(model):
+    """Satellite: Serving/pages_in_use + page_fragmentation gauges and
+    the per-bucket admitted-prompt-length histogram in snapshot()."""
+    cfg, params = model
+    eng = _engine(cfg, params)
+    prompts = _prompts(3, lengths=(3, 7, 4))
+    futs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    eng.drain(max_steps=100)
+    for f in futs:
+        f.result(timeout=1)
+    snap = eng.metrics.snapshot()
+    assert "pages_in_use" in snap and "page_fragmentation" in snap
+    assert snap["admitted_prompts_bucket_4"] == 2
+    assert snap["admitted_prompts_bucket_8"] == 1
+    assert snap["admitted_prompt_len_min_bucket_4"] == 3
+    assert snap["admitted_prompt_len_max_bucket_4"] == 4
+    assert snap["admitted_prompt_len_mean_bucket_8"] == 7.0
+    # numeric keys -> the Prometheus export picks them up unchanged
+    from deepspeed_tpu.telemetry import MetricsRegistry
+    reg = eng.metrics.export_to(MetricsRegistry())
+    text = reg.render_prometheus()
+    assert "pages_in_use" in text and "admitted_prompts_bucket_4" in text
+
+
+def test_engine_rejects_bad_backend_config(model):
+    cfg, params = model
+    with pytest.raises(ValueError, match="attention_impl"):
+        _engine(cfg, params, attention_impl="nope")
+    with pytest.raises(ValueError, match="attention_impl"):
+        _engine(cfg, params, attention_impl={16: "sparse_xla"})
+    with pytest.raises(ValueError, match="kv_page_tokens"):
+        _engine(cfg, params, kv_page_tokens=0)
+    with pytest.raises(ValueError, match="kv_pool_tokens"):
+        _engine(cfg, params, kv_pool_tokens=0)

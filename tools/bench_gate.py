@@ -6,8 +6,9 @@ Two modes:
 ``--check-schema [files...]``
     Validate that bench artifacts are structurally sound (required keys,
     numeric types, ``complete: true``). Defaults to the committed
-    baselines (``SERVING_BENCH_CPU.json`` + ``BENCH_r05.json``). This is
-    the CI step: it needs no jax and takes milliseconds.
+    baselines (``SERVING_BENCH_CPU.json`` + ``BENCH_r05.json`` +
+    ``LONGDOC_BENCH_CPU.json``). This is the CI step: it needs no jax
+    and takes milliseconds.
 
 ``compare FRESH BASELINE``
     Diff a fresh bench run against a committed baseline under per-key
@@ -16,8 +17,10 @@ Two modes:
     the committed artifact is never clobbered).
 
 Artifact kinds are auto-detected: a dict with a ``parsed`` key is a
-driver wrapper (``BENCH_r05.json``) and is unwrapped; ``tokens_per_sec``
-marks a serving artifact; ``metric`` marks a train artifact. Contexts
+driver wrapper (``BENCH_r05.json``) and is unwrapped;
+``speedup_sparse_vs_dense_16k`` marks a long-document serving artifact
+(``LONGDOC_BENCH_CPU.json``); ``tokens_per_sec`` marks a serving
+artifact; ``metric`` marks a train artifact. Contexts
 must match before numbers are compared — platform, model and workload
 knobs for serving; the metric string for train — otherwise the compare
 is skipped with exit 0 (a CPU artifact is not a regression signal for a
@@ -42,7 +45,8 @@ import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-DEFAULT_ARTIFACTS = ("SERVING_BENCH_CPU.json", "BENCH_r05.json")
+DEFAULT_ARTIFACTS = ("SERVING_BENCH_CPU.json", "BENCH_r05.json",
+                     "LONGDOC_BENCH_CPU.json")
 
 # -- tolerance profiles -------------------------------------------------
 # key -> (direction, rel_tol). direction "higher" means bigger is better:
@@ -70,11 +74,31 @@ TRAIN_TOLERANCES = {
     "step_ms":         ("lower", 0.35),
 }
 
+# Long-document leg: tokens/sec per backend are noisy CPU numbers, but
+# the speedup ratio (sparse/dense on the same box, same run) is the
+# gate-worthy signal — dense and sparse noise largely cancels.
+LONGDOC_TOLERANCES = {
+    "dense_longdoc_tokens_per_sec":  ("higher", 0.50),
+    "sparse_longdoc_tokens_per_sec": ("higher", 0.50),
+    "dense_mixed_tokens_per_sec":    ("higher", 0.50),
+    "sparse_mixed_tokens_per_sec":   ("higher", 0.50),
+    "speedup_sparse_vs_dense_16k":   ("higher", 0.40),
+    "dense_avg_ttft_s":              ("lower", 2.00),
+    "sparse_avg_ttft_s":             ("lower", 2.00),
+    "dense_ttft_p95_s":              ("lower", 3.00),
+    "sparse_ttft_p95_s":             ("lower", 3.00),
+    "pool_vs_contiguous":            ("lower", 0.10),
+}
+
 # context keys that must match exactly for numbers to be comparable
 SERVING_CONTEXT = ("platform", "model", "requests", "max_slots",
                    "max_new_tokens", "speculative_k", "kv_cache_dtype",
                    "prefill_chunk_tokens")
 TRAIN_CONTEXT = ("metric", "device_kind", "n_devices", "global_batch")
+LONGDOC_CONTEXT = ("platform", "model", "max_slots", "page_tokens",
+                   "kv_pool_tokens", "longdoc_prompt_len",
+                   "longdoc_new_tokens", "shared_prefix_len",
+                   "requests_mixed")
 
 # -- schema -------------------------------------------------------------
 SERVING_REQUIRED = {
@@ -88,24 +112,55 @@ SERVING_REQUIRED = {
 TRAIN_REQUIRED = {
     "metric": str, "value": (int, float), "unit": str,
 }
+LONGDOC_REQUIRED = {
+    "platform": str, "model": str, "max_slots": int, "page_tokens": int,
+    "kv_pool_tokens": int, "longdoc_prompt_len": int,
+    "longdoc_new_tokens": int,
+    "dense_longdoc_tokens_per_sec": (int, float),
+    "sparse_longdoc_tokens_per_sec": (int, float),
+    "dense_mixed_tokens_per_sec": (int, float),
+    "sparse_mixed_tokens_per_sec": (int, float),
+    "dense_avg_ttft_s": (int, float), "sparse_avg_ttft_s": (int, float),
+    "dense_oracle_ok": bool, "sparse_oracle_ok": bool,
+    "speedup_sparse_vs_dense_16k": (int, float),
+    "pool_bytes": int, "contiguous_equiv_bytes": int,
+    "complete": bool,
+}
+
+# the PR's acceptance floor: sparse must beat dense end-to-end at the
+# 16k bucket by at least this factor for the artifact to be a baseline
+LONGDOC_MIN_SPEEDUP = 5.0
+
+TOLERANCES = {"serving": SERVING_TOLERANCES, "train": TRAIN_TOLERANCES,
+              "longdoc": LONGDOC_TOLERANCES}
+CONTEXTS = {"serving": SERVING_CONTEXT, "train": TRAIN_CONTEXT,
+            "longdoc": LONGDOC_CONTEXT}
+REQUIRED = {"serving": SERVING_REQUIRED, "train": TRAIN_REQUIRED,
+            "longdoc": LONGDOC_REQUIRED}
 
 
 def load_artifact(path):
     """Read + unwrap one artifact; returns (kind, payload).
-    kind is "serving" or "train"."""
+    kind is "serving", "train" or "longdoc"."""
     with open(path) as f:
         doc = json.load(f)
     if not isinstance(doc, dict):
         raise ValueError(f"{path}: artifact must be a JSON object")
     if "parsed" in doc and isinstance(doc["parsed"], dict):
         doc = doc["parsed"]       # driver wrapper (BENCH_r05.json shape)
+    # longdoc first: it carries per-backend tokens/sec but no bare
+    # "tokens_per_sec", and its "metric"-shaped stdout line never lands
+    # in the artifact — still, keep the most specific marker in front.
+    if "speedup_sparse_vs_dense_16k" in doc:
+        return "longdoc", doc
     if "tokens_per_sec" in doc:
         return "serving", doc
     if "metric" in doc:
         return "train", doc
     raise ValueError(
-        f"{path}: unrecognized artifact (no 'tokens_per_sec' or 'metric' "
-        f"key; top-level keys: {sorted(doc)[:8]})")
+        f"{path}: unrecognized artifact (no 'speedup_sparse_vs_dense_16k', "
+        f"'tokens_per_sec' or 'metric' key; top-level keys: "
+        f"{sorted(doc)[:8]})")
 
 
 def check_schema(path):
@@ -115,8 +170,7 @@ def check_schema(path):
         kind, doc = load_artifact(path)
     except (OSError, ValueError) as e:
         return [str(e)]
-    required = SERVING_REQUIRED if kind == "serving" else TRAIN_REQUIRED
-    for key, types in required.items():
+    for key, types in REQUIRED[kind].items():
         if key not in doc:
             problems.append(f"{path}: missing required key '{key}' ({kind})")
             continue
@@ -136,6 +190,37 @@ def check_schema(path):
             if isinstance(v, (int, float)) and not isinstance(v, bool) \
                     and v <= 0:
                 problems.append(f"{path}: '{key}' must be > 0, got {v}")
+    elif kind == "longdoc":
+        if doc.get("complete") is not True:
+            problems.append(f"{path}: 'complete' is not true — a partial "
+                            f"bench run must not be committed as a baseline")
+        for key in ("dense_oracle_ok", "sparse_oracle_ok"):
+            if doc.get(key) is not True:
+                problems.append(
+                    f"{path}: '{key}' is not true — the bitwise "
+                    f"continuous-vs-generate() oracle must hold per backend")
+        for key in ("dense_longdoc_tokens_per_sec",
+                    "sparse_longdoc_tokens_per_sec",
+                    "dense_mixed_tokens_per_sec",
+                    "sparse_mixed_tokens_per_sec"):
+            v = doc.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v <= 0:
+                problems.append(f"{path}: '{key}' must be > 0, got {v}")
+        speed = doc.get("speedup_sparse_vs_dense_16k")
+        if isinstance(speed, (int, float)) and not isinstance(speed, bool) \
+                and speed < LONGDOC_MIN_SPEEDUP:
+            problems.append(
+                f"{path}: 'speedup_sparse_vs_dense_16k' is {speed}, below "
+                f"the {LONGDOC_MIN_SPEEDUP}x acceptance floor")
+        pool = doc.get("pool_bytes")
+        contig = doc.get("contiguous_equiv_bytes")
+        if isinstance(pool, int) and isinstance(contig, int) \
+                and not pool < contig:
+            problems.append(
+                f"{path}: 'pool_bytes' ({pool}) must be strictly below "
+                f"'contiguous_equiv_bytes' ({contig}) — paging must "
+                f"undercut the MaxSlots x S_max footprint")
     else:
         v = doc.get("value")
         if isinstance(v, (int, float)) and not isinstance(v, bool) and v <= 0:
@@ -145,7 +230,7 @@ def check_schema(path):
 
 def comparable(kind, fresh, base):
     """Returns a list of context mismatches (empty = comparable)."""
-    keys = SERVING_CONTEXT if kind == "serving" else TRAIN_CONTEXT
+    keys = CONTEXTS[kind]
     out = []
     for key in keys:
         fv, bv = fresh.get(key), base.get(key)
@@ -225,8 +310,7 @@ def run_compare(args):
             return 2
         print(msg + " — SKIP")
         return 0
-    tolerances = dict(SERVING_TOLERANCES if fkind == "serving"
-                      else TRAIN_TOLERANCES)
+    tolerances = dict(TOLERANCES[fkind])
     for key, frac in parse_tolerance_overrides(args.tolerance).items():
         direction = tolerances.get(key, ("higher", 0.0))[0]
         tolerances[key] = (direction, frac)
@@ -256,7 +340,8 @@ def main(argv=None):
     parser.add_argument("--check-schema", nargs="*", default=None,
                         metavar="FILE",
                         help="validate artifact schema(s); defaults to the "
-                             "committed SERVING_BENCH_CPU.json + BENCH_r05.json")
+                             "committed SERVING_BENCH_CPU.json + BENCH_r05."
+                             "json + LONGDOC_BENCH_CPU.json")
     parser.add_argument("mode", nargs="?", choices=["compare"],
                         help="compare FRESH BASELINE under tolerance bands")
     parser.add_argument("fresh", nargs="?", help="fresh bench JSON")

@@ -221,6 +221,18 @@ ALL_CODES = tuple(sorted(RULES))
 # so new hot loops opt in without editing this table.
 HOT_LOOPS = (
     ("deepspeed_tpu/inference/serving/engine.py", "ServingEngine.step"),
+    # paged prefill/decode programs: the jitted bodies every scheduler
+    # step re-enters — a host sync traced into any of them stalls all
+    # MaxSlots lanes at once
+    ("deepspeed_tpu/inference/serving/engine.py", "_prefill_batch_jit"),
+    ("deepspeed_tpu/inference/serving/engine.py", "_prefill_batch_flash_jit"),
+    ("deepspeed_tpu/inference/serving/engine.py", "_prefill_batch_window_jit"),
+    ("deepspeed_tpu/inference/serving/engine.py", "_decode_step_jit"),
+    ("deepspeed_tpu/inference/serving/engine.py", "_decode_step_quant_jit"),
+    ("deepspeed_tpu/inference/serving/engine.py", "_decode_step_window_jit"),
+    ("deepspeed_tpu/inference/serving/engine.py", "_spec_step_jit"),
+    ("deepspeed_tpu/inference/serving/engine.py", "_spec_step_quant_jit"),
+    ("deepspeed_tpu/inference/serving/engine.py", "_spec_step_window_jit"),
     ("deepspeed_tpu/runtime/engine.py", "DeepSpeedEngine._train_batch_now"),
     ("deepspeed_tpu/runtime/pipe/engine.py", "PipelineEngine._train_batch_now"),
 )
