@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc trace-smoke bench-gate
+.PHONY: test test-fast test-faults test-cluster test-serving test-router lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet trace-smoke bench-gate
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -28,6 +28,14 @@ test-cluster:
 # backpressure/deadline/fault-injection recovery.
 test-serving:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_serving.py tests/unit/test_prefix_cache.py tests/unit/test_speculative.py -q
+
+# Fleet router + replica suite, BOTH tiers: the fast stub-replica tests
+# (routing policy, exactly-once retry accounting, shedding, affinity,
+# fleet fault arms) and the slow multi-process tests that spawn real
+# replica workers (kill_replica mid-decode, SIGTERM drain, prefix
+# affinity surviving scale-out).
+test-router:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_router.py -q
 
 # Static JAX hazard analysis (tools/jaxlint): recompile, host-sync,
 # leaked-tracer, donation, fp16-dtype, collective-axis, RNG-reuse,
@@ -78,6 +86,15 @@ bench-serving:
 bench-longdoc:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=longdoc python bench.py --child
 
+# Fleet serving leg: 1 -> 2 -> 4 real replica processes behind the
+# Router, plus a kill-one-replica recovery measurement. Writes
+# FLEET_BENCH_CPU.json with per-fleet-size tokens/sec, the 2x/4x
+# scaling factors (CPU-time-normalized on core-starved boxes — see
+# scaling_mode), and kill_recovery_s; the bitwise cross-fleet oracle is
+# asserted in-run (see docs/serving.md).
+bench-fleet:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=fleet python bench.py --child
+
 # Benchmark on the real TPU chip (default platform).
 bench:
 	python bench.py
@@ -94,3 +111,6 @@ bench-gate:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=longdoc \
 		BENCH_LONGDOC_OUT=/tmp/bench_gate_longdoc.json python bench.py --child
 	python -m tools.bench_gate compare /tmp/bench_gate_longdoc.json LONGDOC_BENCH_CPU.json
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=fleet \
+		BENCH_FLEET_OUT=/tmp/bench_gate_fleet.json python bench.py --child
+	python -m tools.bench_gate compare /tmp/bench_gate_fleet.json FLEET_BENCH_CPU.json

@@ -134,6 +134,8 @@ def child_main():
         return serving_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "longdoc":
         return longdoc_child_main()
+    if os.environ.get("BENCH_MODEL", "bert") == "fleet":
+        return fleet_child_main()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -616,6 +618,263 @@ def longdoc_child_main():
     return 0
 
 
+def fleet_child_main():
+    """Fleet serving leg: replica scale-out throughput + kill recovery.
+
+    Spawns 1 -> 2 -> 4 REAL replica processes (``python -m
+    deepspeed_tpu.inference.serving.replica``, each its own jax runtime
+    pinned to the CPU backend) and drives the same request mix through
+    the stdlib Router — this parent never imports jax. Reports
+    aggregate streamed tokens/sec per fleet size and the 2x/4x scaling
+    factors, then a final 2-replica leg that arms ``kill_replica``
+    mid-decode and measures the wall time from replica death to the
+    last re-routed request completing (``kill_recovery_s``), asserting
+    zero poisoned requests and bitwise-identical outputs across every
+    fleet size (the failover oracle, greedy determinism).
+
+    Core-starved machines (this CI box has ONE core) cap wall-clock
+    scaling at ~1.0x no matter how good the router is, so the leg
+    records BOTH wall-clock and CPU-time-normalized throughput — each
+    replica's socket health op reports ``process_cpu_s`` and
+    ``tokens_total``, and the per-replica rates ``tokens_r / cpu_r``
+    sum to the aggregate the fleet would sustain with a core per
+    replica. ``scaling_mode`` ("wall" when the box has at least as many
+    cores as the largest fleet, else "cpu") selects which series feeds
+    the headline ``fleet_tokens_per_sec_N`` / ``fleet_scaling_*`` keys
+    the bench gate compares; artifacts from different modes are never
+    comparable. Writes FLEET_BENCH_CPU.json (BENCH_FLEET_OUT redirects,
+    as the gate does). Knobs: BENCH_FLEET_REQUESTS (default 32),
+    BENCH_FLEET_NEW_TOKENS (default 32)."""
+    import shutil
+    import socket
+    import tempfile
+
+    from deepspeed_tpu.inference.serving.config import FleetConfig
+    from deepspeed_tpu.inference.serving.router import (
+        ReplicaEndpoint, Router, read_line, send_line)
+
+    def progress(msg):
+        print(f"# fleet: {msg}", file=sys.stderr, flush=True)
+
+    # model sizing matters on a core-starved box: with a dispatch-
+    # dominated tiny model (~1ms/step) the solo leg runs cache-warm
+    # while multi-replica legs pay a cache refill on every context
+    # switch, inflating per-token CPU ~30% and corrupting the scaling
+    # ratio. At hidden 128 x 4 layers, per-step compute amortizes the
+    # switch penalty and per-replica efficiency is fleet-size-invariant.
+    model = {"vocab_size": 101, "hidden_size": 128, "num_hidden_layers": 4,
+             "num_attention_heads": 4, "max_position_embeddings": 128}
+    # keep requests a multiple of max_slots x max(counts): every fleet
+    # size then runs full 4-lane waves, so per-token step cost is
+    # occupancy-invariant and the scaling ratio measures the fleet,
+    # not batch-fill accidents
+    n_requests = int(os.environ.get("BENCH_FLEET_REQUESTS", "32"))
+    n_new = int(os.environ.get("BENCH_FLEET_NEW_TOKENS", "32"))
+    counts = (1, 2, 4)
+    cores = os.cpu_count() or 1
+    mode = "wall" if cores >= max(counts) else "cpu"
+    prompts = [[(7 * i + 3 * j + 1) % model["vocab_size"] for j in range(8)]
+               for i in range(n_requests)]
+    tmp = tempfile.mkdtemp(prefix="fleet_bench_")
+
+    def spawn(name, faults=None):
+        spec = {"model": model, "seed": 0, "ds_config": {
+            "train_batch_size": 1,
+            "serving": {"max_slots": 4, "max_queue": 256, "max_seq_len": 128,
+                        **({"fault_injection": faults} if faults else {})}}}
+        path = os.path.join(tmp, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump(spec, f)
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=1")
+        def _favor_decode():
+            # priority-isolate the data plane: the router (this bench
+            # process) wakes on every streamed token frame, and on a
+            # core-starved box those wakeups preempt OTHER replicas
+            # mid-decode-step — a disturbance that grows with fleet
+            # size and pollutes per-replica CPU. Nicing replicas above
+            # the front-door keeps decode steps intact; unprivileged
+            # boxes skip it (the scheduler bias is an optimization,
+            # not a correctness requirement).
+            try:
+                os.nice(-5)
+            except OSError:
+                pass
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "deepspeed_tpu.inference.serving.replica",
+             "--config", path, "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, text=True, preexec_fn=_favor_decode,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+        line = proc.stdout.readline()       # blocks until "ready"
+        if not line:
+            proc.kill()
+            raise RuntimeError(f"replica {name} died before ready")
+        ready = json.loads(line)
+        assert ready.get("ready"), ready
+        return proc, int(ready["port"])
+
+    def health(port):
+        with socket.create_connection(("127.0.0.1", port), timeout=10.0) as s:
+            s.settimeout(10.0)
+            send_line(s, {"op": "health"})
+            return read_line(s.makefile("rb")) or {}
+
+    def warm(port, tag):
+        # rehearse the measured leg's exact shapes — four concurrent
+        # lanes, len-8 prompts, full n_new decode — so every jax
+        # compile and first-touch cost lands before any clock or
+        # cpu-counter starts. Per-replica shares shrink as the fleet
+        # grows (1024 -> 256 tokens at 4 replicas), so any fixed
+        # per-replica cost left inside the window would bias the
+        # scaling ratio against the larger fleets.
+        socks = []
+        for k in range(4):
+            s = socket.create_connection(("127.0.0.1", port), timeout=600.0)
+            s.settimeout(600.0)
+            send_line(s, {"op": "submit", "v": 1, "key": f"warm-{tag}-{k}",
+                          "prompt": [2, 3, 5, 7, 11, 13, 17, 19],
+                          "max_new_tokens": n_new, "eos_token_id": None,
+                          "timeout_s": 600.0, "from": 0, "age_s": 0.0})
+            socks.append(s)
+        for s in socks:
+            stream = s.makefile("rb")
+            while True:
+                doc = read_line(stream)
+                if doc is None or "t" not in doc:
+                    assert doc and doc.get("done"), f"warmup failed: {doc}"
+                    break
+            s.close()
+
+    def fleet_router(eps):
+        return Router(eps, FleetConfig(
+            enabled=True, retry_budget=3, retry_backoff_s=0.05,
+            attempt_timeout_s=600.0, health_ttl_s=0.1,
+            saturation_queue_depth=256,
+            affinity_prefix_tokens=0))      # least-loaded spreads the mix
+
+    def run_fleet(n):
+        progress(f"{n} replica(s): spawn + warmup (compile)")
+        procs, eps = [], []
+        try:
+            for i in range(n):
+                proc, port = spawn(f"n{n}r{i}")
+                procs.append(proc)
+                eps.append(ReplicaEndpoint(f"n{n}r{i}", "127.0.0.1", port))
+            for i, ep in enumerate(eps):
+                warm(ep.port, f"{n}-{i}")
+            router = fleet_router(eps)
+            h0 = [health(ep.port) for ep in eps]
+            t0 = time.perf_counter()
+            futs = [router.submit(p, max_new_tokens=n_new, timeout_s=600.0)
+                    for p in prompts]
+            outs = [f.result(timeout=600) for f in futs]
+            wall = time.perf_counter() - t0
+            h1 = [health(ep.port) for ep in eps]
+            c = router.counters()
+            router.close()
+            assert c["completed"] == n_requests and c["poisoned"] == 0, c
+            toks = [h1[i].get("tokens_total", 0) - h0[i].get("tokens_total", 0)
+                    for i in range(n)]
+            cpus = [h1[i].get("process_cpu_s", 0.0)
+                    - h0[i].get("process_cpu_s", 0.0) for i in range(n)]
+            cpu_rate = sum(t / max(s, 1e-9)
+                           for t, s in zip(toks, cpus) if t > 0)
+            progress(f"{n} replica(s): {sum(toks)} tokens in {wall:.1f}s wall"
+                     f" (per-replica shares {toks})")
+            return outs, sum(toks) / wall, cpu_rate
+        finally:
+            for p in procs:
+                p.kill()
+                p.wait(timeout=30)
+
+    flat = {}
+    ref_outs = None
+    oracle_ok = True
+    try:
+        for n in counts:
+            outs, wall_rate, cpu_rate = run_fleet(n)
+            if ref_outs is None:
+                ref_outs = outs
+            oracle_ok = oracle_ok and outs == ref_outs
+            flat[f"wall_tokens_per_sec_{n}"] = round(wall_rate, 2)
+            flat[f"cpu_tokens_per_sec_{n}"] = round(cpu_rate, 2)
+            flat[f"fleet_tokens_per_sec_{n}"] = flat[
+                f"{mode}_tokens_per_sec_{n}"]
+
+        # kill-recovery: a doomed replica SIGKILLs itself mid-decode
+        # (fault_injection kill_replica, busy step 3); every accepted
+        # request must still complete on the survivor, bitwise
+        progress("kill-recovery: 2 replicas, one armed to die mid-decode")
+        procs = []
+        try:
+            doomed, p0 = spawn("kr-doomed",
+                               faults={"kill_replica": {"at_step": 3}})
+            safe, p1 = spawn("kr-safe")
+            procs = [doomed, safe]
+            warm(p1, "kr")      # survivor warm; warming the doomed one
+            #                     would fire its arm before the clock
+            router = fleet_router(
+                [ReplicaEndpoint("kr-doomed", "127.0.0.1", p0),
+                 ReplicaEndpoint("kr-safe", "127.0.0.1", p1)])
+            futs = [router.submit(p, max_new_tokens=n_new, timeout_s=600.0)
+                    for p in prompts[:6]]
+            assert doomed.wait(timeout=600) is not None
+            t_kill = time.perf_counter()
+            outs = [f.result(timeout=600) for f in futs]
+            recovery = time.perf_counter() - t_kill
+            c = router.counters()
+            router.close()
+            assert c["completed"] == 6 and c["poisoned"] == 0, c
+            assert c["retried"] >= 1, c     # the death was actually routed
+            oracle_ok = oracle_ok and outs == ref_outs[:6]
+            progress(f"kill-recovery: {recovery:.2f}s, counters {c}")
+            flat.update({"kill_recovery_s": round(recovery, 2),
+                         "kill_requests": 6,
+                         "kill_retried": c["retried"],
+                         "kill_poisoned": c["poisoned"]})
+        finally:
+            for p in procs:
+                p.kill()
+                p.wait(timeout=30)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    assert oracle_ok, "fleet outputs diverged across replica counts"
+
+    tps = {n: flat[f"fleet_tokens_per_sec_{n}"] for n in counts}
+    result = {
+        "platform": "cpu",      # replicas are pinned to the CPU backend
+        "model": "gpt2-tiny(L4,H128)",
+        "requests": n_requests,
+        "max_new_tokens": n_new,
+        "replica_counts": list(counts),
+        "host_cores": cores,
+        "scaling_mode": mode,
+        **flat,
+        "fleet_scaling_2x": round(tps[2] / tps[1], 3),
+        "fleet_scaling_4x": round(tps[4] / tps[1], 3),
+        "fleet_oracle_ok": bool(oracle_ok),
+        "complete": True,
+    }
+    out = os.environ.get("BENCH_FLEET_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "FLEET_BENCH_CPU.json")
+    with open(out, "w") as f:
+        f.write(json.dumps(result, indent=1) + "\n")
+    print(json.dumps({
+        "metric": "fleet serving scale-out (2 replicas vs 1, "
+                  f"{mode}-normalized)",
+        "value": result["fleet_scaling_2x"],
+        "unit": "x single-replica tokens/sec",
+        "vs_baseline": None,
+        **{k: result[k] for k in (
+            "fleet_tokens_per_sec_1", "fleet_tokens_per_sec_2",
+            "fleet_tokens_per_sec_4", "fleet_scaling_4x",
+            "kill_recovery_s", "scaling_mode")},
+    }))
+    return 0
+
+
 def _attn_impl_label(on_tpu):
     """Which attention core actually ran (shared by every bench leg): "xla"
     (env-forced einsum chain), "pallas" (the TPU default), or "reference"
@@ -812,6 +1071,10 @@ def main():
         label = "16k-bucket sparse-vs-dense serving speedup"
         seq = "16384"
         unit = "x dense end-to-end tokens/sec"
+    elif os.environ.get("BENCH_MODEL", "bert") == "fleet":
+        label = "fleet serving scale-out (2 replicas vs 1)"
+        seq = os.environ.get("BENCH_FLEET_NEW_TOKENS", "32")
+        unit = "x single-replica tokens/sec"
     else:
         label = "bert-large pretrain samples/sec/chip"
         seq = os.environ.get("BENCH_SEQ", "128")

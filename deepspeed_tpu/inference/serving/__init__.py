@@ -14,10 +14,15 @@ tests/unit/test_serving.py).
 
 Layering: kv_pool (device state) <- engine (compiled prefill/step +
 loop) <- scheduler (host policy: queue/buckets/retirement) <-
-prefix_cache (host prompt-KV reuse) <- metrics (monitor).
+prefix_cache (host prompt-KV reuse) <- metrics (monitor). The fleet
+tier sits above: replica (one engine behind a line-JSON socket) <-
+router (health-aware front-door with failover/drain/shedding).
 """
 
-from deepspeed_tpu.inference.serving.config import ServingConfig  # noqa: F401
+from deepspeed_tpu.inference.serving.config import (  # noqa: F401
+    FleetConfig,
+    ServingConfig,
+)
 from deepspeed_tpu.inference.serving.engine import ServingEngine  # noqa: F401
 from deepspeed_tpu.inference.serving.fault_injection import (  # noqa: F401
     ServingFaultInjector,
@@ -30,8 +35,18 @@ from deepspeed_tpu.inference.serving.metrics import ServingMetrics  # noqa: F401
 from deepspeed_tpu.inference.serving.prefix_cache import (  # noqa: F401
     PrefixKVCache,
 )
+from deepspeed_tpu.inference.serving.replica import (  # noqa: F401
+    ReplicaServer,
+)
+from deepspeed_tpu.inference.serving.router import (  # noqa: F401
+    FleetOverloadError,
+    ReplicaEndpoint,
+    RequestPoisonedError,
+    Router,
+)
 from deepspeed_tpu.inference.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
+    EngineDrainingError,
     QueueFullError,
     RequestTimeoutError,
     ServingFuture,
@@ -43,5 +58,7 @@ __all__ = [
     "ServingEngine", "ServingConfig", "ServingMetrics", "ServingFuture",
     "KVCachePool", "PoolExhaustedError", "PrefixKVCache",
     "ContinuousBatchingScheduler", "QueueFullError", "RequestTimeoutError",
-    "ServingFaultInjector", "bucket_for", "default_buckets",
+    "EngineDrainingError", "ServingFaultInjector", "bucket_for",
+    "default_buckets", "FleetConfig", "Router", "ReplicaEndpoint",
+    "ReplicaServer", "FleetOverloadError", "RequestPoisonedError",
 ]

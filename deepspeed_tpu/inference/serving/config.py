@@ -1,9 +1,10 @@
-"""Typed view of the ``serving`` config block.
+"""Typed views of the ``serving`` and ``fleet`` config blocks.
 
-Parsed and validated by ``runtime/config.py::get_serving_config`` (key
-strings and defaults live in ``runtime/constants.py`` next to the
-checkpoint/resilience blocks). Import-light on purpose: the config layer
-must not drag jax in; device work lives in engine.py/kv_pool.py.
+Parsed and validated by ``runtime/config.py::get_serving_config`` /
+``get_fleet_config`` (key strings and defaults live in
+``runtime/constants.py`` next to the checkpoint/resilience blocks).
+Import-light on purpose: the config layer must not drag jax in; device
+work lives in engine.py/kv_pool.py.
 """
 
 from dataclasses import dataclass, field
@@ -73,3 +74,51 @@ class ServingConfig:
     # MaxSlots × S_max bytes — admission backpressures when pages
     # run out instead of over-allocating.
     kv_pool_tokens: int = None
+
+
+@dataclass
+class FleetConfig:
+    """The ``fleet`` block: router + replica-fleet policy
+    (inference/serving/router.py, replica.py). Opt-in like ``serving``:
+    the block's presence enables it."""
+
+    # Master switch: True once a `fleet` section exists (see
+    # get_fleet_config), False when absent.
+    enabled: bool = False
+    # Replica processes the launch path spawns (the router itself
+    # accepts any endpoint list; this sizes launch/bench wiring).
+    replicas: int = 2
+    # Re-route attempts per request after a replica FAILURE (death, EOF,
+    # attempt timeout). Rejections (queue-full / draining / injected) do
+    # NOT consume the budget — they re-route immediately. Exhausting it
+    # quarantines the request with RequestPoisonedError.
+    retry_budget: int = 2
+    # Exponential backoff between failure retries: base * 2^attempt,
+    # jittered, capped at retry_backoff_max_s.
+    retry_backoff_s: float = 0.05
+    retry_backoff_max_s: float = 2.0
+    # Per-attempt socket inactivity deadline (no token / reply for this
+    # long = the replica is wedged; fail the attempt and re-route).
+    # 0 = wait forever. Must exceed worst-case cold prefill compile.
+    attempt_timeout_s: float = 120.0
+    # Replica-side drain deadline on SIGTERM: finish in-flight work for
+    # at most this long, then exit EXIT_PREEMPTED regardless.
+    drain_timeout_s: float = 30.0
+    # Router-side health probe cache TTL: /healthz + /snapshot scrapes
+    # are at most this stale when scoring replicas.
+    health_ttl_s: float = 0.25
+    # Prefix-affinity hash length (tokens): requests sharing their first
+    # N tokens route to the same replica so the prefix KV cache keeps
+    # hitting after scale-out. 0 disables affinity (pure least-loaded).
+    affinity_prefix_tokens: int = 16
+    # A replica with queue_depth + active_requests >= this is saturated:
+    # affinity falls back to least-loaded, and when EVERY healthy
+    # replica is saturated the router sheds with FleetOverloadError.
+    saturation_queue_depth: int = 32
+    # Admission-controller token budgets (prompt + max_new_tokens of
+    # everything in flight through the router): an int caps every
+    # request class; a {class: budget} dict (optional "default" key)
+    # caps per class. 0 = unbounded.
+    max_inflight_tokens: object = 0
+    # retry-after hint carried by FleetOverloadError on shed.
+    shed_retry_after_s: float = 0.5

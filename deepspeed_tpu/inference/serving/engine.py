@@ -141,6 +141,7 @@ from deepspeed_tpu.inference.serving.metrics import ServingMetrics
 from deepspeed_tpu.inference.serving.prefix_cache import PrefixKVCache
 from deepspeed_tpu.inference.serving.scheduler import (
     ContinuousBatchingScheduler,
+    EngineDrainingError,
     RequestTimeoutError,
     bucket_for,
     default_buckets,
@@ -890,8 +891,10 @@ class ServingEngine:
         self._prefill_batch = cfg.max_slots
         self._chunking = None               # at most one chunked prefill
         self._step_count = 0
+        self._busy_steps = 0                # steps that had active lanes
         self._loop_thread = None
         self._stop = threading.Event()
+        self._draining = False              # planned restart: admit nothing
 
         # telemetry: an explicit block arms the process-global tracer and
         # registry; an absent block leaves them untouched. Hot-path guard
@@ -937,7 +940,8 @@ class ServingEngine:
                 "background_loop": t is not None,
                 "steps": self._step_count,
                 "active_requests": len(self._active),
-                "queue_depth": self.scheduler.queue_depth()}
+                "queue_depth": self.scheduler.queue_depth(),
+                "draining": self._draining}
 
     @classmethod
     def from_config(cls, params, model_config, ds_config, rank=0,
@@ -959,14 +963,21 @@ class ServingEngine:
 
     # -- request intake -------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=None, eos_token_id=None,
-               timeout_s=None, stream_cb=None):
+               timeout_s=None, stream_cb=None, age_s=0.0):
         """Queue one request; returns its ``ServingFuture``.
 
         ``prompt_ids`` is a 1-D token sequence. Raises ``QueueFullError``
-        when the admission queue is at capacity (backpressure) and
-        ``ValueError`` for requests that can never fit. ``stream_cb``
-        (optional) is called as ``stream_cb(request_id, token)`` for every
-        generated token, including the first."""
+        when the admission queue is at capacity (backpressure),
+        ``EngineDrainingError`` during a planned drain, and ``ValueError``
+        for requests that can never fit. ``stream_cb`` (optional) is
+        called as ``stream_cb(request_id, token)`` for every generated
+        token, including the first. ``age_s`` backdates the enqueue
+        timestamp by that many seconds — a re-routed or requeued request
+        keeps its original deadline/TTFT clock instead of resetting it."""
+        if self._draining:
+            raise EngineDrainingError(
+                "engine is draining for a planned restart; "
+                "route this request to another replica")
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if len(prompt) < 1:
             raise ValueError("prompt must contain at least one token")
@@ -986,10 +997,13 @@ class ServingEngine:
             raise ValueError(
                 f"eos_token_id={eos_token_id} outside vocab "
                 f"[0, {self.model_config.vocab_size})")
+        submitted_at = (time.monotonic() - float(age_s)
+                        if age_s and age_s > 0 else None)
         req = self.scheduler.submit(
             prompt, max_new_tokens=int(max_new_tokens),
             eos_token_id=None if eos_token_id is None else int(eos_token_id),
-            timeout_s=timeout_s, stream_cb=stream_cb)
+            timeout_s=timeout_s, stream_cb=stream_cb,
+            submitted_at=submitted_at)
         return req.future
 
     # -- the serving loop ----------------------------------------------
@@ -1016,8 +1030,14 @@ class ServingEngine:
             self.injector.maybe_evict_prefix(self._step_count,
                                              self.prefix_cache)
         if self._active:
+            # busy steps (not raw _step_count, which idles forward between
+            # requests in background mode): the kill_replica arm's at_step
+            # must mean "the Nth decode step that had work" to be
+            # reproducible against a live server
+            self._busy_steps += 1
             if self.injector is not None:
                 self.injector.maybe_slow_decode(self._step_count)
+                self.injector.maybe_kill_replica(self._busy_steps)
             # span args (request ids) are built ONLY when tracing is armed:
             # disabled-mode cost is this one attribute read. The dict is
             # kept so the spec path can fill in `accepted` post-step (the
@@ -1263,13 +1283,30 @@ class ServingEngine:
         ``max_steps`` bounds the loop (a deadline-less stuck request
         would otherwise spin forever under fault injection)."""
         steps = 0
-        while (self._active or self._chunking is not None
-               or self.scheduler.queue_depth() > 0):
+        while self.pending():
             self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
         return steps
+
+    def pending(self):
+        """Requests still owed work: queued + chunking + in flight."""
+        return (len(self._active) + (1 if self._chunking is not None else 0)
+                + self.scheduler.queue_depth())
+
+    @property
+    def draining(self):
+        return self._draining
+
+    def begin_drain(self):
+        """Planned-restart drain: stop admitting (``submit`` raises
+        ``EngineDrainingError``), keep stepping accepted work to
+        completion. The SIGTERM path: a replica flips this, finishes its
+        in-flight lanes, then exits ``EXIT_PREEMPTED`` so the supervisor
+        restarts it without backoff while the router re-routes around
+        it."""
+        self._draining = True
 
     # -- background mode ------------------------------------------------
     def start(self, idle_sleep_s=0.001):

@@ -28,6 +28,27 @@ recovery paths are testable on CPU without real stragglers:
                     throughput may suffer). No-op with speculation
                     disabled.
 
+Fleet arms (PR 12) extend the same interface to replica-process faults
+so router failover paths are drivable from config:
+
+    kill_replica    SIGKILL the replica process right before the Nth
+                    decode step that has active lanes (``at_step``
+                    counts BUSY steps, not raw scheduler iterations — a
+                    background loop idles the iteration counter forward
+                    between requests) — the hard-death case
+                    (no drain, no goodbye on the socket): the supervisor
+                    sees a crash and restarts, the router sees EOF and
+                    must re-route every in-flight request
+    slow_replica    delay every socket reply by ``seconds`` (a healthy
+                    engine behind a slow transport: exercises the
+                    router's per-attempt timeout + health scoring
+                    without killing anything)
+    reject_admission
+                    the replica refuses the next ``times`` submissions
+                    with an injected rejection (admission-layer flake:
+                    the router must re-route WITHOUT burning the
+                    request's retry budget)
+
 Arms take ``at_step``/``times`` like the step arms (``slow_decode``,
 ``evict_under_decode``) or ``request_id`` (``stuck_request``, persistent
 by default). Because the class sits at the bottom of the injector
@@ -43,8 +64,12 @@ Programmatically::
     fi.arm_serving("slow_decode", at_step=2, seconds=0.05)
     fi.arm_serving("stuck_request", request_id=1)
     fi.arm_serving("evict_under_decode", at_step=3)
+    fi.arm_serving("kill_replica", at_step=4)
+    fi.arm_serving("reject_admission", times=2)
 """
 
+import os
+import signal
 import time
 
 import numpy as np
@@ -52,7 +77,8 @@ import numpy as np
 from deepspeed_tpu.runtime.resilience.fault_injection import StepFaultInjector
 
 SERVING_POINTS = ("slow_decode", "stuck_request", "evict_under_decode",
-                  "corrupt_draft")
+                  "corrupt_draft", "kill_replica", "slow_replica",
+                  "reject_admission")
 
 
 class _ServingArm:
@@ -143,6 +169,55 @@ class ServingFaultInjector(StepFaultInjector):
         if vocab_size < 2:
             return None                  # nowhere to scramble to
         return 1 + (np.arange(k, dtype=np.int32) * 7919) % (vocab_size - 1)
+
+    # -- fleet hooks (replica.py / router tests) ------------------------
+    def maybe_kill_replica(self, step):
+        """SIGKILL this process when the kill_replica arm matches
+        ``step`` — the replica dies mid-decode with no cleanup, exactly
+        like an OOM-killed or preempted-without-grace worker. The kill
+        primitive is swappable (``_kill``) so unit tests can observe the
+        trigger without dying."""
+        arm = self._serving_arms.get("kill_replica")
+        if arm is None:
+            return
+        if arm.at_step is not None and step != arm.at_step:
+            return
+        if arm.times is not None:
+            if arm.times <= 0:
+                return
+            arm.times -= 1
+        self._fire("kill_replica")
+        self._kill()
+
+    def _kill(self):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def reply_delay_s(self):
+        """Per-reply socket delay while the slow_replica arm is armed
+        (``times`` bounds how many replies are delayed), else 0.0."""
+        arm = self._serving_arms.get("slow_replica")
+        if arm is None:
+            return 0.0
+        if arm.times is not None:
+            if arm.times <= 0:
+                return 0.0
+            arm.times -= 1
+        self._fire("slow_replica")
+        return arm.seconds
+
+    def admission_rejected(self):
+        """True while the reject_admission arm has shots left: the
+        replica server answers the submit with an injected rejection
+        instead of reaching the engine."""
+        arm = self._serving_arms.get("reject_admission")
+        if arm is None:
+            return False
+        if arm.times is not None:
+            if arm.times <= 0:
+                return False
+            arm.times -= 1
+        self._fire("reject_admission")
+        return True
 
     def request_is_stuck(self, request_id):
         """True while the stuck_request arm pins ``request_id`` (persistent

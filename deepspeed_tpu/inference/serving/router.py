@@ -1,0 +1,548 @@
+"""Fleet routing front-door: health-aware load balancing over N replicas.
+
+One :class:`ServingEngine` process answers one queue; this tier answers a
+*fleet*. The :class:`Router` load-balances requests across replica
+processes (each a ``replica.py`` worker under ``launcher/supervisor.py``)
+speaking a line-delimited JSON protocol over local TCP sockets, and makes
+the fleet survive exactly the faults the injectors can produce:
+
+- **health-aware routing**: replicas are scored from their ``/healthz``
+  + ``/snapshot`` telemetry endpoints (loop liveness, queue depth,
+  draining flag — the PR 10 ``DSTPU_TELEMETRY_PORT`` contract), with a
+  socket-level ``{"op": "health"}`` probe as the no-telemetry fallback.
+  Prefix-affinity hashing sends prompts sharing their first N tokens to
+  the same replica so ``Serving/PrefixHitRate`` survives scale-out;
+  least-loaded wins whenever the affinity target is unhealthy, draining,
+  or saturated.
+- **failover with exactly-once completion**: every request carries an
+  idempotency key and a ``delivered`` high-water mark. On replica death
+  (supervisor restart, ``EXIT_POISONED``, socket EOF, per-attempt
+  timeout) the request is re-routed with ``from=delivered``: the new
+  replica recomputes the full greedy generation (deterministic — same
+  seed, same params) and replays only the missing suffix, so a
+  ``stream_cb`` never sees a token twice and the final output is
+  bitwise-identical to single-engine ``generate()``. Failure retries
+  burn a bounded budget with exponential backoff + jitter; exhausting it
+  quarantines the request with :class:`RequestPoisonedError` instead of
+  crash-looping the fleet. Rejections (queue-full / draining / injected)
+  re-route immediately WITHOUT burning budget — the request did nothing
+  wrong.
+- **drain awareness**: a replica answering ``rejected: draining`` (or
+  advertising ``draining`` via health) leaves the rotation at once; its
+  in-flight requests finish where they are (see replica.py's SIGTERM
+  sequence).
+- **overload shedding**: an admission controller sheds with a structured
+  :class:`FleetOverloadError` (retry-after hint) when a request class'
+  token budget is exhausted or every routable replica is saturated —
+  failing fast at the door beats timing out deep in a queue.
+
+Stdlib-only on purpose (sockets + threads + json): the router process
+must never pay a jax import, and the module is reusable from the
+launcher. Wire protocol (one JSON object per line)::
+
+    -> {"op": "submit", "key": K, "prompt": [...], "from": 0, ...}
+    <- {"t": 17, "i": 0}            # token 0
+    <- {"t": 4,  "i": 1}            # token 1
+    <- {"done": true, "n": 2}       # terminal: success
+    <- {"rejected": "queue_full" | "draining" | "injected"}
+    <- {"error": msg, "etype": "RequestTimeoutError", "detail": {...}}
+"""
+
+import json
+import random
+import socket
+import threading
+import time
+import uuid
+import zlib
+from urllib.error import HTTPError
+from urllib.request import urlopen
+
+from deepspeed_tpu.inference.serving.config import FleetConfig
+from deepspeed_tpu.inference.serving.scheduler import (
+    RequestTimeoutError,
+    ServingFuture,
+)
+
+PROTOCOL_VERSION = 1
+
+# terminal error types a replica may report; anything else degrades to
+# RuntimeError with the replica's message
+_TERMINAL_ERRORS = {
+    "RequestTimeoutError": None,     # reconstructed from detail below
+    "ValueError": ValueError,
+}
+
+
+class FleetOverloadError(RuntimeError):
+    """The router shed this request at admission: either its class'
+    token budget is exhausted or every routable replica is saturated.
+    ``retry_after_s`` is the client's backoff hint."""
+
+    def __init__(self, reason, retry_after_s, request_class="default"):
+        self.reason = reason            # "class_budget" | "saturated"
+        self.retry_after_s = float(retry_after_s)
+        self.request_class = request_class
+        super().__init__(
+            f"fleet overloaded ({reason}, class={request_class!r}); "
+            f"retry after {retry_after_s:.2f}s")
+
+
+class RequestPoisonedError(RuntimeError):
+    """A request failed on every retry and was quarantined: the retry
+    budget is spent and the router will not crash-loop the fleet on it."""
+
+    def __init__(self, key, attempts, last_error):
+        self.key = key
+        self.attempts = int(attempts)
+        self.last_error = str(last_error)
+        super().__init__(
+            f"request {key} quarantined after {attempts} failed attempt(s); "
+            f"last error: {last_error}")
+
+
+def send_line(sock, doc):
+    """One protocol frame: compact JSON + newline."""
+    sock.sendall((json.dumps(doc, separators=(",", ":")) + "\n")
+                 .encode("utf-8"))
+
+
+def read_line(stream):
+    """One frame off a socket file object; None at EOF."""
+    line = stream.readline()
+    if not line:
+        return None
+    return json.loads(line)
+
+
+def _http_json(url, timeout_s):
+    """GET a JSON doc; a 503 /healthz body still parses (unhealthy is an
+    answer, not an outage)."""
+    try:
+        with urlopen(url, timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except HTTPError as e:
+        return json.loads(e.read().decode("utf-8"))
+
+
+class ReplicaEndpoint:
+    """One replica's addresses + the router's live view of it."""
+
+    def __init__(self, name, host, port, health_url=None):
+        self.name = str(name)
+        self.host = str(host)
+        self.port = int(port)
+        # telemetry endpoint ("http://127.0.0.1:9100"); None = probe the
+        # serving socket with {"op": "health"} instead
+        self.health_url = health_url.rstrip("/") if health_url else None
+        # router-side view, refreshed by probes
+        self.healthy = True
+        self.draining = False
+        self.load_hint = 0          # queue_depth + active from last probe
+        self.inflight = 0           # attempts the router has on this replica
+        self.last_probe = 0.0
+        self.failures = 0           # consecutive probe/attempt failures
+
+    @property
+    def address(self):
+        return (self.host, self.port)
+
+    def __repr__(self):
+        return (f"ReplicaEndpoint({self.name}, {self.host}:{self.port}, "
+                f"healthy={self.healthy}, draining={self.draining}, "
+                f"load={self.load_hint}+{self.inflight})")
+
+
+class _RoutedRequest:
+    __slots__ = ("key", "prompt", "max_new_tokens", "eos_token_id",
+                 "timeout_s", "stream_cb", "request_class", "cost",
+                 "future", "delivered", "t0")
+
+    def __init__(self, key, prompt, max_new_tokens, eos_token_id, timeout_s,
+                 stream_cb, request_class, cost):
+        self.key = key
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_token_id = eos_token_id
+        self.timeout_s = timeout_s
+        self.stream_cb = stream_cb
+        self.request_class = request_class
+        self.cost = cost
+        self.future = ServingFuture(key)
+        self.delivered = 0          # exactly-once high-water mark
+        self.t0 = time.monotonic()  # original submit time (age_s on retry)
+
+
+class Router:
+    """Health-aware, failover-capable request router over a replica fleet."""
+
+    def __init__(self, endpoints, config=None, registry=None,
+                 probe_timeout_s=2.0, rng=None):
+        self.config = config or FleetConfig(enabled=True)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._rng = rng or random.Random()
+        self._endpoints = []
+        for ep in endpoints:
+            if not isinstance(ep, ReplicaEndpoint):
+                ep = ReplicaEndpoint(*ep)
+            self._endpoints.append(ep)
+        if not self._endpoints:
+            raise ValueError("router needs at least one replica endpoint")
+        # stable order: the affinity hash must map a prefix to the same
+        # replica in every router process
+        self._endpoints.sort(key=lambda e: e.name)
+        self._lock = threading.Lock()
+        self._inflight_tokens = {}      # class -> tokens in flight
+        self._inflight_requests = 0
+        self._threads = set()
+        self._closed = False
+        self._counters = {
+            "routed": 0,        # attempts dispatched to a replica
+            "retried": 0,       # failure retries (budget-burning)
+            "shed": 0,          # FleetOverloadError raised at admission
+            "drained": 0,       # draining rejections observed
+            "rejected": 0,      # queue_full / injected rejections observed
+            "completed": 0,     # requests finished successfully
+            "failed": 0,        # requests finished with a terminal error
+            "poisoned": 0,      # requests quarantined
+        }
+        if registry is not None:
+            self.export_gauges(registry)
+
+    # -- metrics ---------------------------------------------------------
+    def counters(self):
+        with self._lock:
+            out = dict(self._counters)
+            out["inflight_requests"] = self._inflight_requests
+            out["inflight_tokens"] = float(
+                sum(self._inflight_tokens.values()))
+        accepted = out["completed"] + out["failed"] + out["poisoned"] \
+            + out["inflight_requests"]
+        out["shed_rate"] = (out["shed"] / (out["shed"] + accepted)
+                            if out["shed"] + accepted > 0 else 0.0)
+        out["healthy_replicas"] = float(
+            sum(1 for ep in self._endpoints
+                if ep.healthy and not ep.draining))
+        return out
+
+    def export_gauges(self, registry):
+        """Pull gauges under ``Fleet/router/*`` (routed, retried, shed,
+        drained, shed_rate, ...) so the PR 10 SLO engine and the fleet
+        collector can alert on them. Idempotent."""
+        registry.gauge_fn(
+            "Fleet/router",
+            lambda: {k: float(v) for k, v in self.counters().items()},
+            help="fleet router counters (routed/retried/shed/drained)")
+        return registry
+
+    # -- health ----------------------------------------------------------
+    def _probe(self, ep, now=None, force=False):
+        now = time.monotonic() if now is None else now
+        if not force and now - ep.last_probe < self.config.health_ttl_s:
+            return
+        ep.last_probe = now
+        try:
+            if ep.health_url is not None:
+                doc = _http_json(ep.health_url + "/healthz",
+                                 self.probe_timeout_s)
+                loop = doc.get("serving_loop") or {}
+                rep = doc.get("replica") or {}
+                ep.draining = bool(loop.get("draining")
+                                   or rep.get("draining"))
+                ep.healthy = doc.get("status") == "ok"
+                ep.load_hint = (int(loop.get("queue_depth", 0))
+                                + int(loop.get("active_requests", 0)))
+            else:
+                doc = self._socket_health(ep)
+                ep.draining = bool(doc.get("draining"))
+                ep.healthy = bool(doc.get("healthy", True))
+                ep.load_hint = (int(doc.get("queue_depth", 0))
+                                + int(doc.get("active_requests", 0)))
+            ep.failures = 0
+        except (OSError, ValueError):
+            ep.healthy = False
+            ep.failures += 1
+
+    def _socket_health(self, ep):
+        with socket.create_connection(ep.address,
+                                      timeout=self.probe_timeout_s) as sock:
+            sock.settimeout(self.probe_timeout_s)
+            send_line(sock, {"op": "health"})
+            doc = read_line(sock.makefile("rb"))
+        if doc is None:
+            raise OSError("health probe: EOF")
+        return doc
+
+    def probe_all(self, force=True):
+        """Refresh every endpoint's health view; returns the endpoints."""
+        now = time.monotonic()
+        for ep in self._endpoints:
+            self._probe(ep, now=now, force=force)
+        return list(self._endpoints)
+
+    def _routable(self, ep):
+        return ep.healthy and not ep.draining
+
+    def _load(self, ep):
+        return ep.load_hint + ep.inflight
+
+    def _saturated(self, ep):
+        return self._load(ep) >= max(1, self.config.saturation_queue_depth)
+
+    # -- routing policy --------------------------------------------------
+    def _affinity_target(self, prompt):
+        n = self.config.affinity_prefix_tokens
+        if n <= 0:
+            return None
+        prefix = ",".join(str(int(t)) for t in prompt[:n]).encode("ascii")
+        return self._endpoints[zlib.crc32(prefix) % len(self._endpoints)]
+
+    def _pick(self, rr, avoid=None):
+        """Affinity target when healthy and unsaturated; else the
+        least-loaded routable replica; None when nothing is routable."""
+        now = time.monotonic()
+        for ep in self._endpoints:
+            self._probe(ep, now=now)
+        candidates = [ep for ep in self._endpoints if self._routable(ep)]
+        if avoid is not None and len(candidates) > 1:
+            candidates = [ep for ep in candidates if ep is not avoid]
+        if not candidates:
+            return None
+        target = self._affinity_target(rr.prompt)
+        if (target is not None and target in candidates
+                and not self._saturated(target)):
+            return target
+        return min(candidates, key=self._load)
+
+    # -- admission control ----------------------------------------------
+    def _class_budget(self, request_class):
+        b = self.config.max_inflight_tokens
+        if isinstance(b, dict):
+            b = b.get(request_class, b.get("default", 0))
+        return int(b or 0)
+
+    def _admit(self, rr):
+        """Shed checks; reserves the class token budget on success."""
+        budget = self._class_budget(rr.request_class)
+        with self._lock:
+            used = self._inflight_tokens.get(rr.request_class, 0)
+            if budget > 0 and used + rr.cost > budget:
+                self._counters["shed"] += 1
+                raise FleetOverloadError(
+                    "class_budget", self.config.shed_retry_after_s,
+                    request_class=rr.request_class)
+        routable = [ep for ep in self.probe_all(force=False)
+                    if self._routable(ep)]
+        if routable and all(self._saturated(ep) for ep in routable):
+            with self._lock:
+                self._counters["shed"] += 1
+            raise FleetOverloadError(
+                "saturated", self.config.shed_retry_after_s,
+                request_class=rr.request_class)
+        with self._lock:
+            self._inflight_tokens[rr.request_class] = \
+                self._inflight_tokens.get(rr.request_class, 0) + rr.cost
+            self._inflight_requests += 1
+
+    def _release(self, rr):
+        with self._lock:
+            left = self._inflight_tokens.get(rr.request_class, 0) - rr.cost
+            self._inflight_tokens[rr.request_class] = max(0, left)
+            self._inflight_requests -= 1
+
+    # -- public API ------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, eos_token_id=None,
+               timeout_s=None, stream_cb=None, request_class="default",
+               key=None):
+        """Route one request; returns a :class:`ServingFuture`.
+
+        Raises :class:`FleetOverloadError` synchronously when shedding.
+        Every other outcome — success, terminal error from the replica,
+        :class:`RequestPoisonedError` after budget exhaustion — is
+        delivered through the future."""
+        if self._closed:
+            raise RuntimeError("router is closed")
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must contain at least one token")
+        cost = len(prompt) + int(max_new_tokens or 0)
+        rr = _RoutedRequest(
+            key or uuid.uuid4().hex, prompt,
+            None if max_new_tokens is None else int(max_new_tokens),
+            None if eos_token_id is None else int(eos_token_id),
+            timeout_s, stream_cb, request_class, cost)
+        self._admit(rr)
+        t = threading.Thread(target=self._run_request, args=(rr,),
+                             name=f"router-{rr.key[:8]}", daemon=True)
+        with self._lock:
+            self._threads.add(t)
+        t.start()
+        return rr.future
+
+    def close(self, timeout_s=5.0):
+        self._closed = True
+        with self._lock:
+            threads = list(self._threads)
+        deadline = time.monotonic() + timeout_s
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+
+    # -- the per-request worker ------------------------------------------
+    def _run_request(self, rr):
+        try:
+            self._drive(rr)
+        finally:
+            self._release(rr)
+            with self._lock:
+                self._threads.discard(threading.current_thread())
+
+    def _drive(self, rr):
+        cfg = self.config
+        failures = 0
+        reroutes = 0
+        avoid = None
+        while True:
+            ep = self._pick(rr, avoid=avoid)
+            if ep is None:
+                failures += 1
+                if failures > cfg.retry_budget:
+                    self._finish_poisoned(rr, failures,
+                                          "no routable replica")
+                    return
+                with self._lock:
+                    self._counters["retried"] += 1
+                avoid = None
+                self._backoff(failures)
+                continue
+            outcome, detail = self._attempt(rr, ep)
+            if outcome == "done":
+                with self._lock:
+                    self._counters["completed"] += 1
+                rr.future._finish()
+                return
+            if outcome == "terminal":
+                with self._lock:
+                    self._counters["failed"] += 1
+                rr.future._finish(self._terminal_exception(detail))
+                return
+            if outcome == "rejected":
+                # the replica said no before doing work: re-route without
+                # burning retry budget, but bound the carousel
+                with self._lock:
+                    self._counters[
+                        "drained" if detail == "draining"
+                        else "rejected"] += 1
+                if detail == "draining":
+                    ep.draining = True
+                avoid = ep
+                reroutes += 1
+                if reroutes > max(4, 2 * len(self._endpoints)):
+                    reroutes = 0
+                    failures += 1
+                    if failures > cfg.retry_budget:
+                        self._finish_poisoned(
+                            rr, failures, f"rejected everywhere ({detail})")
+                        return
+                    with self._lock:
+                        self._counters["retried"] += 1
+                    self._backoff(failures)
+                continue
+            # outcome == "failed": the replica died / wedged mid-attempt
+            ep.healthy = False
+            ep.failures += 1
+            failures += 1
+            if failures > cfg.retry_budget:
+                self._finish_poisoned(rr, failures, detail)
+                return
+            with self._lock:
+                self._counters["retried"] += 1
+            avoid = ep
+            self._backoff(failures)
+
+    def _backoff(self, n):
+        base = self.config.retry_backoff_s * (2 ** max(0, n - 1))
+        delay = min(base, self.config.retry_backoff_max_s)
+        time.sleep(delay * (0.5 + self._rng.random()))
+
+    def _finish_poisoned(self, rr, attempts, last_error):
+        with self._lock:
+            self._counters["poisoned"] += 1
+        rr.future._finish(
+            RequestPoisonedError(rr.key, attempts, last_error))
+
+    @staticmethod
+    def _terminal_exception(doc):
+        etype = doc.get("etype", "")
+        detail = doc.get("detail") or {}
+        if etype == "RequestTimeoutError":
+            return RequestTimeoutError(
+                detail.get("request_id", doc.get("key", "?")),
+                detail.get("timeout_s"), detail.get("phase", "decoding"),
+                tokens_done=detail.get("tokens_done", 0))
+        exc_cls = _TERMINAL_ERRORS.get(etype) or RuntimeError
+        return exc_cls(doc.get("error", "replica error"))
+
+    def _attempt(self, rr, ep):
+        """One routed attempt. Returns (outcome, detail): "done",
+        ("terminal", error-doc), ("rejected", reason), or
+        ("failed", why) — only "failed" burns retry budget."""
+        timeout = self.config.attempt_timeout_s or None
+        with self._lock:
+            self._counters["routed"] += 1
+        ep.inflight += 1
+        sock = None
+        try:
+            sock = socket.create_connection(ep.address, timeout=timeout)
+            sock.settimeout(timeout)
+            send_line(sock, {
+                "op": "submit", "v": PROTOCOL_VERSION, "key": rr.key,
+                "prompt": rr.prompt, "max_new_tokens": rr.max_new_tokens,
+                "eos_token_id": rr.eos_token_id, "timeout_s": rr.timeout_s,
+                "from": rr.delivered,
+                "age_s": max(0.0, time.monotonic() - rr.t0)})
+            stream = sock.makefile("rb")
+            while True:
+                doc = read_line(stream)
+                if doc is None:
+                    return "failed", "socket EOF (replica died?)"
+                if "t" in doc:
+                    i = int(doc.get("i", -1))
+                    if i == rr.delivered:
+                        self._deliver(rr, int(doc["t"]))
+                    elif i > rr.delivered:
+                        return "failed", (
+                            f"token gap: got index {i}, "
+                            f"delivered {rr.delivered}")
+                    # i < delivered: replayed duplicate — never re-emitted
+                elif doc.get("done"):
+                    n = int(doc.get("n", rr.delivered))
+                    if n != rr.delivered:
+                        return "failed", (
+                            f"done at n={n} but delivered {rr.delivered}")
+                    return "done", None
+                elif "rejected" in doc:
+                    return "rejected", str(doc["rejected"])
+                elif "error" in doc:
+                    return "terminal", doc
+                else:
+                    return "failed", f"unintelligible frame: {doc!r}"
+        except (OSError, ValueError) as e:
+            # connect refused, reset, per-attempt inactivity timeout,
+            # or torn JSON from a dying replica — all the same verdict
+            return "failed", f"{type(e).__name__}: {e}"
+        finally:
+            ep.inflight -= 1
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _deliver(self, rr, token):
+        rr.future._append(token)
+        rr.delivered += 1
+        if rr.stream_cb is not None:
+            try:
+                rr.stream_cb(rr.key, token)
+            except Exception:   # a broken callback must not kill routing
+                pass

@@ -33,6 +33,12 @@ class QueueFullError(RuntimeError):
     front-end under overload must shed or retry with its own policy."""
 
 
+class EngineDrainingError(RuntimeError):
+    """The engine is draining for a planned restart and admits nothing
+    new; in-flight requests keep running to completion. A router should
+    take the replica out of rotation and re-route, not retry here."""
+
+
 class RequestTimeoutError(TimeoutError):
     """A request exceeded its deadline (queued or mid-decode) and was
     retired; delivered via the request's future."""
@@ -111,7 +117,7 @@ class Request:
     """One generation request plus its in-flight state."""
 
     def __init__(self, request_id, prompt, max_new_tokens, eos_token_id=None,
-                 timeout_s=None, stream_cb=None):
+                 timeout_s=None, stream_cb=None, submitted_at=None):
         self.id = request_id
         self.prompt = prompt                    # list[int]
         self.max_new_tokens = int(max_new_tokens)
@@ -119,7 +125,11 @@ class Request:
         self.timeout_s = timeout_s              # None = no deadline
         self.stream_cb = stream_cb
         self.future = ServingFuture(request_id)
-        self.submit_time = time.monotonic()
+        # submitted_at (monotonic) backdates a request that already waited
+        # elsewhere — a PoolExhaustedError requeue or a router re-route
+        # must NOT reset the deadline clock or the TTFT percentiles.
+        self.submit_time = (time.monotonic() if submitted_at is None
+                            else float(submitted_at))
         self.first_token_time = None            # TTFT endpoint
         self.slot = None
         self.emitted = 0
@@ -160,15 +170,21 @@ class ContinuousBatchingScheduler:
             return len(self._queue)
 
     def submit(self, prompt, max_new_tokens=None, eos_token_id=None,
-               timeout_s=None, stream_cb=None):
-        """Enqueue a request; QueueFullError when at capacity."""
+               timeout_s=None, stream_cb=None, submitted_at=None):
+        """Enqueue a request; QueueFullError when at capacity.
+
+        ``submitted_at`` (monotonic seconds) backdates the enqueue
+        timestamp for a request that already waited somewhere else —
+        e.g. one bounced off ``PoolExhaustedError`` backpressure or
+        re-routed from a dead replica — so its deadline and TTFT clock
+        keep running instead of silently resetting on retry."""
         if max_new_tokens is None:
             max_new_tokens = self.default_max_new_tokens
         if timeout_s is None and self.request_timeout_s > 0:
             timeout_s = self.request_timeout_s
         req = Request(next(self._ids), list(prompt), max_new_tokens,
                       eos_token_id=eos_token_id, timeout_s=timeout_s,
-                      stream_cb=stream_cb)
+                      stream_cb=stream_cb, submitted_at=submitted_at)
         with self._lock:
             if len(self._queue) >= self.max_queue:
                 raise QueueFullError(

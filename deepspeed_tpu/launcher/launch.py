@@ -52,6 +52,15 @@ def parse_args():
                         help="Fixed port for the WORKER's telemetry endpoint "
                              "(exported as DSTPU_TELEMETRY_PORT; survives "
                              "restarts so the fleet collector can keep scraping)")
+    parser.add_argument("--replica_port", default=None, type=int,
+                        help="Fixed port for a SERVING replica's request "
+                             "socket (exported as DSTPU_REPLICA_PORT; "
+                             "survives restarts so a fleet router's "
+                             "endpoint list never goes stale)")
+    parser.add_argument("--replica_config", default=None, type=str,
+                        help="Replica config JSON path (exported as "
+                             "DSTPU_REPLICA_CONFIG for "
+                             "inference/serving/replica.py workers)")
     parser.add_argument("--collector_port", default=None, type=int,
                         help="Run a FleetCollector next to the supervisor, "
                              "serving /fleet/metrics, /fleet/trace and "
@@ -122,6 +131,8 @@ def main():
         heartbeat_timeout_s=args.heartbeat_timeout_s,
         http_port=args.telemetry_port,
         worker_port=args.worker_telemetry_port,
+        replica_port=args.replica_port,
+        replica_config=args.replica_config,
         log=lambda msg: logger.warning(f"launch[{node_rank}]: {msg}"),
     )
 

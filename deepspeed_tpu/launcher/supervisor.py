@@ -58,6 +58,12 @@ PREEMPT_SAVE_DIR_ENV = "DSTPU_PREEMPT_SAVE_DIR"
 # block leaves http_port null binds this port instead, so the fleet
 # collector knows where to scrape it.
 TELEMETRY_PORT_ENV = "DSTPU_TELEMETRY_PORT"
+# Serving-replica socket port + config path (duplicated in
+# inference/serving/replica.py, same no-eager-import rule): a supervised
+# serving replica binds this FIXED port so the router's endpoint stays
+# valid across restarts — an ephemeral port would move on every recycle.
+REPLICA_PORT_ENV = "DSTPU_REPLICA_PORT"
+REPLICA_CONFIG_ENV = "DSTPU_REPLICA_CONFIG"
 
 # Exit classes (WorkerSupervisor.exit_history entries).
 CLASS_CLEAN = "clean"
@@ -91,7 +97,7 @@ class WorkerSupervisor:
                  max_backoff_s=30.0, heartbeat_timeout_s=0.0,
                  heartbeat_file=None, poll_interval_s=0.05, term_grace_s=5.0,
                  fatal_exit_codes=(EXIT_POISONED,), log=None, http_port=None,
-                 worker_port=None):
+                 worker_port=None, replica_port=None, replica_config=None):
         self.cmd = list(cmd)
         self.env = dict(env if env is not None else os.environ)
         self.max_restarts = int(max_restarts)
@@ -116,6 +122,13 @@ class WorkerSupervisor:
         self.worker_port = worker_port
         if worker_port is not None:
             self.env[TELEMETRY_PORT_ENV] = str(int(worker_port))
+        # a serving replica likewise keeps a FIXED request socket across
+        # restarts so the router's endpoint list never goes stale
+        self.replica_port = replica_port
+        if replica_port is not None:
+            self.env[REPLICA_PORT_ENV] = str(int(replica_port))
+        if replica_config is not None:
+            self.env[REPLICA_CONFIG_ENV] = str(replica_config)
 
         self.child = None
         self.restarts = 0
@@ -276,6 +289,15 @@ class WorkerSupervisor:
         if self.worker_port is None:
             return None
         return f"http://127.0.0.1:{int(self.worker_port)}"
+
+    @property
+    def replica_endpoint(self):
+        """(host, port) of the supervised serving replica's request
+        socket (for a Router endpoint list), or None when this worker is
+        not a serving replica."""
+        if self.replica_port is None:
+            return None
+        return ("127.0.0.1", int(self.replica_port))
 
     def export_gauges(self, registry):
         """Register the supervisor's liveness as pull ``gauge_fn``s: a

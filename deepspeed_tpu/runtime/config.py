@@ -640,6 +640,106 @@ def get_serving_config(param_dict):
     )
 
 
+def get_fleet_config(param_dict):
+    """fleet: routing front-door over N serving replicas
+    (inference/serving/router.py, replica.py). Opt-in like the serving
+    block: present enables (unless it sets "enabled": false); absent
+    means no fleet policy is built. Shape-only validation — endpoint
+    health and routability are runtime concerns of the Router."""
+    from deepspeed_tpu.inference.serving.config import FleetConfig
+
+    section = param_dict.get(FLEET, None)
+    params = section or {}
+    enabled = bool(get_scalar_param(params, FLEET_ENABLED, section is not None))
+    replicas = get_scalar_param(params, FLEET_REPLICAS, FLEET_REPLICAS_DEFAULT)
+    if not isinstance(replicas, int) or isinstance(replicas, bool) or replicas < 1:
+        raise ValueError(
+            f"fleet.{FLEET_REPLICAS} must be an int >= 1, got {replicas!r}"
+        )
+    retry_budget = get_scalar_param(
+        params, FLEET_RETRY_BUDGET, FLEET_RETRY_BUDGET_DEFAULT
+    )
+    if not isinstance(retry_budget, int) or isinstance(retry_budget, bool) \
+            or retry_budget < 0:
+        raise ValueError(
+            f"fleet.{FLEET_RETRY_BUDGET} must be an int >= 0 (failure "
+            f"re-routes per request; 0 = fail on first death), "
+            f"got {retry_budget!r}"
+        )
+    numbers = (
+        (FLEET_RETRY_BACKOFF, FLEET_RETRY_BACKOFF_DEFAULT,
+         "base failure-retry backoff"),
+        (FLEET_RETRY_BACKOFF_MAX, FLEET_RETRY_BACKOFF_MAX_DEFAULT,
+         "failure-retry backoff cap"),
+        (FLEET_ATTEMPT_TIMEOUT, FLEET_ATTEMPT_TIMEOUT_DEFAULT,
+         "per-attempt socket deadline (0 = unbounded)"),
+        (FLEET_DRAIN_TIMEOUT, FLEET_DRAIN_TIMEOUT_DEFAULT,
+         "replica drain deadline on SIGTERM"),
+        (FLEET_HEALTH_TTL, FLEET_HEALTH_TTL_DEFAULT,
+         "health probe cache TTL"),
+        (FLEET_SHED_RETRY_AFTER, FLEET_SHED_RETRY_AFTER_DEFAULT,
+         "retry-after hint on shed"),
+    )
+    vals = {}
+    for key, default, what in numbers:
+        v = get_scalar_param(params, key, default)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            raise ValueError(
+                f"fleet.{key} must be a number >= 0 ({what}), got {v!r}"
+            )
+        vals[key] = float(v)
+    affinity = get_scalar_param(
+        params, FLEET_AFFINITY_PREFIX_TOKENS,
+        FLEET_AFFINITY_PREFIX_TOKENS_DEFAULT
+    )
+    if not isinstance(affinity, int) or isinstance(affinity, bool) or affinity < 0:
+        raise ValueError(
+            f"fleet.{FLEET_AFFINITY_PREFIX_TOKENS} must be an int >= 0 "
+            f"(0 disables prefix affinity), got {affinity!r}"
+        )
+    saturation = get_scalar_param(
+        params, FLEET_SATURATION_QUEUE_DEPTH,
+        FLEET_SATURATION_QUEUE_DEPTH_DEFAULT
+    )
+    if not isinstance(saturation, int) or isinstance(saturation, bool) \
+            or saturation < 1:
+        raise ValueError(
+            f"fleet.{FLEET_SATURATION_QUEUE_DEPTH} must be an int >= 1, "
+            f"got {saturation!r}"
+        )
+    inflight = params.get(FLEET_MAX_INFLIGHT_TOKENS,
+                          FLEET_MAX_INFLIGHT_TOKENS_DEFAULT)
+    if isinstance(inflight, dict):
+        for cls, budget in inflight.items():
+            if not isinstance(cls, str) or not isinstance(budget, int) \
+                    or isinstance(budget, bool) or budget < 0:
+                raise ValueError(
+                    f"fleet.{FLEET_MAX_INFLIGHT_TOKENS}[{cls!r}] must map a "
+                    f"request-class name to an int >= 0 token budget "
+                    f"(0 = unbounded), got {budget!r}"
+                )
+    elif not isinstance(inflight, int) or isinstance(inflight, bool) \
+            or inflight < 0:
+        raise ValueError(
+            f"fleet.{FLEET_MAX_INFLIGHT_TOKENS} must be an int >= 0 or a "
+            f"{{class: budget}} dict (0 = unbounded), got {inflight!r}"
+        )
+    return FleetConfig(
+        enabled=enabled,
+        replicas=replicas,
+        retry_budget=retry_budget,
+        retry_backoff_s=vals[FLEET_RETRY_BACKOFF],
+        retry_backoff_max_s=vals[FLEET_RETRY_BACKOFF_MAX],
+        attempt_timeout_s=vals[FLEET_ATTEMPT_TIMEOUT],
+        drain_timeout_s=vals[FLEET_DRAIN_TIMEOUT],
+        health_ttl_s=vals[FLEET_HEALTH_TTL],
+        affinity_prefix_tokens=affinity,
+        saturation_queue_depth=saturation,
+        max_inflight_tokens=inflight,
+        shed_retry_after_s=vals[FLEET_SHED_RETRY_AFTER],
+    )
+
+
 def get_progressive_layer_drop(param_dict):
     pld_dict = param_dict.get(PROGRESSIVE_LAYER_DROP, {})
     enabled = get_scalar_param(pld_dict, PLD_ENABLED, PLD_ENABLED_DEFAULT)
@@ -805,6 +905,7 @@ class DeepSpeedConfig:
         self.checkpoint_config = get_checkpoint_config(param_dict)
         self.resilience_config = get_resilience_config(param_dict)
         self.serving_config = get_serving_config(param_dict)
+        self.fleet_config = get_fleet_config(param_dict)
 
         (
             self.pld_enabled,

@@ -239,6 +239,36 @@ SERVING_KV_POOL_TOKENS = "kv_pool_tokens"
 SERVING_KV_POOL_TOKENS_DEFAULT = None  # None = max_slots * max_seq_len
 
 #############################################
+# Fleet (inference/serving/router.py + replica.py: routing front-door
+# over N supervised ServingEngine replicas). Opt-in like serving: the
+# block being present enables it.
+#############################################
+FLEET = "fleet"
+FLEET_ENABLED = "enabled"
+FLEET_REPLICAS = "replicas"
+FLEET_REPLICAS_DEFAULT = 2
+FLEET_RETRY_BUDGET = "retry_budget"
+FLEET_RETRY_BUDGET_DEFAULT = 2  # failure re-routes; rejections are free
+FLEET_RETRY_BACKOFF = "retry_backoff_s"
+FLEET_RETRY_BACKOFF_DEFAULT = 0.05
+FLEET_RETRY_BACKOFF_MAX = "retry_backoff_max_s"
+FLEET_RETRY_BACKOFF_MAX_DEFAULT = 2.0
+FLEET_ATTEMPT_TIMEOUT = "attempt_timeout_s"
+FLEET_ATTEMPT_TIMEOUT_DEFAULT = 120.0  # 0 = unbounded attempt waits
+FLEET_DRAIN_TIMEOUT = "drain_timeout_s"
+FLEET_DRAIN_TIMEOUT_DEFAULT = 30.0
+FLEET_HEALTH_TTL = "health_ttl_s"
+FLEET_HEALTH_TTL_DEFAULT = 0.25
+FLEET_AFFINITY_PREFIX_TOKENS = "affinity_prefix_tokens"
+FLEET_AFFINITY_PREFIX_TOKENS_DEFAULT = 16  # 0 = pure least-loaded
+FLEET_SATURATION_QUEUE_DEPTH = "saturation_queue_depth"
+FLEET_SATURATION_QUEUE_DEPTH_DEFAULT = 32
+FLEET_MAX_INFLIGHT_TOKENS = "max_inflight_tokens"
+FLEET_MAX_INFLIGHT_TOKENS_DEFAULT = 0  # 0 = unbounded; int or {class: n}
+FLEET_SHED_RETRY_AFTER = "shed_retry_after_s"
+FLEET_SHED_RETRY_AFTER_DEFAULT = 0.5
+
+#############################################
 # Sparse attention
 #############################################
 SPARSE_ATTENTION = "sparse_attention"

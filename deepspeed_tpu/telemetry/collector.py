@@ -103,6 +103,17 @@ class FleetCollector:
             self._locals.append((int(rank), str(role), tracer, registry))
         return self
 
+    def attach_router(self, router, registry, rank=LOCAL_RANK):
+        """Merge a fleet Router's counters into the fleet view: exports
+        its ``Fleet/router/*`` gauges into ``registry`` and attaches that
+        registry as a local ``role="router"`` source, so ``/fleet/metrics``
+        carries routed/retried/shed/drained next to the per-replica
+        serving metrics and the SLO engine can alert on shed rate."""
+        router.export_gauges(registry)
+        with self._lock:
+            self._locals.append((int(rank), "router", None, registry))
+        return self
+
     # -- scraping -------------------------------------------------------
     def _fetch_json(self, url):
         with urlopen(url, timeout=self.timeout_s) as resp:
@@ -144,7 +155,8 @@ class FleetCollector:
             summary["events_merged"] += n
         for rank, role, tracer, registry in locals_:
             try:
-                trace = tracer.to_chrome_trace(drain=drain)
+                trace = (tracer.to_chrome_trace(drain=drain)
+                         if tracer is not None else {"traceEvents": []})
                 reg = registry.as_dict() if registry is not None else {}
             except Exception:
                 continue

@@ -25,9 +25,12 @@ default ``"warn"`` only logs/exposes. ``/alerts`` (attach via
 503 while any rule is firing, per-rule detail either way.
 
 Metric lookup resolves aliases so rules read naturally: ``Serving/<k>``
-also matches the pull-gauge name ``Serving/Snapshot/<k>``, and at the
-fleet level a rule matches its worst-case rollup (``Fleet/<metric>/max``
-for ceilings, ``Fleet/<metric>/min`` for floors).
+also matches the pull-gauge name ``Serving/Snapshot/<k>``, ``Router/<k>``
+matches the fleet router's gauges ``Fleet/router/<k>`` (so a rule like
+``{"metric": "Router/shed_rate", "max": 0.1}`` alerts on overload
+shedding), and at the fleet level a rule matches its worst-case rollup
+(``Fleet/<metric>/max`` for ceilings, ``Fleet/<metric>/min`` for
+floors).
 
 Stdlib-only (see ``telemetry/trace.py``).
 """
@@ -150,6 +153,11 @@ class SloEngine:
         candidates = [rule.metric]
         if rule.metric.startswith("Serving/"):
             candidates.append("Serving/Snapshot/" + rule.metric[len("Serving/"):])
+        # router counters export under Fleet/router/* (router.py
+        # export_gauges); let rules name them the short way, e.g.
+        # "Router/shed_rate" -> Fleet/router/shed_rate
+        if rule.metric.startswith("Router/"):
+            candidates.append("Fleet/router/" + rule.metric[len("Router/"):])
         worst = "max" if rule.max is not None else "min"
         candidates += [f"Fleet/{c}/{worst}" for c in list(candidates)]
         for c in candidates:

@@ -7,8 +7,8 @@ Two modes:
     Validate that bench artifacts are structurally sound (required keys,
     numeric types, ``complete: true``). Defaults to the committed
     baselines (``SERVING_BENCH_CPU.json`` + ``BENCH_r05.json`` +
-    ``LONGDOC_BENCH_CPU.json``). This is the CI step: it needs no jax
-    and takes milliseconds.
+    ``LONGDOC_BENCH_CPU.json`` + ``FLEET_BENCH_CPU.json``). This is the
+    CI step: it needs no jax and takes milliseconds.
 
 ``compare FRESH BASELINE``
     Diff a fresh bench run against a committed baseline under per-key
@@ -19,8 +19,9 @@ Two modes:
 Artifact kinds are auto-detected: a dict with a ``parsed`` key is a
 driver wrapper (``BENCH_r05.json``) and is unwrapped;
 ``speedup_sparse_vs_dense_16k`` marks a long-document serving artifact
-(``LONGDOC_BENCH_CPU.json``); ``tokens_per_sec`` marks a serving
-artifact; ``metric`` marks a train artifact. Contexts
+(``LONGDOC_BENCH_CPU.json``); ``fleet_scaling_2x`` marks a fleet
+scale-out artifact (``FLEET_BENCH_CPU.json``); ``tokens_per_sec``
+marks a serving artifact; ``metric`` marks a train artifact. Contexts
 must match before numbers are compared — platform, model and workload
 knobs for serving; the metric string for train — otherwise the compare
 is skipped with exit 0 (a CPU artifact is not a regression signal for a
@@ -46,7 +47,7 @@ import sys
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DEFAULT_ARTIFACTS = ("SERVING_BENCH_CPU.json", "BENCH_r05.json",
-                     "LONGDOC_BENCH_CPU.json")
+                     "LONGDOC_BENCH_CPU.json", "FLEET_BENCH_CPU.json")
 
 # -- tolerance profiles -------------------------------------------------
 # key -> (direction, rel_tol). direction "higher" means bigger is better:
@@ -90,6 +91,19 @@ LONGDOC_TOLERANCES = {
     "pool_vs_contiguous":            ("lower", 0.10),
 }
 
+# Fleet leg: absolute tokens/sec per fleet size are noisy CPU numbers;
+# the scaling ratios (2 and 4 replicas vs 1, same box, same run) are the
+# gate-worthy signal — per-replica noise largely cancels. kill_recovery_s
+# bounds how long failover leaves re-routed work in limbo.
+FLEET_TOLERANCES = {
+    "fleet_tokens_per_sec_1": ("higher", 0.50),
+    "fleet_tokens_per_sec_2": ("higher", 0.50),
+    "fleet_tokens_per_sec_4": ("higher", 0.50),
+    "fleet_scaling_2x":       ("higher", 0.25),
+    "fleet_scaling_4x":       ("higher", 0.30),
+    "kill_recovery_s":        ("lower", 3.00),
+}
+
 # context keys that must match exactly for numbers to be comparable
 SERVING_CONTEXT = ("platform", "model", "requests", "max_slots",
                    "max_new_tokens", "speculative_k", "kv_cache_dtype",
@@ -99,6 +113,11 @@ LONGDOC_CONTEXT = ("platform", "model", "max_slots", "page_tokens",
                    "kv_pool_tokens", "longdoc_prompt_len",
                    "longdoc_new_tokens", "shared_prefix_len",
                    "requests_mixed")
+# scaling_mode is load-bearing: a "wall" artifact (real cores) and a
+# "cpu" artifact (CPU-time-normalized on a core-starved box) measure
+# different things and must never gate each other.
+FLEET_CONTEXT = ("platform", "model", "requests", "max_new_tokens",
+                 "replica_counts", "scaling_mode")
 
 # -- schema -------------------------------------------------------------
 SERVING_REQUIRED = {
@@ -127,16 +146,31 @@ LONGDOC_REQUIRED = {
     "complete": bool,
 }
 
+FLEET_REQUIRED = {
+    "platform": str, "model": str, "requests": int, "max_new_tokens": int,
+    "scaling_mode": str,
+    "fleet_tokens_per_sec_1": (int, float),
+    "fleet_tokens_per_sec_2": (int, float),
+    "fleet_tokens_per_sec_4": (int, float),
+    "fleet_scaling_2x": (int, float), "fleet_scaling_4x": (int, float),
+    "kill_recovery_s": (int, float),
+    "fleet_oracle_ok": bool, "complete": bool,
+}
+
 # the PR's acceptance floor: sparse must beat dense end-to-end at the
 # 16k bucket by at least this factor for the artifact to be a baseline
 LONGDOC_MIN_SPEEDUP = 5.0
 
+# fleet acceptance floor: 2 replicas must sustain near-linear decode
+# scaling vs 1 (in the artifact's own scaling_mode) to be a baseline
+FLEET_MIN_SCALING_2X = 1.8
+
 TOLERANCES = {"serving": SERVING_TOLERANCES, "train": TRAIN_TOLERANCES,
-              "longdoc": LONGDOC_TOLERANCES}
+              "longdoc": LONGDOC_TOLERANCES, "fleet": FLEET_TOLERANCES}
 CONTEXTS = {"serving": SERVING_CONTEXT, "train": TRAIN_CONTEXT,
-            "longdoc": LONGDOC_CONTEXT}
+            "longdoc": LONGDOC_CONTEXT, "fleet": FLEET_CONTEXT}
 REQUIRED = {"serving": SERVING_REQUIRED, "train": TRAIN_REQUIRED,
-            "longdoc": LONGDOC_REQUIRED}
+            "longdoc": LONGDOC_REQUIRED, "fleet": FLEET_REQUIRED}
 
 
 def load_artifact(path):
@@ -153,14 +187,16 @@ def load_artifact(path):
     # in the artifact — still, keep the most specific marker in front.
     if "speedup_sparse_vs_dense_16k" in doc:
         return "longdoc", doc
+    if "fleet_scaling_2x" in doc:
+        return "fleet", doc
     if "tokens_per_sec" in doc:
         return "serving", doc
     if "metric" in doc:
         return "train", doc
     raise ValueError(
         f"{path}: unrecognized artifact (no 'speedup_sparse_vs_dense_16k', "
-        f"'tokens_per_sec' or 'metric' key; top-level keys: "
-        f"{sorted(doc)[:8]})")
+        f"'fleet_scaling_2x', 'tokens_per_sec' or 'metric' key; top-level "
+        f"keys: {sorted(doc)[:8]})")
 
 
 def check_schema(path):
@@ -221,6 +257,31 @@ def check_schema(path):
                 f"{path}: 'pool_bytes' ({pool}) must be strictly below "
                 f"'contiguous_equiv_bytes' ({contig}) — paging must "
                 f"undercut the MaxSlots x S_max footprint")
+    elif kind == "fleet":
+        if doc.get("complete") is not True:
+            problems.append(f"{path}: 'complete' is not true — a partial "
+                            f"bench run must not be committed as a baseline")
+        if doc.get("fleet_oracle_ok") is not True:
+            problems.append(
+                f"{path}: 'fleet_oracle_ok' is not true — outputs must be "
+                f"bitwise-identical across every fleet size and failover")
+        for key in ("fleet_tokens_per_sec_1", "fleet_tokens_per_sec_2",
+                    "fleet_tokens_per_sec_4"):
+            v = doc.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v <= 0:
+                problems.append(f"{path}: '{key}' must be > 0, got {v}")
+        scaling = doc.get("fleet_scaling_2x")
+        if isinstance(scaling, (int, float)) \
+                and not isinstance(scaling, bool) \
+                and scaling < FLEET_MIN_SCALING_2X:
+            problems.append(
+                f"{path}: 'fleet_scaling_2x' is {scaling}, below the "
+                f"{FLEET_MIN_SCALING_2X}x near-linear acceptance floor")
+        if doc.get("scaling_mode") not in ("wall", "cpu"):
+            problems.append(
+                f"{path}: 'scaling_mode' must be 'wall' or 'cpu', got "
+                f"{doc.get('scaling_mode')!r}")
     else:
         v = doc.get("value")
         if isinstance(v, (int, float)) and not isinstance(v, bool) and v <= 0:
@@ -341,7 +402,8 @@ def main(argv=None):
                         metavar="FILE",
                         help="validate artifact schema(s); defaults to the "
                              "committed SERVING_BENCH_CPU.json + BENCH_r05."
-                             "json + LONGDOC_BENCH_CPU.json")
+                             "json + LONGDOC_BENCH_CPU.json + "
+                             "FLEET_BENCH_CPU.json")
     parser.add_argument("mode", nargs="?", choices=["compare"],
                         help="compare FRESH BASELINE under tolerance bands")
     parser.add_argument("fresh", nargs="?", help="fresh bench JSON")
