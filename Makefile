@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving test-router lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet trace-smoke bench-gate
+.PHONY: test test-fast test-faults test-cluster test-serving test-router lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels trace-smoke bench-gate
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -95,6 +95,13 @@ bench-longdoc:
 bench-fleet:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=fleet python bench.py --child
 
+# Kernel-tier microbench: Pallas (interpret on CPU) vs the composed-XLA
+# fallback for the fused paged decode (fp32 + int8) and banded sparse
+# kernels, parity asserted per sample. Writes KERNEL_BENCH_CPU.json
+# (see docs/kernels.md).
+bench-kernels:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=kernels python bench.py --child
+
 # Benchmark on the real TPU chip (default platform).
 bench:
 	python bench.py
@@ -114,3 +121,6 @@ bench-gate:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=fleet \
 		BENCH_FLEET_OUT=/tmp/bench_gate_fleet.json python bench.py --child
 	python -m tools.bench_gate compare /tmp/bench_gate_fleet.json FLEET_BENCH_CPU.json
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=kernels \
+		BENCH_KERNELS_OUT=/tmp/bench_gate_kernels.json python bench.py --child
+	python -m tools.bench_gate compare /tmp/bench_gate_kernels.json KERNEL_BENCH_CPU.json

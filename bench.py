@@ -136,6 +136,8 @@ def child_main():
         return longdoc_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "fleet":
         return fleet_child_main()
+    if os.environ.get("BENCH_MODEL", "bert") == "kernels":
+        return kernels_child_main()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -618,6 +620,134 @@ def longdoc_child_main():
     return 0
 
 
+def kernels_child_main():
+    """Kernel-tier microbench: per-kernel wall time, Pallas vs the
+    composed-XLA fallback, with the parity oracle asserted in-run.
+
+    Times `decode_attend` (fp32 paged + int8 fused-dequant) and
+    `band_attend` through both impls at one fixed shape each. On CPU
+    the Pallas numbers run in INTERPRET mode — they are a correctness
+    treadmill and a relative-regression tripwire for the fallback path,
+    not kernel perf (the artifact records ``interpret`` so the gate
+    never compares across modes); on a real TPU the same leg times the
+    native kernels. Every timed sample is checked against the other
+    impl (`*_parity_ok`) — a kernel that drifts from its oracle must
+    fail the bench, not ship a number. Writes KERNEL_BENCH[_CPU].json
+    (BENCH_KERNELS_OUT redirects, as the bench gate does). Knobs:
+    BENCH_KERNELS_ITERS (timed iterations per impl, default 10)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu import kernels
+
+    def progress(msg):
+        print(f"# kernels: {msg}", file=sys.stderr, flush=True)
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    interpret = jax.default_backend() != "tpu"
+    iters = int(os.environ.get("BENCH_KERNELS_ITERS", "10"))
+
+    # decode shape: one serving-like decode step (C=1) over a paged pool
+    B, C, nh, pt, hd, mp = 4, 1, 4, 16, 64, 4
+    P = B * mp + 1
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, C, nh, hd), jnp.float32)
+    pk = jnp.asarray(rng.randn(P, nh, pt, hd), jnp.float32)
+    pv = jnp.asarray(rng.randn(P, nh, pt, hd), jnp.float32)
+    tables = jnp.asarray(
+        1 + np.arange(B * mp).reshape(B, mp), jnp.int32)
+    qpos = jnp.asarray(
+        np.full((B, C), mp * pt - 3), jnp.int32)
+    sk = jnp.asarray(np.abs(rng.randn(P, nh)) / 127.0 + 1e-6, jnp.float32)
+    sv = jnp.asarray(np.abs(rng.randn(P, nh)) / 127.0 + 1e-6, jnp.float32)
+    pk8 = jnp.asarray(rng.randint(-127, 128, (P, nh, pt, hd)), jnp.int8)
+    pv8 = jnp.asarray(rng.randint(-127, 128, (P, nh, pt, hd)), jnp.int8)
+
+    # band shape: one window-backend decode step, flattened queries
+    N, W = 8, 3 * pt
+    bq = jnp.asarray(rng.randn(N, nh, hd), jnp.float32)
+    bkw = jnp.asarray(rng.randn(N, nh, W, hd), jnp.float32)
+    bvw = jnp.asarray(rng.randn(N, nh, W, hd), jnp.float32)
+    bks = jnp.asarray(rng.randn(N, nh, pt, hd), jnp.float32)
+    bvs = jnp.asarray(rng.randn(N, nh, pt, hd), jnp.float32)
+    base = jnp.asarray(np.full(N, 2 * pt), jnp.int32)
+    pos = base + jnp.asarray(np.arange(N) + 4, jnp.int32)
+
+    # every operand is a jit ARGUMENT (a nullary closure would let XLA
+    # constant-fold the whole attention into a baked buffer)
+    def decode_case(impl, scaled):
+        def f(q_, k_, v_, t_, p_, *scales):
+            kw = (dict(k_scale=scales[0], v_scale=scales[1])
+                  if scales else {})
+            return kernels.decode_attend(
+                q_, k_, v_, t_, p_, page_tokens=pt, dtype=jnp.float32,
+                impl=impl, interpret=interpret, **kw)
+        args = ((q, pk8, pv8, tables, qpos, sk, sv) if scaled
+                else (q, pk, pv, tables, qpos))
+        return f, args
+
+    def band_case(impl, _scaled):
+        def f(q_, kw_, vw_, ks_, vs_, pos_, base_):
+            return kernels.band_attend(
+                q_, kw_, vw_, ks_, vs_, pos_, base_, dtype=jnp.float32,
+                impl=impl, interpret=interpret)
+        return f, (bq, bkw, bvw, bks, bvs, pos, base)
+
+    cases = {"decode": (decode_case, False),
+             "decode_int8": (decode_case, True),
+             "band": (band_case, False)}
+
+    flat = {}
+    for name, (make, scaled) in cases.items():
+        outs = {}
+        for impl in ("pallas", "xla"):
+            progress(f"{name}/{impl}: warmup + {iters} timed iterations")
+            f, args = make(impl, scaled)
+            run = jax.jit(f)
+            run(*args).block_until_ready()         # compile outside clock
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = run(*args)
+            out.block_until_ready()
+            dt = time.perf_counter() - t0
+            outs[impl] = np.asarray(out)
+            flat[f"{name}_{impl}_us"] = round(dt / iters * 1e6, 1)
+        parity = bool(np.allclose(outs["pallas"], outs["xla"],
+                                  rtol=1e-5, atol=1e-5))
+        flat[f"{name}_parity_ok"] = parity
+        assert parity, f"{name}: pallas diverged from the XLA fallback"
+
+    result = {
+        "platform": platform,
+        "interpret": interpret,
+        "iters": iters,
+        "decode_shape": [B, C, nh, pt, hd, mp],
+        "band_shape": [N, nh, W, pt, hd],
+        **flat,
+        "complete": True,
+    }
+    suffix = "" if platform == "tpu" else f"_{platform.upper()}"
+    out_path = os.environ.get("BENCH_KERNELS_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"KERNEL_BENCH{suffix}.json")
+    with open(out_path, "w") as f:
+        f.write(json.dumps(result, indent=1) + "\n")
+    print(json.dumps({
+        "metric": f"kernel-tier microbench ({platform}"
+                  f"{', interpret' if interpret else ''})",
+        "value": result["decode_pallas_us"],
+        "unit": "us/call fused paged decode",
+        "vs_baseline": None,
+        **{k: result[k] for k in (
+            "decode_xla_us", "decode_int8_pallas_us", "decode_int8_xla_us",
+            "band_pallas_us", "band_xla_us", "decode_parity_ok",
+            "decode_int8_parity_ok", "band_parity_ok")},
+    }))
+    return 0
+
+
 def fleet_child_main():
     """Fleet serving leg: replica scale-out throughput + kill recovery.
 
@@ -1075,6 +1205,10 @@ def main():
         label = "fleet serving scale-out (2 replicas vs 1)"
         seq = os.environ.get("BENCH_FLEET_NEW_TOKENS", "32")
         unit = "x single-replica tokens/sec"
+    elif os.environ.get("BENCH_MODEL", "bert") == "kernels":
+        label = "kernel-tier microbench"
+        seq = os.environ.get("BENCH_KERNELS_ITERS", "10")
+        unit = "us/call fused paged decode"
     else:
         label = "bert-large pretrain samples/sec/chip"
         seq = os.environ.get("BENCH_SEQ", "128")

@@ -36,8 +36,16 @@ from deepspeed_tpu.inference.quantization import (
 # changes); "sparse_xla" is the banded block-sparse composition from
 # ops/sparse_attention (per-query window of SPARSE_BAND+1 pages plus the
 # global anchor page 0 — the layout tests/perf/longseq_bench.py measures
-# at 65x dense for seq 16384).
-ATTENTION_IMPLS = ("dense", "flash", "sparse_xla")
+# at 65x dense for seq 16384). "pallas_decode"/"pallas_sparse" route the
+# flash and banded math through the hand-fused kernel tier
+# (deepspeed_tpu/kernels/): same shapes and masks, Pallas bodies, with
+# the registry picking Pallas vs the composed-XLA fallback at resolve
+# time (the kernel_impl/kernel_interpret statics).
+ATTENTION_IMPLS = ("dense", "flash", "sparse_xla",
+                   "pallas_decode", "pallas_sparse")
+
+# The backends that resolve through the kernel registry.
+KERNEL_ATTENTION_IMPLS = ("pallas_decode", "pallas_sparse")
 
 # Page granularity shared by the sparse window, the flash key blocks,
 # and the serving KV pool's pages (kv_pool.py) — one constant so a
@@ -261,6 +269,85 @@ def _step_window(params, nh, caches, token, pos, pt):
     def layer_body(h, inputs):
         lp, ck_l, cv_l = inputs
         h, ck_l, cv_l = _decode_one_window(lp, h, ck_l, cv_l, pos, nh, pt)
+        return h, (ck_l, cv_l)
+
+    h, caches = jax.lax.scan(layer_body, h, (layer_p,) + tuple(caches))
+    h = _ln(h, tr["ln_f"])
+    logits = h @ logits_table(tr["wte"], h.dtype).T
+    return logits, caches
+
+
+def _decode_one_kernel(layer_p, h, cache_k, cache_v, pos, nh, pt,
+                       kernel_impl, kernel_interpret):
+    """One token through one layer with the fused decode-attention
+    kernel: `_decode_one`'s qkv/write/residual/FFN around the kernel
+    tier's paged online-softmax attention at C=1. Requires the cache
+    length to be a multiple of ``pt``."""
+    from deepspeed_tpu import kernels  # lazy: kernels imports this module
+    q, k, v = _window_qkv(layer_p, h, nh)
+    cache_k = jax.lax.dynamic_update_index_in_dim(cache_k, k, pos, axis=2)
+    cache_v = jax.lax.dynamic_update_index_in_dim(cache_v, v, pos, axis=2)
+    B = h.shape[0]
+    qpos = jnp.broadcast_to(pos, (B, 1)).astype(jnp.int32)
+    ctx = kernels.chunk_attend(q[:, None], cache_k, cache_v, qpos, pt,
+                               h.dtype, impl=kernel_impl or "xla",
+                               interpret=bool(kernel_interpret))[:, 0]
+    return _window_finish(layer_p, h, ctx), cache_k, cache_v
+
+
+def _step_kernel(params, nh, caches, token, pos, pt, kernel_impl,
+                 kernel_interpret):
+    """`_step` with the fused decode-attention kernel per layer."""
+    tr = params["params"]["transformer"]
+    wpe = tr["wpe"]["embedding"]
+    layer_p = _layer_tree(params)
+    h = embed_rows(tr["wte"], token) + wpe[pos]
+
+    def layer_body(h, inputs):
+        lp, ck_l, cv_l = inputs
+        h, ck_l, cv_l = _decode_one_kernel(lp, h, ck_l, cv_l, pos, nh, pt,
+                                           kernel_impl, kernel_interpret)
+        return h, (ck_l, cv_l)
+
+    h, caches = jax.lax.scan(layer_body, h, (layer_p,) + tuple(caches))
+    h = _ln(h, tr["ln_f"])
+    logits = h @ logits_table(tr["wte"], h.dtype).T
+    return logits, caches
+
+
+def _decode_one_window_kernel(layer_p, h, cache_k, cache_v, pos, nh, pt,
+                              kernel_impl, kernel_interpret):
+    """`_decode_one_window` with the band math in the kernel tier: the
+    window slicing stays the same XLA dynamic-slice; the fused band
+    kernel does both score einsums, the mask, and the softmax."""
+    from deepspeed_tpu import kernels  # lazy: kernels imports this module
+    q, k, v = _window_qkv(layer_p, h, nh)
+    cache_k = jax.lax.dynamic_update_index_in_dim(cache_k, k, pos, axis=2)
+    cache_v = jax.lax.dynamic_update_index_in_dim(cache_v, v, pos, axis=2)
+    base = _window_base(pos, pt)
+    kw, vw, ks, vs = jax.vmap(
+        lambda ck, cv: _window_slice_one(ck, cv, base, pt))(cache_k, cache_v)
+    B = h.shape[0]
+    ctx = kernels.band_attend(
+        q, kw, vw, ks, vs, jnp.broadcast_to(pos, (B,)),
+        jnp.broadcast_to(base, (B,)), dtype=h.dtype,
+        impl=kernel_impl or "xla", interpret=bool(kernel_interpret))
+    return _window_finish(layer_p, h, ctx), cache_k, cache_v
+
+
+def _step_window_kernel(params, nh, caches, token, pos, pt, kernel_impl,
+                        kernel_interpret):
+    """`_step_window` with the banded-sparse attention fused in the
+    kernel tier."""
+    tr = params["params"]["transformer"]
+    wpe = tr["wpe"]["embedding"]
+    layer_p = _layer_tree(params)
+    h = embed_rows(tr["wte"], token) + wpe[pos]
+
+    def layer_body(h, inputs):
+        lp, ck_l, cv_l = inputs
+        h, ck_l, cv_l = _decode_one_window_kernel(
+            lp, h, ck_l, cv_l, pos, nh, pt, kernel_impl, kernel_interpret)
         return h, (ck_l, cv_l)
 
     h, caches = jax.lax.scan(layer_body, h, (layer_p,) + tuple(caches))
@@ -510,8 +597,40 @@ def _chunk_layer_flash(layer_p, h, cache_k, cache_v, starts, nh, pt):
         lambda q, ck, cv, qpos: _flash_attend(q, ck, cv, qpos, pt, h.dtype))
 
 
+def _chunk_layer_kernel(layer_p, h, cache_k, cache_v, starts, nh, pt,
+                        kernel_impl, kernel_interpret):
+    """`_chunk_layer` with the fused decode-attention kernel: identical
+    qkv projection and cache writes, attention through the kernel tier's
+    paged online-softmax body (kernels.chunk_attend views the contiguous
+    cache as identity-mapped pages, so this is the same program the
+    serving pool runs). Requires the cache length to be a multiple of
+    ``pt`` (callers allocate so)."""
+    from deepspeed_tpu import kernels  # lazy: kernels imports this module
+    return _chunk_layer_with(
+        layer_p, h, cache_k, cache_v, starts, nh,
+        lambda q, ck, cv, qpos: kernels.chunk_attend(
+            q, ck, cv, qpos, pt, h.dtype,
+            impl=kernel_impl or "xla", interpret=bool(kernel_interpret)))
+
+
+def _chunk_layer_kernel_window(layer_p, h, cache_k, cache_v, starts, nh, pt,
+                               kernel_impl, kernel_interpret):
+    """`_chunk_layer` with the banded block-sparse kernel: the window
+    slicing stays XLA (same canonical per-query window as sparse_xla);
+    the band math runs in the kernel tier. Requires the chunk width to
+    be a multiple of ``pt`` OR the small k+1 verify chunk (kernels
+    .chunk_band_attend handles both)."""
+    from deepspeed_tpu import kernels  # lazy: kernels imports this module
+    return _chunk_layer_with(
+        layer_p, h, cache_k, cache_v, starts, nh,
+        lambda q, ck, cv, qpos: kernels.chunk_band_attend(
+            q, ck, cv, qpos, pt, h.dtype,
+            impl=kernel_impl or "xla", interpret=bool(kernel_interpret)))
+
+
 def _forward_chunk(params, n_heads, caches, ids, starts, attn_impl="dense",
-                   page_tokens=DEFAULT_PAGE_TOKENS):
+                   page_tokens=DEFAULT_PAGE_TOKENS, kernel_impl=None,
+                   kernel_interpret=False):
     """Single-pass causal forward of ``ids`` [B, C] written into
     ``caches`` ([L, B, nh, S_cache, hd]) at per-lane offsets ``starts``
     [B]. Returns (hidden states [B, C, H] BEFORE the final LN, updated
@@ -520,7 +639,9 @@ def _forward_chunk(params, n_heads, caches, ids, starts, attn_impl="dense",
     contents are traced operands, so one compiled program per (B, C,
     S_cache) covers all of them. ``attn_impl``/``page_tokens`` are
     static: they pick the per-layer attention program (dense stays the
-    default and is byte-for-byte the original path)."""
+    default and is byte-for-byte the original path).
+    ``kernel_impl``/``kernel_interpret`` are the registry-resolved
+    statics for the pallas_* backends (None -> the XLA fallback)."""
     tr = params["params"]["transformer"]
     layer_p = _layer_tree(params)
     C = ids.shape[1]
@@ -535,6 +656,14 @@ def _forward_chunk(params, n_heads, caches, ids, starts, attn_impl="dense",
         elif attn_impl == "flash":
             h, ck_l, cv_l = _chunk_layer_flash(lp, h, ck_l, cv_l, starts,
                                                n_heads, page_tokens)
+        elif attn_impl == "pallas_decode":
+            h, ck_l, cv_l = _chunk_layer_kernel(
+                lp, h, ck_l, cv_l, starts, n_heads, page_tokens,
+                kernel_impl, kernel_interpret)
+        elif attn_impl == "pallas_sparse":
+            h, ck_l, cv_l = _chunk_layer_kernel_window(
+                lp, h, ck_l, cv_l, starts, n_heads, page_tokens,
+                kernel_impl, kernel_interpret)
         else:
             h, ck_l, cv_l = _chunk_layer(lp, h, ck_l, cv_l, starts, n_heads)
         return h, (ck_l, cv_l)
@@ -578,7 +707,9 @@ def _ngram_draft(history, pos, k):
                      jnp.full((k,), cur, history.dtype)).astype(jnp.int32)
 
 
-def _speculative_verify(params, n_heads, caches, tokens, drafts, positions):
+def _speculative_verify(params, n_heads, caches, tokens, drafts, positions,
+                        attn_impl="dense", page_tokens=DEFAULT_PAGE_TOKENS,
+                        kernel_impl=None, kernel_interpret=False):
     """Verify ``k`` drafts per lane in ONE batched causal forward.
 
     ``tokens`` [B] are the pending tokens, ``drafts`` [B, k] the
@@ -597,7 +728,11 @@ def _speculative_verify(params, n_heads, caches, tokens, drafts, positions):
     tr = params["params"]["transformer"]
     k = drafts.shape[1]
     ids = jnp.concatenate([tokens[:, None], drafts], axis=1)     # [B, k+1]
-    h, caches = _forward_chunk(params, n_heads, caches, ids, positions)
+    h, caches = _forward_chunk(params, n_heads, caches, ids, positions,
+                               attn_impl=attn_impl,
+                               page_tokens=page_tokens,
+                               kernel_impl=kernel_impl,
+                               kernel_interpret=kernel_interpret)
     h = _ln(h, tr["ln_f"])
     logits = h @ logits_table(tr["wte"], h.dtype).T
     oracle = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [B, k+1]
@@ -607,7 +742,8 @@ def _speculative_verify(params, n_heads, caches, tokens, drafts, positions):
 
 
 def _forward_full(params, ids, true_len, n_layers, n_heads, head_dim, total,
-                  attn_impl="dense", page_tokens=DEFAULT_PAGE_TOKENS):
+                  attn_impl="dense", page_tokens=DEFAULT_PAGE_TOKENS,
+                  kernel_impl=None, kernel_interpret=False):
     """Single-pass full-sequence causal prefill: every K/V for the
     (padded) prompt ``ids`` [B, S] computed in ONE batched forward into a
     fresh ``total``-long cache, with the logits selected at the true last
@@ -627,11 +763,11 @@ def _forward_full(params, ids, true_len, n_layers, n_heads, head_dim, total,
     tr = params["params"]["transformer"]
     dtype = _cache_dtype(params)
     cache_len = total
-    if attn_impl == "sparse_xla":
+    if attn_impl in ("sparse_xla", "pallas_sparse"):
         pt = int(page_tokens)
         cache_len = max(_round_up(total, pt), (SPARSE_BAND + 1) * pt)
         ids = jnp.pad(ids, ((0, 0), (0, _round_up(S, pt) - S)))
-    elif attn_impl == "flash":
+    elif attn_impl in ("flash", "pallas_decode"):
         pt = int(page_tokens)
         cache_len = max(_round_up(total, pt), pt)
     shape = (n_layers, B, n_heads, cache_len, head_dim)
@@ -639,7 +775,9 @@ def _forward_full(params, ids, true_len, n_layers, n_heads, head_dim, total,
 
     h, caches = _forward_chunk(params, n_heads, caches, ids,
                                jnp.zeros((B,), jnp.int32),
-                               attn_impl=attn_impl, page_tokens=page_tokens)
+                               attn_impl=attn_impl, page_tokens=page_tokens,
+                               kernel_impl=kernel_impl,
+                               kernel_interpret=kernel_interpret)
     idx = jnp.clip(jnp.broadcast_to(
         jnp.asarray(true_len, jnp.int32) - 1, (B,)), 0, S - 1)
     h_last = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
@@ -650,16 +788,19 @@ def _forward_full(params, ids, true_len, n_layers, n_heads, head_dim, total,
 
 @partial(jax.jit, static_argnames=("n_layers", "n_heads", "head_dim",
                                    "max_new_tokens", "greedy", "filtered",
-                                   "attn_impl", "page_tokens"))
+                                   "attn_impl", "page_tokens",
+                                   "kernel_impl", "kernel_interpret"))
 def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
                   max_new_tokens, greedy, filtered, temperature, top_k,
                   top_p, rng, attn_impl="dense",
-                  page_tokens=DEFAULT_PAGE_TOKENS):
+                  page_tokens=DEFAULT_PAGE_TOKENS, kernel_impl=None,
+                  kernel_interpret=False):
     B, S = prompt_ids.shape
     total = S + max_new_tokens
     caches, last_logits = _forward_full(
         params, prompt_ids, S, n_layers, n_heads, head_dim, total,
-        attn_impl=attn_impl, page_tokens=page_tokens)
+        attn_impl=attn_impl, page_tokens=page_tokens,
+        kernel_impl=kernel_impl, kernel_interpret=kernel_interpret)
 
     def decode_body(carry, pos):
         caches, logits, rng = carry
@@ -680,6 +821,14 @@ def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
         if attn_impl == "sparse_xla":
             logits, caches = _step_window(params, n_heads, caches, token,
                                           pos, page_tokens)
+        elif attn_impl == "pallas_sparse":
+            logits, caches = _step_window_kernel(
+                params, n_heads, caches, token, pos, page_tokens,
+                kernel_impl, kernel_interpret)
+        elif attn_impl == "pallas_decode":
+            logits, caches = _step_kernel(
+                params, n_heads, caches, token, pos, page_tokens,
+                kernel_impl, kernel_interpret)
         else:
             # flash decode IS dense decode: a single query against the
             # whole cache has no blockwise savings, and the dense step
@@ -694,7 +843,8 @@ def _generate_jit(params, prompt_ids, n_layers, n_heads, head_dim,
 
 def generate(params, config, prompt_ids, max_new_tokens, temperature=0.0,
              rng=None, top_k=0, top_p=1.0, attn_impl="dense",
-             kv_page_tokens=None):
+             kv_page_tokens=None, attention_kernel=None,
+             kernel_interpret=None):
     """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, S].
 
     ``temperature=0`` -> greedy argmax; otherwise categorical sampling
@@ -704,7 +854,14 @@ def generate(params, config, prompt_ids, max_new_tokens, temperature=0.0,
     traced (sweeps share a program); crossing the filters-disabled /
     enabled boundary is one extra compile (static, keeps plain sampling
     off the argsort path). Returns [B, max_new_tokens]. One compiled
-    program per (config, shapes, greedy-vs-sampling, filtering on/off)."""
+    program per (config, shapes, greedy-vs-sampling, filtering on/off).
+
+    For the kernel-tier backends (``pallas_decode``/``pallas_sparse``)
+    ``attention_kernel`` forces "pallas"/"xla" (None = the registry's
+    probe result) and ``kernel_interpret`` forces interpret mode (None =
+    auto: interpret everywhere but real TPU); both resolve through
+    `kernels.get_registry()` and become jit statics — a failed probe
+    degrades to the XLA fallback, never a crash."""
     if temperature < 0.0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature != 0.0 and rng is None:
@@ -738,6 +895,17 @@ def generate(params, config, prompt_ids, max_new_tokens, temperature=0.0,
             f"prompt ({prompt_ids.shape[1]}) + max_new_tokens "
             f"({max_new_tokens}) = {total} exceeds "
             f"max_position_embeddings={config.max_position_embeddings}")
+    k_impl, k_interp = None, False
+    if attn_impl in KERNEL_ATTENTION_IMPLS:
+        from deepspeed_tpu import kernels  # lazy: kernels imports us
+        k_impl, k_interp = kernels.resolve(attn_impl,
+                                           requested=attention_kernel,
+                                           interpret=kernel_interpret)
+        kernels.record_call(kernels.kernel_for_backend(attn_impl), k_impl)
+    elif attention_kernel is not None:
+        raise ValueError(
+            f"attention_kernel applies only to {KERNEL_ATTENTION_IMPLS}, "
+            f"not attn_impl={attn_impl!r}")
     return _generate_jit(
         params, prompt_ids, config.num_hidden_layers,
         config.num_attention_heads,
@@ -748,7 +916,8 @@ def generate(params, config, prompt_ids, max_new_tokens, temperature=0.0,
         jnp.asarray(int(top_k), jnp.int32),
         jnp.asarray(float(top_p), jnp.float32), rng,
         attn_impl=attn_impl,
-        page_tokens=int(kv_page_tokens or DEFAULT_PAGE_TOKENS))
+        page_tokens=int(kv_page_tokens or DEFAULT_PAGE_TOKENS),
+        kernel_impl=k_impl, kernel_interpret=bool(k_interp))
 
 
 def greedy_generate(params, config, prompt_ids, max_new_tokens):

@@ -64,6 +64,17 @@ class ServingConfig:
     # the 16k bucket goes sparse. Validated in engine.py against the
     # bucket ladder.
     attention_impl: object = None
+    # Kernel-tier implementation for the "pallas_decode"/"pallas_sparse"
+    # attention backends: None (the registry's execution-probe result —
+    # Pallas where it runs, the composed-XLA fallback otherwise),
+    # "pallas" (prefer the fused kernels; still degrades with a
+    # telemetry instant if the probe failed), or "xla" (force the
+    # fallback — the parity-oracle side of every kernel test).
+    attention_kernel: str = None
+    # Pallas interpret mode: None = auto (interpret everywhere but a
+    # real TPU backend, so CPU CI executes the same kernel bodies
+    # eagerly), True/False to force. Static in every jitted program.
+    kernel_interpret: object = None
     # Tokens per KV page. None = 128 (clamped/adjusted to divide
     # max_seq_len — see resolve_page_tokens). Smaller pages = finer
     # allocation granularity + smaller sparse windows.

@@ -84,9 +84,28 @@ dense decode program — its lanes are "full-gather class". sparse_xla
 lanes decode through a windowed program that touches only
 O(page_tokens) KV per token (window + anchor pages) — the long-context
 speedup — and hold the bitwise oracle against sparse ``generate()``.
-Requests are grouped at admission by (bucket, backend); the two lane
-classes run as (at most) two jitted calls per step sharing the
-token/position/pool operands, still with ONE host read per step.
+Requests are grouped at admission by (bucket, backend); the lane
+classes run as (at most) one jitted call per armed class per step
+sharing the token/position/pool operands, still with ONE host read per
+step.
+
+Kernel-tier backends (``pallas_decode`` / ``pallas_sparse``): the same
+dispatch seam routed through ``deepspeed_tpu/kernels`` — hand-fused
+Pallas attention resolved ONCE at engine construction through the
+op_builder-style ``KernelRegistry`` (``serving.attention_kernel`` can
+force "pallas"/"xla"; None takes the probe result, degrading to the
+composed-XLA fallback with an edge-triggered ``jax/kernel_fallback``
+instant instead of crashing). ``pallas_decode`` lanes decode through
+``_decode_step_kernel_jit``: the fused paged kernel consumes the pool's
+STORAGE-dtype pages directly through the lane page tables (int8 scales
+fused into the matmul — no dequantized gather copy), so the paged
+``pool[tables]`` reassembly disappears into the kernel's DMA schedule.
+``pallas_sparse`` lanes run the windowed program with the band math
+swapped for the fused band kernel. The resolved (impl, interpret) pair
+is threaded into every jitted program as STATIC arguments — selection
+is part of the jit cache key, and each backend holds the same
+continuous-vs-``generate()`` oracle as its XLA twin (bitwise for
+fp32/bf16-compute parity classes, threshold for int8).
 """
 
 import threading
@@ -120,7 +139,7 @@ from deepspeed_tpu.inference.generation import (
     resolve_page_tokens,
 )
 from deepspeed_tpu.profiling.sentinels import CompileSentinel, transfer_free
-from deepspeed_tpu import telemetry
+from deepspeed_tpu import kernels, telemetry
 from deepspeed_tpu.inference.quantization import (
     dequantize_kv,
     dequantize_kv_np,
@@ -319,6 +338,45 @@ def _prefill_batch_window_jit(params, init_k, init_v, padded_ids, starts,
     return k, v, _prefill_tail(params, h, starts, true_lens)
 
 
+@partial(jax.jit, static_argnames=("n_heads", "page_tokens", "kernel_impl",
+                                   "kernel_interpret"),
+         donate_argnums=(1, 2))
+def _prefill_batch_kernel_jit(params, init_k, init_v, padded_ids, starts,
+                              true_lens, *, n_heads, page_tokens,
+                              kernel_impl, kernel_interpret):
+    """``_prefill_batch_jit`` through the fused decode-attention kernel
+    (``pallas_decode`` lanes): the chunk attends via ``chunk_attend`` —
+    the contiguous-cache adapter over the SAME paged kernel the decode
+    step runs — so prefill and decode share one math path and the
+    per-backend oracle holds bitwise. ``kernel_impl``/``kernel_interpret``
+    are the registry's resolved statics (part of the cache key: a
+    selection change can never serve a stale program)."""
+    h, (k, v) = _forward_chunk(params, n_heads, (init_k, init_v),
+                               padded_ids, starts, attn_impl="pallas_decode",
+                               page_tokens=page_tokens,
+                               kernel_impl=kernel_impl,
+                               kernel_interpret=kernel_interpret)
+    return k, v, _prefill_tail(params, h, starts, true_lens)
+
+
+@partial(jax.jit, static_argnames=("n_heads", "page_tokens", "kernel_impl",
+                                   "kernel_interpret"),
+         donate_argnums=(1, 2))
+def _prefill_batch_kernel_window_jit(params, init_k, init_v, padded_ids,
+                                     starts, true_lens, *, n_heads,
+                                     page_tokens, kernel_impl,
+                                     kernel_interpret):
+    """``_prefill_batch_window_jit`` with the band math fused into the
+    Pallas band kernel (``pallas_sparse`` lanes): same canonical
+    window + anchor key set, same page-multiple chunk-width contract."""
+    h, (k, v) = _forward_chunk(params, n_heads, (init_k, init_v),
+                               padded_ids, starts, attn_impl="pallas_sparse",
+                               page_tokens=page_tokens,
+                               kernel_impl=kernel_impl,
+                               kernel_interpret=kernel_interpret)
+    return k, v, _prefill_tail(params, h, starts, true_lens)
+
+
 @partial(jax.jit, static_argnames=("n_heads",), donate_argnums=(1, 2, 4, 5))
 def _decode_step_jit(params, pool_k, pool_v, page_tables, tokens, positions,
                      active, *, n_heads):
@@ -410,11 +468,13 @@ def _decode_step_quant_jit(params, pool_k, pool_v, k_scale, v_scale,
     return tokens, positions, pool_k, pool_v
 
 
-@partial(jax.jit, static_argnames=("n_heads", "page_tokens", "qmode"),
+@partial(jax.jit, static_argnames=("n_heads", "page_tokens", "qmode",
+                                   "kernel_impl", "kernel_interpret"),
          donate_argnums=(1, 2, 6, 7))
 def _decode_step_window_jit(params, pool_k, pool_v, k_scale, v_scale,
                             page_tables, tokens, positions, active, *,
-                            n_heads, page_tokens, qmode):
+                            n_heads, page_tokens, qmode, kernel_impl=None,
+                            kernel_interpret=False):
     """Banded block-sparse decode over the paged pool. Unlike the dense
     step, it never reassembles whole lanes: each lane touches only its
     canonical window pages (SPARSE_BAND+1 pages ending at the query)
@@ -426,7 +486,12 @@ def _decode_step_window_jit(params, pool_k, pool_v, k_scale, v_scale,
     matching ``_decode_one_window``) — the per-lane key set is identical
     by construction, so fp32 storage keeps the bitwise oracle. Window
     lanes use their own ``active`` mask; the pool and the token/position
-    vectors are threaded through both class programs each step."""
+    vectors are threaded through both class programs each step.
+
+    ``kernel_impl`` (static, ``pallas_sparse`` lanes) swaps the band
+    MATH for the fused Pallas band kernel (``kernels.band_attend``) —
+    the window/anchor gather stays on the XLA side either way, so the
+    per-lane key set (hence the oracle) is backend-identical."""
     dtype = _cache_dtype(params)
     pt = page_tokens
     B, mp = page_tables.shape
@@ -471,9 +536,85 @@ def _decode_step_window_jit(params, pool_k, pool_v, k_scale, v_scale,
 
         k_win, k_sink = stripe(pk_l, sk_l)
         v_win, v_sink = stripe(pv_l, sv_l)
-        ctx = jax.vmap(_attend_window_one,
-                       in_axes=(0, 0, 0, 0, 0, 0, 0, None))(
-            q, k_win, v_win, k_sink, v_sink, positions, base, dtype)
+        if kernel_impl is not None:
+            ctx = kernels.band_attend(
+                q, k_win, v_win, k_sink, v_sink, positions, base,
+                dtype=dtype, impl=kernel_impl, interpret=kernel_interpret)
+        else:
+            ctx = jax.vmap(_attend_window_one,
+                           in_axes=(0, 0, 0, 0, 0, 0, 0, None))(
+                q, k_win, v_win, k_sink, v_sink, positions, base, dtype)
+        h = _window_finish(lp, h, ctx)
+        return h, (pk_l, pv_l)
+
+    h, (pool_k, pool_v) = jax.lax.scan(
+        layer_body, h, (layer_p, pool_k, pool_v, k_scale, v_scale))
+    h = _ln(h, tr["ln_f"])
+    logits = h @ logits_table(tr["wte"], h.dtype).T
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    tokens = jnp.where(active, nxt, tokens)
+    positions = jnp.where(active, positions + 1, positions)
+    return tokens, positions, pool_k, pool_v
+
+
+@partial(jax.jit, static_argnames=("n_heads", "page_tokens", "qmode",
+                                   "kernel_impl", "kernel_interpret"),
+         donate_argnums=(1, 2, 6, 7))
+def _decode_step_kernel_jit(params, pool_k, pool_v, k_scale, v_scale,
+                            page_tables, tokens, positions, active, *,
+                            n_heads, page_tokens, qmode, kernel_impl,
+                            kernel_interpret):
+    """Fused-kernel decode for ``pallas_decode`` lanes. Unlike the dense
+    step it never reassembles contiguous stripes on the XLA side: each
+    layer writes the lane's fresh KV row into its page, then hands the
+    POOL ITSELF (storage dtype — int8 pages included) plus the lane page
+    tables to ``kernels.decode_attend``, whose scalar-prefetch index map
+    performs the paged gather inside the kernel's DMA schedule. int8
+    pools pass per-page scales (the lane's fixed install scale scattered
+    to its pages) so dequantization fuses into the QK/PV matmuls —
+    no dequantized pool copy ever exists. The online-softmax recurrence
+    is bitwise invariant to trailing fully-masked pages, so fp32 pools
+    keep the bitwise continuous-vs-``generate()`` oracle even though
+    ``generate()`` runs a shorter identity-table cache."""
+    dtype = _cache_dtype(params)
+    pt = page_tokens
+    B, mp = page_tables.shape
+    P = pool_k.shape[1]
+    tr = params["params"]["transformer"]
+    layer_p = _layer_tree(params)
+
+    h = embed_rows(tr["wte"], tokens) + tr["wpe"]["embedding"][positions]
+    dp = _row_pages(page_tables, positions, active, pt)
+    off = positions % pt
+    qpos = positions[:, None]
+
+    def page_scales(sl):
+        # per-(slot, head) install scales -> per-physical-page scales the
+        # kernel gathers alongside each page block. Lanes never share
+        # data pages; the null page takes whatever lane scatters last,
+        # which only ever scales masked (exact-zero-probability) keys.
+        s = jnp.broadcast_to(sl.reshape(B, 1, n_heads), (B, mp, n_heads))
+        return jnp.zeros((P, n_heads), jnp.float32).at[page_tables].set(s)
+
+    def layer_body(h, inputs):
+        lp, pk_l, pv_l, sk_l, sv_l = inputs
+        q, kk, vv = _window_qkv(lp, h, n_heads)        # each [B, nh, hd]
+        if qmode == "int8":
+            krow = requantize_kv(kk[:, :, None, :], sk_l)[:, :, 0]
+            vrow = requantize_kv(vv[:, :, None, :], sv_l)[:, :, 0]
+            ksp, vsp = page_scales(sk_l), page_scales(sv_l)
+        elif qmode == "bf16":
+            krow, vrow = kk.astype(jnp.bfloat16), vv.astype(jnp.bfloat16)
+            ksp = vsp = None
+        else:
+            krow, vrow = kk, vv
+            ksp = vsp = None
+        pk_l = pk_l.at[dp, :, off].set(krow)
+        pv_l = pv_l.at[dp, :, off].set(vrow)
+        ctx = kernels.decode_attend(
+            q[:, None], pk_l, pv_l, page_tables, qpos, page_tokens=pt,
+            dtype=dtype, impl=kernel_impl, interpret=kernel_interpret,
+            k_scale=ksp, v_scale=vsp)[:, 0]
         h = _window_finish(lp, h, ctx)
         return h, (pk_l, pv_l)
 
@@ -549,10 +690,15 @@ def _speculative_verify_window(params, n_heads, caches, tokens, drafts,
 
 
 def _spec_core(params, n_heads, caches, history, tokens, positions, active,
-               draft_noise, k, window_pt=None):
+               draft_noise, k, window_pt=None, kernel_backend=None,
+               kernel_impl=None, kernel_interpret=False):
     """Shared body of the speculative step programs: draft -> (optional
     noise) -> one-forward verify -> advance. Operates on COMPUTE-dtype
-    caches; the quantized wrapper handles storage conversion."""
+    caches; the quantized wrapper handles storage conversion.
+    ``kernel_backend`` (static) routes the k+1-wide verify forward
+    through the kernel tier ("pallas_decode"/"pallas_sparse" with
+    ``window_pt`` as its page size) instead of the dense/window XLA
+    verifies."""
     S_max = history.shape[1]
     V = vocab_size(params["params"]["transformer"]["wte"])
     drafts = jax.vmap(partial(_ngram_draft, k=k))(history, positions)
@@ -561,7 +707,12 @@ def _spec_core(params, n_heads, caches, history, tokens, positions, active,
     # nonzero values without changing shapes, so scrambling never
     # recompiles
     drafts = (drafts + draft_noise) % V
-    if window_pt is None:
+    if kernel_backend is not None:
+        oracle, accepted, caches = _speculative_verify(
+            params, n_heads, caches, tokens, drafts, positions,
+            attn_impl=kernel_backend, page_tokens=window_pt,
+            kernel_impl=kernel_impl, kernel_interpret=kernel_interpret)
+    elif window_pt is None:
         oracle, accepted, caches = _speculative_verify(
             params, n_heads, caches, tokens, drafts, positions)
     else:
@@ -693,6 +844,52 @@ def _spec_step_window_jit(params, pool_k, pool_v, k_scale, v_scale,
     return tokens, positions, pool_k, pool_v, history, oracle, accepted
 
 
+@partial(jax.jit, static_argnames=("n_heads", "k", "page_tokens", "qmode",
+                                   "attn_backend", "kernel_impl",
+                                   "kernel_interpret"),
+         donate_argnums=(1, 2, 6, 7, 8))
+def _spec_step_kernel_jit(params, pool_k, pool_v, k_scale, v_scale,
+                          page_tables, history, tokens, positions, active,
+                          draft_noise, *, n_heads, k, page_tokens, qmode,
+                          attn_backend, kernel_impl, kernel_interpret):
+    """Speculative step for kernel-tier lanes: same draft/accept core as
+    ``_spec_step_window_jit``, with the k+1-wide verify forward routed
+    through the resolved kernel backend (``attn_backend`` is the static
+    ``pallas_decode``/``pallas_sparse`` name; the verify gathers full
+    lane stripes like every spec step — speculation trades gather
+    traffic for acceptance throughput) and the k+1 written rows
+    scattered back by page index. ``qmode`` is static; scale operands
+    are None unless int8."""
+    dtype = _cache_dtype(params)
+    pt = pool_k.shape[3]
+    lk = _gather_lanes(pool_k, page_tables)
+    lv = _gather_lanes(pool_v, page_tables)
+    if qmode == "int8":
+        kf = dequantize_kv(lk, k_scale, dtype)
+        vf = dequantize_kv(lv, v_scale, dtype)
+    elif qmode == "bf16":
+        kf, vf = lk.astype(dtype), lv.astype(dtype)
+    else:
+        kf, vf = lk, lv
+    written = positions[:, None] + jnp.arange(k + 1)[None, :]
+    tokens, positions, (kf, vf), history, oracle, accepted = _spec_core(
+        params, n_heads, (kf, vf), history, tokens, positions, active,
+        draft_noise, k, window_pt=page_tokens, kernel_backend=attn_backend,
+        kernel_impl=kernel_impl, kernel_interpret=kernel_interpret)
+    if qmode == "int8":
+        rows_k = _lane_rows(requantize_kv(kf, k_scale), written)
+        rows_v = _lane_rows(requantize_kv(vf, v_scale), written)
+    elif qmode == "bf16":
+        rows_k = _lane_rows(kf, written).astype(jnp.bfloat16)
+        rows_v = _lane_rows(vf, written).astype(jnp.bfloat16)
+    else:
+        rows_k = _lane_rows(kf, written)
+        rows_v = _lane_rows(vf, written)
+    pool_k = _scatter_rows(pool_k, page_tables, rows_k, written, active, pt)
+    pool_v = _scatter_rows(pool_v, page_tables, rows_v, written, active, pt)
+    return tokens, positions, pool_k, pool_v, history, oracle, accepted
+
+
 class _ChunkedPrefill:
     """In-flight chunked prefill: the request, its private cache pair
     (carried across engine steps between chunk calls), how far it has
@@ -783,14 +980,40 @@ class ServingEngine:
         impls.add(self._impl_default)
         self._any_window = "sparse_xla" in impls
         self._any_flash = "flash" in impls
+        self._any_kfull = "pallas_decode" in impls
+        self._any_kwin = "pallas_sparse" in impls
         page_tokens = resolve_page_tokens(
             cfg.kv_page_tokens or DEFAULT_PAGE_TOKENS, self.max_seq_len)
-        if self._any_window and self.max_seq_len < (SPARSE_BAND + 1) * page_tokens:
+        if ((self._any_window or self._any_kwin)
+                and self.max_seq_len < (SPARSE_BAND + 1) * page_tokens):
             raise ValueError(
-                f"serving.attention_impl='sparse_xla' needs at least "
-                f"{SPARSE_BAND + 1} pages per lane: max_seq_len="
+                f"serving.attention_impl='sparse_xla'/'pallas_sparse' needs "
+                f"at least {SPARSE_BAND + 1} pages per lane: max_seq_len="
                 f"{self.max_seq_len} < {(SPARSE_BAND + 1) * page_tokens} "
                 f"(kv_page_tokens={page_tokens})")
+        # kernel-tier backends: resolve the (impl, interpret) statics ONCE
+        # here, through the registry's availability probe — a failed probe
+        # degrades the whole engine to the XLA fallback math (same oracle)
+        # instead of crashing construction or, worse, the serving loop.
+        kernel_backends = sorted(impls & set(kernels.KERNEL_BACKENDS))
+        if cfg.attention_kernel is not None and not kernel_backends:
+            raise ValueError(
+                f"serving.attention_kernel={cfg.attention_kernel!r} applies "
+                f"only when a kernel-tier attention_impl "
+                f"({tuple(sorted(kernels.KERNEL_BACKENDS))}) is armed")
+        if (cfg.kernel_interpret is not None
+                and not isinstance(cfg.kernel_interpret, bool)):
+            raise ValueError(
+                f"serving.kernel_interpret must be a bool or None "
+                f"(None = auto: interpret off-TPU), "
+                f"got {cfg.kernel_interpret!r}")
+        self._kernel_impl = {}
+        self._kernel_interpret = {}
+        for be in kernel_backends:
+            ki, kint = kernels.resolve(be, requested=cfg.attention_kernel,
+                                       interpret=cfg.kernel_interpret)
+            self._kernel_impl[be] = ki
+            self._kernel_interpret[be] = kint
 
         dtype = _cache_dtype(params)
         self.pool = KVCachePool(self.n_layers, cfg.max_slots, self.n_heads,
@@ -831,6 +1054,10 @@ class ServingEngine:
         # shared token/position/pool operands through both leaves every
         # lane with exactly its own class's result.
         self._lane_impl_window = np.zeros(cfg.max_slots, bool)
+        # which active lanes route through the kernel tier: pallas_decode
+        # lanes are (kernel & ~window), pallas_sparse (kernel & window) —
+        # four lane classes total, each masked by its own class vector
+        self._lane_impl_kernel = np.zeros(cfg.max_slots, bool)
         # device-resident decode operands: uploaded ONLY on lane churn
         # (_lane_dirty), advanced in-jit otherwise — steady-state decode
         # performs exactly one explicit transfer per step (the EOS read)
@@ -838,6 +1065,8 @@ class ServingEngine:
         self._dev_positions = None
         self._dev_active = None
         self._dev_active_win = None
+        self._dev_active_kfull = None
+        self._dev_active_kwin = None
         self._dev_page_tables = None
         self._lane_dirty = True
         # speculative state: per-lane token-by-position history feeding
@@ -878,6 +1107,30 @@ class ServingEngine:
                 CompileSentinel(_prefill_batch_flash_jit, budget,
                                 name="serving flash prefill")
                 if self._any_flash else None)
+            # kernel-class decode pins: pallas_decode lanes always run a
+            # kernel-tier program; pallas_sparse lanes run the kernel spec
+            # step under speculation but the (kernel-static) window
+            # program otherwise, so non-spec kwin pins that instead
+            self.decode_kernel_sentinel = (
+                CompileSentinel(
+                    _spec_step_kernel_jit if self._spec_k > 0
+                    else _decode_step_kernel_jit,
+                    budget, name="serving kernel decode step")
+                if (self._any_kfull
+                    or (self._any_kwin and self._spec_k > 0)) else None)
+            if (self._any_kwin and self._spec_k == 0
+                    and self.decode_window_sentinel is None):
+                self.decode_window_sentinel = CompileSentinel(
+                    _decode_step_window_jit, budget,
+                    name="serving window decode step")
+            self.prefill_kernel_sentinel = (
+                CompileSentinel(_prefill_batch_kernel_jit, budget,
+                                name="serving kernel prefill")
+                if self._any_kfull else None)
+            self.prefill_kernel_window_sentinel = (
+                CompileSentinel(_prefill_batch_kernel_window_jit, budget,
+                                name="serving kernel window prefill")
+                if self._any_kwin else None)
             self._transfer_guard = bool(sentinel_config.transfer_guard)
         else:
             self.decode_sentinel = None
@@ -885,6 +1138,9 @@ class ServingEngine:
             self.decode_window_sentinel = None
             self.prefill_window_sentinel = None
             self.prefill_flash_sentinel = None
+            self.decode_kernel_sentinel = None
+            self.prefill_kernel_sentinel = None
+            self.prefill_kernel_window_sentinel = None
             self._transfer_guard = False
         # batched prefill always runs at the pool width: the batch dim is
         # STATIC, so any admission-group size shares one program per bucket
@@ -910,6 +1166,10 @@ class ServingEngine:
         if telemetry_config is not None and telemetry_config.enabled:
             self._trace_file = telemetry_config.trace_file
             self.metrics.export_to(telemetry.get_registry())
+            if self._kernel_impl:
+                # per-kernel selected-backend gauges next to the
+                # Kernels/<name>/calls counters at /metrics
+                kernels.get_registry().export_gauges(telemetry.get_registry())
             # explicit http_port wins; a supervised worker with a null
             # port inherits DSTPU_TELEMETRY_PORT so the fleet collector
             # can scrape it without per-worker config edits
@@ -929,6 +1189,7 @@ class ServingEngine:
         srv.add_snapshot_provider("serving", self.metrics.snapshot)
         srv.add_snapshot_provider("kv_pool", self.occupancy)
         srv.add_snapshot_provider("prefix_cache", self.prefix_stats)
+        srv.add_snapshot_provider("kernels", kernels.registry_snapshot)
         srv.add_health_provider("serving_loop", self._loop_health)
         return srv.start()
 
@@ -1058,36 +1319,56 @@ class ServingEngine:
             guard = transfer_free() if self._transfer_guard else nullcontext()
             # host-side np masks: np.bool_ drives the dispatch branches
             # directly (a bool() cast here reads as a device sync to JL002)
-            full_any = np.any(self._lane_active & ~self._lane_impl_window)
-            win_any = np.any(self._lane_active & self._lane_impl_window)
+            lw, lk = self._lane_impl_window, self._lane_impl_kernel
+            full_mask = self._lane_active & ~lw & ~lk
+            win_mask = self._lane_active & lw & ~lk
+            kfull_mask = self._lane_active & ~lw & lk
+            kwin_mask = self._lane_active & lw & lk
+            full_any = np.any(full_mask)
+            win_any = np.any(win_mask)
+            kfull_any = np.any(kfull_mask)
+            kwin_any = np.any(kwin_mask)
             if self._spec_k > 0:
                 self._maybe_update_noise()
                 with guard:
-                    got = []
+                    got = []           # (class mask, oracle, accepted)
                     if full_any:
                         (self._dev_tokens, self._dev_positions, self.pool.k,
                          self.pool.v, self._dev_history, oracle_dev,
                          accepted_dev) = self._call_spec_step()
-                        got.append((oracle_dev, accepted_dev))
+                        got.append((full_mask, oracle_dev, accepted_dev))
                     if win_any:
                         (self._dev_tokens, self._dev_positions, self.pool.k,
                          self.pool.v, self._dev_history, oracle_dev,
                          accepted_dev) = self._call_spec_step_window()
-                        got.append((oracle_dev, accepted_dev))
-                if self.decode_sentinel is not None:
-                    self.decode_sentinel.check()
-                if self.decode_window_sentinel is not None:
-                    self.decode_window_sentinel.check()
+                        got.append((win_mask, oracle_dev, accepted_dev))
+                    if kfull_any:
+                        (self._dev_tokens, self._dev_positions, self.pool.k,
+                         self.pool.v, self._dev_history, oracle_dev,
+                         accepted_dev) = self._call_spec_step_kernel(
+                            "pallas_decode")
+                        got.append((kfull_mask, oracle_dev, accepted_dev))
+                    if kwin_any:
+                        (self._dev_tokens, self._dev_positions, self.pool.k,
+                         self.pool.v, self._dev_history, oracle_dev,
+                         accepted_dev) = self._call_spec_step_kernel(
+                            "pallas_sparse")
+                        got.append((kwin_mask, oracle_dev, accepted_dev))
+                self._check_decode_sentinels()
                 # the step's single deliberate sync: the emit loop needs
                 # the oracle tokens and per-lane acceptance counts (one
-                # tuple read even when both class programs ran)
-                host = jax.device_get(tuple(got))  # jaxlint: disable=JL002(one explicit host read per step)
-                if full_any and win_any:
-                    wm = self._lane_impl_window
-                    oracle = np.where(wm[:, None], host[1][0], host[0][0])
-                    accepted = np.where(wm, host[1][1], host[0][1])
-                else:
-                    oracle, accepted = host[0]
+                # tuple read even when several class programs ran)
+                host = jax.device_get(tuple((o, a) for _, o, a in got))  # jaxlint: disable=JL002(one explicit host read per step)
+                oracle, accepted = host[0]
+                if len(got) > 1:
+                    # overlay each later class's lanes onto the first's
+                    # result (every active lane is in exactly one class);
+                    # device_get already landed host numpy — no copies here
+                    oracle = oracle.copy()
+                    accepted = accepted.copy()
+                    for (mask, _, _), (o, a) in zip(got[1:], host[1:]):
+                        oracle[mask] = o[mask]
+                        accepted[mask] = a[mask]
                 step_s = time.monotonic() - t0
                 oracle = oracle.tolist()        # host numpy -> python ints
                 accepted = accepted.tolist()
@@ -1156,10 +1437,39 @@ class ServingEngine:
                             n_heads=self.n_heads,
                             page_tokens=self.pool.page_tokens,
                             qmode=self._qmode)
-                if self.decode_sentinel is not None:
-                    self.decode_sentinel.check()
-                if self.decode_window_sentinel is not None:
-                    self.decode_window_sentinel.check()
+                    if kfull_any:
+                        kernels.record_call(
+                            "decode_attention",
+                            self._kernel_impl["pallas_decode"])
+                        (self._dev_tokens, self._dev_positions, self.pool.k,
+                         self.pool.v) = _decode_step_kernel_jit(
+                            self.params, self.pool.k, self.pool.v,
+                            self.pool.k_scale, self.pool.v_scale,
+                            self._dev_page_tables, self._dev_tokens,
+                            self._dev_positions, self._dev_active_kfull,
+                            n_heads=self.n_heads,
+                            page_tokens=self.pool.page_tokens,
+                            qmode=self._qmode,
+                            kernel_impl=self._kernel_impl["pallas_decode"],
+                            kernel_interpret=self._kernel_interpret[
+                                "pallas_decode"])
+                    if kwin_any:
+                        kernels.record_call(
+                            "sparse_attention",
+                            self._kernel_impl["pallas_sparse"])
+                        (self._dev_tokens, self._dev_positions, self.pool.k,
+                         self.pool.v) = _decode_step_window_jit(
+                            self.params, self.pool.k, self.pool.v,
+                            self.pool.k_scale, self.pool.v_scale,
+                            self._dev_page_tables, self._dev_tokens,
+                            self._dev_positions, self._dev_active_kwin,
+                            n_heads=self.n_heads,
+                            page_tokens=self.pool.page_tokens,
+                            qmode=self._qmode,
+                            kernel_impl=self._kernel_impl["pallas_sparse"],
+                            kernel_interpret=self._kernel_interpret[
+                                "pallas_sparse"])
+                self._check_decode_sentinels()
                 # the step's single deliberate sync: EOS checks need the
                 # tokens
                 host_tokens = jax.device_get(self._dev_tokens)  # jaxlint: disable=JL002(one explicit host read per step)
@@ -1211,22 +1521,27 @@ class ServingEngine:
         flag lane churn already sets (allocate/free happen exactly
         there), so paging adds no extra steady-state transfers."""
         pos = np.ascontiguousarray(self.pool.positions, dtype=np.int32)
-        full = self._lane_active & ~self._lane_impl_window
-        win = self._lane_active & self._lane_impl_window
+        lw, lk = self._lane_impl_window, self._lane_impl_kernel
+        full = self._lane_active & ~lw & ~lk
+        win = self._lane_active & lw & ~lk
+        kfull = self._lane_active & ~lw & lk
+        kwin = self._lane_active & lw & lk
         tables = np.ascontiguousarray(self.pool.page_tables)
         if self._spec_k > 0:
             (self._dev_tokens, self._dev_positions, self._dev_active,
-             self._dev_active_win, self._dev_page_tables,
+             self._dev_active_win, self._dev_active_kfull,
+             self._dev_active_kwin, self._dev_page_tables,
              self._dev_history) = jax.device_put(
-                (self._lane_tokens, pos, full, win, tables,
+                (self._lane_tokens, pos, full, win, kfull, kwin, tables,
                  self._lane_history))
             if self._dev_noise is None:
                 self._dev_noise = jax.device_put(
                     np.zeros((self.pool.max_slots, self._spec_k), np.int32))
         else:
             (self._dev_tokens, self._dev_positions, self._dev_active,
-             self._dev_active_win, self._dev_page_tables) = jax.device_put(
-                (self._lane_tokens, pos, full, win, tables))
+             self._dev_active_win, self._dev_active_kfull,
+             self._dev_active_kwin, self._dev_page_tables) = jax.device_put(
+                (self._lane_tokens, pos, full, win, kfull, kwin, tables))
         self._lane_dirty = False
 
     def _call_spec_step(self):
@@ -1258,6 +1573,32 @@ class ServingEngine:
             self._dev_active_win, self._dev_noise,
             n_heads=self.n_heads, k=self._spec_k,
             page_tokens=self.pool.page_tokens, qmode=self._qmode)
+
+    def _call_spec_step_kernel(self, backend):
+        """Dispatch the kernel-tier speculative step program for one lane
+        class (``pallas_decode`` = kfull mask, ``pallas_sparse`` = kwin)
+        with that backend's resolved registry statics."""
+        kernels.record_call(kernels.kernel_for_backend(backend),
+                            self._kernel_impl[backend])
+        mask = (self._dev_active_kwin if backend == "pallas_sparse"
+                else self._dev_active_kfull)
+        return _spec_step_kernel_jit(
+            self.params, self.pool.k, self.pool.v,
+            self.pool.k_scale, self.pool.v_scale, self._dev_page_tables,
+            self._dev_history, self._dev_tokens, self._dev_positions,
+            mask, self._dev_noise, n_heads=self.n_heads, k=self._spec_k,
+            page_tokens=self.pool.page_tokens, qmode=self._qmode,
+            attn_backend=backend,
+            kernel_impl=self._kernel_impl[backend],
+            kernel_interpret=self._kernel_interpret[backend])
+
+    def _check_decode_sentinels(self):
+        """Post-dispatch budget asserts for every armed decode pin (the
+        per-class programs share the step, so they share the check)."""
+        for s in (self.decode_sentinel, self.decode_window_sentinel,
+                  self.decode_kernel_sentinel):
+            if s is not None:
+                s.check()
 
     def _maybe_update_noise(self):
         """Swap the device-resident draft-noise operand when the
@@ -1422,10 +1763,11 @@ class ServingEngine:
         pspan.__enter__()
         B, total = self._prefill_batch, self.max_seq_len
         pt = self.pool.page_tokens
-        # the sparse prefill's blocked attention needs a page-multiple
+        # the sparse prefills' blocked attention needs a page-multiple
         # chunk width; pad queries are invisible (outputs discarded,
         # their garbage KV is overwritten by decode before attendable)
-        Sb = _round_up(bucket, pt) if impl == "sparse_xla" else bucket
+        Sb = (_round_up(bucket, pt)
+              if impl in ("sparse_xla", "pallas_sparse") else bucket)
         ids = np.zeros((B, Sb), np.int32)
         starts = np.zeros(B, np.int32)
         lens = np.ones(B, np.int32)        # dummy lanes: 1-token no-ops
@@ -1506,6 +1848,24 @@ class ServingEngine:
                 self.params, init_k, init_v, ids, starts, lens,
                 n_heads=self.n_heads, page_tokens=self.pool.page_tokens)
             sentinel = self.prefill_window_sentinel
+        elif impl == "pallas_decode":
+            kernels.record_call("decode_attention",
+                                self._kernel_impl["pallas_decode"])
+            out = _prefill_batch_kernel_jit(
+                self.params, init_k, init_v, ids, starts, lens,
+                n_heads=self.n_heads, page_tokens=self.pool.page_tokens,
+                kernel_impl=self._kernel_impl["pallas_decode"],
+                kernel_interpret=self._kernel_interpret["pallas_decode"])
+            sentinel = self.prefill_kernel_sentinel
+        elif impl == "pallas_sparse":
+            kernels.record_call("sparse_attention",
+                                self._kernel_impl["pallas_sparse"])
+            out = _prefill_batch_kernel_window_jit(
+                self.params, init_k, init_v, ids, starts, lens,
+                n_heads=self.n_heads, page_tokens=self.pool.page_tokens,
+                kernel_impl=self._kernel_impl["pallas_sparse"],
+                kernel_interpret=self._kernel_interpret["pallas_sparse"])
+            sentinel = self.prefill_kernel_window_sentinel
         elif impl == "flash":
             out = _prefill_batch_flash_jit(
                 self.params, init_k, init_v, ids, starts, lens,
@@ -1583,7 +1943,7 @@ class ServingEngine:
         # final chunk's by decode — same write-before-attend argument
         # as batched prefill padding
         cw = (_round_up(chunk_len, self.pool.page_tokens)
-              if impl == "sparse_xla" else chunk_len)
+              if impl in ("sparse_xla", "pallas_sparse") else chunk_len)
         chunk = req.prompt[st.pos:st.pos + chunk_len]
         ids = np.zeros((1, cw), np.int32)
         ids[0, :len(chunk)] = chunk
@@ -1688,8 +2048,10 @@ class ServingEngine:
         self._active[slot] = req
         self._lane_tokens[slot] = first_tok
         self._lane_active[slot] = True
-        self._lane_impl_window[slot] = (
-            getattr(req, "attn_impl", "dense") == "sparse_xla")
+        impl = getattr(req, "attn_impl", "dense")
+        self._lane_impl_window[slot] = impl in ("sparse_xla", "pallas_sparse")
+        self._lane_impl_kernel[slot] = impl in ("pallas_decode",
+                                                "pallas_sparse")
         if self._lane_history is not None:
             # seed the drafter: prompt tokens by position, then the
             # PENDING first generated token at position len(prompt)
@@ -1742,6 +2104,7 @@ class ServingEngine:
         if req.slot is not None:
             self._lane_active[req.slot] = False
             self._lane_impl_window[req.slot] = False
+            self._lane_impl_kernel[req.slot] = False
             self._lane_dirty = True
             self._active.pop(req.slot, None)
             self.pool.free(req.slot)

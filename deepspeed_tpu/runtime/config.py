@@ -601,6 +601,25 @@ def get_serving_config(param_dict):
                 f"serving.{SERVING_ATTENTION_IMPL} must be an impl name, a "
                 f"{{bucket: impl}} dict, or absent, got {attention_impl!r}"
             )
+    attention_kernel = get_scalar_param(
+        params, SERVING_ATTENTION_KERNEL, SERVING_ATTENTION_KERNEL_DEFAULT
+    )
+    if (attention_kernel is not None
+            and attention_kernel not in SERVING_ATTENTION_KERNELS):
+        raise ValueError(
+            f"serving.{SERVING_ATTENTION_KERNEL} must be one of "
+            f"{SERVING_ATTENTION_KERNELS} or absent (= the kernel "
+            f"registry's probe result), got {attention_kernel!r}"
+        )
+    kernel_interpret = get_scalar_param(
+        params, SERVING_KERNEL_INTERPRET, SERVING_KERNEL_INTERPRET_DEFAULT
+    )
+    if kernel_interpret is not None and not isinstance(kernel_interpret, bool):
+        raise ValueError(
+            f"serving.{SERVING_KERNEL_INTERPRET} must be a bool or absent "
+            f"(= auto: Pallas interpret mode everywhere but TPU), "
+            f"got {kernel_interpret!r}"
+        )
     kv_page_tokens = get_scalar_param(
         params, SERVING_KV_PAGE_TOKENS, SERVING_KV_PAGE_TOKENS_DEFAULT
     )
@@ -635,6 +654,8 @@ def get_serving_config(param_dict):
         kv_cache_dtype=kv_cache_dtype,
         fault_injection=fault_injection,
         attention_impl=attention_impl,
+        attention_kernel=attention_kernel,
+        kernel_interpret=kernel_interpret,
         kv_page_tokens=kv_page_tokens,
         kv_pool_tokens=kv_pool_tokens,
     )

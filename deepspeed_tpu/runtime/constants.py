@@ -232,7 +232,13 @@ SERVING_KV_CACHE_DTYPES = ("fp32", "bf16", "int8")
 SERVING_FAULT_INJECTION = "fault_injection"
 SERVING_ATTENTION_IMPL = "attention_impl"
 SERVING_ATTENTION_IMPL_DEFAULT = None  # None = dense everywhere
-SERVING_ATTENTION_IMPLS = ("dense", "flash", "sparse_xla")
+SERVING_ATTENTION_IMPLS = ("dense", "flash", "sparse_xla",
+                           "pallas_decode", "pallas_sparse")
+SERVING_ATTENTION_KERNEL = "attention_kernel"
+SERVING_ATTENTION_KERNEL_DEFAULT = None  # None = registry probe result
+SERVING_ATTENTION_KERNELS = ("pallas", "xla")
+SERVING_KERNEL_INTERPRET = "kernel_interpret"
+SERVING_KERNEL_INTERPRET_DEFAULT = None  # None = auto (interpret off-TPU)
 SERVING_KV_PAGE_TOKENS = "kv_page_tokens"
 SERVING_KV_PAGE_TOKENS_DEFAULT = None  # None = 128 (resolve_page_tokens)
 SERVING_KV_POOL_TOKENS = "kv_pool_tokens"

@@ -7,8 +7,9 @@ Two modes:
     Validate that bench artifacts are structurally sound (required keys,
     numeric types, ``complete: true``). Defaults to the committed
     baselines (``SERVING_BENCH_CPU.json`` + ``BENCH_r05.json`` +
-    ``LONGDOC_BENCH_CPU.json`` + ``FLEET_BENCH_CPU.json``). This is the
-    CI step: it needs no jax and takes milliseconds.
+    ``LONGDOC_BENCH_CPU.json`` + ``FLEET_BENCH_CPU.json`` +
+    ``KERNEL_BENCH_CPU.json``). This is the CI step: it needs no jax
+    and takes milliseconds.
 
 ``compare FRESH BASELINE``
     Diff a fresh bench run against a committed baseline under per-key
@@ -20,8 +21,10 @@ Artifact kinds are auto-detected: a dict with a ``parsed`` key is a
 driver wrapper (``BENCH_r05.json``) and is unwrapped;
 ``speedup_sparse_vs_dense_16k`` marks a long-document serving artifact
 (``LONGDOC_BENCH_CPU.json``); ``fleet_scaling_2x`` marks a fleet
-scale-out artifact (``FLEET_BENCH_CPU.json``); ``tokens_per_sec``
-marks a serving artifact; ``metric`` marks a train artifact. Contexts
+scale-out artifact (``FLEET_BENCH_CPU.json``); ``decode_pallas_us``
+marks a kernel-tier microbench artifact (``KERNEL_BENCH_CPU.json``);
+``tokens_per_sec`` marks a serving artifact; ``metric`` marks a train
+artifact. Contexts
 must match before numbers are compared — platform, model and workload
 knobs for serving; the metric string for train — otherwise the compare
 is skipped with exit 0 (a CPU artifact is not a regression signal for a
@@ -47,7 +50,8 @@ import sys
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DEFAULT_ARTIFACTS = ("SERVING_BENCH_CPU.json", "BENCH_r05.json",
-                     "LONGDOC_BENCH_CPU.json", "FLEET_BENCH_CPU.json")
+                     "LONGDOC_BENCH_CPU.json", "FLEET_BENCH_CPU.json",
+                     "KERNEL_BENCH_CPU.json")
 
 # -- tolerance profiles -------------------------------------------------
 # key -> (direction, rel_tol). direction "higher" means bigger is better:
@@ -104,6 +108,20 @@ FLEET_TOLERANCES = {
     "kill_recovery_s":        ("lower", 3.00),
 }
 
+# Kernel-tier microbench: on CPU the Pallas numbers run in interpret
+# mode (a correctness treadmill, not kernel perf), so the Pallas bands
+# are very loose; the XLA-fallback times gate the composed path that
+# actually serves CPU traffic. Parity flags are schema-checked, not
+# toleranced.
+KERNELS_TOLERANCES = {
+    "decode_pallas_us":      ("lower", 4.00),
+    "decode_xla_us":         ("lower", 2.00),
+    "decode_int8_pallas_us": ("lower", 4.00),
+    "decode_int8_xla_us":    ("lower", 2.00),
+    "band_pallas_us":        ("lower", 4.00),
+    "band_xla_us":           ("lower", 2.00),
+}
+
 # context keys that must match exactly for numbers to be comparable
 SERVING_CONTEXT = ("platform", "model", "requests", "max_slots",
                    "max_new_tokens", "speculative_k", "kv_cache_dtype",
@@ -118,6 +136,10 @@ LONGDOC_CONTEXT = ("platform", "model", "max_slots", "page_tokens",
 # different things and must never gate each other.
 FLEET_CONTEXT = ("platform", "model", "requests", "max_new_tokens",
                  "replica_counts", "scaling_mode")
+# interpret is load-bearing: interpret-mode (CPU CI) and native-TPU
+# kernel times are different universes and must never gate each other.
+KERNELS_CONTEXT = ("platform", "interpret", "iters", "decode_shape",
+                   "band_shape")
 
 # -- schema -------------------------------------------------------------
 SERVING_REQUIRED = {
@@ -157,6 +179,16 @@ FLEET_REQUIRED = {
     "fleet_oracle_ok": bool, "complete": bool,
 }
 
+KERNELS_REQUIRED = {
+    "platform": str, "interpret": bool, "iters": int,
+    "decode_pallas_us": (int, float), "decode_xla_us": (int, float),
+    "decode_int8_pallas_us": (int, float),
+    "decode_int8_xla_us": (int, float),
+    "band_pallas_us": (int, float), "band_xla_us": (int, float),
+    "decode_parity_ok": bool, "decode_int8_parity_ok": bool,
+    "band_parity_ok": bool, "complete": bool,
+}
+
 # the PR's acceptance floor: sparse must beat dense end-to-end at the
 # 16k bucket by at least this factor for the artifact to be a baseline
 LONGDOC_MIN_SPEEDUP = 5.0
@@ -166,16 +198,19 @@ LONGDOC_MIN_SPEEDUP = 5.0
 FLEET_MIN_SCALING_2X = 1.8
 
 TOLERANCES = {"serving": SERVING_TOLERANCES, "train": TRAIN_TOLERANCES,
-              "longdoc": LONGDOC_TOLERANCES, "fleet": FLEET_TOLERANCES}
+              "longdoc": LONGDOC_TOLERANCES, "fleet": FLEET_TOLERANCES,
+              "kernels": KERNELS_TOLERANCES}
 CONTEXTS = {"serving": SERVING_CONTEXT, "train": TRAIN_CONTEXT,
-            "longdoc": LONGDOC_CONTEXT, "fleet": FLEET_CONTEXT}
+            "longdoc": LONGDOC_CONTEXT, "fleet": FLEET_CONTEXT,
+            "kernels": KERNELS_CONTEXT}
 REQUIRED = {"serving": SERVING_REQUIRED, "train": TRAIN_REQUIRED,
-            "longdoc": LONGDOC_REQUIRED, "fleet": FLEET_REQUIRED}
+            "longdoc": LONGDOC_REQUIRED, "fleet": FLEET_REQUIRED,
+            "kernels": KERNELS_REQUIRED}
 
 
 def load_artifact(path):
-    """Read + unwrap one artifact; returns (kind, payload).
-    kind is "serving", "train" or "longdoc"."""
+    """Read + unwrap one artifact; returns (kind, payload). kind is
+    "serving", "train", "longdoc", "fleet" or "kernels"."""
     with open(path) as f:
         doc = json.load(f)
     if not isinstance(doc, dict):
@@ -189,14 +224,16 @@ def load_artifact(path):
         return "longdoc", doc
     if "fleet_scaling_2x" in doc:
         return "fleet", doc
+    if "decode_pallas_us" in doc:
+        return "kernels", doc
     if "tokens_per_sec" in doc:
         return "serving", doc
     if "metric" in doc:
         return "train", doc
     raise ValueError(
         f"{path}: unrecognized artifact (no 'speedup_sparse_vs_dense_16k', "
-        f"'fleet_scaling_2x', 'tokens_per_sec' or 'metric' key; top-level "
-        f"keys: {sorted(doc)[:8]})")
+        f"'fleet_scaling_2x', 'decode_pallas_us', 'tokens_per_sec' or "
+        f"'metric' key; top-level keys: {sorted(doc)[:8]})")
 
 
 def check_schema(path):
@@ -282,6 +319,23 @@ def check_schema(path):
             problems.append(
                 f"{path}: 'scaling_mode' must be 'wall' or 'cpu', got "
                 f"{doc.get('scaling_mode')!r}")
+    elif kind == "kernels":
+        if doc.get("complete") is not True:
+            problems.append(f"{path}: 'complete' is not true — a partial "
+                            f"bench run must not be committed as a baseline")
+        for key in ("decode_parity_ok", "decode_int8_parity_ok",
+                    "band_parity_ok"):
+            if doc.get(key) is not True:
+                problems.append(
+                    f"{path}: '{key}' is not true — a kernel that drifts "
+                    f"from its XLA-fallback oracle must not be a baseline")
+        for key in ("decode_pallas_us", "decode_xla_us",
+                    "decode_int8_pallas_us", "decode_int8_xla_us",
+                    "band_pallas_us", "band_xla_us"):
+            v = doc.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v <= 0:
+                problems.append(f"{path}: '{key}' must be > 0, got {v}")
     else:
         v = doc.get("value")
         if isinstance(v, (int, float)) and not isinstance(v, bool) and v <= 0:
@@ -403,7 +457,7 @@ def main(argv=None):
                         help="validate artifact schema(s); defaults to the "
                              "committed SERVING_BENCH_CPU.json + BENCH_r05."
                              "json + LONGDOC_BENCH_CPU.json + "
-                             "FLEET_BENCH_CPU.json")
+                             "FLEET_BENCH_CPU.json + KERNEL_BENCH_CPU.json")
     parser.add_argument("mode", nargs="?", choices=["compare"],
                         help="compare FRESH BASELINE under tolerance bands")
     parser.add_argument("fresh", nargs="?", help="fresh bench JSON")

@@ -233,6 +233,13 @@ HOT_LOOPS = (
     ("deepspeed_tpu/inference/serving/engine.py", "_spec_step_jit"),
     ("deepspeed_tpu/inference/serving/engine.py", "_spec_step_quant_jit"),
     ("deepspeed_tpu/inference/serving/engine.py", "_spec_step_window_jit"),
+    # kernel-tier programs: the same per-step contract, plus the fused
+    # int8 path (JL010 taint through the pool pages the kernel consumes)
+    ("deepspeed_tpu/inference/serving/engine.py", "_prefill_batch_kernel_jit"),
+    ("deepspeed_tpu/inference/serving/engine.py",
+     "_prefill_batch_kernel_window_jit"),
+    ("deepspeed_tpu/inference/serving/engine.py", "_decode_step_kernel_jit"),
+    ("deepspeed_tpu/inference/serving/engine.py", "_spec_step_kernel_jit"),
     ("deepspeed_tpu/runtime/engine.py", "DeepSpeedEngine._train_batch_now"),
     ("deepspeed_tpu/runtime/pipe/engine.py", "PipelineEngine._train_batch_now"),
 )
