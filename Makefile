@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving test-router lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels trace-smoke bench-gate
+.PHONY: test test-fast test-faults test-cluster test-serving test-router lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels trace-smoke bench-gate chaos-smoke
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -94,6 +94,18 @@ bench-longdoc:
 # asserted in-run (see docs/serving.md).
 bench-fleet:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=fleet python bench.py --child
+
+# Chaos harness: a seeded 20-episode randomized fault schedule
+# (kill/drain/slow/reject/overload composed) against 2 live replica
+# processes behind the Router. Writes CHAOS_BENCH_CPU.json with
+# recovery-time p50/p95 and the four invariant flags (bitwise
+# exactly-once, no stuck requests, bounded recovery, convergence back
+# to healthy) that the bench gate's schema check refuses when false.
+# Knobs: BENCH_CHAOS_SEED (default 0), BENCH_CHAOS_EPISODES (default
+# 20), BENCH_CHAOS_OUT (redirects the artifact).
+chaos-smoke:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=chaos python bench.py --child
+	python -m tools.bench_gate --check-schema CHAOS_BENCH_CPU.json
 
 # Kernel-tier microbench: Pallas (interpret on CPU) vs the composed-XLA
 # fallback for the fused paged decode (fp32 + int8) and banded sparse

@@ -136,6 +136,8 @@ def child_main():
         return longdoc_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "fleet":
         return fleet_child_main()
+    if os.environ.get("BENCH_MODEL", "bert") == "chaos":
+        return chaos_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "kernels":
         return kernels_child_main()
     import jax
@@ -1005,6 +1007,140 @@ def fleet_child_main():
     return 0
 
 
+def chaos_child_main():
+    """Chaos-harness leg: a seeded randomized fault schedule against a
+    live 2-replica fleet, with the self-healing invariants recorded as
+    gate-refusable flags.
+
+    Spawns REAL replica processes (chaos-flagged so the socket ``inject``
+    op can arm fault points at runtime) behind the stdlib Router, then
+    runs ``ChaosHarness.run(BENCH_CHAOS_EPISODES)`` composing
+    kill/drain/slow/reject/overload episodes from ``BENCH_CHAOS_SEED``.
+    Every completed request is checked bitwise against an in-process
+    single-engine ``generate()`` oracle (memoized per prompt). Writes
+    CHAOS_BENCH_CPU.json (BENCH_CHAOS_OUT redirects, as the gate does):
+    recovery p50/p95 plus four ``invariant_*`` flags the bench gate's
+    schema check REFUSES when false — a baseline with a failed invariant
+    can never be committed. Recovery times themselves are context-only
+    (CPU-noisy), not compared."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from deepspeed_tpu.inference import generate
+    from deepspeed_tpu.inference.serving.autoscaler import (
+        ProcessReplicaSpawner,
+    )
+    from deepspeed_tpu.inference.serving.chaos import ChaosHarness
+    from deepspeed_tpu.inference.serving.config import FleetConfig
+    from deepspeed_tpu.inference.serving.router import Router
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2
+
+    def progress(msg):
+        print(f"# chaos: {msg}", file=sys.stderr, flush=True)
+
+    model = {"vocab_size": 101, "hidden_size": 32, "num_hidden_layers": 2,
+             "num_attention_heads": 2, "max_position_embeddings": 128}
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "0"))
+    episodes = int(os.environ.get("BENCH_CHAOS_EPISODES", "20"))
+    n_new = int(os.environ.get("BENCH_CHAOS_NEW_TOKENS", "8"))
+
+    gcfg = GPT2Config(**model, hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    _, params = init_gpt2(gcfg, batch_size=1, seq_len=8, seed=0)
+    _oracle_cache = {}
+
+    def reference(prompt, max_new):
+        key = (tuple(prompt), max_new)
+        if key not in _oracle_cache:
+            _oracle_cache[key] = np.asarray(generate(
+                params, gcfg, np.asarray([prompt], np.int32),
+                max_new))[0].tolist()
+        return _oracle_cache[key]
+
+    tmp = tempfile.mkdtemp(prefix="chaos_bench_")
+    cfg_path = os.path.join(tmp, "replica.json")
+    with open(cfg_path, "w") as f:
+        json.dump({"model": model, "seed": 0, "chaos": True,
+                   "ds_config": {"train_batch_size": 1,
+                                 "serving": {"max_slots": 4, "max_queue": 16,
+                                             "max_seq_len": 128}}}, f)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    spawner = ProcessReplicaSpawner(cfg_path, env=env)
+    router = None
+    t_wall = time.perf_counter()
+    try:
+        progress("spawning 2 chaos-flagged replicas (compile)")
+        replicas = [spawner.spawn("c0"), spawner.spawn("c1")]
+        router = Router(
+            [h.endpoint() for h in replicas],
+            FleetConfig(enabled=True, retry_budget=3, retry_backoff_s=0.05,
+                        attempt_timeout_s=300.0, health_ttl_s=0.1,
+                        saturation_queue_depth=8, shed_retry_after_s=0.1,
+                        affinity_prefix_tokens=0))
+        # warm both replicas so compiles land before any recovery clock
+        for h in replicas:
+            router.submit([2, 3, 5, 7], max_new_tokens=n_new).result(
+                timeout=600)
+        harness = ChaosHarness(
+            router, spawner, reference, replicas, seed=seed,
+            max_new_tokens=n_new, request_timeout_s=300.0,
+            recovery_timeout_s=300.0, vocab=model["vocab_size"])
+        progress(f"running {episodes} episodes (seed {seed})")
+        report = harness.run(episodes=episodes)
+        for i, ep in enumerate(report["episodes"]):
+            progress(f"episode {i}: {ep['kind']} completed={ep['completed']}"
+                     f" recovery={ep.get('recovery_s', -1):.2f}s")
+    finally:
+        if router is not None:
+            router.close()
+        spawner.stop_all()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    result = {
+        "platform": "cpu",
+        "model": "gpt2-tiny(L2,H32)",
+        "chaos_episodes": report["chaos_episodes"],
+        "chaos_seed": report["chaos_seed"],
+        "faults_composed": ["kill_replica", "drain_replica", "slow_replica",
+                            "reject_admission", "overload"],
+        "completed_total": report["completed_total"],
+        "shed_total": report["shed_total"],
+        "errors_total": report["errors_total"],
+        "recovery_p50_s": report["recovery_p50_s"],
+        "recovery_p95_s": report["recovery_p95_s"],
+        "recovery_max_s": report["recovery_max_s"],
+        "invariant_bitwise_ok": report["invariant_bitwise_ok"],
+        "invariant_no_stuck": report["invariant_no_stuck"],
+        "invariant_recovery_bounded": report["invariant_recovery_bounded"],
+        "invariant_converged": report["invariant_converged"],
+        "wall_s": round(time.perf_counter() - t_wall, 1),
+        "complete": True,
+    }
+    out = os.environ.get("BENCH_CHAOS_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "CHAOS_BENCH_CPU.json")
+    with open(out, "w") as f:
+        f.write(json.dumps(result, indent=1) + "\n")
+    print(json.dumps({
+        "metric": f"chaos schedule ({episodes} episodes, seed {seed}) "
+                  "recovery p95",
+        "value": result["recovery_p95_s"],
+        "unit": "s",
+        "vs_baseline": None,
+        **{k: result[k] for k in (
+            "recovery_p50_s", "completed_total", "shed_total",
+            "invariant_bitwise_ok", "invariant_no_stuck",
+            "invariant_recovery_bounded", "invariant_converged")},
+    }))
+    if not (result["invariant_bitwise_ok"] and result["invariant_no_stuck"]
+            and result["invariant_recovery_bounded"]
+            and result["invariant_converged"]):
+        return 1
+    return 0
+
+
 def _attn_impl_label(on_tpu):
     """Which attention core actually ran (shared by every bench leg): "xla"
     (env-forced einsum chain), "pallas" (the TPU default), or "reference"
@@ -1205,6 +1341,10 @@ def main():
         label = "fleet serving scale-out (2 replicas vs 1)"
         seq = os.environ.get("BENCH_FLEET_NEW_TOKENS", "32")
         unit = "x single-replica tokens/sec"
+    elif os.environ.get("BENCH_MODEL", "bert") == "chaos":
+        label = "chaos-schedule recovery p95"
+        seq = os.environ.get("BENCH_CHAOS_EPISODES", "20")
+        unit = "s recovery p95"
     elif os.environ.get("BENCH_MODEL", "bert") == "kernels":
         label = "kernel-tier microbench"
         seq = os.environ.get("BENCH_KERNELS_ITERS", "10")

@@ -1,0 +1,307 @@
+"""Randomized chaos harness for the serving fleet.
+
+The fleet tier accumulated a lot of robustness machinery — failover with
+exactly-once replay, drain-aware routing, retry budgets, overload
+shedding, crash-loop breakers, the degrade ladder, the autoscaler — each
+tested in isolation. This harness tests the COMPOSITION: a seeded
+randomized schedule of fault episodes against a LIVE router + replica
+fleet, with the paper's correctness bar asserted after every single
+episode, not just at the end:
+
+- **exactly-once, bitwise**: every request that completes must return
+  tokens bitwise-identical to the single-engine ``generate()`` oracle
+  (greedy decoding is deterministic, so any divergence means a replay
+  bug, a duplicated token, or cross-replica state leakage).
+- **no stuck requests**: every submitted request reaches a terminal
+  state — tokens, a structured error, or a shed — within a deadline.
+  A future that never resolves is the worst serving failure mode.
+- **bounded recovery**: after each fault clears, the time until the
+  fleet is healthy again (every routed endpoint probing healthy AND a
+  canary request completing) is measured and bounded.
+- **convergence**: after the full schedule the fleet must walk itself
+  back to normal — degrade rung 0, no draining endpoints, all healthy.
+
+Fault kinds composed by the schedule (all five can interleave across
+episodes; seeds make any failure replayable):
+
+===================  ====================================================
+``kill_replica``     SIGKILL a routed replica mid-traffic (hard death —
+                     no drain, no flush), then respawn and re-attach.
+``drain_replica``    SIGTERM (the polite path): replica finishes
+                     in-flight work, exits ``EXIT_PREEMPTED``; respawn.
+``slow_replica``     arm the ``slow_replica`` fault point over the
+                     socket ``inject`` op: every reply delayed.
+``reject_admission`` arm ``reject_admission``: the replica bounces new
+                     keys, forcing the router's free re-route path.
+``overload``         submit a burst past the fleet's saturation budget;
+                     shed requests must carry ``retry_after_s`` and
+                     succeed on honored re-admission.
+===================  ====================================================
+
+The harness is transport-real (subprocess replicas over TCP via
+:class:`ProcessReplicaSpawner`) but fleet-shape-agnostic: tests can also
+hand it an in-process fake spawner. Stdlib-only, like everything else
+on the router side of the fleet.
+"""
+
+import random
+import statistics
+import time
+
+from deepspeed_tpu.inference.serving.autoscaler import replica_op
+from deepspeed_tpu.inference.serving.router import (
+    FleetOverloadError,
+    RequestPoisonedError,
+)
+
+FAULT_KINDS = ("kill_replica", "drain_replica", "slow_replica",
+               "reject_admission", "overload")
+
+
+def default_make_prompt(rng, vocab=100):
+    """Deterministic-from-seed prompt generator (token 0 avoided: some
+    models reserve it)."""
+    n = rng.randint(3, 10)
+    return [rng.randint(1, vocab - 1) for _ in range(n)]
+
+
+class ChaosReport(dict):
+    """Schedule results: per-episode records + the rollup the bench
+    gate consumes (``chaos_episodes`` is the artifact-kind marker)."""
+
+    @property
+    def ok(self):
+        return (self["invariant_bitwise_ok"] and self["invariant_no_stuck"]
+                and self["invariant_recovery_bounded"]
+                and self["invariant_converged"])
+
+
+class ChaosHarness:
+    """Drive one seeded fault schedule against a live fleet.
+
+    ``reference_fn(prompt, max_new_tokens) -> list[int]`` is the bitwise
+    oracle (single-engine ``generate()`` precomputed in-process, or the
+    stub token function in router unit tests). ``replicas`` maps the
+    router's endpoint names to :class:`SpawnedReplica`-shaped handles so
+    faults can kill/drain/respawn the actual processes."""
+
+    def __init__(self, router, spawner, reference_fn, replicas,
+                 seed=0, faults=FAULT_KINDS, make_prompt=None,
+                 max_new_tokens=8, request_timeout_s=60.0,
+                 recovery_timeout_s=60.0, vocab=100):
+        self.router = router
+        self.spawner = spawner
+        self.reference_fn = reference_fn
+        self._replicas = {h.name: h for h in replicas}
+        self.seed = int(seed)
+        self.rng = random.Random(self.seed)
+        self.faults = tuple(faults)
+        unknown = set(self.faults) - set(FAULT_KINDS)
+        if unknown:
+            raise ValueError(f"unknown fault kinds: {sorted(unknown)}")
+        self.make_prompt = make_prompt or (
+            lambda rng: default_make_prompt(rng, vocab))
+        self.max_new_tokens = int(max_new_tokens)
+        self.request_timeout_s = float(request_timeout_s)
+        self.recovery_timeout_s = float(recovery_timeout_s)
+        self.episodes = []
+        self._respawn_seq = 0
+
+    # -- request plumbing ------------------------------------------------
+    def _submit_batch(self, count, shed_retries=0):
+        """Submit ``count`` seeded requests; returns [(prompt, future)].
+        A synchronous shed (overload episodes with retries exhausted)
+        records as a None future — shed is a legal terminal state, not a
+        stuck request."""
+        out = []
+        for _ in range(count):
+            prompt = self.make_prompt(self.rng)
+            try:
+                fut = self.router.submit(
+                    prompt, max_new_tokens=self.max_new_tokens,
+                    shed_retries=shed_retries)
+            except FleetOverloadError:
+                fut = None
+            out.append((prompt, fut))
+        return out
+
+    def _collect(self, batch, record):
+        """Resolve every future; folds outcomes into the episode record.
+        Completions are checked bitwise against the oracle; structured
+        terminal errors (poisoned, shed) are legal; a TimeoutError from
+        the future itself is a STUCK request — the invariant killer."""
+        deadline = time.monotonic() + self.request_timeout_s
+        for prompt, fut in batch:
+            if fut is None:
+                record["shed"] += 1
+                continue
+            try:
+                tokens = fut.result(
+                    timeout=max(0.1, deadline - time.monotonic()))
+            except TimeoutError:
+                record["stuck"] += 1
+                continue
+            except (RequestPoisonedError, FleetOverloadError):
+                record["errors"] += 1
+                continue
+            except Exception:
+                record["errors"] += 1
+                continue
+            record["completed"] += 1
+            expect = self.reference_fn(prompt, self.max_new_tokens)
+            if list(tokens) != list(expect):
+                record["bitwise_mismatch"] += 1
+
+    # -- fault application -----------------------------------------------
+    def _routed_handles(self):
+        names = {ep.name for ep in self.router.endpoints()}
+        return [h for n, h in self._replicas.items() if n in names]
+
+    def _respawn(self, old):
+        """Replace a dead/drained replica: spawn a fresh process and
+        attach it (the autoscaler's attach path, exercised under fire)."""
+        self._respawn_seq += 1
+        handle = self.spawner.spawn(f"{old.name}.r{self._respawn_seq}")
+        self._replicas.pop(old.name, None)
+        self._replicas[handle.name] = handle
+        self.router.add_endpoint(handle.endpoint())
+        return handle
+
+    def _apply_fault(self, kind, record):
+        """Arm/execute one fault; returns a ``clear()`` callable that
+        undoes it (respawn, disarm) — recovery timing starts after."""
+        handles = self._routed_handles()
+        if kind in ("kill_replica", "drain_replica") and len(handles) > 1:
+            victim = self.rng.choice(handles)
+            record["victim"] = victim.name
+            if kind == "kill_replica":
+                self.spawner.kill(victim)
+            else:
+                self.spawner.drain(victim, wait_s=self.request_timeout_s)
+
+            def clear(victim=victim):
+                try:
+                    self.router.remove_endpoint(victim.name)
+                except ValueError:
+                    pass
+                self._respawn(victim)
+            return clear
+        if kind in ("slow_replica", "reject_admission") and handles:
+            victim = self.rng.choice(handles)
+            record["victim"] = victim.name
+            args = {"op": "inject", "point": kind}
+            if kind == "slow_replica":
+                args["seconds"] = round(self.rng.uniform(0.05, 0.2), 3)
+                args["times"] = self.rng.randint(2, 6)
+            else:
+                args["times"] = self.rng.randint(1, 4)
+            try:
+                replica_op(victim.host, victim.port, args)
+            except OSError:
+                record["inject_failed"] = True
+
+            def clear(victim=victim):
+                try:
+                    replica_op(victim.host, victim.port,
+                               {"op": "inject", "point": None})
+                except OSError:
+                    pass
+            return clear
+        # overload (or a degenerate fleet): the fault IS extra traffic
+        record["victim"] = None
+        burst = self._submit_batch(
+            self.rng.randint(4, 8),
+            shed_retries=3)             # honor retry_after_s on re-admission
+        self._collect(burst, record)
+        return lambda: None
+
+    # -- recovery --------------------------------------------------------
+    def _await_recovery(self, record):
+        """Time from fault-clear until the fleet is demonstrably healthy:
+        every routed endpoint probes healthy and non-draining, and one
+        canary request completes bitwise-correct."""
+        t0 = time.monotonic()
+        deadline = t0 + self.recovery_timeout_s
+        while time.monotonic() < deadline:
+            eps = self.router.probe_all(force=True)
+            if all(ep.healthy and not ep.draining for ep in eps):
+                break
+            time.sleep(0.02)
+        else:
+            record["recovered"] = False
+            record["recovery_s"] = time.monotonic() - t0
+            return
+        canary = self.make_prompt(self.rng)
+        try:
+            tokens = self.router.submit(
+                canary, max_new_tokens=self.max_new_tokens,
+                shed_retries=5).result(
+                    timeout=max(0.1, deadline - time.monotonic()))
+            record["recovered"] = (
+                list(tokens) == list(self.reference_fn(
+                    canary, self.max_new_tokens)))
+        except Exception:
+            record["recovered"] = False
+        record["recovery_s"] = time.monotonic() - t0
+
+    # -- the schedule ----------------------------------------------------
+    def run_episode(self, kind=None):
+        """One episode: traffic before, fault during, traffic after,
+        collect, clear, time recovery. Returns the episode record."""
+        kind = kind or self.rng.choice(self.faults)
+        record = {"kind": kind, "completed": 0, "shed": 0, "errors": 0,
+                  "stuck": 0, "bitwise_mismatch": 0}
+        before = self._submit_batch(self.rng.randint(1, 3))
+        clear = self._apply_fault(kind, record)
+        during = self._submit_batch(self.rng.randint(1, 3),
+                                    shed_retries=3)
+        self._collect(before, record)
+        self._collect(during, record)
+        clear()
+        self._await_recovery(record)
+        self.episodes.append(record)
+        return record
+
+    def run(self, episodes=20):
+        """The full seeded schedule; returns a :class:`ChaosReport`."""
+        for _ in range(int(episodes)):
+            self.run_episode()
+        return self.report()
+
+    def report(self):
+        eps = self.episodes
+        recoveries = sorted(e["recovery_s"] for e in eps
+                            if "recovery_s" in e)
+        converged = self._converged()
+
+        def pctl(p):
+            if not recoveries:
+                return 0.0
+            return float(recoveries[min(len(recoveries) - 1,
+                                        int(p * len(recoveries)))])
+
+        return ChaosReport({
+            "chaos_episodes": len(eps),
+            "chaos_seed": self.seed,
+            "completed_total": sum(e["completed"] for e in eps),
+            "shed_total": sum(e["shed"] for e in eps),
+            "errors_total": sum(e["errors"] for e in eps),
+            "recovery_p50_s": round(
+                statistics.median(recoveries), 4) if recoveries else 0.0,
+            "recovery_p95_s": round(pctl(0.95), 4),
+            "recovery_max_s": round(
+                max(recoveries), 4) if recoveries else 0.0,
+            "invariant_bitwise_ok": all(
+                e["bitwise_mismatch"] == 0 for e in eps),
+            "invariant_no_stuck": all(e["stuck"] == 0 for e in eps),
+            "invariant_recovery_bounded": all(
+                e.get("recovered", False) for e in eps),
+            "invariant_converged": converged,
+            "episodes": [dict(e) for e in eps],
+        })
+
+    def _converged(self):
+        """Post-schedule convergence: healthy fleet, ladder back at 0."""
+        eps = self.router.probe_all(force=True)
+        healthy = all(ep.healthy and not ep.draining for ep in eps)
+        return bool(healthy and self.router.degrade_rung == 0)

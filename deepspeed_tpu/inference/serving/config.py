@@ -88,6 +88,68 @@ class ServingConfig:
 
 
 @dataclass
+class AutoscaleConfig:
+    """The ``fleet.autoscale`` sub-block: the SLO-driven control loop
+    (inference/serving/autoscaler.py). Opt-in: the sub-block's presence
+    enables it."""
+
+    enabled: bool = False
+    # Fleet-size bounds the control loop may move between. Scale-down
+    # never drains below min_replicas; scale-up never attaches past
+    # max_replicas (past it, pressure escalates the degrade ladder
+    # instead).
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # Pre-spawned replica processes kept listening but NOT routed to:
+    # scale-up is attach-not-cold-start (the pool refills in the
+    # background after an attach). 0 = cold-start scale-up.
+    warm_spares: int = 1
+    # Hysteresis: an alert must fire this long before a scale-up acts...
+    up_after_s: float = 1.0
+    # ...and the fleet must be alert-quiet this long before a scale-down.
+    down_after_s: float = 5.0
+    # Minimum gap between ANY two scaling actions (flap damping).
+    cooldown_s: float = 2.0
+    # Control-loop tick interval for the background thread.
+    poll_interval_s: float = 0.25
+
+
+@dataclass
+class DegradeConfig:
+    """The ``fleet.degrade`` sub-block: the degraded-mode ladder
+    (inference/serving/degrade.py). Opt-in: presence enables."""
+
+    enabled: bool = False
+    # Sustained pressure before climbing ONE rung...
+    escalate_after_s: float = 0.5
+    # ...and sustained quiet before descending ONE rung (rung-by-rung
+    # recovery; never a jump back to healthy).
+    recover_after_s: float = 2.0
+    # Engine-side pressure signal: queue_depth >= this fraction of
+    # serving.max_queue counts as pressure for the automatic ladder.
+    pressure_queue_frac: float = 0.75
+    # Request classes the router sheds at rung 3. Empty = every class
+    # EXCEPT "default" (the protected class).
+    shed_classes: tuple = ()
+
+
+@dataclass
+class BreakerConfig:
+    """The ``fleet.breaker`` sub-block: per-replica crash-loop circuit
+    breakers (launcher/supervisor.py). Opt-in: presence enables."""
+
+    enabled: bool = False
+    # Failure exits (crash/hung/fatal — NOT clean or preempted) within
+    # window_s that open the breaker.
+    threshold: int = 3
+    window_s: float = 30.0
+    # Quarantine length while open: the worker stays down (the router
+    # routes around its dead port), then ONE half-open probe restart is
+    # allowed; a probe failure re-opens with a fresh cooldown.
+    cooldown_s: float = 5.0
+
+
+@dataclass
 class FleetConfig:
     """The ``fleet`` block: router + replica-fleet policy
     (inference/serving/router.py, replica.py). Opt-in like ``serving``:
@@ -133,3 +195,9 @@ class FleetConfig:
     max_inflight_tokens: object = 0
     # retry-after hint carried by FleetOverloadError on shed.
     shed_retry_after_s: float = 0.5
+    # Self-healing sub-blocks (autoscaler control loop, degraded-mode
+    # ladder, crash-loop breakers). Each is opt-in by presence, like the
+    # fleet block itself.
+    autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
+    degrade: DegradeConfig = field(default_factory=DegradeConfig)
+    breaker: BreakerConfig = field(default_factory=BreakerConfig)

@@ -1,0 +1,147 @@
+"""Degraded-mode ladder: shed capability before shedding requests.
+
+ZeRO-Infinity's design principle — walk down a resource hierarchy
+instead of failing — applied to overload. When the fleet is saturated
+and there is no scale-up headroom left, the engine/router pair climbs a
+small ladder of *capability* concessions, one rung at a time, and walks
+back down the same way once pressure clears:
+
+====  ==============  ====================================================
+rung  name            effect
+====  ==============  ====================================================
+0     healthy         full service
+1     spec_off        speculative decoding disabled (k -> 0). Safe at any
+                      moment: drafts are verified against the oracle
+                      forward, so turning the drafter off changes
+                      throughput, never output bits.
+2     budget_shrink   rung 1 + prefix-cache inserts paused and the
+                      admission queue budget halved — less host RAM/work
+                      per admitted request, earlier backpressure.
+3     class_shed      rung 2 + the router sheds the configured request
+                      classes at the door (``FleetOverloadError``) so the
+                      protected classes keep their latency.
+====  ==============  ====================================================
+
+The ladder itself is a tiny hysteresis state machine: ``update(pressure)``
+escalates one rung after ``escalate_after_s`` of sustained pressure and
+recovers one rung after ``recover_after_s`` of sustained quiet — never
+two rungs at once, so a pressure blip cannot slam the fleet to rung 3
+and a recovery overshoot cannot flap. Every transition is edge-triggered:
+one ``fleet/degrade_rung`` telemetry instant per change, not per step.
+
+Stdlib-only on purpose: the router imports this module and the router
+must never pay a jax import. Telemetry is imported lazily (the package
+is stdlib-only too) and only when it is already loaded in-process, so a
+bare Router keeps its import graph unchanged.
+"""
+
+import sys
+import threading
+import time
+
+from deepspeed_tpu.inference.serving.config import DegradeConfig
+
+RUNGS = ("healthy", "spec_off", "budget_shrink", "class_shed")
+MAX_RUNG = len(RUNGS) - 1
+
+
+def rung_name(rung):
+    return RUNGS[max(0, min(int(rung), MAX_RUNG))]
+
+
+class DegradeLadder:
+    """Hysteresis state machine over the degrade rungs.
+
+    ``update(pressure)`` is the automatic driver (call it once per
+    engine step / autoscaler tick — host-only, a few comparisons);
+    ``set_rung`` is the external override (the autoscaler pushing the
+    fleet to a rung, a test pinning one). Both are edge-triggered
+    through the same ``_change`` path, so the telemetry story is
+    identical no matter who moved the ladder.
+    """
+
+    def __init__(self, config=None, on_change=None, name="engine",
+                 clock=time.monotonic):
+        self.config = config or DegradeConfig(enabled=True)
+        self.name = str(name)
+        self.rung = 0
+        self._on_change = on_change
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._pressure_since = None
+        self._quiet_since = None
+        self.transitions = 0            # lifetime rung changes (tests/bench)
+
+    # -- automatic driver ------------------------------------------------
+    def update(self, pressure, now=None):
+        """One observation of the pressure signal; returns the (possibly
+        changed) rung. Escalation and recovery both move ONE rung per
+        sustained window — the window clock re-arms at each change."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            if pressure:
+                self._quiet_since = None
+                if self._pressure_since is None:
+                    self._pressure_since = now
+                if (self.rung < MAX_RUNG
+                        and now - self._pressure_since
+                        >= self.config.escalate_after_s):
+                    self._change(self.rung + 1, "pressure")
+                    self._pressure_since = now
+            else:
+                self._pressure_since = None
+                if self._quiet_since is None:
+                    self._quiet_since = now
+                if (self.rung > 0
+                        and now - self._quiet_since
+                        >= self.config.recover_after_s):
+                    self._change(self.rung - 1, "recovered")
+                    self._quiet_since = now
+            return self.rung
+
+    # -- external override -----------------------------------------------
+    def set_rung(self, rung, reason="forced"):
+        """Jump to ``rung`` (clamped). Resets the hysteresis clocks so
+        the automatic driver doesn't immediately undo the override."""
+        rung = max(0, min(int(rung), MAX_RUNG))
+        with self._lock:
+            self._pressure_since = None
+            self._quiet_since = None
+            if rung != self.rung:
+                self._change(rung, reason)
+            return self.rung
+
+    # -- internals ---------------------------------------------------------
+    def _change(self, new, reason):
+        # caller holds the lock
+        old = self.rung
+        self.rung = new
+        self.transitions += 1
+        self._note(old, new, reason)
+        if self._on_change is not None:
+            self._on_change(old, new, reason)
+
+    def _note(self, old, new, reason):
+        """One edge-triggered ``fleet/degrade_rung`` instant per change.
+        Lazy like the supervisor's: only when telemetry is already
+        loaded in-process, so the router's import graph stays jax- and
+        telemetry-free."""
+        if "deepspeed_tpu.telemetry" not in sys.modules:
+            return
+        try:
+            from deepspeed_tpu import telemetry
+            telemetry.instant(
+                "fleet/degrade_rung", cat="fleet",
+                args={"ladder": self.name, "from": old, "to": new,
+                      "from_name": rung_name(old), "to_name": rung_name(new),
+                      "reason": reason})
+        except Exception:
+            pass                        # telemetry must never break serving
+
+    def export_gauges(self, registry):
+        """``Fleet/degrade_rung`` pull gauge (the SLO engine's and the
+        chaos harness' convergence signal). Idempotent."""
+        registry.gauge_fn(
+            "Fleet/degrade_rung", lambda: float(self.rung),
+            help="current degraded-mode ladder rung (0 = healthy)")
+        return registry

@@ -158,9 +158,11 @@ from deepspeed_tpu.inference.serving.kv_pool import (
 )
 from deepspeed_tpu.inference.serving.metrics import ServingMetrics
 from deepspeed_tpu.inference.serving.prefix_cache import PrefixKVCache
+from deepspeed_tpu.inference.serving.degrade import DegradeLadder
 from deepspeed_tpu.inference.serving.scheduler import (
     ContinuousBatchingScheduler,
     EngineDrainingError,
+    QueueFullError,
     RequestTimeoutError,
     bucket_for,
     default_buckets,
@@ -1032,6 +1034,12 @@ class ServingEngine:
         else:
             self._qmode = None
         self._spec_k = int(cfg.speculative_k)
+        # degraded-mode ladder: armed by configure_degrade() (from_config
+        # wires the fleet.degrade block) or lazily by set_degrade_rung()
+        # (the replica "degrade" socket op / the autoscaler's push).
+        # _degrade_rung is the hot-path mirror — one int read per check.
+        self._degrade = None
+        self._degrade_rung = 0
         self.scheduler = ContinuousBatchingScheduler(
             max_queue=cfg.max_queue, buckets=buckets,
             default_max_new_tokens=cfg.default_max_new_tokens,
@@ -1202,7 +1210,62 @@ class ServingEngine:
                 "steps": self._step_count,
                 "active_requests": len(self._active),
                 "queue_depth": self.scheduler.queue_depth(),
-                "draining": self._draining}
+                "draining": self._draining,
+                "degrade_rung": self._degrade_rung}
+
+    # -- degraded-mode ladder -------------------------------------------
+    def configure_degrade(self, degrade_config):
+        """Arm the degraded-mode ladder (fleet.degrade block or a
+        DegradeLadder). Rung 1 disables speculation (k -> 0 — safe
+        mid-flight: emitted tokens always come from the verify oracle,
+        so the classic program continues the exact same sequence);
+        rung 2 additionally pauses prefix-cache inserts and halves the
+        admission queue budget. Rung 3 is router-side (class shedding).
+        """
+        if isinstance(degrade_config, DegradeLadder):
+            self._degrade = degrade_config
+            self._degrade._on_change = self._on_degrade_change
+        else:
+            self._degrade = DegradeLadder(
+                degrade_config, on_change=self._on_degrade_change,
+                name="engine")
+        self._degrade_rung = self._degrade.rung
+        self._degrade.export_gauges(telemetry.get_registry())
+        return self._degrade
+
+    def set_degrade_rung(self, rung, reason="forced"):
+        """External rung override (the replica's ``degrade`` socket op,
+        the autoscaler's no-headroom push). Arms a default ladder when
+        none is configured, so the op always works."""
+        if self._degrade is None:
+            self.configure_degrade(None)
+        return self._degrade.set_rung(rung, reason=reason)
+
+    @property
+    def degrade_rung(self):
+        return self._degrade_rung
+
+    def _effective_spec_k(self):
+        """Speculation knob after the ladder: rung >= 1 runs the classic
+        one-token decode program (which always exists — it IS the k=0
+        path), so toggling never recompiles anything new per rung flip."""
+        return 0 if self._degrade_rung >= 1 else self._spec_k
+
+    def _on_degrade_change(self, old, new, reason):
+        self._degrade_rung = new
+        # crossing the speculation boundary switches decode programs;
+        # re-upload lane state so the program about to run sees fresh
+        # operands (spec needs the host history mirror, which the classic
+        # path keeps warm — see step()).
+        if self._spec_k > 0 and (old >= 1) != (new >= 1):
+            self._lane_dirty = True
+
+    def _degrade_queue_budget(self):
+        """Effective admission-queue budget under the ladder: rung >= 2
+        halves it (earlier backpressure, less queued work to carry)."""
+        if self._degrade_rung >= 2:
+            return max(1, self.config.max_queue // 2)
+        return self.config.max_queue
 
     @classmethod
     def from_config(cls, params, model_config, ds_config, rank=0,
@@ -1214,13 +1277,17 @@ class ServingEngine:
 
         if isinstance(ds_config, dict):
             ds_config = DeepSpeedConfig(ds_config, world_size=1)
-        return cls(params, model_config,
-                   serving_config=ds_config.serving_config,
-                   monitor=monitor_from_config(ds_config, rank),
-                   injector=injector,
-                   sentinel_config=ds_config.sentinel_config,
-                   telemetry_config=ds_config.telemetry_config,
-                   rank=rank)
+        eng = cls(params, model_config,
+                  serving_config=ds_config.serving_config,
+                  monitor=monitor_from_config(ds_config, rank),
+                  injector=injector,
+                  sentinel_config=ds_config.sentinel_config,
+                  telemetry_config=ds_config.telemetry_config,
+                  rank=rank)
+        fleet = getattr(ds_config, "fleet_config", None)
+        if fleet is not None and fleet.enabled and fleet.degrade.enabled:
+            eng.configure_degrade(fleet.degrade)
+        return eng
 
     # -- request intake -------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=None, eos_token_id=None,
@@ -1258,6 +1325,13 @@ class ServingEngine:
             raise ValueError(
                 f"eos_token_id={eos_token_id} outside vocab "
                 f"[0, {self.model_config.vocab_size})")
+        if self._degrade_rung >= 2:
+            # budget_shrink rung: earlier backpressure at half the queue
+            budget = self._degrade_queue_budget()
+            if self.scheduler.queue_depth() >= budget:
+                raise QueueFullError(
+                    f"admission queue shrunk to {budget} at degrade rung "
+                    f"{self._degrade_rung}")
         submitted_at = (time.monotonic() - float(age_s)
                         if age_s and age_s > 0 else None)
         req = self.scheduler.submit(
@@ -1328,7 +1402,7 @@ class ServingEngine:
             win_any = np.any(win_mask)
             kfull_any = np.any(kfull_mask)
             kwin_any = np.any(kwin_mask)
-            if self._spec_k > 0:
+            if self._effective_spec_k() > 0:
                 self._maybe_update_noise()
                 with guard:
                     got = []           # (class mask, oracle, accepted)
@@ -1481,7 +1555,16 @@ class ServingEngine:
                 n_active = len(self._active)
                 for slot in list(self._active):
                     req = self._active[slot]
+                    base = self.pool.positions[slot]
                     self.pool.advance(slot)
+                    if (self._lane_history is not None
+                            and base + 1 < self.max_seq_len):
+                        # speculation is configured but ladder-disabled:
+                        # keep the host history mirror warm so recovery
+                        # back to the spec program re-uploads fresh
+                        # drafter context (stale history would only cost
+                        # accept rate, but fresh is free here)
+                        self._lane_history[slot, base + 1] = toks[slot]
                     self._emit(req, toks[slot])
                     stats["decoded"] += 1
                     stats["retired"] += self._maybe_retire(req, toks[slot],
@@ -1494,6 +1577,13 @@ class ServingEngine:
                     pages_in_use=occ["pages_in_use"],
                     page_fragmentation=occ["page_fragmentation"])
         self._step_count += 1
+        if self._degrade is not None and self._degrade.config.enabled:
+            # host-only pressure signal, evaluated once per step: a
+            # sustained near-full admission queue climbs the ladder one
+            # rung; sustained quiet walks it back down
+            threshold = max(1, int(self._degrade.config.pressure_queue_frac
+                                   * self.config.max_queue))
+            self._degrade.update(self.scheduler.queue_depth() >= threshold)
         if self.slo is not None:
             # host-only snapshot + pushed gauges; under policy="fail" a
             # firing rule raises SloViolationError out of step()
@@ -2010,6 +2100,10 @@ class ServingEngine:
         the trie's byte budget buys ~4x the prefix positions, same
         at-use-dequant contract as the pool itself."""
         if self.prefix_cache is None:
+            return
+        if self._degrade_rung >= 2:
+            # budget_shrink rung: stop growing the host-RAM trie under
+            # overload (lookups/hits still work — reuse stays free)
             return
         n = len(req.prompt)
         if reuse >= n - 1:

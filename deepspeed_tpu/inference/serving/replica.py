@@ -248,11 +248,40 @@ class ReplicaServer:
                     self.begin_drain()
                     self._reply(conn, {"draining": True,
                                        "pending": self.engine.pending()})
+                elif kind == "degrade":
+                    rung = self.engine.set_degrade_rung(
+                        int(op.get("rung", 0)),
+                        reason=str(op.get("reason", "fleet")))
+                    self._reply(conn, {"rung": rung})
+                elif kind == "inject":
+                    self._handle_inject(conn, op)
                 else:
                     self._reply(conn, {"error": f"unknown op {kind!r}",
                                        "etype": "ValueError"})
         except (OSError, ValueError):
             pass                        # peer went away mid-reply
+
+    # -- the inject op (the chaos harness's remote arm) ------------------
+    def _handle_inject(self, conn, op):
+        """Arm/disarm a serving fault point over the socket so the chaos
+        harness can slow/reject/kill a LIVE replica without reaching into
+        its process. ``{"op": "inject", "point": null}`` disarms all;
+        any other keys ride through as arm kwargs."""
+        if self.injector is None:
+            self._reply(conn, {"error": "replica built without injector",
+                               "etype": "RuntimeError"})
+            return
+        point = op.get("point")
+        try:
+            if point is None or point == "disarm":
+                self.injector.disarm_serving(op.get("only"))
+                self._reply(conn, {"disarmed": True})
+                return
+            kwargs = {k: v for k, v in op.items() if k not in ("op", "point")}
+            self.injector.arm_serving(str(point), **kwargs)
+            self._reply(conn, {"armed": str(point)})
+        except (ValueError, TypeError) as e:
+            self._reply(conn, _error_doc(e))
 
     # -- the submit op ---------------------------------------------------
     def _handle_submit(self, conn, op):
@@ -350,9 +379,20 @@ def _build_engine(spec):
     cfg = GPT2Config(**model)
     _, params = init_gpt2(cfg, batch_size=1, seq_len=8,
                           seed=int(spec.get("seed", 0)))
+    injector = None
+    if spec.get("chaos"):
+        # chaos-harness replicas carry an (unarmed) injector so the
+        # "inject" socket op can arm fault points at runtime; normal
+        # fleet replicas stay injector-free (an injector claims full
+        # lanes in _alloc_tokens, which changes packing behavior)
+        from deepspeed_tpu.inference.serving.fault_injection import (
+            ServingFaultInjector,
+        )
+        injector = ServingFaultInjector()
     return ServingEngine.from_config(
         params, cfg, dict(spec.get("ds_config") or {}),
-        rank=int(os.environ.get("RANK", "0")))
+        rank=int(os.environ.get("RANK", "0")),
+        injector=injector)
 
 
 def replica_main(argv=None):

@@ -141,6 +141,11 @@ class ReplicaEndpoint:
         self.load_hint = 0          # queue_depth + active from last probe
         self.inflight = 0           # attempts the router has on this replica
         self.last_probe = 0.0
+        # last SUCCESSFUL probe: a cached health snapshot older than
+        # 2 x health_ttl_s is treated as unhealthy rather than routed on
+        # (stale scores). Seeded to construction time: a fresh endpoint
+        # gets one staleness window of benefit of the doubt.
+        self.last_ok = time.monotonic()
         self.failures = 0           # consecutive probe/attempt failures
 
     @property
@@ -194,6 +199,7 @@ class Router:
         self._lock = threading.Lock()
         self._inflight_tokens = {}      # class -> tokens in flight
         self._inflight_requests = 0
+        self._degrade_rung = 0          # rung 3 sheds classes at the door
         self._threads = set()
         self._closed = False
         self._counters = {
@@ -223,6 +229,8 @@ class Router:
         out["healthy_replicas"] = float(
             sum(1 for ep in self._endpoints
                 if ep.healthy and not ep.draining))
+        out["replicas"] = float(len(self._endpoints))
+        out["degrade_rung"] = float(self._degrade_rung)
         return out
 
     def export_gauges(self, registry):
@@ -259,6 +267,7 @@ class Router:
                 ep.load_hint = (int(doc.get("queue_depth", 0))
                                 + int(doc.get("active_requests", 0)))
             ep.failures = 0
+            ep.last_ok = now
         except (OSError, ValueError):
             ep.healthy = False
             ep.failures += 1
@@ -276,11 +285,68 @@ class Router:
     def probe_all(self, force=True):
         """Refresh every endpoint's health view; returns the endpoints."""
         now = time.monotonic()
-        for ep in self._endpoints:
+        eps = self._endpoints
+        for ep in eps:
             self._probe(ep, now=now, force=force)
+        return list(eps)
+
+    # -- fleet membership (the autoscaler's contract) --------------------
+    def endpoints(self):
+        """Current endpoint list (a snapshot)."""
         return list(self._endpoints)
 
-    def _routable(self, ep):
+    def add_endpoint(self, ep):
+        """Attach a replica to the rotation (the autoscaler's scale-up:
+        the process is already warm and listening, attach is O(1)).
+        The list is re-sorted by name so the affinity hash stays stable
+        across router processes."""
+        if not isinstance(ep, ReplicaEndpoint):
+            ep = ReplicaEndpoint(*ep)
+        with self._lock:
+            if any(e.name == ep.name for e in self._endpoints):
+                raise ValueError(f"endpoint {ep.name!r} already routed")
+            eps = self._endpoints + [ep]
+            eps.sort(key=lambda e: e.name)
+            self._endpoints = eps           # atomic swap: readers snapshot
+        return ep
+
+    def remove_endpoint(self, name):
+        """Detach a replica from the rotation (the autoscaler's
+        scale-down: the caller then SIGTERMs the process, which drains
+        and exits ``EXIT_PREEMPTED``). In-flight attempts on it finish
+        where they are; the endpoint is marked draining so nothing new
+        lands during the handoff. Refuses to empty the fleet."""
+        with self._lock:
+            ep = next((e for e in self._endpoints if e.name == name), None)
+            if ep is None:
+                raise ValueError(f"no endpoint named {name!r}")
+            if len(self._endpoints) == 1:
+                raise ValueError("cannot remove the last endpoint")
+            self._endpoints = [e for e in self._endpoints if e is not ep]
+        ep.draining = True
+        return ep
+
+    # -- degraded-mode ladder (rung 3 lives here) ------------------------
+    def set_degrade_rung(self, rung):
+        """Fleet degrade rung as pushed by the autoscaler (or a test).
+        The router acts on rung >= 3: per-class shedding at admission.
+        Edge-triggered bookkeeping only — instants are the ladder
+        owner's job."""
+        self._degrade_rung = max(0, int(rung))
+        return self._degrade_rung
+
+    @property
+    def degrade_rung(self):
+        return self._degrade_rung
+
+    def _routable(self, ep, now=None):
+        ttl = self.config.health_ttl_s
+        if ttl > 0:
+            now = time.monotonic() if now is None else now
+            if now - ep.last_ok > 2.0 * ttl:
+                # the health view went stale (probes failing or never
+                # completing): don't route on old scores
+                return False
         return ep.healthy and not ep.draining
 
     def _load(self, ep):
@@ -290,25 +356,27 @@ class Router:
         return self._load(ep) >= max(1, self.config.saturation_queue_depth)
 
     # -- routing policy --------------------------------------------------
-    def _affinity_target(self, prompt):
+    def _affinity_target(self, prompt, eps=None):
         n = self.config.affinity_prefix_tokens
-        if n <= 0:
+        eps = self._endpoints if eps is None else eps
+        if n <= 0 or not eps:
             return None
         prefix = ",".join(str(int(t)) for t in prompt[:n]).encode("ascii")
-        return self._endpoints[zlib.crc32(prefix) % len(self._endpoints)]
+        return eps[zlib.crc32(prefix) % len(eps)]
 
     def _pick(self, rr, avoid=None):
         """Affinity target when healthy and unsaturated; else the
         least-loaded routable replica; None when nothing is routable."""
         now = time.monotonic()
-        for ep in self._endpoints:
+        eps = self._endpoints        # snapshot: add/remove swaps the list
+        for ep in eps:
             self._probe(ep, now=now)
-        candidates = [ep for ep in self._endpoints if self._routable(ep)]
+        candidates = [ep for ep in eps if self._routable(ep, now=now)]
         if avoid is not None and len(candidates) > 1:
             candidates = [ep for ep in candidates if ep is not avoid]
         if not candidates:
             return None
-        target = self._affinity_target(rr.prompt)
+        target = self._affinity_target(rr.prompt, eps)
         if (target is not None and target in candidates
                 and not self._saturated(target)):
             return target
@@ -321,8 +389,27 @@ class Router:
             b = b.get(request_class, b.get("default", 0))
         return int(b or 0)
 
+    def _shed_class(self, request_class):
+        """Rung-3 (class_shed) verdict for one request class: the
+        configured ``fleet.degrade.shed_classes``, or — with an empty
+        list — every class EXCEPT the protected ``"default"``."""
+        if self._degrade_rung < 3:
+            return False
+        classes = tuple(getattr(self.config.degrade, "shed_classes", ())
+                        if getattr(self.config, "degrade", None) is not None
+                        else ())
+        if classes:
+            return request_class in classes
+        return request_class != "default"
+
     def _admit(self, rr):
         """Shed checks; reserves the class token budget on success."""
+        if self._shed_class(rr.request_class):
+            with self._lock:
+                self._counters["shed"] += 1
+            raise FleetOverloadError(
+                "degraded", self.config.shed_retry_after_s,
+                request_class=rr.request_class)
         budget = self._class_budget(rr.request_class)
         with self._lock:
             used = self._inflight_tokens.get(rr.request_class, 0)
@@ -353,10 +440,13 @@ class Router:
     # -- public API ------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, eos_token_id=None,
                timeout_s=None, stream_cb=None, request_class="default",
-               key=None):
+               key=None, shed_retries=0):
         """Route one request; returns a :class:`ServingFuture`.
 
         Raises :class:`FleetOverloadError` synchronously when shedding.
+        ``shed_retries`` re-admits a shed request up to that many times,
+        honoring the error's ``retry_after_s`` hint between attempts, so
+        callers get load-aware backoff instead of a hot retry loop.
         Every other outcome — success, terminal error from the replica,
         :class:`RequestPoisonedError` after budget exhaustion — is
         delivered through the future."""
@@ -371,7 +461,16 @@ class Router:
             None if max_new_tokens is None else int(max_new_tokens),
             None if eos_token_id is None else int(eos_token_id),
             timeout_s, stream_cb, request_class, cost)
-        self._admit(rr)
+        attempts_left = max(0, int(shed_retries))
+        while True:
+            try:
+                self._admit(rr)
+                break
+            except FleetOverloadError as exc:
+                if attempts_left <= 0 or self._closed:
+                    raise
+                attempts_left -= 1
+                time.sleep(max(0.0, float(exc.retry_after_s)))
         t = threading.Thread(target=self._run_request, args=(rr,),
                              name=f"router-{rr.key[:8]}", daemon=True)
         with self._lock:

@@ -85,6 +85,109 @@ def classify_exit(returncode, fatal_exit_codes=(EXIT_POISONED,)):
     return CLASS_CRASH
 
 
+class CrashLoopBreaker:
+    """Per-worker crash-loop circuit breaker (the ``fleet.breaker`` block).
+
+    Exponential backoff alone caps restart RATE but still burns the
+    restart budget on a worker that dies the same way every time — and a
+    serving replica mid-crash-loop keeps a live-looking endpoint the
+    router wastes retries on. The breaker adds the missing state:
+
+    - ``closed``: failures accumulate; ``threshold`` failure exits
+      (crash/hung — never clean or preempted) inside ``window_s`` OPEN
+      the breaker.
+    - ``open``: the worker stays down for ``cooldown_s`` (its dead port
+      makes the router's health probe fail, so the fleet routes around
+      the quarantined endpoint without any extra coordination).
+    - ``half_open``: after the cooldown exactly ONE probe restart is
+      allowed. The probe failing re-opens with a fresh cooldown; the
+      probe exiting clean/preempted closes the breaker.
+
+    Deliberately clock-injectable and supervisor-agnostic so the chaos
+    harness and unit tests can drive it through years of simulated
+    crash-loops in milliseconds."""
+
+    def __init__(self, threshold=3, window_s=30.0, cooldown_s=5.0,
+                 clock=time.monotonic):
+        self.threshold = max(1, int(threshold))
+        self.window_s = float(window_s)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self.state = "closed"
+        self.open_count = 0             # times the breaker has opened
+        self._failures = []             # failure timestamps inside window
+        self._opened_at = 0.0
+
+    @classmethod
+    def from_config(cls, cfg, clock=time.monotonic):
+        """Build from a ``BreakerConfig``-shaped object or dict; None when
+        the block is absent or disabled."""
+        if cfg is None or isinstance(cfg, CrashLoopBreaker):
+            return cfg
+        if isinstance(cfg, dict):
+            if not cfg.get("enabled", True):
+                return None
+            return cls(threshold=cfg.get("threshold", 3),
+                       window_s=cfg.get("window_s", 30.0),
+                       cooldown_s=cfg.get("cooldown_s", 5.0), clock=clock)
+        if not getattr(cfg, "enabled", True):
+            return None
+        return cls(threshold=getattr(cfg, "threshold", 3),
+                   window_s=getattr(cfg, "window_s", 30.0),
+                   cooldown_s=getattr(cfg, "cooldown_s", 5.0), clock=clock)
+
+    @property
+    def is_open(self):
+        return self.state == "open"
+
+    def record_failure(self, now=None):
+        """Note one failure exit; returns True when this failure OPENS
+        the breaker (the edge the telemetry instant fires on)."""
+        now = self._clock() if now is None else now
+        if self.state == "half_open":
+            # the single probe failed: straight back to quarantine with a
+            # fresh cooldown (and a fresh window — the probe IS evidence)
+            self.state = "open"
+            self._opened_at = now
+            self._failures = [now]
+            self.open_count += 1
+            return True
+        self._failures = [t for t in self._failures
+                          if now - t <= self.window_s]
+        self._failures.append(now)
+        if self.state == "closed" and len(self._failures) >= self.threshold:
+            self.state = "open"
+            self._opened_at = now
+            self.open_count += 1
+            return True
+        return False
+
+    def record_success(self, now=None):
+        """A clean/preempted exit closes the breaker and clears history."""
+        self.state = "closed"
+        self._failures = []
+
+    def restart_delay_s(self, now=None):
+        """Seconds the supervisor must hold the worker down: the
+        remaining quarantine when open, else 0 (normal backoff rules)."""
+        if self.state != "open":
+            return 0.0
+        now = self._clock() if now is None else now
+        return max(0.0, self._opened_at + self.cooldown_s - now)
+
+    def allow_probe(self, now=None):
+        """True when a restart may proceed. An open breaker past its
+        cooldown transitions to half_open (the one probe); an open
+        breaker inside it refuses."""
+        if self.state != "open":
+            return True
+        now = self._clock() if now is None else now
+        if now >= self._opened_at + self.cooldown_s:
+            self.state = "half_open"
+            return True
+        return False
+
+
 class WorkerSupervisor:
     """Run one worker command under restart supervision.
 
@@ -97,7 +200,8 @@ class WorkerSupervisor:
                  max_backoff_s=30.0, heartbeat_timeout_s=0.0,
                  heartbeat_file=None, poll_interval_s=0.05, term_grace_s=5.0,
                  fatal_exit_codes=(EXIT_POISONED,), log=None, http_port=None,
-                 worker_port=None, replica_port=None, replica_config=None):
+                 worker_port=None, replica_port=None, replica_config=None,
+                 breaker=None, rank=None):
         self.cmd = list(cmd)
         self.env = dict(env if env is not None else os.environ)
         self.max_restarts = int(max_restarts)
@@ -129,6 +233,13 @@ class WorkerSupervisor:
             self.env[REPLICA_PORT_ENV] = str(int(replica_port))
         if replica_config is not None:
             self.env[REPLICA_CONFIG_ENV] = str(replica_config)
+
+        # crash-loop circuit breaker (fleet.breaker): accepts a built
+        # CrashLoopBreaker, a BreakerConfig-shaped object/dict, or None
+        self.breaker = CrashLoopBreaker.from_config(breaker)
+        self.rank = int(rank if rank is not None
+                        else os.environ.get("RANK", "0") or 0)
+        self.consecutive_failures = 0   # failure exits since last clean
 
         self.child = None
         self.restarts = 0
@@ -170,6 +281,12 @@ class WorkerSupervisor:
                 return returncode
             cls = CLASS_HUNG if hung else classify_exit(returncode, self.fatal_exit_codes)
             self.exit_history.append((cls, returncode))
+            if cls in (CLASS_CLEAN, CLASS_PREEMPTED):
+                self.consecutive_failures = 0
+                if self.breaker is not None:
+                    self.breaker.record_success()
+            else:
+                self.consecutive_failures += 1
             self._note_exit(cls, returncode)
             if cls == CLASS_CLEAN:
                 return EXIT_CLEAN
@@ -187,6 +304,16 @@ class WorkerSupervisor:
                 delay = 0.0  # resumable checkpoint committed: come back fast
             else:
                 delay = min(self.backoff_s * (2 ** (self.restarts - 1)), self.max_backoff_s)
+            if self.breaker is not None and cls in (CLASS_CRASH, CLASS_HUNG):
+                if self.breaker.record_failure():
+                    self._note_breaker_open(cls, returncode)
+                    self._log(
+                        f"crash-loop breaker OPEN after "
+                        f"{self.consecutive_failures} consecutive failures; "
+                        f"quarantined {self.breaker.cooldown_s:.1f}s"
+                    )
+                # quarantine dominates backoff while the breaker is open
+                delay = max(delay, self.breaker.restart_delay_s())
             self._note_restart(cls, returncode, delay)
             self._log(
                 f"worker {cls} (exit {returncode}); restart "
@@ -194,6 +321,9 @@ class WorkerSupervisor:
             )
             if delay > 0:
                 time.sleep(delay)
+            if self.breaker is not None:
+                # open -> half_open: the next spawn is the single probe
+                self.breaker.allow_probe()
 
     def _spawn(self):
         self.child = subprocess.Popen(self.cmd, env=self.env)
@@ -319,6 +449,17 @@ class WorkerSupervisor:
                           help="worker restarts performed so far")
         registry.gauge_fn("Supervisor/worker", _liveness,
                           help="supervised worker liveness")
+        # fleet-facing per-rank health: the collector's Fleet/* rollups
+        # (and the autoscaler reading them) see crash-loop state without
+        # parsing exit history; both reset on a clean/preempted exit
+        registry.gauge_fn(
+            f"Fleet/rank{self.rank}/restarts_consecutive",
+            lambda: float(self.consecutive_failures),
+            help="failure exits since this worker last exited clean")
+        registry.gauge_fn(
+            f"Fleet/rank{self.rank}/breaker_open",
+            lambda: float(self.breaker is not None and self.breaker.is_open),
+            help="1 while this worker's crash-loop breaker is open")
         return registry
 
     def _worker_health(self):
@@ -354,6 +495,20 @@ class WorkerSupervisor:
         tel.get_registry().counter(
             f"Supervisor/exits/{cls}",
             help="worker exits by supervision class").inc()
+
+    def _note_breaker_open(self, cls, returncode):
+        tel = self._telemetry()
+        if tel is None:
+            return
+        tel.instant("fleet/breaker_open", cat="fleet",
+                    args={"rank": self.rank, "class": cls,
+                          "returncode": returncode,
+                          "consecutive_failures": self.consecutive_failures,
+                          "cooldown_s": self.breaker.cooldown_s,
+                          "open_count": self.breaker.open_count})
+        tel.get_registry().counter(
+            "Fleet/breaker_opens_total",
+            help="crash-loop breaker open events").inc()
 
     def _note_restart(self, cls, returncode, delay):
         tel = self._telemetry()

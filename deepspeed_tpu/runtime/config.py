@@ -661,6 +661,172 @@ def get_serving_config(param_dict):
     )
 
 
+def _get_fleet_autoscale(params):
+    """fleet.autoscale sub-block: the SLO-driven control loop. Opt-in
+    by presence, like every fleet sub-block."""
+    from deepspeed_tpu.inference.serving.config import AutoscaleConfig
+
+    section = params.get(FLEET_AUTOSCALE, None)
+    if section is not None and not isinstance(section, dict):
+        raise ValueError(
+            f"fleet.{FLEET_AUTOSCALE} must be a dict, "
+            f"got {type(section).__name__}"
+        )
+    sub = section or {}
+    enabled = bool(get_scalar_param(sub, FLEET_AUTOSCALE_ENABLED, section is not None))
+    ints = (
+        (FLEET_AUTOSCALE_MIN_REPLICAS, FLEET_AUTOSCALE_MIN_REPLICAS_DEFAULT,
+         1, "scale-down floor"),
+        (FLEET_AUTOSCALE_MAX_REPLICAS, FLEET_AUTOSCALE_MAX_REPLICAS_DEFAULT,
+         1, "scale-up ceiling"),
+        (FLEET_AUTOSCALE_WARM_SPARES, FLEET_AUTOSCALE_WARM_SPARES_DEFAULT,
+         0, "pre-spawned replicas kept out of rotation"),
+    )
+    ivals = {}
+    for key, default, floor, what in ints:
+        v = get_scalar_param(sub, key, default)
+        if not isinstance(v, int) or isinstance(v, bool) or v < floor:
+            raise ValueError(
+                f"fleet.{FLEET_AUTOSCALE}.{key} must be an int >= {floor} "
+                f"({what}), got {v!r}"
+            )
+        ivals[key] = v
+    if ivals[FLEET_AUTOSCALE_MIN_REPLICAS] > ivals[FLEET_AUTOSCALE_MAX_REPLICAS]:
+        raise ValueError(
+            f"fleet.{FLEET_AUTOSCALE}.{FLEET_AUTOSCALE_MIN_REPLICAS}="
+            f"{ivals[FLEET_AUTOSCALE_MIN_REPLICAS]} must not exceed "
+            f"{FLEET_AUTOSCALE_MAX_REPLICAS}="
+            f"{ivals[FLEET_AUTOSCALE_MAX_REPLICAS]}"
+        )
+    numbers = (
+        (FLEET_AUTOSCALE_UP_AFTER, FLEET_AUTOSCALE_UP_AFTER_DEFAULT,
+         "sustained-alert window before scale-up"),
+        (FLEET_AUTOSCALE_DOWN_AFTER, FLEET_AUTOSCALE_DOWN_AFTER_DEFAULT,
+         "alert-quiet window before scale-down"),
+        (FLEET_AUTOSCALE_COOLDOWN, FLEET_AUTOSCALE_COOLDOWN_DEFAULT,
+         "minimum gap between scaling actions"),
+        (FLEET_AUTOSCALE_POLL_INTERVAL, FLEET_AUTOSCALE_POLL_INTERVAL_DEFAULT,
+         "control-loop tick interval"),
+    )
+    fvals = {}
+    for key, default, what in numbers:
+        v = get_scalar_param(sub, key, default)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            raise ValueError(
+                f"fleet.{FLEET_AUTOSCALE}.{key} must be a number >= 0 "
+                f"({what}), got {v!r}"
+            )
+        fvals[key] = float(v)
+    return AutoscaleConfig(
+        enabled=enabled,
+        min_replicas=ivals[FLEET_AUTOSCALE_MIN_REPLICAS],
+        max_replicas=ivals[FLEET_AUTOSCALE_MAX_REPLICAS],
+        warm_spares=ivals[FLEET_AUTOSCALE_WARM_SPARES],
+        up_after_s=fvals[FLEET_AUTOSCALE_UP_AFTER],
+        down_after_s=fvals[FLEET_AUTOSCALE_DOWN_AFTER],
+        cooldown_s=fvals[FLEET_AUTOSCALE_COOLDOWN],
+        poll_interval_s=fvals[FLEET_AUTOSCALE_POLL_INTERVAL],
+    )
+
+
+def _get_fleet_degrade(params):
+    """fleet.degrade sub-block: the degraded-mode ladder."""
+    from deepspeed_tpu.inference.serving.config import DegradeConfig
+
+    section = params.get(FLEET_DEGRADE, None)
+    if section is not None and not isinstance(section, dict):
+        raise ValueError(
+            f"fleet.{FLEET_DEGRADE} must be a dict, "
+            f"got {type(section).__name__}"
+        )
+    sub = section or {}
+    enabled = bool(get_scalar_param(sub, FLEET_DEGRADE_ENABLED, section is not None))
+    numbers = (
+        (FLEET_DEGRADE_ESCALATE_AFTER, FLEET_DEGRADE_ESCALATE_AFTER_DEFAULT,
+         "sustained pressure before climbing one rung"),
+        (FLEET_DEGRADE_RECOVER_AFTER, FLEET_DEGRADE_RECOVER_AFTER_DEFAULT,
+         "sustained quiet before descending one rung"),
+    )
+    fvals = {}
+    for key, default, what in numbers:
+        v = get_scalar_param(sub, key, default)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            raise ValueError(
+                f"fleet.{FLEET_DEGRADE}.{key} must be a number >= 0 "
+                f"({what}), got {v!r}"
+            )
+        fvals[key] = float(v)
+    frac = get_scalar_param(sub, FLEET_DEGRADE_PRESSURE_QUEUE_FRAC,
+                            FLEET_DEGRADE_PRESSURE_QUEUE_FRAC_DEFAULT)
+    if not isinstance(frac, (int, float)) or isinstance(frac, bool) \
+            or not 0 < frac <= 1:
+        raise ValueError(
+            f"fleet.{FLEET_DEGRADE}.{FLEET_DEGRADE_PRESSURE_QUEUE_FRAC} "
+            f"must be a number in (0, 1] (queue-depth fraction that counts "
+            f"as pressure), got {frac!r}"
+        )
+    shed = sub.get(FLEET_DEGRADE_SHED_CLASSES,
+                   FLEET_DEGRADE_SHED_CLASSES_DEFAULT)
+    if not isinstance(shed, (list, tuple)) or any(
+            not isinstance(c, str) or not c for c in shed):
+        raise ValueError(
+            f"fleet.{FLEET_DEGRADE}.{FLEET_DEGRADE_SHED_CLASSES} must be a "
+            f"list of request-class names (empty = every class except "
+            f"'default'), got {shed!r}"
+        )
+    return DegradeConfig(
+        enabled=enabled,
+        escalate_after_s=fvals[FLEET_DEGRADE_ESCALATE_AFTER],
+        recover_after_s=fvals[FLEET_DEGRADE_RECOVER_AFTER],
+        pressure_queue_frac=float(frac),
+        shed_classes=tuple(shed),
+    )
+
+
+def _get_fleet_breaker(params):
+    """fleet.breaker sub-block: per-replica crash-loop circuit breakers."""
+    from deepspeed_tpu.inference.serving.config import BreakerConfig
+
+    section = params.get(FLEET_BREAKER, None)
+    if section is not None and not isinstance(section, dict):
+        raise ValueError(
+            f"fleet.{FLEET_BREAKER} must be a dict, "
+            f"got {type(section).__name__}"
+        )
+    sub = section or {}
+    enabled = bool(get_scalar_param(sub, FLEET_BREAKER_ENABLED, section is not None))
+    threshold = get_scalar_param(sub, FLEET_BREAKER_THRESHOLD,
+                                 FLEET_BREAKER_THRESHOLD_DEFAULT)
+    if not isinstance(threshold, int) or isinstance(threshold, bool) \
+            or threshold < 1:
+        raise ValueError(
+            f"fleet.{FLEET_BREAKER}.{FLEET_BREAKER_THRESHOLD} must be an "
+            f"int >= 1 (failure exits in the window that open the "
+            f"breaker), got {threshold!r}"
+        )
+    numbers = (
+        (FLEET_BREAKER_WINDOW, FLEET_BREAKER_WINDOW_DEFAULT,
+         "sliding failure-count window"),
+        (FLEET_BREAKER_COOLDOWN, FLEET_BREAKER_COOLDOWN_DEFAULT,
+         "quarantine before the half-open probe restart"),
+    )
+    fvals = {}
+    for key, default, what in numbers:
+        v = get_scalar_param(sub, key, default)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            raise ValueError(
+                f"fleet.{FLEET_BREAKER}.{key} must be a number >= 0 "
+                f"({what}), got {v!r}"
+            )
+        fvals[key] = float(v)
+    return BreakerConfig(
+        enabled=enabled,
+        threshold=threshold,
+        window_s=fvals[FLEET_BREAKER_WINDOW],
+        cooldown_s=fvals[FLEET_BREAKER_COOLDOWN],
+    )
+
+
 def get_fleet_config(param_dict):
     """fleet: routing front-door over N serving replicas
     (inference/serving/router.py, replica.py). Opt-in like the serving
@@ -758,6 +924,9 @@ def get_fleet_config(param_dict):
         saturation_queue_depth=saturation,
         max_inflight_tokens=inflight,
         shed_retry_after_s=vals[FLEET_SHED_RETRY_AFTER],
+        autoscale=_get_fleet_autoscale(params),
+        degrade=_get_fleet_degrade(params),
+        breaker=_get_fleet_breaker(params),
     )
 
 

@@ -274,6 +274,48 @@ FLEET_MAX_INFLIGHT_TOKENS_DEFAULT = 0  # 0 = unbounded; int or {class: n}
 FLEET_SHED_RETRY_AFTER = "shed_retry_after_s"
 FLEET_SHED_RETRY_AFTER_DEFAULT = 0.5
 
+# fleet.autoscale: SLO-driven replica-count control loop
+# (inference/serving/autoscaler.py). Opt-in by sub-block presence.
+FLEET_AUTOSCALE = "autoscale"
+FLEET_AUTOSCALE_ENABLED = "enabled"
+FLEET_AUTOSCALE_MIN_REPLICAS = "min_replicas"
+FLEET_AUTOSCALE_MIN_REPLICAS_DEFAULT = 1
+FLEET_AUTOSCALE_MAX_REPLICAS = "max_replicas"
+FLEET_AUTOSCALE_MAX_REPLICAS_DEFAULT = 4
+FLEET_AUTOSCALE_WARM_SPARES = "warm_spares"
+FLEET_AUTOSCALE_WARM_SPARES_DEFAULT = 1  # 0 = cold-start scale-up
+FLEET_AUTOSCALE_UP_AFTER = "up_after_s"
+FLEET_AUTOSCALE_UP_AFTER_DEFAULT = 1.0
+FLEET_AUTOSCALE_DOWN_AFTER = "down_after_s"
+FLEET_AUTOSCALE_DOWN_AFTER_DEFAULT = 5.0
+FLEET_AUTOSCALE_COOLDOWN = "cooldown_s"
+FLEET_AUTOSCALE_COOLDOWN_DEFAULT = 2.0
+FLEET_AUTOSCALE_POLL_INTERVAL = "poll_interval_s"
+FLEET_AUTOSCALE_POLL_INTERVAL_DEFAULT = 0.25
+
+# fleet.degrade: degraded-mode ladder (inference/serving/degrade.py).
+FLEET_DEGRADE = "degrade"
+FLEET_DEGRADE_ENABLED = "enabled"
+FLEET_DEGRADE_ESCALATE_AFTER = "escalate_after_s"
+FLEET_DEGRADE_ESCALATE_AFTER_DEFAULT = 0.5
+FLEET_DEGRADE_RECOVER_AFTER = "recover_after_s"
+FLEET_DEGRADE_RECOVER_AFTER_DEFAULT = 2.0
+FLEET_DEGRADE_PRESSURE_QUEUE_FRAC = "pressure_queue_frac"
+FLEET_DEGRADE_PRESSURE_QUEUE_FRAC_DEFAULT = 0.75
+FLEET_DEGRADE_SHED_CLASSES = "shed_classes"
+FLEET_DEGRADE_SHED_CLASSES_DEFAULT = ()  # empty = all but "default"
+
+# fleet.breaker: per-replica crash-loop circuit breakers
+# (launcher/supervisor.py CrashLoopBreaker).
+FLEET_BREAKER = "breaker"
+FLEET_BREAKER_ENABLED = "enabled"
+FLEET_BREAKER_THRESHOLD = "threshold"
+FLEET_BREAKER_THRESHOLD_DEFAULT = 3
+FLEET_BREAKER_WINDOW = "window_s"
+FLEET_BREAKER_WINDOW_DEFAULT = 30.0
+FLEET_BREAKER_COOLDOWN = "cooldown_s"
+FLEET_BREAKER_COOLDOWN_DEFAULT = 5.0
+
 #############################################
 # Sparse attention
 #############################################

@@ -123,6 +123,9 @@ class StubReplica:
                         "queue_depth": self.queue_depth,
                         "active_requests": 0})
                     return
+                if op["op"] == "degrade":   # fleet rung fan-out: just ack
+                    send_line(conn, {"rung": int(op.get("rung", 0))})
+                    return
                 with self.lock:
                     self.submits.append((op["key"], int(op.get("from", 0))))
                     if self.reject is not None and self.reject_times > 0:
@@ -542,6 +545,55 @@ def test_sigterm_drains_without_killing_inflight(tmp_path):
         # post-drain traffic lands on the backup
         out2 = r.submit([1, 2, 3], max_new_tokens=6).result(timeout=600)
         assert out2 == _reference([[1, 2, 3]], 6)[0]
+        assert r.counters()["poisoned"] == 0
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait(timeout=30)
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+def test_sigterm_mid_chunked_prefill_holds_oracle(tmp_path):
+    """SIGTERM lands while a long prompt is still CHUNKING through
+    prefill (prefill_chunk_tokens=8, 48-token prompt: six chunks, the
+    signal arrives during the first compile). The accepted request must
+    either finish on the draining replica or fail over — either way the
+    output is bitwise-identical to generate() and nothing poisons."""
+    from deepspeed_tpu.launcher.supervisor import EXIT_PREEMPTED
+
+    procs = []
+    try:
+        primary, p0 = _spawn_replica(
+            tmp_path, "primary",
+            serving_overrides={"prefill_chunk_tokens": 8})
+        backup, p1 = _spawn_replica(
+            tmp_path, "backup",
+            serving_overrides={"prefill_chunk_tokens": 8})
+        procs = [primary, backup]
+        r = Router(
+            [ReplicaEndpoint("primary", "127.0.0.1", p0),
+             ReplicaEndpoint("backup", "127.0.0.1", p1)],
+            FleetConfig(enabled=True, retry_budget=3, retry_backoff_s=0.05,
+                        attempt_timeout_s=300.0, health_ttl_s=0.1,
+                        affinity_prefix_tokens=0))
+        prompt = [(i * 13 + 5) % MODEL["vocab_size"] for i in range(48)]
+        n_new = 8
+        # park the request on the primary (same bias as the drain test)
+        eps = {e.name: e for e in r.probe_all()}
+        now = time.monotonic()
+        eps["backup"].load_hint = 50
+        eps["backup"].last_probe = now + 5.0
+        eps["primary"].healthy = True
+        eps["primary"].load_hint = 0
+        eps["primary"].last_probe = now + 5.0
+        f = r.submit(prompt, max_new_tokens=n_new, timeout_s=600.0)
+        time.sleep(0.2)                     # accepted; prefill still chunking
+        assert not f.tokens, "prefill finished before the SIGTERM landed"
+        primary.send_signal(signal.SIGTERM)
+        out = f.result(timeout=600)
+        assert out == _reference([prompt], n_new)[0]
+        assert primary.wait(timeout=120) == EXIT_PREEMPTED
         assert r.counters()["poisoned"] == 0
     finally:
         for p in procs:

@@ -8,8 +8,8 @@ Two modes:
     numeric types, ``complete: true``). Defaults to the committed
     baselines (``SERVING_BENCH_CPU.json`` + ``BENCH_r05.json`` +
     ``LONGDOC_BENCH_CPU.json`` + ``FLEET_BENCH_CPU.json`` +
-    ``KERNEL_BENCH_CPU.json``). This is the CI step: it needs no jax
-    and takes milliseconds.
+    ``KERNEL_BENCH_CPU.json`` + ``CHAOS_BENCH_CPU.json``). This is the
+    CI step: it needs no jax and takes milliseconds.
 
 ``compare FRESH BASELINE``
     Diff a fresh bench run against a committed baseline under per-key
@@ -21,10 +21,11 @@ Artifact kinds are auto-detected: a dict with a ``parsed`` key is a
 driver wrapper (``BENCH_r05.json``) and is unwrapped;
 ``speedup_sparse_vs_dense_16k`` marks a long-document serving artifact
 (``LONGDOC_BENCH_CPU.json``); ``fleet_scaling_2x`` marks a fleet
-scale-out artifact (``FLEET_BENCH_CPU.json``); ``decode_pallas_us``
-marks a kernel-tier microbench artifact (``KERNEL_BENCH_CPU.json``);
-``tokens_per_sec`` marks a serving artifact; ``metric`` marks a train
-artifact. Contexts
+scale-out artifact (``FLEET_BENCH_CPU.json``); ``chaos_episodes`` marks
+a chaos-harness artifact (``CHAOS_BENCH_CPU.json``);
+``decode_pallas_us`` marks a kernel-tier microbench artifact
+(``KERNEL_BENCH_CPU.json``); ``tokens_per_sec`` marks a serving
+artifact; ``metric`` marks a train artifact. Contexts
 must match before numbers are compared — platform, model and workload
 knobs for serving; the metric string for train — otherwise the compare
 is skipped with exit 0 (a CPU artifact is not a regression signal for a
@@ -51,7 +52,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DEFAULT_ARTIFACTS = ("SERVING_BENCH_CPU.json", "BENCH_r05.json",
                      "LONGDOC_BENCH_CPU.json", "FLEET_BENCH_CPU.json",
-                     "KERNEL_BENCH_CPU.json")
+                     "KERNEL_BENCH_CPU.json", "CHAOS_BENCH_CPU.json")
 
 # -- tolerance profiles -------------------------------------------------
 # key -> (direction, rel_tol). direction "higher" means bigger is better:
@@ -122,6 +123,15 @@ KERNELS_TOLERANCES = {
     "band_xla_us":           ("lower", 2.00),
 }
 
+# Chaos leg: recovery times on a shared CPU runner are pure noise, so
+# only the episode/throughput counters get (very loose) bands — the real
+# gate is the schema check refusing any baseline whose invariant flags
+# are false or whose schedule ran short.
+CHAOS_TOLERANCES = {
+    "completed_total": ("higher", 0.50),
+    "recovery_p95_s":  ("lower", 10.00),
+}
+
 # context keys that must match exactly for numbers to be comparable
 SERVING_CONTEXT = ("platform", "model", "requests", "max_slots",
                    "max_new_tokens", "speculative_k", "kv_cache_dtype",
@@ -140,6 +150,9 @@ FLEET_CONTEXT = ("platform", "model", "requests", "max_new_tokens",
 # kernel times are different universes and must never gate each other.
 KERNELS_CONTEXT = ("platform", "interpret", "iters", "decode_shape",
                    "band_shape")
+# the seed is load-bearing: two different seeds run two different fault
+# schedules, so their counters are not comparable.
+CHAOS_CONTEXT = ("platform", "model", "chaos_seed", "chaos_episodes")
 
 # -- schema -------------------------------------------------------------
 SERVING_REQUIRED = {
@@ -189,6 +202,19 @@ KERNELS_REQUIRED = {
     "band_parity_ok": bool, "complete": bool,
 }
 
+CHAOS_REQUIRED = {
+    "platform": str, "model": str, "chaos_episodes": int, "chaos_seed": int,
+    "completed_total": int, "shed_total": int,
+    "recovery_p50_s": (int, float), "recovery_p95_s": (int, float),
+    "invariant_bitwise_ok": bool, "invariant_no_stuck": bool,
+    "invariant_recovery_bounded": bool, "invariant_converged": bool,
+    "complete": bool,
+}
+
+# chaos acceptance floor: the committed schedule must compose at least
+# this many episodes (the issue's bar) to count as evidence
+CHAOS_MIN_EPISODES = 20
+
 # the PR's acceptance floor: sparse must beat dense end-to-end at the
 # 16k bucket by at least this factor for the artifact to be a baseline
 LONGDOC_MIN_SPEEDUP = 5.0
@@ -199,18 +225,18 @@ FLEET_MIN_SCALING_2X = 1.8
 
 TOLERANCES = {"serving": SERVING_TOLERANCES, "train": TRAIN_TOLERANCES,
               "longdoc": LONGDOC_TOLERANCES, "fleet": FLEET_TOLERANCES,
-              "kernels": KERNELS_TOLERANCES}
+              "kernels": KERNELS_TOLERANCES, "chaos": CHAOS_TOLERANCES}
 CONTEXTS = {"serving": SERVING_CONTEXT, "train": TRAIN_CONTEXT,
             "longdoc": LONGDOC_CONTEXT, "fleet": FLEET_CONTEXT,
-            "kernels": KERNELS_CONTEXT}
+            "kernels": KERNELS_CONTEXT, "chaos": CHAOS_CONTEXT}
 REQUIRED = {"serving": SERVING_REQUIRED, "train": TRAIN_REQUIRED,
             "longdoc": LONGDOC_REQUIRED, "fleet": FLEET_REQUIRED,
-            "kernels": KERNELS_REQUIRED}
+            "kernels": KERNELS_REQUIRED, "chaos": CHAOS_REQUIRED}
 
 
 def load_artifact(path):
     """Read + unwrap one artifact; returns (kind, payload). kind is
-    "serving", "train", "longdoc", "fleet" or "kernels"."""
+    "serving", "train", "longdoc", "fleet", "chaos" or "kernels"."""
     with open(path) as f:
         doc = json.load(f)
     if not isinstance(doc, dict):
@@ -224,6 +250,8 @@ def load_artifact(path):
         return "longdoc", doc
     if "fleet_scaling_2x" in doc:
         return "fleet", doc
+    if "chaos_episodes" in doc:
+        return "chaos", doc
     if "decode_pallas_us" in doc:
         return "kernels", doc
     if "tokens_per_sec" in doc:
@@ -232,8 +260,9 @@ def load_artifact(path):
         return "train", doc
     raise ValueError(
         f"{path}: unrecognized artifact (no 'speedup_sparse_vs_dense_16k', "
-        f"'fleet_scaling_2x', 'decode_pallas_us', 'tokens_per_sec' or "
-        f"'metric' key; top-level keys: {sorted(doc)[:8]})")
+        f"'fleet_scaling_2x', 'chaos_episodes', 'decode_pallas_us', "
+        f"'tokens_per_sec' or 'metric' key; "
+        f"top-level keys: {sorted(doc)[:8]})")
 
 
 def check_schema(path):
@@ -319,6 +348,29 @@ def check_schema(path):
             problems.append(
                 f"{path}: 'scaling_mode' must be 'wall' or 'cpu', got "
                 f"{doc.get('scaling_mode')!r}")
+    elif kind == "chaos":
+        if doc.get("complete") is not True:
+            problems.append(f"{path}: 'complete' is not true — a partial "
+                            f"chaos schedule must not be committed as a "
+                            f"baseline")
+        for key in ("invariant_bitwise_ok", "invariant_no_stuck",
+                    "invariant_recovery_bounded", "invariant_converged"):
+            if doc.get(key) is not True:
+                problems.append(
+                    f"{path}: '{key}' is not true — a chaos run with a "
+                    f"failed self-healing invariant must never become a "
+                    f"baseline")
+        eps = doc.get("chaos_episodes")
+        if isinstance(eps, int) and not isinstance(eps, bool) \
+                and eps < CHAOS_MIN_EPISODES:
+            problems.append(
+                f"{path}: 'chaos_episodes' is {eps}, below the "
+                f"{CHAOS_MIN_EPISODES}-episode acceptance floor")
+        comp = doc.get("completed_total")
+        if isinstance(comp, int) and not isinstance(comp, bool) and comp <= 0:
+            problems.append(
+                f"{path}: 'completed_total' must be > 0 — a schedule where "
+                f"nothing completed proves nothing")
     elif kind == "kernels":
         if doc.get("complete") is not True:
             problems.append(f"{path}: 'complete' is not true — a partial "
@@ -457,7 +509,8 @@ def main(argv=None):
                         help="validate artifact schema(s); defaults to the "
                              "committed SERVING_BENCH_CPU.json + BENCH_r05."
                              "json + LONGDOC_BENCH_CPU.json + "
-                             "FLEET_BENCH_CPU.json + KERNEL_BENCH_CPU.json")
+                             "FLEET_BENCH_CPU.json + KERNEL_BENCH_CPU.json "
+                             "+ CHAOS_BENCH_CPU.json")
     parser.add_argument("mode", nargs="?", choices=["compare"],
                         help="compare FRESH BASELINE under tolerance bands")
     parser.add_argument("fresh", nargs="?", help="fresh bench JSON")
