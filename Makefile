@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving test-router lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels trace-smoke bench-gate chaos-smoke
+.PHONY: test test-fast test-faults test-cluster test-serving test-router lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels bench-train trace-smoke bench-gate chaos-smoke
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -114,6 +114,14 @@ chaos-smoke:
 bench-kernels:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=kernels python bench.py --child
 
+# Train-step fusion bench: overlapped per-bucket backward/reduce vs the
+# sequential post-backward reduce (bitwise parity asserted in-run) plus
+# interleaved-1F1B bubble accounting on a simulated 4-device CPU mesh.
+# Writes TRAIN_BENCH_CPU.json (see docs/training_perf.md).
+bench-train:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=train python bench.py --child
+	python -m tools.bench_gate --check-schema TRAIN_BENCH_CPU.json
+
 # Benchmark on the real TPU chip (default platform).
 bench:
 	python bench.py
@@ -136,3 +144,6 @@ bench-gate:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=kernels \
 		BENCH_KERNELS_OUT=/tmp/bench_gate_kernels.json python bench.py --child
 	python -m tools.bench_gate compare /tmp/bench_gate_kernels.json KERNEL_BENCH_CPU.json
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=train \
+		BENCH_TRAIN_OUT=/tmp/bench_gate_train.json python bench.py --child
+	python -m tools.bench_gate compare /tmp/bench_gate_train.json TRAIN_BENCH_CPU.json

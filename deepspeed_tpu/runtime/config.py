@@ -224,6 +224,7 @@ def get_pipeline_config(param_dict):
         PIPELINE_PARTITION: PIPELINE_PARTITION_DEFAULT,
         PIPELINE_SEED_LAYERS: PIPELINE_SEED_LAYERS_DEFAULT,
         PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL: PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT,
+        PIPELINE_NUM_MODEL_CHUNKS: PIPELINE_NUM_MODEL_CHUNKS_DEFAULT,
     }
     if PIPELINE in param_dict:
         pipeline.update(param_dict[PIPELINE])
@@ -1181,6 +1182,29 @@ class DeepSpeedConfig:
             assert (
                 self.zero_optimization_stage <= MAX_STAGE_ZERO_OPTIMIZATION
             ), f"DeepSpeedConfig: Maximum supported ZeRO stage is {MAX_STAGE_ZERO_OPTIMIZATION}"
+            for knob in ("reduce_bucket_size", "allgather_bucket_size"):
+                val = getattr(self.zero_config, knob)
+                if not isinstance(val, (int, float)) or isinstance(val, bool) or val <= 0:
+                    raise DeepSpeedConfigError(
+                        f"DeepSpeedConfig: zero_optimization.{knob} must be a "
+                        f"positive number of elements, got {val!r}")
+            if not isinstance(self.zero_config.overlap_comm, bool):
+                raise DeepSpeedConfigError(
+                    "DeepSpeedConfig: zero_optimization.overlap_comm must be a "
+                    f"boolean, got {self.zero_config.overlap_comm!r}")
+        chunks = self.pipeline.get(PIPELINE_NUM_MODEL_CHUNKS, PIPELINE_NUM_MODEL_CHUNKS_DEFAULT)
+        if not isinstance(chunks, int) or isinstance(chunks, bool) or chunks < 1:
+            raise DeepSpeedConfigError(
+                f"DeepSpeedConfig: pipeline.{PIPELINE_NUM_MODEL_CHUNKS} must be "
+                f"an integer >= 1 (virtual stages per rank), got {chunks!r}")
+        if chunks > 1:
+            stages = self.pipeline.get(PIPELINE_STAGES)
+            if stages is not None and self.gradient_accumulation_steps % int(stages) != 0:
+                raise DeepSpeedConfigError(
+                    f"DeepSpeedConfig: pipeline.{PIPELINE_NUM_MODEL_CHUNKS}="
+                    f"{chunks} (interleaved 1F1B) requires "
+                    f"gradient_accumulation_steps ({self.gradient_accumulation_steps}) "
+                    f"divisible by pipeline stages ({stages})")
 
     def _do_warning_check(self):
         fp16_enabled = self.fp16_enabled or self.zero_enabled

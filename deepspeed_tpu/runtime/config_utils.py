@@ -46,6 +46,15 @@ def resolve_dp_size(config):
     return int(val) if val else None
 
 
+def resolve_num_model_chunks(config):
+    """``pipeline.num_model_chunks`` (V, interleaved-1F1B virtual stages per
+    physical rank; 1 = plain 1F1B) from a raw config dict/path. The
+    PipelineEngine needs this BEFORE DeepSpeedConfig exists — its device grid
+    is carved per-physical-stage while the virtual-stage count is S*V."""
+    val = (as_config_dict(config).get("pipeline", {}) or {}).get("num_model_chunks", 1)
+    return int(val) if val else 1
+
+
 def get_list_param(param_dict, param_name, param_default_value):
     return param_dict.get(param_name, param_default_value)
 

@@ -364,3 +364,5 @@ PIPELINE_SEED_LAYERS = "seed_layers"
 PIPELINE_SEED_LAYERS_DEFAULT = False
 PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL = "activation_checkpoint_interval"
 PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT = 0
+PIPELINE_NUM_MODEL_CHUNKS = "num_model_chunks"
+PIPELINE_NUM_MODEL_CHUNKS_DEFAULT = 1

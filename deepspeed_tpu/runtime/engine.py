@@ -691,13 +691,12 @@ class DeepSpeedEngine:
                 clip_grad=self.gradient_clipping(),
                 keep_master=keep_master,
             )
-        # Like the bucket-size knobs (ZeroShardedOptimizer.__init__): these
-        # two schedule eager NCCL work in the reference (stage2.py overlap /
-        # IPG buffers); under XLA the step is ONE program whose collectives
-        # the latency-hiding scheduler already overlaps, and grads are
-        # compiler-managed buffers — accepted for parity, loudly a no-op.
-        for knob, val in (("overlap_comm", self.zero_overlap_comm()),
-                          ("contiguous_gradients", self.zero_contiguous_gradients())):
+        # contiguous_gradients schedules eager IPG buffers in the reference
+        # (stage2.py); under XLA grads are compiler-managed buffers — accepted
+        # for parity, loudly a no-op. overlap_comm, by contrast, is REAL since
+        # the DeepCompile-style tap landed: it buckets the backward's gradient
+        # reduction (see ZeroShardedOptimizer.grad_overlap_tap).
+        for knob, val in (("contiguous_gradients", self.zero_contiguous_gradients()),):
             if val:
                 log_dist(
                     f"ZeRO: '{knob}'={val} is accepted for parity but is a "
@@ -717,6 +716,7 @@ class DeepSpeedEngine:
             elastic_checkpoint=self.zero_elastic_checkpoint(),
             clip_grad=self.gradient_clipping(),
             keep_master=keep_master,
+            overlap_comm=self.zero_overlap_comm(),
         )
 
     def _configure_lr_scheduler(self, client_lr_scheduler):
@@ -775,6 +775,13 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # jitted programs
     # ------------------------------------------------------------------
+    def _grad_overlap_tap(self):
+        """``params -> params`` per-bucket reduce tap from the ZeRO optimizer
+        (overlap_comm), or ``None`` when overlap is off or the configured
+        optimizer doesn't support it (pytree ZeRO, 1-bit, plain Adam)."""
+        tap = getattr(self.optimizer, "grad_overlap_tap", None)
+        return tap() if callable(tap) else None
+
     def _fwd_bwd_core(self, needs_rng):
         """Traceable (loss, grads) of one microbatch. The model outputs are NOT
         returned: only the loss is consumed, and returning e.g. BERT-large
@@ -784,9 +791,17 @@ class DeepSpeedEngine:
         pld = self.progressive_layer_drop is not None
         remat = getattr(self, "_remat_apply_fn", False)
         gather = self._gather_params_fn()
+        tap = self._grad_overlap_tap()
 
         def fwd_bwd(params, scale, rng, theta, *batch):
             def loss_fn(p):
+                if tap is not None:
+                    # overlap_comm: identity on the forward; each bucket's
+                    # custom-vjp backward pins that bucket's reduce layout
+                    # INSIDE the backward pass (per-bucket collectives XLA
+                    # overlaps with remaining backward compute) — tapped
+                    # FIRST so the cotangents are the final param grads
+                    p = tap(p)
                 p_c = gather(jax.tree_util.tree_map(lambda x: x.astype(compute_dtype), p))
                 kwargs = {}
                 if needs_rng:
@@ -1111,7 +1126,16 @@ class DeepSpeedEngine:
                 )
                 return new_params, new_opt_state, new_scaler, jnp.mean(losses), overflow, gnorm
 
-            jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
+            # params/opt_state/scaler donate always (in-place update in HBM).
+            # Under overlap_comm the stacked microbatch buffers donate too —
+            # they are rebuilt fresh each step (jnp.stack in train_step()) and
+            # freeing them mid-program gives the per-bucket collectives'
+            # transients headroom. Kept off otherwise: the 3-call/test paths
+            # may re-feed a batch object across calls.
+            donate = (0, 1, 2)
+            if self._grad_overlap_tap() is not None:
+                donate = donate + tuple(range(6, 6 + batch_ndims))
+            jitted = jax.jit(train_step, donate_argnums=donate)
             sent = self._config.sentinel_config
             if sent.enabled:
                 # transparent proxy: pytree/cache introspection still works
@@ -1124,6 +1148,7 @@ class DeepSpeedEngine:
         if self.opt_state is None:
             if self._onebit_path():
                 self.opt_state = self.basic_optimizer.init_engine_state(self.params, self.mesh)
+                self._home_small_state()
                 return
             self.opt_state = self.optimizer.init(self.params)
             if self.zero_optimization() and self.compute_dtype != jnp.float32:
@@ -1133,6 +1158,23 @@ class DeepSpeedEngine:
                     lambda p: p.astype(self.compute_dtype), self.params
                 )
                 self._jit_cache.pop("step", None)
+            self._home_small_state()
+
+    def _home_small_state(self):
+        """Replicate any off-mesh opt/scaler leaf onto the mesh. Fresh
+        ``init``/checkpoint scalars (step counters, loss-scale state, the
+        empty flat master) land on ONE device, but the fused train step
+        returns them mesh-replicated — left alone, the second step's input
+        signature differs from the first and the whole donated program
+        compiles twice."""
+        rep = NamedSharding(self.mesh, PartitionSpec())
+
+        def home(x):
+            sh = getattr(x, "sharding", None)
+            return x if isinstance(sh, NamedSharding) else jax.device_put(x, rep)
+
+        self.opt_state = jax.tree_util.tree_map(home, self.opt_state)
+        self.scaler_state = jax.tree_util.tree_map(home, self.scaler_state)
 
     def _next_rng(self):
         self._step_rng, sub = jax.random.split(self._step_rng)
@@ -1398,6 +1440,17 @@ class DeepSpeedEngine:
                 self._loss_sum / self.gradient_accumulation_steps(), samples,
             )
         self.monitor.record("Train/Samples/lr", self.get_lr()[0], samples)
+        if hasattr(self.optimizer, "overlap_comm"):
+            # Schedule-derived overlap fraction: of the B per-bucket reduces
+            # the backward emits, all but the LAST have remaining backward
+            # compute to hide under (the last bucket holds the earliest
+            # layers' grads — backward is finished when it reduces). 0 when
+            # overlap is off: the one monolithic reduce hides under nothing.
+            frac = 0.0
+            if self.optimizer.overlap_comm:
+                b = len(self.optimizer.bucket_numels or ())
+                frac = (b - 1) / b if b > 0 else 0.0
+            self.monitor.record("Train/comm_overlap_frac", frac, samples)
         if self.fp16_enabled():
             # Device-side COPY: the monitor host-syncs only at flush, and the
             # live scaler_state buffer gets DONATED into the next fused
@@ -1504,6 +1557,19 @@ class DeepSpeedEngine:
                 self.params, self.opt_state, self.scaler_state, self._next_rng(), theta,
                 lr, *stacked,
             )
+            if self._tracer.enabled:
+                # overlap_comm: one child span per reduce bucket. The dispatch
+                # is async and the collectives live inside ONE XLA program, so
+                # these are schedule markers (bucket id + numel), not wall
+                # timings — the timeline shows WHICH buckets the backward
+                # reduces and in what order.
+                for b, n in enumerate(
+                        getattr(self.optimizer, "bucket_numels", None) or ()):
+                    with self._tracer.span(
+                            "train/grad_reduce", cat="train",
+                            args={"step": self.global_steps, "bucket": b,
+                                  "numel": n}):
+                        pass
         self._last_loss = loss
         self._loss_sum = loss * gas
         self.micro_steps += gas
@@ -1883,6 +1949,7 @@ class DeepSpeedEngine:
                 cur_scale=jnp.asarray(s.cur_scale), cur_iter=jnp.asarray(s.cur_iter),
                 last_overflow_iter=jnp.asarray(s.last_overflow_iter), cur_hysteresis=jnp.asarray(s.cur_hysteresis),
             )
+        self._home_small_state()
 
         self.global_steps = checkpoint.get("global_steps", 0)
         self.global_samples = checkpoint.get("global_samples", self.global_steps * self.train_batch_size())

@@ -48,9 +48,12 @@ def _manual_axes(mesh):
     return ({PIPE_AXIS, DATA_AXIS} if MODEL_AXIS in mesh.axis_names else None)
 
 
-def analytic_bubble_fraction(num_stages, num_micro):
-    """Idle fraction of the 1F1B/GPipe fill+drain schedule."""
-    return (num_stages - 1) / (num_micro + num_stages - 1)
+def analytic_bubble_fraction(num_stages, num_micro, num_model_chunks=1):
+    """Idle fraction of the 1F1B/GPipe fill+drain schedule. With
+    ``num_model_chunks`` V > 1 (interleaved 1F1B, which the compiled
+    executors bow out of — the interpreter runs it) each rank's fill/drain
+    exposure shrinks by V: (S-1)/(M*V + S-1)."""
+    return (num_stages - 1) / (num_micro * num_model_chunks + num_stages - 1)
 
 
 def pipeline_mesh(num_stages, devices=None, tp=1):

@@ -84,14 +84,31 @@ class PipelineEngine:
             config = config_params
         assert config is not None, "DeepSpeed requires a config"
 
-        self.num_stages = model.num_pipeline_stages()
-        devices = jax.devices()
         # 3D parallelism: tensor parallel INSIDE each pipeline stage
         # (reference PipeModelDataParallelTopology, pipe/topology.py:246-250).
         # TP here is sharding-based (parallel/tp.py): stage params commit to
         # the stage sub-mesh's ``model`` axis and GSPMD inserts the Megatron
         # collectives inside the per-stage programs.
-        from deepspeed_tpu.runtime.config_utils import resolve_dp_size, resolve_tp_size
+        from deepspeed_tpu.runtime.config_utils import (
+            resolve_dp_size, resolve_num_model_chunks, resolve_tp_size)
+
+        # Interleaved 1F1B (pipeline.num_model_chunks = V > 1): the module
+        # re-partitions into S*V VIRTUAL stages and every per-stage structure
+        # below (params, buffers, jitted programs, schedules) is per-virtual-
+        # stage — but the DEVICE grid stays per physical rank, with virtual
+        # stage p running on rank p % S (chunk p // S of that rank's layers).
+        # Resolved from the raw dict: the grid is carved before DeepSpeedConfig
+        # exists (the same reason resolve_tp_size/resolve_dp_size peek).
+        self.num_model_chunks = resolve_num_model_chunks(config)
+        if self.num_model_chunks > 1:
+            model.interleave_virtual_stages(self.num_model_chunks)
+        self.num_stages = model.num_pipeline_stages()  # VIRTUAL stage count
+        assert self.num_stages % self.num_model_chunks == 0, (
+            f"module reports {self.num_stages} stages, not a multiple of "
+            f"num_model_chunks {self.num_model_chunks}"
+        )
+        self.num_phys_stages = self.num_stages // self.num_model_chunks
+        devices = jax.devices()
 
         mp = resolve_tp_size(config, mpu)
         dp_explicit = resolve_dp_size(config)
@@ -102,16 +119,16 @@ class PipelineEngine:
             assert jax.process_count() == 1, (
                 "mesh.data_parallel_size is single-process only"
             )
-            need = self.num_stages * dp_explicit * mp
+            need = self.num_phys_stages * dp_explicit * mp
             assert need <= len(devices), (
                 f"mesh.data_parallel_size={dp_explicit} x tensor_parallel={mp} "
-                f"x stages={self.num_stages} needs {need} devices, have {len(devices)}"
+                f"x stages={self.num_phys_stages} needs {need} devices, have {len(devices)}"
             )
             devices = devices[:need]
-        assert len(devices) % self.num_stages == 0, (
-            f"device count {len(devices)} not divisible by num_stages {self.num_stages}"
+        assert len(devices) % self.num_phys_stages == 0, (
+            f"device count {len(devices)} not divisible by num_stages {self.num_phys_stages}"
         )
-        per_stage = len(devices) // self.num_stages
+        per_stage = len(devices) // self.num_phys_stages
         assert per_stage % mp == 0, (
             f"devices per stage {per_stage} not divisible by tensor_parallel size {mp}"
         )
@@ -123,10 +140,13 @@ class PipelineEngine:
         # executor (global-mesh shard_map) is the only execution path, like
         # any multi-host SPMD jax program.
         self._multi_host = jax.process_count() > 1
-        self.stage_meshes = []
-        for s in range(self.num_stages):
-            devs = np.asarray(devices[s * per_stage:(s + 1) * per_stage]).reshape(self.dp_world_size, mp)
-            self.stage_meshes.append(Mesh(devs, (DATA_AXIS, MODEL_AXIS)))
+        phys_meshes = []
+        for r in range(self.num_phys_stages):
+            devs = np.asarray(devices[r * per_stage:(r + 1) * per_stage]).reshape(self.dp_world_size, mp)
+            phys_meshes.append(Mesh(devs, (DATA_AXIS, MODEL_AXIS)))
+        # virtual stage p = chunk * S + rank -> rank p % S's device slice
+        self.stage_meshes = [phys_meshes[p % self.num_phys_stages]
+                             for p in range(self.num_stages)]
 
         self._config = DeepSpeedConfig(config, mpu, world_size=self.dp_world_size)
         assert not self._config.elasticity_enabled, (
@@ -135,6 +155,15 @@ class PipelineEngine:
 
         self.micro_batches = self._config.gradient_accumulation_steps
         self.micro_batch_size = self._config.train_micro_batch_size_per_gpu
+        if self.num_model_chunks > 1 and self.micro_batches % self.num_phys_stages != 0:
+            raise PipelineError(
+                f"interleaved 1F1B (num_model_chunks={self.num_model_chunks}) "
+                f"requires micro_batches ({self.micro_batches}) divisible by "
+                f"pipeline stages ({self.num_phys_stages})")
+        if self.num_model_chunks > 1 and self._multi_host:
+            raise PipelineError(
+                "interleaved 1F1B runs on the interpreter, which cannot cross "
+                "process boundaries — multi-host requires num_model_chunks=1")
 
         if self._config.fp16_enabled:
             self.compute_dtype = jnp.float16
@@ -575,6 +604,14 @@ class PipelineEngine:
         reasons = []
         if getattr(self, "_compiled_unavailable", None):
             reasons.append(self._compiled_unavailable)
+        if self.num_model_chunks > 1:
+            # The synchronous scan+ppermute conveyor advances every physical
+            # rank's ONE block per tick; interleaving needs each rank to hop
+            # between its V chunks mid-flight, which that program shape
+            # cannot express without V colliding programs per rank.
+            reasons.append(
+                f"interleaved 1F1B (num_model_chunks={self.num_model_chunks}) "
+                "runs on the interpreter")
         return reasons
 
     def _homogeneous_ok(self):
@@ -1366,7 +1403,11 @@ class PipelineEngine:
             return self.agg_train_loss
 
         self._losses = []
-        sched = _MergedSchedule(pipe_schedule.TrainSchedule, self.micro_batches, self.num_stages)
+        if self.num_model_chunks > 1:
+            sched = _MergedInterleavedSchedule(
+                self.micro_batches, self.num_phys_stages, self.num_model_chunks)
+        else:
+            sched = _MergedSchedule(pipe_schedule.TrainSchedule, self.micro_batches, self.num_stages)
         espan = (self._tracer.span("pipe/exec_schedule", cat="pipe",
                                    args={"step": self.global_steps,
                                          "micro_batches": self.micro_batches})
@@ -1391,15 +1432,77 @@ class PipelineEngine:
             for s, wall_s in enumerate(self._stage_wall_s):
                 self.monitor.record(f"Train/Pipe/stage{s}_time_ms",
                                     wall_s * 1000.0, self.global_samples)
+            if self.num_model_chunks > 1:
+                # under interleaving the device-facing unit is the physical
+                # rank, which hosts V virtual stages' wall time
+                for r, wall_s in enumerate(self._rank_wall_s()):
+                    self.monitor.record(f"Train/Pipe/rank{r}_time_ms",
+                                        wall_s * 1000.0, self.global_samples)
+            self.monitor.record("Train/Pipe/bubble_frac",
+                                self._schedule_bubble_fraction(),
+                                self.global_samples)
+            self.monitor.record("Train/Pipe/est_parallel_step_ms",
+                                self._est_parallel_step_s() * 1000.0,
+                                self.global_samples)
         self.tput_timer.stop(self.global_steps % self._config.steps_per_print == 0)
         if self.global_steps % self._config.steps_per_print == 0:
             log_dist(
                 f"step={self.global_steps}, loss={self.agg_train_loss:.4f}, lr={self.get_lr()}",
                 ranks=[0],
             )
+            if self._config.wall_clock_breakdown:
+                # The single-controller interpreter serializes stages, so
+                # whole-step wall time (what ThroughputTimer measures) double
+                # counts work that overlaps on a real multi-controller
+                # deployment. Report throughput against the BOTTLENECK rank's
+                # busy time inflated by the schedule's bubble instead.
+                est = self._est_parallel_step_s()
+                if est > 0:
+                    sps = (self.micro_batch_size * self.micro_batches
+                           * self.dp_world_size) / est
+                    log_dist(
+                        f"wall_clock: train_batch {sps:.1f} samples/sec "
+                        f"(bottleneck-stage estimate; schedule bubble "
+                        f"{self._schedule_bubble_fraction():.3f})", ranks=[0])
             if self.monitor is not None:
                 self.monitor.flush()
         return self.agg_train_loss
+
+    def _rank_wall_s(self):
+        """Per-PHYSICAL-rank wall seconds of the last interpreted step: rank r
+        hosts virtual stages r, S+r, 2S+r, ... (sum of their dispatch time)."""
+        S = self.num_phys_stages
+        out = [0.0] * S
+        for p, wall_s in enumerate(self._stage_wall_s):
+            out[p % S] += wall_s
+        return out
+
+    def _schedule_bubble_fraction(self):
+        """Idle fraction of the CURRENT schedule shape (S, M, V), from the
+        deterministic list-scheduling simulator over the real instruction
+        streams — the honest bubble number a multi-controller deployment of
+        this schedule would see (host wall time can't measure it: the
+        single-controller interpreter serializes every stage)."""
+        key = (self.num_phys_stages, self.micro_batches, self.num_model_chunks)
+        cached = getattr(self, "_bubble_cache", None)
+        if cached is None or cached[0] != key:
+            frac = pipe_schedule.simulate_bubble_fraction(
+                stages=self.num_phys_stages, micro_batches=self.micro_batches,
+                num_model_chunks=self.num_model_chunks)
+            self._bubble_cache = (key, frac)
+        return self._bubble_cache[1]
+
+    def _est_parallel_step_s(self):
+        """Estimated parallel-deployment step seconds: the bottleneck physical
+        rank's busy time stretched by the schedule's bubble. This is what the
+        throughput/MFU log should divide by — NOT the interpreter's summed
+        whole-step wall time, which grows with S even when stages overlap."""
+        ranks = self._rank_wall_s()
+        busiest = max(ranks) if ranks else 0.0
+        bubble = self._schedule_bubble_fraction()
+        if bubble >= 1.0:
+            return busiest
+        return busiest / (1.0 - bubble)
 
     def _ensure_compiled_eval(self):
         """Deterministic (dropout-off) compiled loss program over the same
@@ -2129,6 +2232,31 @@ class _MergedSchedule:
             for s in range(stages)
         ]
         self.stages = stages
+
+
+class _MergedInterleavedSchedule:
+    """Interleaved-1F1B bundle: each physical rank's InterleavedTrainSchedule
+    stream, re-homed onto VIRTUAL stage ids so the engine's per-stage executor
+    (params/buffers/counters all indexed by virtual stage p = chunk*S + rank)
+    runs it unchanged. Every instruction carries ``chunk_id``; a rank tick is
+    split into per-chunk ticks routed to stage ``chunk*S + rank``."""
+
+    def __init__(self, micro_batches, phys_stages, num_model_chunks):
+        S, V = phys_stages, num_model_chunks
+        self.stages = S * V
+        self.per_stage = [[] for _ in range(self.stages)]
+        for r in range(S):
+            sched = pipe_schedule.InterleavedTrainSchedule(
+                micro_batches=micro_batches, stages=S, stage_id=r,
+                num_model_chunks=V)
+            for tick in sched.steps():
+                by_chunk = {}
+                for cmd in tick:
+                    by_chunk.setdefault(cmd.chunk_id, []).append(cmd)
+                # rank-order ticks stay intact per virtual stage; the
+                # dependency-driven executor orders across stages itself
+                for v, cmds in by_chunk.items():
+                    self.per_stage[v * S + r].append(cmds)
 
 
 def _snake(name):

@@ -168,6 +168,31 @@ class PipelineModule:
     def stage_layer_range(self, stage_id):
         return self.parts[stage_id], self.parts[stage_id + 1]
 
+    def interleave_virtual_stages(self, num_model_chunks):
+        """Re-partition into ``S * V`` virtual stages for interleaved 1F1B.
+
+        Virtual stage ``p = chunk * S + rank`` owns the p-th of ``S*V``
+        contiguous layer slices, so each physical rank ends up holding ``V``
+        NON-contiguous model chunks (rank r gets slices r, S+r, 2S+r, ...) —
+        the Megatron-style virtual-pipeline layout. Linear ordering of ``p``
+        makes chunk boundaries plain next-stage hops: the last rank's chunk v
+        feeds rank 0's chunk v+1 as ``p -> p+1``. Idempotent per V; call
+        before ``init_params`` (the 'parameters' re-balance uses whatever
+        stage count is current)."""
+        V = int(num_model_chunks)
+        if V <= 1 or getattr(self, "_virtual_chunks", 1) == V:
+            return
+        assert getattr(self, "_virtual_chunks", 1) == 1, \
+            "interleave_virtual_stages called twice with different V"
+        phys = self.num_stages
+        if self._num_layers < phys * V:
+            raise ValueError(
+                f"num_model_chunks={V}: cannot split {self._num_layers} layers "
+                f"into {phys * V} virtual stages (need >= 1 layer per stage)")
+        self._virtual_chunks = V
+        self.num_stages = phys * V
+        self.parts = self._partition_layers(self._partition_method)
+
     # -- lazy parameter init ----------------------------------------------
     def _layer_rng(self, idx):
         """Per-layer PRNG key (reference seeds each built layer,

@@ -231,6 +231,191 @@ class TrainSchedule(PipeSchedule):
         return micro_batch_id % self.num_pipe_buffers()
 
 
+class InterleavedTrainSchedule(PipeSchedule):
+    """Interleaved 1F1B (Megatron-LM's virtual-pipeline schedule, the
+    MPMD-pipeline-parallelism paper's bubble cut): each physical rank hosts
+    ``num_model_chunks`` (V) non-contiguous model chunks — virtual stage
+    ``p = chunk * stages + stage_id`` — so microbatches re-enter the rank V
+    times and the warmup bubble shrinks from ``(S-1)/M`` toward
+    ``(S-1)/(M*V)``.
+
+    ``stages``/``stage_id`` are the PHYSICAL rank grid; every instruction
+    carries ``chunk_id`` so the engine can route it to the right virtual
+    stage. Ticks follow the standard interleaved stream: ``warmup = min(M*V,
+    2*(S-stage_id-1) + (V-1)*S)`` forwards (the ``(V-1)*S`` term keeps later
+    chunks' forwards flowing before the first backward), a steady
+    one-forward-one-backward alternation, then a backward drain. Forward op
+    ``i`` maps to ``chunk = (i % (S*V)) // S`` of microbatch
+    ``(i // (S*V)) * S + i % S``; backward op ``j`` walks chunks in reverse.
+    Requires ``micro_batches % stages == 0`` when V > 1 (the group rotation
+    above is only a valid dependency order on whole groups of S
+    microbatches — Megatron imposes the same constraint).
+
+    Buffering is deliberately simple: one buffer per microbatch
+    (``num_pipe_buffers == micro_batches``) instead of the reference's
+    liveness-tight ring — interleaving keeps up to ``V`` chunks of a rank's
+    microbatches in flight at once and the engine's buffers hold only
+    activations of microbatches that haven't completed backward.
+    """
+
+    def __init__(self, micro_batches, stages, stage_id, num_model_chunks=2):
+        super().__init__(micro_batches, stages, stage_id)
+        assert num_model_chunks >= 1, num_model_chunks
+        if num_model_chunks > 1 and micro_batches % stages != 0:
+            raise ValueError(
+                f"interleaved schedule needs micro_batches ({micro_batches}) "
+                f"divisible by stages ({stages}) when num_model_chunks > 1")
+        self.num_model_chunks = num_model_chunks
+
+    # -- op index -> (chunk, micro_batch) maps (interleaved 1F1B) ----------
+    def _fwd_op(self, i):
+        S, V = self.stages, self.num_model_chunks
+        g, rem = divmod(i, S * V)
+        return rem // S, g * S + i % S
+
+    def _bwd_op(self, j):
+        S, V = self.stages, self.num_model_chunks
+        g, rem = divmod(j, S * V)
+        return V - 1 - rem // S, g * S + j % S
+
+    def steps(self):
+        S, V, M = self.stages, self.num_model_chunks, self.micro_batches
+        total = M * V
+        warmup = min(total, (S - self.stage_id - 1) * 2 + (V - 1) * S)
+        fwd_id = 0
+        bwd_id = 0
+        # Idle ticks before this rank's first forward can start.
+        for _ in range(self.stage_id):
+            yield []
+        for _ in range(warmup):
+            yield self._forward_cmds(*self._fwd_op(fwd_id))
+            fwd_id += 1
+        while fwd_id < total:
+            yield self._forward_cmds(*self._fwd_op(fwd_id))
+            fwd_id += 1
+            yield self._backward_cmds(*self._bwd_op(bwd_id))
+            bwd_id += 1
+        while bwd_id < total:
+            yield self._backward_cmds(*self._bwd_op(bwd_id))
+            bwd_id += 1
+        # Batch-end reductions + step, once per chunk (each virtual stage
+        # owns its slice of params; the engine barriers across all of them).
+        tail = []
+        for v in range(V):
+            tail.extend([ReduceTiedGrads(chunk_id=v), ReduceGrads(chunk_id=v),
+                         OptimizerStep(chunk_id=v)])
+        yield tail
+
+    def _forward_cmds(self, chunk, micro_batch_id):
+        p = chunk * self.stages + self.stage_id
+        last = self.stages * self.num_model_chunks - 1
+        buf = self._buffer_idx(micro_batch_id)
+        cmds = []
+        if p == 0 or p == last:
+            cmds.append(LoadMicroBatch(buf, chunk_id=chunk))
+        if p > 0:
+            cmds.append(RecvActivation(buf, chunk_id=chunk))
+        cmds.append(ForwardPass(buf, chunk_id=chunk))
+        if p < last:
+            cmds.append(SendActivation(buf, chunk_id=chunk))
+        return cmds
+
+    def _backward_cmds(self, chunk, micro_batch_id):
+        p = chunk * self.stages + self.stage_id
+        last = self.stages * self.num_model_chunks - 1
+        buf = self._buffer_idx(micro_batch_id)
+        cmds = []
+        if p < last:
+            cmds.append(RecvGrad(buf, chunk_id=chunk))
+        cmds.append(BackwardPass(buf, chunk_id=chunk))
+        if p > 0:
+            cmds.append(SendGrad(buf, chunk_id=chunk))
+        return cmds
+
+    def num_pipe_buffers(self):
+        return max(2, self.micro_batches)
+
+    def _buffer_idx(self, micro_batch_id):
+        assert self._valid_micro_batch(micro_batch_id)
+        return micro_batch_id % self.num_pipe_buffers()
+
+
+def simulate_bubble_fraction(stages, micro_batches, num_model_chunks=1,
+                             fwd_cost=1.0, bwd_cost=2.0):
+    """Deterministic bubble fraction of the ACTUAL instruction streams.
+
+    List-schedules every rank's real ``TrainSchedule`` /
+    ``InterleavedTrainSchedule`` op order (per-rank order fixed, exactly as
+    the engine dispatches) against the true dataflow dependencies —
+    ``F(mb, p)`` needs ``F(mb, p-1)``; ``B(mb, p)`` needs ``F(mb, p)`` and
+    ``B(mb, p+1)`` — with unit costs ``fwd_cost``/``bwd_cost`` per FULL-rank
+    microbatch (a chunk op costs ``1/V`` of that, so total work is invariant
+    in V and fractions are comparable across schedules). Communication is
+    free, so the result isolates the SCHEDULE's bubble; the analytic ideals
+    are ``(S-1)/(M+S-1)`` for 1F1B and ``(S-1)/(M*V+S-1)`` interleaved.
+
+    This is the gateable measurement behind ``TRAIN_BENCH_CPU.json``'s
+    bubble fields: the single-controller interpreter serializes all stages
+    on one host thread, so wall-clock per-stage gauges cannot expose the
+    bubble directly — the simulator plays the same instruction streams on
+    an idealized S-way-parallel machine instead.
+    """
+    S, V, M = stages, num_model_chunks, micro_batches
+    streams = []
+    for r in range(S):
+        if V > 1:
+            sched = InterleavedTrainSchedule(
+                micro_batches=M, stages=S, stage_id=r, num_model_chunks=V)
+        else:
+            sched = TrainSchedule(micro_batches=M, stages=S, stage_id=r)
+        ops, counts = [], {}
+        for tick in sched.steps():
+            for cmd in tick:
+                if isinstance(cmd, (ForwardPass, BackwardPass)):
+                    kind = "F" if isinstance(cmd, ForwardPass) else "B"
+                    v = getattr(cmd, "chunk_id", 0)
+                    # buffer ids alias; per-(kind, chunk) ops run in
+                    # microbatch order on every rank, so a counter recovers mb
+                    mb = counts.get((kind, v), 0)
+                    counts[(kind, v)] = mb + 1
+                    ops.append((kind, v * S + r, mb))
+        streams.append(ops)
+    P = S * V
+    tf, tb = fwd_cost / V, bwd_cost / V
+    done = {}
+    cursor = [0] * S
+    free = [0.0] * S
+    busy = [0.0] * S
+    remaining = sum(len(s) for s in streams)
+    while remaining:
+        progressed = False
+        for r in range(S):
+            while cursor[r] < len(streams[r]):
+                kind, p, mb = streams[r][cursor[r]]
+                if kind == "F":
+                    deps = [("F", p - 1, mb)] if p > 0 else []
+                else:
+                    deps = [("F", p, mb)]
+                    if p < P - 1:
+                        deps.append(("B", p + 1, mb))
+                if any(d not in done for d in deps):
+                    break
+                start = max([free[r]] + [done[d] for d in deps])
+                dur = tf if kind == "F" else tb
+                free[r] = start + dur
+                busy[r] += dur
+                done[(kind, p, mb)] = free[r]
+                cursor[r] += 1
+                remaining -= 1
+                progressed = True
+        if not progressed:
+            raise RuntimeError(
+                "pipeline schedule deadlocked in bubble simulation — "
+                "an op's dependencies never complete")
+    makespan = max(free)
+    return 1.0 - sum(busy) / (S * makespan)
+
+
 class DataParallelSchedule(PipeSchedule):
     """Pure DP schedule expressed in pipeline instructions."""
 

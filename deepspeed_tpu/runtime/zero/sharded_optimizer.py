@@ -46,6 +46,31 @@ from deepspeed_tpu.utils.logging import log_dist
 DEFAULT_BUCKET_SIZE = 500000000
 
 
+def compute_bucket_ranges(sizes, bucket_size):
+    """Greedy split of the flat leaf order into contiguous buckets holding at
+    most ``bucket_size`` elements each (a single oversized leaf still gets its
+    own bucket — leaves are never split across buckets, so every bucket's
+    segment of the flat master is a plain concat of whole leaves).
+
+    Returns ``[(lo, hi), ...]`` half-open leaf-index ranges covering every
+    leaf exactly once, in leaf order. This is the overlap_comm analogue of the
+    reference's IPG buckets (stage2.py:904-940): each range becomes one
+    backward-interleaved reduce collective instead of one eager NCCL call.
+    """
+    bucket_size = max(1, int(bucket_size))
+    ranges = []
+    start, acc = 0, 0
+    for i, n in enumerate(sizes):
+        n = max(1, int(n))
+        if acc > 0 and acc + n > bucket_size:
+            ranges.append((start, i))
+            start, acc = i, 0
+        acc += n
+    if start < len(sizes):
+        ranges.append((start, len(sizes)))
+    return ranges
+
+
 class ZeroState(NamedTuple):
     flat_master: jnp.ndarray  # fp32, padded, sharded along data axis
     inner_state: object  # inner optimizer state over the flat vector (sharded)
@@ -78,7 +103,7 @@ class ZeroShardedOptimizer:
                  allgather_bucket_size=DEFAULT_BUCKET_SIZE,
                  elastic_checkpoint=True, clip_grad=0.0, postscale_gradients=True,
                  gradient_predivide_factor=1.0, keep_master=True,
-                 param_shardings=None):
+                 param_shardings=None, overlap_comm=False):
         assert mesh is not None, "ZeroShardedOptimizer requires a mesh"
         self.inner = inner
         self.stage = stage
@@ -86,21 +111,28 @@ class ZeroShardedOptimizer:
         self.dp = dp_world_size(mesh)
         self.cpu_offload = cpu_offload
         self.reduce_scatter = reduce_scatter
-        # Bucket-size knobs are accepted for config parity but are NO-OPS on
-        # TPU, by design rather than omission: the reference buckets grads to
-        # bound transient memory because its reduce/all-gather are eager
-        # NCCL calls issued from backward hooks (stage2.py:904-940,1444-1477).
-        # Here the whole step is ONE XLA program — the reduce-scatter and
-        # all-gather are compiler-scheduled ops whose buffers the scheduler
-        # already bounds (XLA splits oversized collectives internally), and
-        # hand-chunking them would impose an interleaved master layout for no
-        # measured gain. Each ignored non-default knob logs once, loudly.
+        # overlap_comm=False (default): bucket-size knobs are accepted for
+        # config parity but are NO-OPS, by design rather than omission — the
+        # reference buckets grads to bound transient memory because its
+        # reduce/all-gather are eager NCCL calls issued from backward hooks
+        # (stage2.py:904-940,1444-1477); here the whole step is ONE XLA
+        # program whose collectives the scheduler bounds on its own. Each
+        # ignored non-default knob logs once, loudly.
+        #
+        # overlap_comm=True (DeepCompile-style): reduce_bucket_size becomes
+        # REAL — the param leaves split into contiguous buckets of at most
+        # that many elements, and grad_overlap_tap() pins each bucket's
+        # post-reduce layout INSIDE the backward pass, so XLA emits one
+        # collective per bucket as soon as that bucket's grads exist and
+        # schedules it against the remaining backward compute.
+        self.overlap_comm = overlap_comm and not cpu_offload
         self.reduce_bucket_size = reduce_bucket_size
         self.allgather_bucket_size = allgather_bucket_size
-        for knob, val in (
+        ignored = (("allgather_bucket_size", allgather_bucket_size),) if self.overlap_comm else (
             ("reduce_bucket_size", reduce_bucket_size),
             ("allgather_bucket_size", allgather_bucket_size),
-        ):
+        )
+        for knob, val in ignored:
             if val != DEFAULT_BUCKET_SIZE:
                 log_dist(
                     f"ZeRO: '{knob}'={val} is accepted for parity but IGNORED "
@@ -108,6 +140,14 @@ class ZeroShardedOptimizer:
                     "XLA program (see ZeroShardedOptimizer docstring)",
                     ranks=[0],
                 )
+        if overlap_comm and cpu_offload:
+            log_dist(
+                "ZeRO: overlap_comm is IGNORED under cpu_offload — the host "
+                "step fetches whole grad leaves; there is no in-program "
+                "backward to interleave collectives into", ranks=[0],
+            )
+        self._buckets = None       # [(lo, hi)] leaf ranges, set by init()
+        self.bucket_numels = None  # per-bucket element counts (telemetry)
         self.elastic_checkpoint = elastic_checkpoint
         self.clip_grad = clip_grad
         # keep_master=False (fp32 compute): the replicated params ARE fp32, so
@@ -125,8 +165,89 @@ class ZeroShardedOptimizer:
     def _shard_sharding(self):
         return NamedSharding(self.mesh, PartitionSpec(DATA_AXIS))
 
+    def _ensure_buckets(self, params=None):
+        """Leaf-range bucket plan for overlap_comm (lazily derivable from a
+        params pytree before ``init`` runs, e.g. at trace time)."""
+        if self._buckets is not None:
+            return self._buckets
+        spec = self._spec if self._spec is not None else tree_spec(params)
+        _, _, _, sizes = spec
+        self._buckets = compute_bucket_ranges(sizes, self.reduce_bucket_size)
+        self.bucket_numels = [int(sum(sizes[lo:hi])) for lo, hi in self._buckets]
+        return self._buckets
+
+    def grad_overlap_tap(self):
+        """Per-bucket identity taps that pin gradient-reduce layout INSIDE the
+        backward pass (DeepCompile's overlapped reduce, expressed to GSPMD).
+
+        Returns a ``params -> params`` function to apply at the TOP of the
+        loss function, or ``None`` when overlap is off. Forward is the
+        identity; each bucket's custom-vjp backward takes that bucket's
+        cotangents (the final grads w.r.t. the tapped leaves), flattens them
+        to one fp32 vector, pads to the dp multiple, and pins a REPLICATED
+        sharding constraint before slicing/reshaping back. Numerically this
+        is the identity — but the constraint forces XLA to complete the
+        data-parallel reduction of that bucket at the point in the backward
+        where its grads are produced, free to overlap the remaining backward
+        compute, instead of one monolithic reduce after the whole backward.
+
+        The pin is replicated (all-reduce) rather than ``P('data')`` on
+        purpose, for BOTH stages: the tapped leaves re-enter the graph
+        replicated either way, so a sharded pin would force reduce-scatter
+        immediately followed by all-gather — identical total comm volume to
+        one all-reduce (RS + AG == AR) plus a layout round-trip the compiler
+        cannot always elide. Stage>=2's scatter still happens: ``update()``
+        constrains the flat grads to ``P('data')``, which against an
+        already-reduced replicated buffer is a free local slice.
+        """
+        if not self.overlap_comm:
+            return None
+        dp = self.dp
+        out_sharding = NamedSharding(self.mesh, PartitionSpec())
+
+        @jax.custom_vjp
+        def _bucket_tap(*leaves):
+            return leaves
+
+        def _tap_fwd(*leaves):
+            # no residuals: the cotangents carry the leaf shapes/dtypes
+            return leaves, None
+
+        def _tap_bwd(_, cts):
+            flat = jnp.concatenate(
+                [c.astype(jnp.float32).reshape(-1) for c in cts])
+            n = flat.shape[0]
+            padded, _ = pad_to_multiple(flat, dp)
+            padded = jax.lax.with_sharding_constraint(padded, out_sharding)
+            flat = padded[:n]
+            outs, off = [], 0
+            for c in cts:
+                outs.append(
+                    flat[off:off + c.size].reshape(c.shape).astype(c.dtype))
+                off += c.size
+            return tuple(outs)
+
+        _bucket_tap.defvjp(_tap_fwd, _tap_bwd)
+
+        def apply(params):
+            buckets = self._ensure_buckets(params)
+            leaves, treedef = jax.tree_util.tree_flatten(params)
+            out = list(leaves)
+            for b, (lo, hi) in enumerate(buckets):
+                with jax.named_scope(f"grad_reduce_bucket{b}"):
+                    out[lo:hi] = list(_bucket_tap(*leaves[lo:hi]))
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        return apply
+
     def init(self, params):
         self._spec = tree_spec(params)
+        if self.overlap_comm:
+            self._ensure_buckets(params)
+            log_dist(
+                f"ZeRO overlap_comm: {len(self._buckets)} reduce bucket(s) of "
+                f"at most {self.reduce_bucket_size} elements "
+                f"(numels={self.bucket_numels})", ranks=[0])
         if getattr(self.inner, "no_decay_names", None):
             if self.cpu_offload:
                 # ValueError, not assert: must fire under python -O too (a

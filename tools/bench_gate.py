@@ -8,7 +8,8 @@ Two modes:
     numeric types, ``complete: true``). Defaults to the committed
     baselines (``SERVING_BENCH_CPU.json`` + ``BENCH_r05.json`` +
     ``LONGDOC_BENCH_CPU.json`` + ``FLEET_BENCH_CPU.json`` +
-    ``KERNEL_BENCH_CPU.json`` + ``CHAOS_BENCH_CPU.json``). This is the
+    ``KERNEL_BENCH_CPU.json`` + ``CHAOS_BENCH_CPU.json`` +
+    ``TRAIN_BENCH_CPU.json``). This is the
     CI step: it needs no jax and takes milliseconds.
 
 ``compare FRESH BASELINE``
@@ -24,8 +25,9 @@ driver wrapper (``BENCH_r05.json``) and is unwrapped;
 scale-out artifact (``FLEET_BENCH_CPU.json``); ``chaos_episodes`` marks
 a chaos-harness artifact (``CHAOS_BENCH_CPU.json``);
 ``decode_pallas_us`` marks a kernel-tier microbench artifact
-(``KERNEL_BENCH_CPU.json``); ``tokens_per_sec`` marks a serving
-artifact; ``metric`` marks a train artifact. Contexts
+(``KERNEL_BENCH_CPU.json``); ``train_fusion`` marks a train-step
+fusion artifact (``TRAIN_BENCH_CPU.json``); ``tokens_per_sec`` marks
+a serving artifact; ``metric`` marks a train artifact. Contexts
 must match before numbers are compared — platform, model and workload
 knobs for serving; the metric string for train — otherwise the compare
 is skipped with exit 0 (a CPU artifact is not a regression signal for a
@@ -52,7 +54,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DEFAULT_ARTIFACTS = ("SERVING_BENCH_CPU.json", "BENCH_r05.json",
                      "LONGDOC_BENCH_CPU.json", "FLEET_BENCH_CPU.json",
-                     "KERNEL_BENCH_CPU.json", "CHAOS_BENCH_CPU.json")
+                     "KERNEL_BENCH_CPU.json", "CHAOS_BENCH_CPU.json",
+                     "TRAIN_BENCH_CPU.json")
 
 # -- tolerance profiles -------------------------------------------------
 # key -> (direction, rel_tol). direction "higher" means bigger is better:
@@ -123,6 +126,20 @@ KERNELS_TOLERANCES = {
     "band_xla_us":           ("lower", 2.00),
 }
 
+# Train-step fusion leg: absolute step_ms on a shared CPU runner is
+# noisy, so its bands are loose; the overlapped/sequential ratio (same
+# box, same run — noise cancels) and the deterministic schedule-
+# simulator bubbles are the gate-worthy signals. Parity flags are
+# schema-checked, not toleranced.
+TRAINSTEP_TOLERANCES = {
+    "seq_step_ms":         ("lower", 1.00),
+    "overlap_step_ms":     ("lower", 1.00),
+    "overlap_vs_seq":      ("lower", 0.15),
+    "bubble_1f1b":         ("lower", 0.01),
+    "bubble_interleaved":  ("lower", 0.01),
+    "comm_overlap_frac":   ("higher", 0.10),
+}
+
 # Chaos leg: recovery times on a shared CPU runner are pure noise, so
 # only the episode/throughput counters get (very loose) bands — the real
 # gate is the schema check refusing any baseline whose invariant flags
@@ -153,6 +170,12 @@ KERNELS_CONTEXT = ("platform", "interpret", "iters", "decode_shape",
 # the seed is load-bearing: two different seeds run two different fault
 # schedules, so their counters are not comparable.
 CHAOS_CONTEXT = ("platform", "model", "chaos_seed", "chaos_episodes")
+# bucket size and the pipeline shape are load-bearing: a different
+# bucket plan compiles a different collective structure, and bubbles
+# are a pure function of (S, M, V).
+TRAINSTEP_CONTEXT = ("platform", "model", "n_devices", "zero_stage",
+                     "reduce_bucket_size", "pipe_stages",
+                     "pipe_micro_batches")
 
 # -- schema -------------------------------------------------------------
 SERVING_REQUIRED = {
@@ -202,6 +225,18 @@ KERNELS_REQUIRED = {
     "band_parity_ok": bool, "complete": bool,
 }
 
+TRAINSTEP_REQUIRED = {
+    "platform": str, "model": str, "n_devices": int, "zero_stage": int,
+    "reduce_bucket_size": int, "reduce_buckets": int, "parity_ok": bool,
+    "parity_steps": int, "baseline_step_ms": (int, float),
+    "seq_step_ms": (int, float),
+    "overlap_step_ms": (int, float), "overlap_vs_seq": (int, float),
+    "collectives_seq": int, "collectives_overlap": int,
+    "pipe_stages": int, "pipe_micro_batches": int, "pipe_loss_match": bool,
+    "bubble_1f1b": (int, float), "bubble_interleaved": (int, float),
+    "complete": bool,
+}
+
 CHAOS_REQUIRED = {
     "platform": str, "model": str, "chaos_episodes": int, "chaos_seed": int,
     "completed_total": int, "shed_total": int,
@@ -223,20 +258,28 @@ LONGDOC_MIN_SPEEDUP = 5.0
 # scaling vs 1 (in the artifact's own scaling_mode) to be a baseline
 FLEET_MIN_SCALING_2X = 1.8
 
+# trainstep acceptance floor: the bucket plan must actually split the
+# gradient set — a single bucket is the monolithic reduce wearing a hat
+TRAINSTEP_MIN_BUCKETS = 2
+
 TOLERANCES = {"serving": SERVING_TOLERANCES, "train": TRAIN_TOLERANCES,
               "longdoc": LONGDOC_TOLERANCES, "fleet": FLEET_TOLERANCES,
-              "kernels": KERNELS_TOLERANCES, "chaos": CHAOS_TOLERANCES}
+              "kernels": KERNELS_TOLERANCES, "chaos": CHAOS_TOLERANCES,
+              "trainstep": TRAINSTEP_TOLERANCES}
 CONTEXTS = {"serving": SERVING_CONTEXT, "train": TRAIN_CONTEXT,
             "longdoc": LONGDOC_CONTEXT, "fleet": FLEET_CONTEXT,
-            "kernels": KERNELS_CONTEXT, "chaos": CHAOS_CONTEXT}
+            "kernels": KERNELS_CONTEXT, "chaos": CHAOS_CONTEXT,
+            "trainstep": TRAINSTEP_CONTEXT}
 REQUIRED = {"serving": SERVING_REQUIRED, "train": TRAIN_REQUIRED,
             "longdoc": LONGDOC_REQUIRED, "fleet": FLEET_REQUIRED,
-            "kernels": KERNELS_REQUIRED, "chaos": CHAOS_REQUIRED}
+            "kernels": KERNELS_REQUIRED, "chaos": CHAOS_REQUIRED,
+            "trainstep": TRAINSTEP_REQUIRED}
 
 
 def load_artifact(path):
     """Read + unwrap one artifact; returns (kind, payload). kind is
-    "serving", "train", "longdoc", "fleet", "chaos" or "kernels"."""
+    "serving", "train", "longdoc", "fleet", "chaos", "kernels" or
+    "trainstep"."""
     with open(path) as f:
         doc = json.load(f)
     if not isinstance(doc, dict):
@@ -254,6 +297,10 @@ def load_artifact(path):
         return "chaos", doc
     if "decode_pallas_us" in doc:
         return "kernels", doc
+    # trainstep before the generic serving/train markers: its stdout
+    # "metric" line shape must never demote the artifact to kind "train"
+    if "train_fusion" in doc:
+        return "trainstep", doc
     if "tokens_per_sec" in doc:
         return "serving", doc
     if "metric" in doc:
@@ -261,7 +308,7 @@ def load_artifact(path):
     raise ValueError(
         f"{path}: unrecognized artifact (no 'speedup_sparse_vs_dense_16k', "
         f"'fleet_scaling_2x', 'chaos_episodes', 'decode_pallas_us', "
-        f"'tokens_per_sec' or 'metric' key; "
+        f"'train_fusion', 'tokens_per_sec' or 'metric' key; "
         f"top-level keys: {sorted(doc)[:8]})")
 
 
@@ -371,6 +418,44 @@ def check_schema(path):
             problems.append(
                 f"{path}: 'completed_total' must be > 0 — a schedule where "
                 f"nothing completed proves nothing")
+    elif kind == "trainstep":
+        if doc.get("complete") is not True:
+            problems.append(f"{path}: 'complete' is not true — a partial "
+                            f"bench run must not be committed as a baseline")
+        if doc.get("parity_ok") is not True:
+            problems.append(
+                f"{path}: 'parity_ok' is not true — an overlapped step that "
+                f"diverges from the sequential oracle must never become a "
+                f"baseline")
+        if doc.get("pipe_loss_match") is not True:
+            problems.append(
+                f"{path}: 'pipe_loss_match' is not true — the interleaved "
+                f"schedule must reproduce the 1F1B losses")
+        seq_ms = doc.get("seq_step_ms")
+        ovl_ms = doc.get("overlap_step_ms")
+        for key, v in (("seq_step_ms", seq_ms), ("overlap_step_ms", ovl_ms)):
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v <= 0:
+                problems.append(f"{path}: '{key}' must be > 0, got {v}")
+        if all(isinstance(v, (int, float)) and not isinstance(v, bool)
+               for v in (seq_ms, ovl_ms)) and ovl_ms > seq_ms:
+            problems.append(
+                f"{path}: 'overlap_step_ms' ({ovl_ms}) exceeds "
+                f"'seq_step_ms' ({seq_ms}) — the overlapped step must not "
+                f"be slower than the sequential reduce it replaces")
+        b1, b2 = doc.get("bubble_1f1b"), doc.get("bubble_interleaved")
+        if all(isinstance(v, (int, float)) and not isinstance(v, bool)
+               for v in (b1, b2)) and not b2 < b1:
+            problems.append(
+                f"{path}: 'bubble_interleaved' ({b2}) must be strictly "
+                f"below 'bubble_1f1b' ({b1}) — interleaving that doesn't "
+                f"shrink the bubble proves nothing")
+        nb = doc.get("reduce_buckets")
+        if isinstance(nb, int) and not isinstance(nb, bool) \
+                and nb < TRAINSTEP_MIN_BUCKETS:
+            problems.append(
+                f"{path}: 'reduce_buckets' is {nb}, below the "
+                f"{TRAINSTEP_MIN_BUCKETS}-bucket acceptance floor")
     elif kind == "kernels":
         if doc.get("complete") is not True:
             problems.append(f"{path}: 'complete' is not true — a partial "
@@ -510,7 +595,7 @@ def main(argv=None):
                              "committed SERVING_BENCH_CPU.json + BENCH_r05."
                              "json + LONGDOC_BENCH_CPU.json + "
                              "FLEET_BENCH_CPU.json + KERNEL_BENCH_CPU.json "
-                             "+ CHAOS_BENCH_CPU.json")
+                             "+ CHAOS_BENCH_CPU.json + TRAIN_BENCH_CPU.json")
     parser.add_argument("mode", nargs="?", choices=["compare"],
                         help="compare FRESH BASELINE under tolerance bands")
     parser.add_argument("fresh", nargs="?", help="fresh bench JSON")

@@ -242,6 +242,18 @@ HOT_LOOPS = (
     ("deepspeed_tpu/inference/serving/engine.py", "_spec_step_kernel_jit"),
     ("deepspeed_tpu/runtime/engine.py", "DeepSpeedEngine._train_batch_now"),
     ("deepspeed_tpu/runtime/pipe/engine.py", "PipelineEngine._train_batch_now"),
+    # train-step fusion tier: the overlap tap's custom-vjp backward is
+    # traced into every fused train step (one reduce per bucket, pinned
+    # mid-backward), and the fused step builder assembles the donated
+    # jit program itself — a host sync in either serializes every step
+    ("deepspeed_tpu/runtime/zero/sharded_optimizer.py",
+     "ZeroShardedOptimizer.grad_overlap_tap"),
+    ("deepspeed_tpu/runtime/engine.py", "DeepSpeedEngine._get_train_step"),
+    # interleaved-1F1B conveyor: the merged schedule's per-tick command
+    # stream is what the interpreter executes every train_batch — its
+    # construction runs per (M, S, V) change, inside the step path
+    ("deepspeed_tpu/runtime/pipe/engine.py",
+     "_MergedInterleavedSchedule.__init__"),
 )
 
 HOT_MARKER = "jaxlint: hot"
