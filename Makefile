@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving test-router lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels bench-train trace-smoke bench-gate chaos-smoke
+.PHONY: test test-fast test-faults test-cluster test-serving test-router lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels bench-train trace-smoke bench-gate chaos-smoke bench-rollout
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -106,6 +106,19 @@ bench-fleet:
 chaos-smoke:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=chaos python bench.py --child
 	python -m tools.bench_gate --check-schema CHAOS_BENCH_CPU.json
+
+# Zero-downtime weight rollout: live checkpoint hot-swap against 2
+# incumbent replica processes — roll-forward on identical weights
+# (canary + shadow traffic + promote) and a forced-regression rollback
+# on different weights, both under continuous traffic with a streamed
+# exactly-once oracle. Writes ROLLOUT_BENCH_CPU.json; the bench gate's
+# schema check refuses any dropped/duplicated request, a rollback
+# exceeding the recovery bound, or a canary that never carried traffic.
+# Knobs: BENCH_ROLLOUT_SEED (default 0), BENCH_ROLLOUT_REQUESTS (per
+# phase, default 48), BENCH_ROLLOUT_OUT (redirects the artifact).
+bench-rollout:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=rollout python bench.py --child
+	python -m tools.bench_gate --check-schema ROLLOUT_BENCH_CPU.json
 
 # Kernel-tier microbench: Pallas (interpret on CPU) vs the composed-XLA
 # fallback for the fused paged decode (fp32 + int8) and banded sparse

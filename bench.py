@@ -138,6 +138,8 @@ def child_main():
         return fleet_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "chaos":
         return chaos_child_main()
+    if os.environ.get("BENCH_MODEL", "bert") == "rollout":
+        return rollout_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "kernels":
         return kernels_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "train":
@@ -1143,6 +1145,281 @@ def chaos_child_main():
     return 0
 
 
+def rollout_child_main():
+    """Zero-downtime weight-rollout leg: a live checkpoint hot-swap with
+    canary, shadow traffic, and a forced-regression rollback, proven
+    exactly-once end to end.
+
+    Spawns 2 incumbent replicas on a committed weight tag, then drives
+    :class:`RolloutController` through both halves of its contract under
+    continuous traffic:
+
+    1. ROLL-FORWARD: commit a tag with IDENTICAL weights (same init
+       seed). The canary's shadow replays diff bitwise-clean, the canary
+       slice carries real traffic, and the controller promotes +
+       commits, draining the old generation down the SIGTERM path.
+    2. FORCED REGRESSION: commit a tag with DIFFERENT weights (new init
+       seed). Shadow replays diff, the controller rolls the canary back
+       down the same drain path, and the fleet settles on the prior
+       generation within ``recovery_bound_s``.
+
+    Every request streams through a ``stream_cb`` idempotency oracle:
+    the streamed tokens must equal the final result exactly (no drop, no
+    dup, no reorder) and the result must match ONE per-generation
+    in-process ``generate()`` reference bitwise — a cross-generation
+    splice matches neither. Writes ROLLOUT_BENCH_CPU.json
+    (BENCH_ROLLOUT_OUT redirects); the gate's schema check REFUSES any
+    dropped/duplicated request, an unbounded rollback, or a canary that
+    never carried traffic."""
+    import random
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from deepspeed_tpu.inference import generate
+    from deepspeed_tpu.inference.serving.autoscaler import (
+        ProcessReplicaSpawner,
+    )
+    from deepspeed_tpu.inference.serving.config import (
+        FleetConfig,
+        RolloutConfig,
+    )
+    from deepspeed_tpu.inference.serving.rollout import RolloutController
+    from deepspeed_tpu.inference.serving.router import Router
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2
+    from deepspeed_tpu.runtime.checkpoint import CheckpointStorage
+
+    def progress(msg):
+        print(f"# rollout: {msg}", file=sys.stderr, flush=True)
+
+    model = {"vocab_size": 101, "hidden_size": 32, "num_hidden_layers": 2,
+             "num_attention_heads": 2, "max_position_embeddings": 128}
+    seed = int(os.environ.get("BENCH_ROLLOUT_SEED", "0"))
+    n_req = int(os.environ.get("BENCH_ROLLOUT_REQUESTS", "48"))
+    n_new = int(os.environ.get("BENCH_ROLLOUT_NEW_TOKENS", "8"))
+    canary_fraction = 0.5
+
+    gcfg = GPT2Config(**model, hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    _params = {}    # init seed -> params (the per-generation oracles)
+    _oracle_cache = {}
+
+    def reference(init_seed, prompt):
+        key = (init_seed, tuple(prompt))
+        if key not in _oracle_cache:
+            if init_seed not in _params:
+                _, _params[init_seed] = init_gpt2(
+                    gcfg, batch_size=1, seq_len=8, seed=init_seed)
+            _oracle_cache[key] = np.asarray(generate(
+                _params[init_seed], gcfg, np.asarray([prompt], np.int32),
+                n_new))[0].tolist()
+        return _oracle_cache[key]
+
+    tmp = tempfile.mkdtemp(prefix="rollout_bench_")
+    ckpt_root = os.path.join(tmp, "ckpts")
+    storage = CheckpointStorage()
+
+    def commit_tag(tag, init_seed):
+        w = storage.tag_writer(ckpt_root, tag)
+        w.write_file("weights.json",
+                     json.dumps({"seed": init_seed}).encode())
+        w.commit()
+
+    def config_for_generation(tag):
+        """Weight tag -> replica config booted on that tag's init seed
+        (the tiny-model stand-in for loading the tag's weights)."""
+        with open(os.path.join(ckpt_root, tag, "weights.json")) as f:
+            init_seed = int(json.load(f)["seed"])
+        path = os.path.join(tmp, f"replica-{tag}.json")
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                json.dump({"model": model, "seed": init_seed,
+                           "ds_config": {"train_batch_size": 1,
+                                         "serving": {"max_slots": 4,
+                                                     "max_queue": 16,
+                                                     "max_seq_len": 128}}},
+                          f)
+        return path
+
+    commit_tag("v1", 0)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    spawner = ProcessReplicaSpawner(
+        config_for_generation("v1"), env=env,
+        config_for_generation=config_for_generation)
+
+    streams = {}
+    oops = []            # idempotency-oracle violations, described
+
+    def stream_cb(key, tok):
+        streams.setdefault(key, []).append(tok)
+
+    router = None
+    controller = None
+    t_wall = time.perf_counter()
+    try:
+        progress("spawning 2 incumbent replicas on tag v1 (compile)")
+        incumbents = [spawner.spawn(f"inc-{i}", generation="v1")
+                      for i in range(2)]
+        router = Router(
+            [h.endpoint() for h in incumbents],
+            FleetConfig(enabled=True, retry_budget=3, retry_backoff_s=0.05,
+                        attempt_timeout_s=300.0, health_ttl_s=0.1,
+                        saturation_queue_depth=8, shed_retry_after_s=0.1,
+                        affinity_prefix_tokens=4))
+        for i in range(2):      # land compiles before any recovery clock
+            router.submit([2 + i, 3, 5, 7],
+                          max_new_tokens=n_new).result(timeout=600)
+        controller = RolloutController(
+            router, spawner, ckpt_root,
+            config=RolloutConfig(
+                enabled=True, canary_fraction=canary_fraction,
+                canary_replicas=1, shadow_sample_rate=0.5,
+                shadow_max_pending=16, canary_hold_s=0.5,
+                min_canary_requests=4, min_shadow_compared=3,
+                shadow_diff_threshold=0.0, max_canary_crashes=1,
+                poll_interval_s=0.05, recovery_bound_s=30.0),
+            replicas=incumbents, incumbent_tag="v1",
+            rng=random.Random(seed))
+
+        rng = random.Random(seed)
+
+        def pump(label, done):
+            """Submit n_req requests while single-stepping the
+            controller, then keep stepping until ``done()``."""
+            futs, i = [], 0
+            deadline = time.monotonic() + 300.0
+            while (i < n_req or not done()) \
+                    and time.monotonic() < deadline:
+                if i < n_req:
+                    prompt = [rng.randrange(2, 90) for _ in range(6)]
+                    key = f"{label}-{i}"
+                    try:
+                        futs.append((key, prompt, router.submit(
+                            prompt, max_new_tokens=n_new,
+                            stream_cb=stream_cb, key=key,
+                            shed_retries=20)))
+                    except Exception as e:
+                        oops.append(f"{key}: submit failed: {e!r}")
+                    i += 1
+                controller.step()
+                time.sleep(0.01)
+            return futs, done()
+
+        def settle(futs):
+            """Resolve every future against the idempotency oracle.
+            Returns (completed, dropped, duplicated)."""
+            completed = dropped = duplicated = 0
+            for key, prompt, fut in futs:
+                try:
+                    tokens = fut.result(timeout=300.0)
+                except Exception as e:
+                    dropped += 1
+                    oops.append(f"{key}: lost: {e!r}")
+                    continue
+                completed += 1
+                s = streams.get(key, [])
+                if len(s) > len(tokens) \
+                        or (len(s) == len(tokens) and s != tokens):
+                    duplicated += 1
+                    oops.append(f"{key}: stream/result divergence")
+                elif len(s) < len(tokens):
+                    dropped += 1
+                    oops.append(f"{key}: stream dropped tokens")
+                elif tokens not in (reference(0, prompt),
+                                    reference(1, prompt)):
+                    oops.append(f"{key}: matches no single generation")
+            return completed, dropped, duplicated
+
+        # -- phase 1: roll-forward on identical weights ------------------
+        progress("committing tag v2 (same weights) — expecting promote")
+        commit_tag("v2", 0)
+        futs, ok = pump("fwd", lambda: controller.current_tag == "v2")
+        m_fwd = controller.metrics.snapshot()
+        eps = {ep.generation for ep in router.endpoints()}
+        rollforward_ok = bool(ok) and eps == {"v2"}
+        c1, d1, dup1 = settle(futs)
+        rollforward_ok = rollforward_ok and not oops
+        progress(f"roll-forward: phase={controller.phase} "
+                 f"generations={sorted(eps)} completed={c1}")
+
+        # -- phase 2: forced regression on different weights -------------
+        controller.drive(until=("idle",), timeout_s=10.0)
+        progress("committing tag v3 (regressed weights) — expecting "
+                 "rollback")
+        commit_tag("v3", 1)
+        futs, ok = pump(
+            "bad", lambda: (controller.metrics.rollbacks_total >= 1
+                            and controller.phase == "idle"))
+        m_bad = controller.metrics.snapshot()
+        eps = {ep.generation for ep in router.endpoints()}
+        rollback_ok = (bool(ok) and eps == {"v2"}
+                       and controller.current_tag == "v2"
+                       and controller.metrics.last_rollback_reason
+                       == "shadow_diff")
+        c2, d2, dup2 = settle(futs)
+        rollback_ok = rollback_ok and not oops
+        recovery_s = controller.metrics.last_recovery_s
+        progress(f"rollback: phase={controller.phase} "
+                 f"reason={controller.metrics.last_rollback_reason!r} "
+                 f"recovery={recovery_s}s completed={c2}")
+        for msg in oops:
+            progress(f"ORACLE VIOLATION: {msg}")
+
+        canary_routed = int(router.counters().get("canary_routed", 0))
+    finally:
+        if controller is not None:
+            controller.stop()
+        if router is not None:
+            router.close()
+        spawner.stop_all()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    result = {
+        "platform": "cpu",
+        "model": "gpt2-tiny(L2,H32)",
+        "rollout_seed": seed,
+        "canary_fraction": canary_fraction,
+        "requests_total": 2 * n_req,
+        "completed_total": c1 + c2,
+        "dropped_total": d1 + d2,
+        "duplicated_total": dup1 + dup2,
+        "canary_routed_total": canary_routed,
+        "shadow_compared_total": int(m_fwd["shadow_compared_total"]
+                                     + m_bad["shadow_compared_total"]),
+        "shadow_diff_total": int(m_fwd["shadow_diff_total"]
+                                 + m_bad["shadow_diff_total"]),
+        "rollbacks_total": int(m_bad["rollbacks_total"]),
+        "rollforward_ok": rollforward_ok,
+        "rollback_ok": rollback_ok,
+        "rollback_recovery_s": round(float(recovery_s or 0.0), 3),
+        "recovery_bound_s": 30.0,
+        "wall_s": round(time.perf_counter() - t_wall, 1),
+        "complete": True,
+    }
+    out = os.environ.get("BENCH_ROLLOUT_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "ROLLOUT_BENCH_CPU.json")
+    with open(out, "w") as f:
+        f.write(json.dumps(result, indent=1) + "\n")
+    print(json.dumps({
+        "metric": f"weight rollout hot-swap ({2 * n_req} requests, "
+                  f"seed {seed}) rollback recovery",
+        "value": result["rollback_recovery_s"],
+        "unit": "s",
+        "vs_baseline": None,
+        **{k: result[k] for k in (
+            "completed_total", "dropped_total", "duplicated_total",
+            "canary_routed_total", "shadow_compared_total",
+            "shadow_diff_total", "rollforward_ok", "rollback_ok")},
+    }))
+    if not (result["rollforward_ok"] and result["rollback_ok"]
+            and result["dropped_total"] == 0
+            and result["duplicated_total"] == 0):
+        return 1
+    return 0
+
+
 def train_child_main():
     """Train-step fusion leg: overlapped per-bucket backward/reduce-scatter +
     donated buffers vs the sequential post-backward reduce, plus interleaved
@@ -1603,6 +1880,10 @@ def main():
         label = "chaos-schedule recovery p95"
         seq = os.environ.get("BENCH_CHAOS_EPISODES", "20")
         unit = "s recovery p95"
+    elif os.environ.get("BENCH_MODEL", "bert") == "rollout":
+        label = "weight-rollout hot-swap rollback recovery"
+        seq = os.environ.get("BENCH_ROLLOUT_REQUESTS", "48")
+        unit = "s rollback recovery"
     elif os.environ.get("BENCH_MODEL", "bert") == "kernels":
         label = "kernel-tier microbench"
         seq = os.environ.get("BENCH_KERNELS_ITERS", "10")

@@ -21,6 +21,7 @@ router (health-aware front-door with failover/drain/shedding).
 
 from deepspeed_tpu.inference.serving.config import (  # noqa: F401
     FleetConfig,
+    RolloutConfig,
     ServingConfig,
 )
 from deepspeed_tpu.inference.serving.engine import ServingEngine  # noqa: F401
@@ -31,12 +32,18 @@ from deepspeed_tpu.inference.serving.kv_pool import (  # noqa: F401
     KVCachePool,
     PoolExhaustedError,
 )
-from deepspeed_tpu.inference.serving.metrics import ServingMetrics  # noqa: F401
+from deepspeed_tpu.inference.serving.metrics import (  # noqa: F401
+    RolloutMetrics,
+    ServingMetrics,
+)
 from deepspeed_tpu.inference.serving.prefix_cache import (  # noqa: F401
     PrefixKVCache,
 )
 from deepspeed_tpu.inference.serving.replica import (  # noqa: F401
     ReplicaServer,
+)
+from deepspeed_tpu.inference.serving.rollout import (  # noqa: F401
+    RolloutController,
 )
 from deepspeed_tpu.inference.serving.router import (  # noqa: F401
     FleetOverloadError,
@@ -61,4 +68,5 @@ __all__ = [
     "EngineDrainingError", "ServingFaultInjector", "bucket_for",
     "default_buckets", "FleetConfig", "Router", "ReplicaEndpoint",
     "ReplicaServer", "FleetOverloadError", "RequestPoisonedError",
+    "RolloutConfig", "RolloutController", "RolloutMetrics",
 ]

@@ -69,11 +69,14 @@ def replica_op(host, port, doc, timeout_s=5.0):
 class SpawnedReplica:
     """Handle on one replica subprocess the spawner owns."""
 
-    def __init__(self, name, host, port, proc):
+    def __init__(self, name, host, port, proc, generation="0"):
         self.name = str(name)
         self.host = str(host)
         self.port = int(port)
         self.proc = proc
+        # weight-version tag the replica was booted on (which committed
+        # checkpoint generation it serves)
+        self.generation = str(generation if generation is not None else "0")
 
     @property
     def pid(self):
@@ -83,10 +86,12 @@ class SpawnedReplica:
         return self.proc.poll() is None
 
     def endpoint(self):
-        return ReplicaEndpoint(self.name, self.host, self.port)
+        return ReplicaEndpoint(self.name, self.host, self.port,
+                               generation=self.generation)
 
     def __repr__(self):
         return (f"SpawnedReplica({self.name}, {self.host}:{self.port}, "
+                f"gen={self.generation}, "
                 f"pid={self.pid}, alive={self.alive()})")
 
 
@@ -101,20 +106,30 @@ class ProcessReplicaSpawner:
     is SIGKILL (the chaos harness's hard death)."""
 
     def __init__(self, config_path, host="127.0.0.1", env=None,
-                 ready_timeout_s=120.0):
+                 ready_timeout_s=120.0, config_for_generation=None):
         self.config_path = str(config_path)
         self.host = str(host)
         self.env = dict(env) if env is not None else None
         self.ready_timeout_s = float(ready_timeout_s)
+        # optional resolver: weight tag -> replica config path, so a
+        # spawn can boot a specific committed checkpoint generation (the
+        # rollout controller's canary path). None = every spawn uses the
+        # default config regardless of tag.
+        self.config_for_generation = config_for_generation
         self._spawned = []
         self._lock = threading.Lock()
         self._seq = 0
 
-    def spawn(self, name=None):
-        """Start one replica and wait for its ready line."""
+    def spawn(self, name=None, generation=None):
+        """Start one replica and wait for its ready line. ``generation``
+        boots the replica on that weight tag (via the resolver) and
+        stamps the handle so the router can pin retries to it."""
         with self._lock:
             self._seq += 1
             name = name or f"replica-{self._seq}"
+        config_path = self.config_path
+        if generation is not None and self.config_for_generation is not None:
+            config_path = str(self.config_for_generation(str(generation)))
         env = dict(self.env if self.env is not None else os.environ)
         # the package may be a repo checkout rather than installed: the
         # child must import deepspeed_tpu regardless of the parent's cwd
@@ -124,7 +139,7 @@ class ProcessReplicaSpawner:
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
         proc = subprocess.Popen(
             [sys.executable, "-m", "deepspeed_tpu.inference.serving.replica",
-             "--config", self.config_path, "--port", "0",
+             "--config", config_path, "--port", "0",
              "--host", self.host],
             env=env, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, text=True)
@@ -146,7 +161,8 @@ class ProcessReplicaSpawner:
         if not ready.get("ready"):
             proc.kill()
             raise RuntimeError(f"replica {name} not ready: {ready}")
-        handle = SpawnedReplica(name, self.host, int(ready["port"]), proc)
+        handle = SpawnedReplica(name, self.host, int(ready["port"]), proc,
+                                generation=generation)
         with self._lock:
             self._spawned.append(handle)
         return handle
@@ -209,6 +225,11 @@ class Autoscaler:
         self._lock = threading.Lock()
         self._active = {h.name: h for h in replicas}    # routed handles
         self._spares = []                               # warm, NOT routed
+        # weight-version tag the pool targets: refills spawn on it, and
+        # scale-up refuses to attach a spare from a different generation
+        # (attaching stale weights mid-rollout would split the fleet).
+        # None = untagged fleet, any spare attaches.
+        self._weight_tag = None
         # fleet-level degrade ladder, driven only at the capacity ceiling
         self.ladder = ladder or DegradeLadder(
             None, on_change=self._push_rung, name="fleet")
@@ -287,17 +308,72 @@ class Autoscaler:
         self._refill_spares()
         return action
 
+    # -- weight-version-aware spare pool ---------------------------------
+    def set_weight_tag(self, tag):
+        """Target weight generation for the spare pool (the rollout
+        controller calls this on promote/rollback). Spares on a stale
+        generation are drained — they can never be attached again."""
+        tag = None if tag is None else str(tag)
+        with self._lock:
+            self._weight_tag = tag
+            keep, stale = [], []
+            for h in self._spares:
+                (keep if self._spare_matches(h, tag) else stale).append(h)
+            self._spares = keep
+        for h in stale:
+            self.spawner.drain(h)
+        return tag
+
+    @property
+    def weight_tag(self):
+        return self._weight_tag
+
+    @staticmethod
+    def _spare_matches(handle, tag):
+        return tag is None or getattr(handle, "generation", "0") == tag
+
+    def take_spares(self, tag, n):
+        """Hand up to ``n`` live spares on weight tag ``tag`` to a
+        caller (the rollout controller's canary boot), spawning the
+        shortfall cold on that tag. The caller owns routing and drain of
+        the returned handles. Spawn failures return a short list rather
+        than raising — the caller decides whether a partial canary is
+        acceptable."""
+        tag = str(tag)
+        out = []
+        with self._lock:
+            keep = []
+            for h in self._spares:
+                if (len(out) < n and h.alive()
+                        and getattr(h, "generation", "0") == tag):
+                    out.append(h)
+                else:
+                    keep.append(h)
+            self._spares = keep
+        while len(out) < n:
+            try:
+                out.append(self.spawner.spawn(generation=tag))
+            except Exception:
+                break
+        return out
+
     def _scale_up(self, now):
         handle = None
         with self._lock:
+            tag = self._weight_tag
+            keep = []
             while self._spares:
                 cand = self._spares.pop(0)
-                if cand.alive():
+                if handle is None and cand.alive() \
+                        and self._spare_matches(cand, tag):
                     handle = cand
-                    break
+                else:
+                    keep.append(cand)
+            self._spares = keep + self._spares
         if handle is None:
-            try:
-                handle = self.spawner.spawn()     # cold-start fallback
+            try:                        # cold-start fallback, on the tag
+                handle = (self.spawner.spawn() if tag is None
+                          else self.spawner.spawn(generation=tag))
             except Exception:
                 return None
         self.router.add_endpoint(handle.endpoint())
@@ -365,8 +441,10 @@ class Autoscaler:
                     < self.config.max_replicas + self.config.warm_spares)
         if not want:
             return
+        tag = self._weight_tag
         try:
-            handle = self.spawner.spawn()
+            handle = (self.spawner.spawn() if tag is None
+                      else self.spawner.spawn(generation=tag))
         except Exception:
             return
         with self._lock:

@@ -305,3 +305,131 @@ class ChaosHarness:
         eps = self.router.probe_all(force=True)
         healthy = all(ep.healthy and not ep.draining for ep in eps)
         return bool(healthy and self.router.degrade_rung == 0)
+
+
+ROLLOUT_FAULT_KINDS = ("kill_canary_mid_swap", "corrupt_new_tag")
+
+
+class RolloutChaosHarness(ChaosHarness):
+    """Chaos arms for the weight-rollout state machine
+    (inference/serving/rollout.py), on top of the base harness's
+    invariants (bitwise exactly-once, no stuck, bounded recovery,
+    convergence):
+
+    ``kill_canary_mid_swap``
+        Commit a good tag, drive the controller into its canary phase
+        under live traffic, then SIGKILL a canary replica. The
+        controller must detect the crash-loop, roll back down the drain
+        path, and the fleet must recover on the incumbent generation —
+        with every completed request still bitwise-correct.
+    ``corrupt_new_tag``
+        Commit a tag that fails manifest verification. The controller
+        must refuse it before any process boots on it: the machine never
+        leaves idle for that tag, no endpoint ever carries its
+        generation, and live traffic is untouched.
+
+    ``commit_good_tag()`` / ``commit_corrupt_tag()`` are injected
+    callables returning a fresh tag name — the test/bench owns the
+    checkpoint root and how "corrupt" is produced (torn shard, bad
+    digest). The controller must be constructed over the same root and
+    is stepped inline (not on its background thread) so every episode
+    is deterministic from the seed."""
+
+    def __init__(self, router, spawner, reference_fn, replicas, controller,
+                 commit_good_tag, commit_corrupt_tag, seed=0,
+                 faults=ROLLOUT_FAULT_KINDS, **kw):
+        super().__init__(router, spawner, reference_fn, replicas,
+                         seed=seed, faults=(), **kw)
+        self.controller = controller
+        self.commit_good_tag = commit_good_tag
+        self.commit_corrupt_tag = commit_corrupt_tag
+        self.faults = tuple(faults)
+        unknown = set(self.faults) - set(FAULT_KINDS + ROLLOUT_FAULT_KINDS)
+        if unknown:
+            raise ValueError(f"unknown fault kinds: {sorted(unknown)}")
+
+    def _drive_controller(self, until, timeout_s=30.0):
+        """Step the controller inline until its phase lands in ``until``
+        (or the deadline passes); returns the final phase."""
+        deadline = time.monotonic() + timeout_s
+        until = set(until)
+        while time.monotonic() < deadline:
+            self.controller.step()
+            if self.controller.phase in until:
+                break
+            time.sleep(0.01)
+        return self.controller.phase
+
+    def run_episode(self, kind=None):
+        kind = kind or self.rng.choice(self.faults)
+        if kind not in ROLLOUT_FAULT_KINDS:
+            return super().run_episode(kind)
+        record = {"kind": kind, "completed": 0, "shed": 0, "errors": 0,
+                  "stuck": 0, "bitwise_mismatch": 0}
+        if kind == "kill_canary_mid_swap":
+            self._episode_kill_canary(record)
+        else:
+            self._episode_corrupt_tag(record)
+        self._await_recovery(record)
+        self.episodes.append(record)
+        return record
+
+    def _episode_kill_canary(self, record):
+        c = self.controller
+        tag = self.commit_good_tag()
+        record["tag"] = tag
+        before = self._submit_batch(self.rng.randint(1, 3))
+        phase = self._drive_controller(("canary",),
+                                       timeout_s=self.recovery_timeout_s)
+        if phase != "canary":
+            record["rollout_ok"] = False
+            record["victim"] = None
+            self._collect(before, record)
+            return
+        with c._lock:
+            canaries = [h for h in c._canaries.values() if h.alive()]
+        victim = self.rng.choice(canaries) if canaries else None
+        record["victim"] = victim.name if victim else None
+        if victim is not None:
+            self.spawner.kill(victim)   # hard death mid-swap: no drain
+        during = self._submit_batch(self.rng.randint(1, 3), shed_retries=3)
+        # the controller must notice the crash-loop and walk the machine
+        # back to idle through rolling_back
+        phase = self._drive_controller(("idle",),
+                                       timeout_s=self.recovery_timeout_s)
+        self._collect(before, record)
+        self._collect(during, record)
+        eps = self.router.endpoints()
+        record["rollout_ok"] = (
+            phase == "idle"
+            and c.metrics.last_rollback_reason == "canary_crash"
+            and all(ep.generation == c.current_tag for ep in eps))
+
+    def _episode_corrupt_tag(self, record):
+        c = self.controller
+        tag = self.commit_corrupt_tag()
+        record["tag"] = tag
+        record["victim"] = None
+        before = self._submit_batch(self.rng.randint(1, 3))
+        # give the watcher several polls: the tag must be rejected (valid
+        # manifest, corrupt payload) or never observed (torn manifest)
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and c.phase == "idle" \
+                and tag not in c._bad_tags:
+            c.step()
+            time.sleep(0.01)
+        during = self._submit_batch(self.rng.randint(1, 3), shed_retries=3)
+        self._drive_controller(("idle",), timeout_s=self.recovery_timeout_s)
+        self._collect(before, record)
+        self._collect(during, record)
+        eps = self.router.endpoints()
+        record["rollout_ok"] = (
+            c.current_tag != tag
+            and all(ep.generation != tag for ep in eps))
+
+    def report(self):
+        rep = super().report()
+        rep["invariant_rollout_ok"] = all(
+            e.get("rollout_ok", True) for e in self.episodes)
+        rep["rollbacks_total"] = self.controller.metrics.rollbacks_total
+        return rep

@@ -150,6 +150,46 @@ class BreakerConfig:
 
 
 @dataclass
+class RolloutConfig:
+    """The ``fleet.rollout`` sub-block: zero-downtime weight rollout
+    (inference/serving/rollout.py). Opt-in: presence enables."""
+
+    enabled: bool = False
+    # Fraction of NEW requests routed onto the canary generation while
+    # the rollout is in its canary phase (deterministic prefix-hash
+    # slice, so cache affinity survives the split).
+    canary_fraction: float = 0.1
+    # Replicas booted on the new weights for the canary phase.
+    canary_replicas: int = 1
+    # Fraction of completed live requests replayed against the canary as
+    # shadow traffic (output-diffed against the incumbent's answer).
+    # 0 = shadow mode off.
+    shadow_sample_rate: float = 0.25
+    # Bounded shadow backlog; beyond it, samples are dropped (shadowing
+    # must never apply backpressure to live traffic).
+    shadow_max_pending: int = 64
+    # Canary soak gates before promotion: hold at least this long AND
+    # carry at least this many canary-routed attempts AND (with
+    # shadowing on) compare at least this many shadow replays.
+    canary_hold_s: float = 5.0
+    min_canary_requests: int = 8
+    min_shadow_compared: int = 4
+    # Shadow diff rate (diffs / compared) ABOVE this triggers rollback.
+    # 0.0 = any diff at all rolls back (the bitwise-oracle default).
+    shadow_diff_threshold: float = 0.0
+    # Canary process deaths during canary/promote that trigger rollback.
+    max_canary_crashes: int = 1
+    # Which regression signals may trigger automatic rollback; subset of
+    # {"slo_alert", "shadow_diff", "canary_crash"}.
+    rollback_on: tuple = ("slo_alert", "shadow_diff", "canary_crash")
+    # Manifest poll cadence of the background watch loop.
+    poll_interval_s: float = 0.5
+    # Rollback must restore a healthy single-generation fleet within
+    # this bound (the chaos harness asserts it).
+    recovery_bound_s: float = 30.0
+
+
+@dataclass
 class FleetConfig:
     """The ``fleet`` block: router + replica-fleet policy
     (inference/serving/router.py, replica.py). Opt-in like ``serving``:
@@ -201,3 +241,4 @@ class FleetConfig:
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
     degrade: DegradeConfig = field(default_factory=DegradeConfig)
     breaker: BreakerConfig = field(default_factory=BreakerConfig)
+    rollout: RolloutConfig = field(default_factory=RolloutConfig)

@@ -256,3 +256,100 @@ class ServingMetrics:
     def close(self):
         if self.monitor is not None:
             self.monitor.flush()
+
+
+# rollout phases in escalation order; the phase gauge exports the index
+ROLLOUT_PHASES = ("idle", "staging", "canary", "promoting", "rolling_back",
+                  "committed")
+
+
+class RolloutMetrics:
+    """Counters and gauges for the weight-rollout state machine.
+
+    Two lifetimes on purpose: *per-rollout* counters (shadow compares,
+    shadow diffs, canary crashes) reset when ``begin_rollout`` starts the
+    next attempt — a diff rate must describe THIS canary, not a previous
+    one — while *fleet-lifetime* counters (rollouts/rollbacks/commits)
+    only ever grow. Exported under ``Rollout/*`` (``Rollout/phase``,
+    ``Rollout/shadow_diff_total``, ``Rollout/rollbacks_total``, ...)."""
+
+    def __init__(self, monitor=None):
+        self.monitor = monitor
+        self.phase = "idle"
+        self.target_tag = None
+        # lifetime
+        self.rollouts_total = 0
+        self.rollbacks_total = 0
+        self.commits_total = 0
+        # per-rollout (reset by begin_rollout)
+        self.shadow_compared_total = 0
+        self.shadow_diff_total = 0
+        self.canary_crashes = 0
+        self.last_rollback_reason = None
+        self.last_recovery_s = None
+
+    def begin_rollout(self, tag):
+        self.rollouts_total += 1
+        self.target_tag = str(tag)
+        self.shadow_compared_total = 0
+        self.shadow_diff_total = 0
+        self.canary_crashes = 0
+        self.last_rollback_reason = None
+        self.last_recovery_s = None
+        self.set_phase("staging")
+
+    def set_phase(self, phase):
+        if phase not in ROLLOUT_PHASES:
+            raise ValueError(f"unknown rollout phase {phase!r}")
+        self.phase = phase
+        self._record("Rollout/phase", float(ROLLOUT_PHASES.index(phase)),
+                     self.rollouts_total)
+
+    def record_shadow(self, matched):
+        self.shadow_compared_total += 1
+        if not matched:
+            self.shadow_diff_total += 1
+        self._record("Rollout/shadow_diff_total",
+                     float(self.shadow_diff_total),
+                     self.shadow_compared_total)
+
+    def record_canary_crash(self):
+        self.canary_crashes += 1
+
+    def record_rollback(self, reason):
+        self.rollbacks_total += 1
+        self.last_rollback_reason = str(reason)
+        self._record("Rollout/rollbacks_total",
+                     float(self.rollbacks_total), self.rollouts_total)
+
+    def record_commit(self):
+        self.commits_total += 1
+
+    def shadow_diff_rate(self):
+        if self.shadow_compared_total <= 0:
+            return 0.0
+        return self.shadow_diff_total / self.shadow_compared_total
+
+    def _record(self, tag, value, step):
+        if self.monitor is not None:
+            self.monitor.record(tag, value, step)
+
+    def snapshot(self):
+        return {
+            "phase": float(ROLLOUT_PHASES.index(self.phase)),
+            "rollouts_total": float(self.rollouts_total),
+            "rollbacks_total": float(self.rollbacks_total),
+            "commits_total": float(self.commits_total),
+            "shadow_compared_total": float(self.shadow_compared_total),
+            "shadow_diff_total": float(self.shadow_diff_total),
+            "shadow_diff_rate": float(self.shadow_diff_rate()),
+            "canary_crashes": float(self.canary_crashes),
+            "last_recovery_s": float(self.last_recovery_s or 0.0),
+        }
+
+    def export_to(self, registry, name="Rollout"):
+        """Pull gauges under ``Rollout/*`` so the SLO engine and the
+        fleet collector can alert on a stuck or flapping rollout."""
+        registry.gauge_fn(name, self.snapshot,
+                          help="weight-rollout state machine counters")
+        return registry

@@ -128,16 +128,22 @@ def _http_json(url, timeout_s):
 class ReplicaEndpoint:
     """One replica's addresses + the router's live view of it."""
 
-    def __init__(self, name, host, port, health_url=None):
+    def __init__(self, name, host, port, health_url=None, generation="0"):
         self.name = str(name)
         self.host = str(host)
         self.port = int(port)
         # telemetry endpoint ("http://127.0.0.1:9100"); None = probe the
         # serving socket with {"op": "health"} instead
         self.health_url = health_url.rstrip("/") if health_url else None
+        # weight-version tag: which committed checkpoint generation this
+        # replica serves. Greedy decoding is deterministic PER generation,
+        # so exactly-once replay across replicas is only bitwise-safe
+        # within one generation — retry selection pins on it.
+        self.generation = str(generation if generation is not None else "0")
         # router-side view, refreshed by probes
         self.healthy = True
         self.draining = False
+        self.removed = False        # detached via remove_endpoint()
         self.load_hint = 0          # queue_depth + active from last probe
         self.inflight = 0           # attempts the router has on this replica
         self.last_probe = 0.0
@@ -154,6 +160,7 @@ class ReplicaEndpoint:
 
     def __repr__(self):
         return (f"ReplicaEndpoint({self.name}, {self.host}:{self.port}, "
+                f"gen={self.generation}, "
                 f"healthy={self.healthy}, draining={self.draining}, "
                 f"load={self.load_hint}+{self.inflight})")
 
@@ -161,7 +168,7 @@ class ReplicaEndpoint:
 class _RoutedRequest:
     __slots__ = ("key", "prompt", "max_new_tokens", "eos_token_id",
                  "timeout_s", "stream_cb", "request_class", "cost",
-                 "future", "delivered", "t0")
+                 "future", "delivered", "t0", "generation")
 
     def __init__(self, key, prompt, max_new_tokens, eos_token_id, timeout_s,
                  stream_cb, request_class, cost):
@@ -176,6 +183,11 @@ class _RoutedRequest:
         self.future = ServingFuture(key)
         self.delivered = 0          # exactly-once high-water mark
         self.t0 = time.monotonic()  # original submit time (age_s on retry)
+        # weight generation that streamed the first token: once any token
+        # is delivered, retries must stay on this generation (different
+        # weights would replay a different suffix and break bitwise
+        # exactly-once). None until then.
+        self.generation = None
 
 
 class Router:
@@ -200,6 +212,8 @@ class Router:
         self._inflight_tokens = {}      # class -> tokens in flight
         self._inflight_requests = 0
         self._degrade_rung = 0          # rung 3 sheds classes at the door
+        self._canary = None             # (generation, fraction) or None
+        self._tap = None                # completion tap (shadow sampling)
         self._threads = set()
         self._closed = False
         self._counters = {
@@ -211,6 +225,7 @@ class Router:
             "completed": 0,     # requests finished successfully
             "failed": 0,        # requests finished with a terminal error
             "poisoned": 0,      # requests quarantined
+            "canary_routed": 0,  # attempts landed on the canary generation
         }
         if registry is not None:
             self.export_gauges(registry)
@@ -295,13 +310,16 @@ class Router:
         """Current endpoint list (a snapshot)."""
         return list(self._endpoints)
 
-    def add_endpoint(self, ep):
+    def add_endpoint(self, ep, generation=None):
         """Attach a replica to the rotation (the autoscaler's scale-up:
         the process is already warm and listening, attach is O(1)).
         The list is re-sorted by name so the affinity hash stays stable
-        across router processes."""
+        across router processes. ``generation`` overrides the endpoint's
+        weight-version tag (the rollout controller tags canaries here)."""
         if not isinstance(ep, ReplicaEndpoint):
             ep = ReplicaEndpoint(*ep)
+        if generation is not None:
+            ep.generation = str(generation)
         with self._lock:
             if any(e.name == ep.name for e in self._endpoints):
                 raise ValueError(f"endpoint {ep.name!r} already routed")
@@ -322,9 +340,60 @@ class Router:
                 raise ValueError(f"no endpoint named {name!r}")
             if len(self._endpoints) == 1:
                 raise ValueError("cannot remove the last endpoint")
+            # flags first, THEN the list swap: a picker holding the old
+            # list snapshot still sees removed/draining on the shared
+            # endpoint object and skips it (the drain race fix — the swap
+            # alone leaves a window where a mid-retry request re-selects
+            # the detached replica from its stale snapshot)
+            ep.removed = True
+            ep.draining = True
             self._endpoints = [e for e in self._endpoints if e is not ep]
-        ep.draining = True
         return ep
+
+    # -- canary slice (the rollout controller's contract) ----------------
+    def set_canary(self, generation, fraction):
+        """Route a deterministic ``fraction`` of NEW requests onto
+        replicas tagged ``generation``. The slice is chosen by hashing
+        the same prompt prefix the affinity hash uses, so a given prefix
+        always lands in the same group and cache locality survives the
+        split; within each group, prefix-affinity hashing applies
+        unchanged. In-flight requests are never migrated."""
+        fraction = float(fraction)
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"canary fraction {fraction} not in [0, 1]")
+        self._canary = (str(generation), fraction)
+        return self._canary
+
+    def clear_canary(self):
+        """Drop the canary split: routing reverts to one undivided pool
+        (rollback, or promotion once every replica is on the new tag)."""
+        self._canary = None
+
+    @property
+    def canary(self):
+        """(generation, fraction) of the active canary slice, or None."""
+        return self._canary
+
+    def _in_canary_slice(self, prompt, fraction):
+        if fraction >= 1.0:
+            return True
+        if fraction <= 0.0:
+            return False
+        n = max(1, self.config.affinity_prefix_tokens)
+        prefix = ",".join(str(int(t)) for t in prompt[:n]).encode("ascii")
+        # salted so the slice decision decorrelates from the in-group
+        # replica choice, but still a pure function of the prefix
+        return (zlib.crc32(b"canary:" + prefix) % 10000) < fraction * 10000
+
+    # -- completion tap (shadow traffic sampling) ------------------------
+    def set_completion_tap(self, tap):
+        """Install ``tap(info)`` called once per successfully completed
+        request with ``{key, prompt, max_new_tokens, eos_token_id,
+        request_class, tokens, generation, latency_s}``. The rollout
+        controller samples these to replay as shadow traffic against the
+        canary. Pass None to uninstall. Tap exceptions are swallowed —
+        observation must not affect routing."""
+        self._tap = tap
 
     # -- degraded-mode ladder (rung 3 lives here) ------------------------
     def set_degrade_rung(self, rung):
@@ -340,6 +409,8 @@ class Router:
         return self._degrade_rung
 
     def _routable(self, ep, now=None):
+        if ep.removed:
+            return False
         ttl = self.config.health_ttl_s
         if ttl > 0:
             now = time.monotonic() if now is None else now
@@ -364,11 +435,21 @@ class Router:
         prefix = ",".join(str(int(t)) for t in prompt[:n]).encode("ascii")
         return eps[zlib.crc32(prefix) % len(eps)]
 
-    def _pick(self, rr, avoid=None):
+    def _pick(self, rr, avoid=None, eps=None):
         """Affinity target when healthy and unsaturated; else the
-        least-loaded routable replica; None when nothing is routable."""
+        least-loaded routable replica; None when nothing is routable.
+
+        Generation rules: a request that has delivered tokens is pinned
+        to the generation that produced them — a cross-generation replay
+        would recompute a different suffix and break bitwise exactly-once
+        — so candidates of other generations are never selected, even
+        when that means returning None and backing off. An unpinned
+        request under an active canary is assigned to the canary or
+        incumbent slice by prefix hash; affinity then applies within the
+        slice. ``eps`` exists for tests: pass a stale snapshot to prove
+        removed endpoints are still skipped."""
         now = time.monotonic()
-        eps = self._endpoints        # snapshot: add/remove swaps the list
+        eps = self._endpoints if eps is None else eps
         for ep in eps:
             self._probe(ep, now=now)
         candidates = [ep for ep in eps if self._routable(ep, now=now)]
@@ -376,11 +457,42 @@ class Router:
             candidates = [ep for ep in candidates if ep is not avoid]
         if not candidates:
             return None
-        target = self._affinity_target(rr.prompt, eps)
+        pool = eps                   # affinity pool: stable across health
+        if rr.generation is not None:
+            same_gen = [ep for ep in candidates
+                        if ep.generation == rr.generation]
+            if not same_gen:
+                return None
+            candidates = same_gen
+            pool = [ep for ep in eps if ep.generation == rr.generation]
+        else:
+            canary = self._canary
+            if canary is not None:
+                gen, frac = canary
+                want = self._in_canary_slice(rr.prompt, frac)
+                group = [ep for ep in candidates
+                         if (ep.generation == gen) == want]
+                if group:
+                    candidates = group
+                    pool = [ep for ep in eps
+                            if (ep.generation == gen) == want]
+                # an empty slice (canary crashed / not yet attached)
+                # falls through to the full candidate set: traffic keeps
+                # flowing on whatever is routable
+        chosen = None
+        target = self._affinity_target(rr.prompt, pool)
         if (target is not None and target in candidates
                 and not self._saturated(target)):
-            return target
-        return min(candidates, key=self._load)
+            chosen = target
+        else:
+            chosen = min(candidates, key=self._load)
+        # final re-validation: remove_endpoint() may have detached the
+        # chosen replica after the candidate filter ran (flags are set on
+        # the shared object before the list swap, so this check closes
+        # the stale-snapshot window)
+        if chosen.removed or chosen.draining:
+            return None
+        return chosen
 
     # -- admission control ----------------------------------------------
     def _class_budget(self, request_class):
@@ -518,6 +630,19 @@ class Router:
                 with self._lock:
                     self._counters["completed"] += 1
                 rr.future._finish()
+                tap = self._tap
+                if tap is not None:
+                    try:
+                        tap({"key": rr.key, "prompt": list(rr.prompt),
+                             "max_new_tokens": rr.max_new_tokens,
+                             "eos_token_id": rr.eos_token_id,
+                             "request_class": rr.request_class,
+                             "tokens": rr.future.tokens,
+                             "generation": ep.generation,
+                             "latency_s": max(
+                                 0.0, time.monotonic() - rr.t0)})
+                    except Exception:
+                        pass    # observation must not affect routing
                 return
             if outcome == "terminal":
                 with self._lock:
@@ -586,8 +711,11 @@ class Router:
         ("terminal", error-doc), ("rejected", reason), or
         ("failed", why) — only "failed" burns retry budget."""
         timeout = self.config.attempt_timeout_s or None
+        canary = self._canary
         with self._lock:
             self._counters["routed"] += 1
+            if canary is not None and ep.generation == canary[0]:
+                self._counters["canary_routed"] += 1
         ep.inflight += 1
         sock = None
         try:
@@ -607,7 +735,7 @@ class Router:
                 if "t" in doc:
                     i = int(doc.get("i", -1))
                     if i == rr.delivered:
-                        self._deliver(rr, int(doc["t"]))
+                        self._deliver(rr, int(doc["t"]), ep)
                     elif i > rr.delivered:
                         return "failed", (
                             f"token gap: got index {i}, "
@@ -637,7 +765,9 @@ class Router:
                 except OSError:
                     pass
 
-    def _deliver(self, rr, token):
+    def _deliver(self, rr, token, ep):
+        if rr.generation is None:
+            rr.generation = ep.generation   # pin: retries stay bitwise
         rr.future._append(token)
         rr.delivered += 1
         if rr.stream_cb is not None:

@@ -23,6 +23,8 @@ from deepspeed_tpu.runtime.checkpoint.fault_injection import (
 from deepspeed_tpu.runtime.checkpoint.manifest import (
     MANIFEST_NAME,
     CheckpointCorruptionError,
+    TagWatcher,
+    latest_committed_tag,
     read_manifest,
     verify_tag_dir,
 )
@@ -41,7 +43,9 @@ __all__ = [
     "InjectedCrash",
     "InjectedFault",
     "MANIFEST_NAME",
+    "TagWatcher",
     "TagWriter",
+    "latest_committed_tag",
     "read_manifest",
     "verify_tag_dir",
 ]

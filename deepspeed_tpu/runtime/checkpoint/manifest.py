@@ -119,6 +119,69 @@ def verify_entry(name, entry, size, crc, sha):
         )
 
 
+def latest_committed_tag(root):
+    """``(tag, sequence)`` of the newest committed tag under ``root``,
+    or None when nothing is committed.
+
+    "Committed" means a valid manifest is present, so a torn or
+    half-written tag is invisible here by construction: the manifest is
+    written last and ``read_manifest`` returns None for an absent or
+    unparseable one. Deleting the newest tag's manifest (an operator
+    rollback) makes this fall back to the previous committed tag. Ties
+    on sequence (should not happen) break lexicographically so the
+    answer is deterministic."""
+    best = None
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return None
+    for name in entries:
+        tag_dir = os.path.join(root, name)
+        if not os.path.isdir(tag_dir):
+            continue
+        m = read_manifest(tag_dir)
+        if m is None:
+            continue
+        key = (int(m["sequence"]), name)
+        if best is None or key > best:
+            best = key
+    if best is None:
+        return None
+    return best[1], best[0]
+
+
+class TagWatcher:
+    """Poll-based watch over a checkpoint save dir's committed tags.
+
+    ``poll()`` returns ``(tag, sequence)`` exactly once per observed
+    change of the latest committed tag, else None. Both directions are
+    reported: a newly committed tag (higher sequence) and a rollback to
+    a previous tag (the newest manifest was deleted, so the latest
+    committed tag regresses). Consumers that only want roll-forward
+    filter on ``sequence`` themselves.
+
+    The watcher never reports a half-committed tag: visibility is
+    gated on the atomically-written manifest, the tag's commit record.
+    """
+
+    def __init__(self, root):
+        self.root = root
+        self._last = self.current()
+
+    def current(self):
+        """Latest committed ``(tag, sequence)`` right now, or None."""
+        return latest_committed_tag(self.root)
+
+    def poll(self):
+        """(tag, sequence) if the latest committed tag changed since the
+        previous poll (or since construction), else None."""
+        now = self.current()
+        if now == self._last:
+            return None
+        self._last = now
+        return now
+
+
 def verify_tag_dir(tag_dir, manifest=None, deep=False):
     """Check a committed tag's inventory against the filesystem.
 

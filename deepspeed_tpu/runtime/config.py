@@ -828,6 +828,106 @@ def _get_fleet_breaker(params):
     )
 
 
+def _get_fleet_rollout(params):
+    """fleet.rollout sub-block: zero-downtime weight rollout."""
+    from deepspeed_tpu.inference.serving.config import RolloutConfig
+
+    section = params.get(FLEET_ROLLOUT, None)
+    if section is not None and not isinstance(section, dict):
+        raise ValueError(
+            f"fleet.{FLEET_ROLLOUT} must be a dict, "
+            f"got {type(section).__name__}"
+        )
+    sub = section or {}
+    enabled = bool(get_scalar_param(sub, FLEET_ROLLOUT_ENABLED, section is not None))
+    fractions = (
+        (FLEET_ROLLOUT_CANARY_FRACTION, FLEET_ROLLOUT_CANARY_FRACTION_DEFAULT,
+         "traffic slice routed to the canary generation"),
+        (FLEET_ROLLOUT_SHADOW_SAMPLE_RATE,
+         FLEET_ROLLOUT_SHADOW_SAMPLE_RATE_DEFAULT,
+         "completed-request fraction replayed as shadow traffic"),
+        (FLEET_ROLLOUT_SHADOW_DIFF_THRESHOLD,
+         FLEET_ROLLOUT_SHADOW_DIFF_THRESHOLD_DEFAULT,
+         "shadow diff rate above which the canary rolls back"),
+    )
+    fracs = {}
+    for key, default, what in fractions:
+        v = get_scalar_param(sub, key, default)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or not 0 <= v <= 1:
+            raise ValueError(
+                f"fleet.{FLEET_ROLLOUT}.{key} must be a number in [0, 1] "
+                f"({what}), got {v!r}"
+            )
+        fracs[key] = float(v)
+    ints = (
+        (FLEET_ROLLOUT_CANARY_REPLICAS, FLEET_ROLLOUT_CANARY_REPLICAS_DEFAULT,
+         1, "replicas booted on the new weights for the canary"),
+        (FLEET_ROLLOUT_SHADOW_MAX_PENDING,
+         FLEET_ROLLOUT_SHADOW_MAX_PENDING_DEFAULT, 1,
+         "bounded shadow backlog"),
+        (FLEET_ROLLOUT_MIN_CANARY_REQUESTS,
+         FLEET_ROLLOUT_MIN_CANARY_REQUESTS_DEFAULT, 0,
+         "canary-routed attempts required before promotion"),
+        (FLEET_ROLLOUT_MIN_SHADOW_COMPARED,
+         FLEET_ROLLOUT_MIN_SHADOW_COMPARED_DEFAULT, 0,
+         "shadow compares required before promotion"),
+        (FLEET_ROLLOUT_MAX_CANARY_CRASHES,
+         FLEET_ROLLOUT_MAX_CANARY_CRASHES_DEFAULT, 0,
+         "canary process deaths that trigger rollback"),
+    )
+    ivals = {}
+    for key, default, lo, what in ints:
+        v = get_scalar_param(sub, key, default)
+        if not isinstance(v, int) or isinstance(v, bool) or v < lo:
+            raise ValueError(
+                f"fleet.{FLEET_ROLLOUT}.{key} must be an int >= {lo} "
+                f"({what}), got {v!r}"
+            )
+        ivals[key] = v
+    numbers = (
+        (FLEET_ROLLOUT_CANARY_HOLD, FLEET_ROLLOUT_CANARY_HOLD_DEFAULT,
+         "minimum canary soak before promotion"),
+        (FLEET_ROLLOUT_POLL_INTERVAL, FLEET_ROLLOUT_POLL_INTERVAL_DEFAULT,
+         "manifest poll cadence"),
+        (FLEET_ROLLOUT_RECOVERY_BOUND, FLEET_ROLLOUT_RECOVERY_BOUND_DEFAULT,
+         "rollback recovery deadline"),
+    )
+    fvals = {}
+    for key, default, what in numbers:
+        v = get_scalar_param(sub, key, default)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            raise ValueError(
+                f"fleet.{FLEET_ROLLOUT}.{key} must be a number >= 0 "
+                f"({what}), got {v!r}"
+            )
+        fvals[key] = float(v)
+    rollback_on = sub.get(FLEET_ROLLOUT_ROLLBACK_ON,
+                          FLEET_ROLLOUT_ROLLBACK_ON_DEFAULT)
+    valid = set(FLEET_ROLLOUT_ROLLBACK_ON_DEFAULT)
+    if not isinstance(rollback_on, (list, tuple)) or any(
+            trigger not in valid for trigger in rollback_on):
+        raise ValueError(
+            f"fleet.{FLEET_ROLLOUT}.{FLEET_ROLLOUT_ROLLBACK_ON} must be a "
+            f"list drawn from {sorted(valid)}, got {rollback_on!r}"
+        )
+    return RolloutConfig(
+        enabled=enabled,
+        canary_fraction=fracs[FLEET_ROLLOUT_CANARY_FRACTION],
+        canary_replicas=ivals[FLEET_ROLLOUT_CANARY_REPLICAS],
+        shadow_sample_rate=fracs[FLEET_ROLLOUT_SHADOW_SAMPLE_RATE],
+        shadow_max_pending=ivals[FLEET_ROLLOUT_SHADOW_MAX_PENDING],
+        canary_hold_s=fvals[FLEET_ROLLOUT_CANARY_HOLD],
+        min_canary_requests=ivals[FLEET_ROLLOUT_MIN_CANARY_REQUESTS],
+        min_shadow_compared=ivals[FLEET_ROLLOUT_MIN_SHADOW_COMPARED],
+        shadow_diff_threshold=fracs[FLEET_ROLLOUT_SHADOW_DIFF_THRESHOLD],
+        max_canary_crashes=ivals[FLEET_ROLLOUT_MAX_CANARY_CRASHES],
+        rollback_on=tuple(rollback_on),
+        poll_interval_s=fvals[FLEET_ROLLOUT_POLL_INTERVAL],
+        recovery_bound_s=fvals[FLEET_ROLLOUT_RECOVERY_BOUND],
+    )
+
+
 def get_fleet_config(param_dict):
     """fleet: routing front-door over N serving replicas
     (inference/serving/router.py, replica.py). Opt-in like the serving
@@ -928,6 +1028,7 @@ def get_fleet_config(param_dict):
         autoscale=_get_fleet_autoscale(params),
         degrade=_get_fleet_degrade(params),
         breaker=_get_fleet_breaker(params),
+        rollout=_get_fleet_rollout(params),
     )
 
 

@@ -316,6 +316,36 @@ FLEET_BREAKER_WINDOW_DEFAULT = 30.0
 FLEET_BREAKER_COOLDOWN = "cooldown_s"
 FLEET_BREAKER_COOLDOWN_DEFAULT = 5.0
 
+# fleet.rollout: zero-downtime weight rollout state machine
+# (inference/serving/rollout.py). Opt-in by sub-block presence.
+FLEET_ROLLOUT = "rollout"
+FLEET_ROLLOUT_ENABLED = "enabled"
+FLEET_ROLLOUT_CANARY_FRACTION = "canary_fraction"
+FLEET_ROLLOUT_CANARY_FRACTION_DEFAULT = 0.1
+FLEET_ROLLOUT_CANARY_REPLICAS = "canary_replicas"
+FLEET_ROLLOUT_CANARY_REPLICAS_DEFAULT = 1
+FLEET_ROLLOUT_SHADOW_SAMPLE_RATE = "shadow_sample_rate"
+FLEET_ROLLOUT_SHADOW_SAMPLE_RATE_DEFAULT = 0.25  # 0 = shadow mode off
+FLEET_ROLLOUT_SHADOW_MAX_PENDING = "shadow_max_pending"
+FLEET_ROLLOUT_SHADOW_MAX_PENDING_DEFAULT = 64
+FLEET_ROLLOUT_CANARY_HOLD = "canary_hold_s"
+FLEET_ROLLOUT_CANARY_HOLD_DEFAULT = 5.0
+FLEET_ROLLOUT_MIN_CANARY_REQUESTS = "min_canary_requests"
+FLEET_ROLLOUT_MIN_CANARY_REQUESTS_DEFAULT = 8
+FLEET_ROLLOUT_MIN_SHADOW_COMPARED = "min_shadow_compared"
+FLEET_ROLLOUT_MIN_SHADOW_COMPARED_DEFAULT = 4
+FLEET_ROLLOUT_SHADOW_DIFF_THRESHOLD = "shadow_diff_threshold"
+FLEET_ROLLOUT_SHADOW_DIFF_THRESHOLD_DEFAULT = 0.0  # any diff rolls back
+FLEET_ROLLOUT_MAX_CANARY_CRASHES = "max_canary_crashes"
+FLEET_ROLLOUT_MAX_CANARY_CRASHES_DEFAULT = 1
+FLEET_ROLLOUT_ROLLBACK_ON = "rollback_on"
+FLEET_ROLLOUT_ROLLBACK_ON_DEFAULT = (
+    "slo_alert", "shadow_diff", "canary_crash")
+FLEET_ROLLOUT_POLL_INTERVAL = "poll_interval_s"
+FLEET_ROLLOUT_POLL_INTERVAL_DEFAULT = 0.5
+FLEET_ROLLOUT_RECOVERY_BOUND = "recovery_bound_s"
+FLEET_ROLLOUT_RECOVERY_BOUND_DEFAULT = 30.0
+
 #############################################
 # Sparse attention
 #############################################

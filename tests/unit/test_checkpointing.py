@@ -483,3 +483,74 @@ def test_ckpt_legacy_tag_without_manifest_loads(tmpdir):
     assert "one" in name
     assert engine2.global_steps == steps_one
     _tree_equal(engine2.params, params_one)
+
+
+# ---------------------------------------------------------------------------
+# Tag watch: latest_committed_tag + TagWatcher (the rollout controller's
+# view of the commit protocol — no engine needed, pure manifest-level).
+# ---------------------------------------------------------------------------
+
+from deepspeed_tpu.runtime.checkpoint import (  # noqa: E402
+    CheckpointStorage,
+    TagWatcher,
+    latest_committed_tag,
+)
+
+
+def _commit_plain_tag(root, tag, payload=b"w"):
+    w = CheckpointStorage().tag_writer(str(root), tag)
+    w.write_file("weights.bin", payload)
+    w.commit()
+
+
+def test_latest_committed_tag_orders_by_sequence(tmpdir):
+    root = str(tmpdir.join("ckpt"))
+    assert latest_committed_tag(root) is None          # absent root
+    _commit_plain_tag(root, "zz-first")
+    _commit_plain_tag(root, "aa-second")               # lexically earlier
+    assert latest_committed_tag(root) == ("aa-second", 2)  # sequence wins
+
+
+def test_latest_committed_tag_ignores_torn_and_uncommitted(tmpdir):
+    root = str(tmpdir.join("ckpt"))
+    _commit_plain_tag(root, "good")
+    # an uncommitted tag dir (crash before the manifest landed)
+    os.makedirs(os.path.join(root, "torn"))
+    with open(os.path.join(root, "torn", "weights.bin"), "wb") as f:
+        f.write(b"partial")
+    # a torn manifest (crash mid-write): unparseable = uncommitted
+    os.makedirs(os.path.join(root, "half"))
+    with open(os.path.join(root, "half", MANIFEST_NAME), "w") as f:
+        f.write('{"version": 1, "seq')
+    # a stray file at the root is not a tag
+    with open(os.path.join(root, "latest"), "w") as f:
+        f.write("half")
+    assert latest_committed_tag(root) == ("good", 1)
+
+
+def test_tag_watcher_reports_each_change_once(tmpdir):
+    root = str(tmpdir.join("ckpt"))
+    w = TagWatcher(root)                   # over a not-yet-created root
+    assert w.current() is None and w.poll() is None
+    _commit_plain_tag(root, "a")
+    assert w.poll() == ("a", 1)
+    assert w.poll() is None                # no change, no report
+    _commit_plain_tag(root, "b")
+    _commit_plain_tag(root, "c")           # two commits between polls:
+    assert w.poll() == ("c", 3)            # only the latest is reported
+    assert w.poll() is None
+
+
+def test_tag_watcher_reports_rollback_to_previous_tag(tmpdir):
+    root = str(tmpdir.join("ckpt"))
+    _commit_plain_tag(root, "a")
+    _commit_plain_tag(root, "b")
+    w = TagWatcher(root)                   # starts at ("b", 2)
+    assert w.poll() is None
+    # operator rollback: deleting the newest manifest regresses latest
+    os.remove(os.path.join(root, "b", MANIFEST_NAME))
+    assert w.poll() == ("a", 1)
+    assert w.poll() is None
+    # ...and rolling everything out reports None-as-change exactly once
+    os.remove(os.path.join(root, "a", MANIFEST_NAME))
+    assert w.current() is None

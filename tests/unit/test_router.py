@@ -66,7 +66,7 @@ class StubReplica:
 
     def __init__(self, die_after=None, reject=None, reject_times=10 ** 9,
                  queue_depth=0, draining=False, reply_delay_s=0.0,
-                 n_tokens=6):
+                 n_tokens=6, token_fn=None):
         self.die_after = die_after      # close socket after N token frames
         self.reject = reject            # "queue_full"|"draining"|"injected"
         self.reject_times = reject_times
@@ -74,6 +74,9 @@ class StubReplica:
         self.draining = draining
         self.reply_delay_s = reply_delay_s
         self.n_tokens = n_tokens
+        # overridable "weights": rollout tests give stubs per-generation
+        # token functions so shadow diffing has something to diff
+        self.token_fn = token_fn or stub_tokens
         self.submits = []               # (key, from) observed
         self.lock = threading.Lock()
         self._ls = socket.socket()
@@ -132,7 +135,7 @@ class StubReplica:
                         self.reject_times -= 1
                         send_line(conn, {"rejected": self.reject})
                         return
-                toks = stub_tokens(op["prompt"], self.n_tokens)
+                toks = self.token_fn(op["prompt"], self.n_tokens)
                 sent = 0
                 for i in range(int(op.get("from", 0)), len(toks)):
                     if self.die_after is not None and sent >= self.die_after:

@@ -9,7 +9,7 @@ Two modes:
     baselines (``SERVING_BENCH_CPU.json`` + ``BENCH_r05.json`` +
     ``LONGDOC_BENCH_CPU.json`` + ``FLEET_BENCH_CPU.json`` +
     ``KERNEL_BENCH_CPU.json`` + ``CHAOS_BENCH_CPU.json`` +
-    ``TRAIN_BENCH_CPU.json``). This is the
+    ``ROLLOUT_BENCH_CPU.json`` + ``TRAIN_BENCH_CPU.json``). This is the
     CI step: it needs no jax and takes milliseconds.
 
 ``compare FRESH BASELINE``
@@ -24,6 +24,8 @@ driver wrapper (``BENCH_r05.json``) and is unwrapped;
 (``LONGDOC_BENCH_CPU.json``); ``fleet_scaling_2x`` marks a fleet
 scale-out artifact (``FLEET_BENCH_CPU.json``); ``chaos_episodes`` marks
 a chaos-harness artifact (``CHAOS_BENCH_CPU.json``);
+``canary_routed_total`` marks a weight-rollout artifact
+(``ROLLOUT_BENCH_CPU.json``);
 ``decode_pallas_us`` marks a kernel-tier microbench artifact
 (``KERNEL_BENCH_CPU.json``); ``train_fusion`` marks a train-step
 fusion artifact (``TRAIN_BENCH_CPU.json``); ``tokens_per_sec`` marks
@@ -55,7 +57,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_ARTIFACTS = ("SERVING_BENCH_CPU.json", "BENCH_r05.json",
                      "LONGDOC_BENCH_CPU.json", "FLEET_BENCH_CPU.json",
                      "KERNEL_BENCH_CPU.json", "CHAOS_BENCH_CPU.json",
-                     "TRAIN_BENCH_CPU.json")
+                     "ROLLOUT_BENCH_CPU.json", "TRAIN_BENCH_CPU.json")
 
 # -- tolerance profiles -------------------------------------------------
 # key -> (direction, rel_tol). direction "higher" means bigger is better:
@@ -149,6 +151,15 @@ CHAOS_TOLERANCES = {
     "recovery_p95_s":  ("lower", 10.00),
 }
 
+# Rollout leg: wall-clock on a shared CPU runner is noise; the gate-
+# worthy signals are the counters (zero dropped/duplicated is enforced
+# by the schema, not a band) and the rollback recovery time against its
+# own committed bound.
+ROLLOUT_TOLERANCES = {
+    "completed_total":     ("higher", 0.50),
+    "rollback_recovery_s": ("lower", 10.00),
+}
+
 # context keys that must match exactly for numbers to be comparable
 SERVING_CONTEXT = ("platform", "model", "requests", "max_slots",
                    "max_new_tokens", "speculative_k", "kv_cache_dtype",
@@ -176,6 +187,11 @@ CHAOS_CONTEXT = ("platform", "model", "chaos_seed", "chaos_episodes")
 TRAINSTEP_CONTEXT = ("platform", "model", "n_devices", "zero_stage",
                      "reduce_bucket_size", "pipe_stages",
                      "pipe_micro_batches")
+# the seed and canary fraction are load-bearing: a different seed runs a
+# different traffic schedule, and a different slice carries a different
+# share of it.
+ROLLOUT_CONTEXT = ("platform", "model", "requests_total", "rollout_seed",
+                   "canary_fraction")
 
 # -- schema -------------------------------------------------------------
 SERVING_REQUIRED = {
@@ -246,6 +262,20 @@ CHAOS_REQUIRED = {
     "complete": bool,
 }
 
+ROLLOUT_REQUIRED = {
+    "platform": str, "model": str, "rollout_seed": int,
+    "canary_fraction": (int, float),
+    "requests_total": int, "completed_total": int,
+    "dropped_total": int, "duplicated_total": int,
+    "canary_routed_total": int,
+    "shadow_compared_total": int, "shadow_diff_total": int,
+    "rollbacks_total": int,
+    "rollforward_ok": bool, "rollback_ok": bool,
+    "rollback_recovery_s": (int, float),
+    "recovery_bound_s": (int, float),
+    "complete": bool,
+}
+
 # chaos acceptance floor: the committed schedule must compose at least
 # this many episodes (the issue's bar) to count as evidence
 CHAOS_MIN_EPISODES = 20
@@ -265,21 +295,24 @@ TRAINSTEP_MIN_BUCKETS = 2
 TOLERANCES = {"serving": SERVING_TOLERANCES, "train": TRAIN_TOLERANCES,
               "longdoc": LONGDOC_TOLERANCES, "fleet": FLEET_TOLERANCES,
               "kernels": KERNELS_TOLERANCES, "chaos": CHAOS_TOLERANCES,
+              "rollout": ROLLOUT_TOLERANCES,
               "trainstep": TRAINSTEP_TOLERANCES}
 CONTEXTS = {"serving": SERVING_CONTEXT, "train": TRAIN_CONTEXT,
             "longdoc": LONGDOC_CONTEXT, "fleet": FLEET_CONTEXT,
             "kernels": KERNELS_CONTEXT, "chaos": CHAOS_CONTEXT,
+            "rollout": ROLLOUT_CONTEXT,
             "trainstep": TRAINSTEP_CONTEXT}
 REQUIRED = {"serving": SERVING_REQUIRED, "train": TRAIN_REQUIRED,
             "longdoc": LONGDOC_REQUIRED, "fleet": FLEET_REQUIRED,
             "kernels": KERNELS_REQUIRED, "chaos": CHAOS_REQUIRED,
+            "rollout": ROLLOUT_REQUIRED,
             "trainstep": TRAINSTEP_REQUIRED}
 
 
 def load_artifact(path):
     """Read + unwrap one artifact; returns (kind, payload). kind is
-    "serving", "train", "longdoc", "fleet", "chaos", "kernels" or
-    "trainstep"."""
+    "serving", "train", "longdoc", "fleet", "chaos", "rollout",
+    "kernels" or "trainstep"."""
     with open(path) as f:
         doc = json.load(f)
     if not isinstance(doc, dict):
@@ -295,6 +328,8 @@ def load_artifact(path):
         return "fleet", doc
     if "chaos_episodes" in doc:
         return "chaos", doc
+    if "canary_routed_total" in doc:
+        return "rollout", doc
     if "decode_pallas_us" in doc:
         return "kernels", doc
     # trainstep before the generic serving/train markers: its stdout
@@ -307,9 +342,9 @@ def load_artifact(path):
         return "train", doc
     raise ValueError(
         f"{path}: unrecognized artifact (no 'speedup_sparse_vs_dense_16k', "
-        f"'fleet_scaling_2x', 'chaos_episodes', 'decode_pallas_us', "
-        f"'train_fusion', 'tokens_per_sec' or 'metric' key; "
-        f"top-level keys: {sorted(doc)[:8]})")
+        f"'fleet_scaling_2x', 'chaos_episodes', 'canary_routed_total', "
+        f"'decode_pallas_us', 'train_fusion', 'tokens_per_sec' or "
+        f"'metric' key; top-level keys: {sorted(doc)[:8]})")
 
 
 def check_schema(path):
@@ -418,6 +453,43 @@ def check_schema(path):
             problems.append(
                 f"{path}: 'completed_total' must be > 0 — a schedule where "
                 f"nothing completed proves nothing")
+    elif kind == "rollout":
+        if doc.get("complete") is not True:
+            problems.append(f"{path}: 'complete' is not true — a partial "
+                            f"rollout run must not be committed as a "
+                            f"baseline")
+        for key in ("rollforward_ok", "rollback_ok"):
+            if doc.get(key) is not True:
+                problems.append(
+                    f"{path}: '{key}' is not true — both the roll-forward "
+                    f"and the forced-regression rollback must succeed for "
+                    f"the run to become a baseline")
+        for key in ("dropped_total", "duplicated_total"):
+            v = doc.get(key)
+            if isinstance(v, int) and not isinstance(v, bool) and v != 0:
+                problems.append(
+                    f"{path}: '{key}' is {v} — a rollout that drops or "
+                    f"duplicates a request breaks exactly-once and must "
+                    f"never become a baseline")
+        routed = doc.get("canary_routed_total")
+        if isinstance(routed, int) and not isinstance(routed, bool) \
+                and routed <= 0:
+            problems.append(
+                f"{path}: 'canary_routed_total' must be > 0 — a canary "
+                f"phase that never carried traffic proves nothing")
+        comp = doc.get("completed_total")
+        if isinstance(comp, int) and not isinstance(comp, bool) and comp <= 0:
+            problems.append(
+                f"{path}: 'completed_total' must be > 0 — a rollout under "
+                f"which nothing completed proves nothing")
+        rec = doc.get("rollback_recovery_s")
+        bound = doc.get("recovery_bound_s")
+        if all(isinstance(v, (int, float)) and not isinstance(v, bool)
+               for v in (rec, bound)) and rec > bound:
+            problems.append(
+                f"{path}: 'rollback_recovery_s' ({rec}) exceeds "
+                f"'recovery_bound_s' ({bound}) — an unbounded rollback is "
+                f"downtime wearing a hat")
     elif kind == "trainstep":
         if doc.get("complete") is not True:
             problems.append(f"{path}: 'complete' is not true — a partial "
@@ -595,7 +667,8 @@ def main(argv=None):
                              "committed SERVING_BENCH_CPU.json + BENCH_r05."
                              "json + LONGDOC_BENCH_CPU.json + "
                              "FLEET_BENCH_CPU.json + KERNEL_BENCH_CPU.json "
-                             "+ CHAOS_BENCH_CPU.json + TRAIN_BENCH_CPU.json")
+                             "+ CHAOS_BENCH_CPU.json + ROLLOUT_BENCH_CPU."
+                             "json + TRAIN_BENCH_CPU.json")
     parser.add_argument("mode", nargs="?", choices=["compare"],
                         help="compare FRESH BASELINE under tolerance bands")
     parser.add_argument("fresh", nargs="?", help="fresh bench JSON")
