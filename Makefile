@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving test-router lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels bench-train trace-smoke bench-gate chaos-smoke bench-rollout
+.PHONY: test test-fast test-faults test-cluster test-serving test-router test-disagg lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels bench-train trace-smoke bench-gate chaos-smoke bench-rollout bench-disagg
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -36,6 +36,14 @@ test-serving:
 # affinity surviving scale-out).
 test-router:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_router.py -q
+
+# Disaggregated prefill/decode suite, BOTH tiers: the fast tests (frame
+# codec, pool page-state guards, handoff sender/receiver state machines,
+# role routing + degraded fallback, role-pool autoscaler, bitwise
+# engine/socket roundtrips) and the slow multi-process chaos tests that
+# kill a prefill worker mid-handoff and a decode worker post-ack.
+test-disagg:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_disagg.py -q
 
 # Static JAX hazard analysis (tools/jaxlint): recompile, host-sync,
 # leaked-tracer, donation, fp16-dtype, collective-axis, RNG-reuse,
@@ -119,6 +127,18 @@ chaos-smoke:
 bench-rollout:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=rollout python bench.py --child
 	python -m tools.bench_gate --check-schema ROLLOUT_BENCH_CPU.json
+
+# Disaggregated prefill/decode leg: the same seeded longdoc+chat
+# workload against 2 interleaved mixed replicas vs 1 prefill + 1 decode
+# worker with KV-page handoff, plus a chaos mini-leg (kill prefill
+# mid-handoff, kill decode post-ack, corrupt a page frame). Writes
+# DISAGG_BENCH_CPU.json with chat TTFT p95 both legs, the improvement
+# ratio, decode tok/s, and the exactly-once / zero-orphan counters the
+# bench gate's schema check refuses when nonzero. Knobs:
+# BENCH_DISAGG_SEED, BENCH_DISAGG_ROUNDS (default 5), BENCH_DISAGG_OUT.
+bench-disagg:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=disagg python bench.py --child
+	python -m tools.bench_gate --check-schema DISAGG_BENCH_CPU.json
 
 # Kernel-tier microbench: Pallas (interpret on CPU) vs the composed-XLA
 # fallback for the fused paged decode (fp32 + int8) and banded sparse

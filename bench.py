@@ -140,6 +140,8 @@ def child_main():
         return chaos_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "rollout":
         return rollout_child_main()
+    if os.environ.get("BENCH_MODEL", "bert") == "disagg":
+        return disagg_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "kernels":
         return kernels_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "train":
@@ -1420,6 +1422,325 @@ def rollout_child_main():
     return 0
 
 
+def disagg_child_main():
+    """Disaggregated prefill/decode leg: the SAME mixed longdoc+chat
+    workload driven against two equal-cost topologies — two interleaved
+    mixed replicas (baseline) vs one prefill + one decode worker with
+    fault-tolerant KV-page handoff — measuring chat TTFT p95 AND
+    longdoc decode tokens/sec for both.
+
+    The workload is the disaggregation motivator: each round puts
+    sustained longdoc decode load on the fleet, then lands latency-
+    sensitive chat prompts in the middle of it. Interleaved replicas run
+    the chat prefill inside the same engine loop as the longdoc decode
+    steps; the disaggregated prefill worker is decode-free, so chat TTFT
+    does not pay for other requests' decode. Every request is checked
+    bitwise against the in-process ``generate()`` oracle and its stream
+    counted (exactly-once accounting); after each leg every replica must
+    drain to zero in-use KV pages and zero pending handoff claims.
+
+    A chaos mini-leg then runs one episode of each disagg fault arm
+    (kill prefill mid-handoff, kill decode post-ack, corrupt a page
+    frame) on a 2-prefill + 1-decode fleet, recording bounded recovery.
+
+    Writes DISAGG_BENCH_CPU.json (BENCH_DISAGG_OUT redirects, as the
+    gate does). The gate's schema check REFUSES dropped or duplicated
+    requests, bitwise mismatches, leaked pages, failed chaos invariants,
+    and a disagg TTFT p95 that is not better than interleaved."""
+    import shutil
+    import tempfile
+    import random as pyrandom
+
+    import numpy as np
+
+    from deepspeed_tpu.inference import generate
+    from deepspeed_tpu.inference.serving.autoscaler import (
+        ProcessReplicaSpawner,
+        replica_op,
+    )
+    from deepspeed_tpu.inference.serving.chaos import (
+        DISAGG_FAULT_KINDS,
+        DisaggChaosHarness,
+    )
+    from deepspeed_tpu.inference.serving.config import FleetConfig
+    from deepspeed_tpu.inference.serving.router import Router
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2
+
+    def progress(msg):
+        print(f"# disagg: {msg}", file=sys.stderr, flush=True)
+
+    model = {"vocab_size": 101, "hidden_size": 128, "num_hidden_layers": 4,
+             "num_attention_heads": 4, "max_position_embeddings": 128}
+    seed = int(os.environ.get("BENCH_DISAGG_SEED", "0"))
+    rounds = int(os.environ.get("BENCH_DISAGG_ROUNDS", "5"))
+    long_new = int(os.environ.get("BENCH_DISAGG_LONG_NEW_TOKENS", "40"))
+    chat_new = int(os.environ.get("BENCH_DISAGG_CHAT_NEW_TOKENS", "8"))
+
+    gcfg = GPT2Config(**model, hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    _, params = init_gpt2(gcfg, batch_size=1, seq_len=8, seed=0)
+    _oracle_cache = {}
+
+    def reference(prompt, max_new):
+        key = (tuple(prompt), max_new)
+        if key not in _oracle_cache:
+            _oracle_cache[key] = np.asarray(generate(
+                params, gcfg, np.asarray([prompt], np.int32),
+                max_new))[0].tolist()
+        return _oracle_cache[key]
+
+    def pctl(xs, p):
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        return float(xs[min(len(xs) - 1, int(p * len(xs)))])
+
+    def make_workload(rng):
+        """One deterministic request schedule, replayed on both legs."""
+        schedule = []
+        for _ in range(rounds):
+            batch = []
+            for _ in range(3):
+                plen = rng.randint(48, 64)
+                batch.append(("longdoc",
+                              [rng.randint(1, model["vocab_size"] - 1)
+                               for _ in range(plen)], long_new))
+            for _ in range(4):
+                plen = rng.randint(4, 8)
+                batch.append(("chat",
+                              [rng.randint(1, model["vocab_size"] - 1)
+                               for _ in range(plen)], chat_new))
+            schedule.append(batch)
+        return schedule
+
+    def pages_drained(router, timeout_s=30.0):
+        """Zero-orphan check: every replica back to zero in-use KV lanes
+        and zero pending handoff claims (polling doubles as the reaper
+        heartbeat). Returns pages still held after the timeout."""
+        deadline = time.monotonic() + timeout_s
+        leaked = 0
+        while time.monotonic() < deadline:
+            leaked = 0
+            for ep in router.endpoints():
+                try:
+                    doc = replica_op(ep.host, ep.port, {"op": "health"})
+                except OSError:
+                    leaked += 1
+                    continue
+                pool = doc.get("kv_pool") or {}
+                leaked += int(pool.get("in_use", 0))
+                leaked += int(doc.get("handoff_pending", 0))
+            if leaked == 0:
+                return 0
+            time.sleep(0.1)
+        return leaked
+
+    def run_leg(router, schedule, label):
+        """Drive the schedule; returns per-kind TTFT/decode-rate stats
+        plus the exactly-once accounting."""
+        stats = {"submitted": 0, "completed": 0, "dropped": 0,
+                 "duplicated": 0, "mismatch": 0,
+                 "chat_ttft": [], "long_ttft": [], "decode_tok_s": []}
+        for rno, batch in enumerate(schedule):
+            inflight = []
+            for kind, prompt, n_new in batch:
+                if kind == "chat":
+                    time.sleep(0.03)    # land mid-decode, one at a time
+                times = []
+                t0 = time.monotonic()
+                fut = router.submit(
+                    prompt, max_new_tokens=n_new,
+                    stream_cb=lambda k, t, ts=times: ts.append(
+                        time.monotonic()),
+                    shed_retries=5)
+                stats["submitted"] += 1
+                inflight.append((kind, prompt, n_new, t0, times, fut))
+                if kind == "longdoc":
+                    time.sleep(0.01)
+            # let longdoc decode build up before the chats arrive
+            for kind, prompt, n_new, t0, times, fut in inflight:
+                try:
+                    tokens = list(fut.result(timeout=300))
+                except Exception as e:
+                    progress(f"{label} round {rno}: {kind} failed "
+                             f"{type(e).__name__}: {e}")
+                    stats["dropped"] += 1
+                    continue
+                stats["completed"] += 1
+                if tokens != reference(prompt, n_new):
+                    stats["mismatch"] += 1
+                if len(times) > len(tokens):
+                    stats["duplicated"] += 1
+                elif len(times) < len(tokens):
+                    stats["dropped"] += 1
+                if times:
+                    ttft = times[0] - t0
+                    stats["chat_ttft" if kind == "chat"
+                          else "long_ttft"].append(ttft)
+                if len(times) >= 2 and times[-1] > times[0]:
+                    stats["decode_tok_s"].append(
+                        (len(times) - 1) / (times[-1] - times[0]))
+        return stats
+
+    tmp = tempfile.mkdtemp(prefix="disagg_bench_")
+    cfg_path = os.path.join(tmp, "replica.json")
+    with open(cfg_path, "w") as f:
+        json.dump({"model": model, "seed": 0, "chaos": True,
+                   "ds_config": {"train_batch_size": 1,
+                                 "serving": {"max_slots": 8, "max_queue": 32,
+                                             "max_seq_len": 128},
+                                 "fleet": {"handoff": {
+                                     "attempt_timeout_s": 60.0,
+                                     "retries": 3, "backoff_s": 0.02,
+                                     "backoff_max_s": 0.2,
+                                     "claim_ttl_s": 2.0,
+                                     "resume_ttl_s": 4.0}}}}, f)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    fleet_cfg = dict(enabled=True, retry_budget=4, retry_backoff_s=0.05,
+                     attempt_timeout_s=300.0, health_ttl_s=0.1,
+                     saturation_queue_depth=16, shed_retry_after_s=0.1,
+                     affinity_prefix_tokens=0)
+    schedule = make_workload(pyrandom.Random(seed))
+    warm_long = schedule[0][0][1]
+    warm_chat = schedule[0][3][1]
+    t_wall = time.perf_counter()
+
+    def warm(router, n_each):
+        # land both prompt buckets AND the decode path on every replica
+        # before any clock starts
+        for _ in range(n_each):
+            router.submit(warm_long, max_new_tokens=4).result(timeout=600)
+            router.submit(warm_chat, max_new_tokens=4).result(timeout=600)
+
+    spawner = ProcessReplicaSpawner(cfg_path, env=env)
+    inter = disagg = chaos_report = None
+    leaked_total = 0
+    handoff_counters = {}
+    try:
+        # -- leg A: two interleaved mixed replicas ----------------------
+        progress("leg A: spawning 2 interleaved mixed replicas (compile)")
+        mixed = [spawner.spawn("m0"), spawner.spawn("m1")]
+        router = Router([h.endpoint() for h in mixed],
+                        FleetConfig(**fleet_cfg))
+        try:
+            warm(router, 2)
+            progress(f"leg A: {rounds} rounds")
+            inter = run_leg(router, schedule, "interleaved")
+            leaked_total += pages_drained(router)
+        finally:
+            router.close()
+        for h in mixed:
+            spawner.drain(h, wait_s=5.0)
+
+        # -- leg B: one prefill + one decode worker ---------------------
+        progress("leg B: spawning 1 prefill + 1 decode replica (compile)")
+        pre = spawner.spawn("p0", role="prefill")
+        dec = spawner.spawn("d0", role="decode")
+        router = Router([pre.endpoint(), dec.endpoint()],
+                        FleetConfig(**fleet_cfg))
+        try:
+            warm(router, 2)
+            progress(f"leg B: {rounds} rounds")
+            disagg = run_leg(router, schedule, "disagg")
+            leaked_total += pages_drained(router)
+            handoff_counters = {
+                k: v for k, v in router.counters().items()
+                if k.startswith("handoff_")}
+
+            # -- chaos mini-leg on a 2-prefill + 1-decode fleet ---------
+            progress("chaos mini-leg: +1 prefill replica, one episode "
+                     "per disagg fault arm")
+            pre2 = spawner.spawn("p1", role="prefill")
+            router.add_endpoint(pre2.endpoint())
+            warm(router, 1)
+            harness = DisaggChaosHarness(
+                router, spawner, reference, [pre, pre2, dec],
+                seed=seed, max_new_tokens=chat_new,
+                request_timeout_s=300.0, recovery_timeout_s=300.0,
+                vocab=model["vocab_size"])
+            for kind in DISAGG_FAULT_KINDS:
+                ep = harness.run_episode(kind=kind)
+                progress(f"chaos {kind}: completed={ep['completed']} "
+                         f"fired={ep.get('fired')} "
+                         f"recovery={ep.get('recovery_s', -1):.2f}s "
+                         f"pages_clean={ep['pages_clean']}")
+            chaos_report = harness.report()
+        finally:
+            router.close()
+    finally:
+        spawner.stop_all()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    inter_ttft = pctl(inter["chat_ttft"], 0.95)
+    disagg_ttft = pctl(disagg["chat_ttft"], 0.95)
+    result = {
+        "platform": "cpu",
+        "model": "gpt2-tiny(L4,H128)",
+        "rounds": rounds,
+        "requests_per_leg": inter["submitted"],
+        "long_new_tokens": long_new,
+        "chat_new_tokens": chat_new,
+        "interleaved_ttft_p95_s": round(inter_ttft, 4),
+        "disagg_ttft_p95_s": round(disagg_ttft, 4),
+        "interleaved_ttft_p50_s": round(pctl(inter["chat_ttft"], 0.5), 4),
+        "disagg_ttft_p50_s": round(pctl(disagg["chat_ttft"], 0.5), 4),
+        # the headline: how much cheaper the p95 chat TTFT gets when
+        # prefill stops paying for other requests' decode
+        "ttft_improvement": round(inter_ttft / max(disagg_ttft, 1e-9), 3),
+        "interleaved_decode_tok_s": round(
+            pctl(inter["decode_tok_s"], 0.5), 2),
+        "disagg_decode_tok_s": round(
+            pctl(disagg["decode_tok_s"], 0.5), 2),
+        "handoffs_total": int(handoff_counters.get("handoff_routed", 0)),
+        "handoffs_completed": int(
+            handoff_counters.get("handoff_completed", 0)),
+        "handoffs_failed": int(handoff_counters.get("handoff_failed", 0)),
+        "completed_total": inter["completed"] + disagg["completed"],
+        "dropped_total": inter["dropped"] + disagg["dropped"],
+        "duplicated_total": inter["duplicated"] + disagg["duplicated"],
+        "bitwise_mismatch_total": inter["mismatch"] + disagg["mismatch"],
+        "leaked_pages_total": leaked_total,
+        "chaos_episodes": chaos_report["chaos_episodes"],
+        "chaos_faults_fired": chaos_report["handoff_faults_fired"],
+        "chaos_recovery_max_s": chaos_report["recovery_max_s"],
+        "chaos_bitwise_ok": chaos_report["invariant_bitwise_ok"],
+        "chaos_no_stuck": chaos_report["invariant_no_stuck"],
+        "chaos_recovery_bounded": chaos_report[
+            "invariant_recovery_bounded"],
+        "chaos_pages_clean": chaos_report["invariant_pages_clean"],
+        "wall_s": round(time.perf_counter() - t_wall, 1),
+        "complete": True,
+    }
+    out = os.environ.get("BENCH_DISAGG_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "DISAGG_BENCH_CPU.json")
+    with open(out, "w") as f:
+        f.write(json.dumps(result, indent=1) + "\n")
+    print(json.dumps({
+        "metric": f"disaggregated prefill/decode chat TTFT p95 "
+                  f"({rounds} rounds, seed {seed}) vs interleaved",
+        "value": result["ttft_improvement"],
+        "unit": "x interleaved TTFT p95",
+        "vs_baseline": None,
+        **{k: result[k] for k in (
+            "interleaved_ttft_p95_s", "disagg_ttft_p95_s",
+            "interleaved_decode_tok_s", "disagg_decode_tok_s",
+            "handoffs_total", "dropped_total", "duplicated_total",
+            "bitwise_mismatch_total", "leaked_pages_total",
+            "chaos_bitwise_ok", "chaos_pages_clean")},
+    }))
+    if not (result["ttft_improvement"] > 1.0
+            and result["dropped_total"] == 0
+            and result["duplicated_total"] == 0
+            and result["bitwise_mismatch_total"] == 0
+            and result["leaked_pages_total"] == 0
+            and result["chaos_bitwise_ok"] and result["chaos_no_stuck"]
+            and result["chaos_recovery_bounded"]
+            and result["chaos_pages_clean"]):
+        return 1
+    return 0
+
+
 def train_child_main():
     """Train-step fusion leg: overlapped per-bucket backward/reduce-scatter +
     donated buffers vs the sequential post-backward reduce, plus interleaved
@@ -1884,6 +2205,10 @@ def main():
         label = "weight-rollout hot-swap rollback recovery"
         seq = os.environ.get("BENCH_ROLLOUT_REQUESTS", "48")
         unit = "s rollback recovery"
+    elif os.environ.get("BENCH_MODEL", "bert") == "disagg":
+        label = "disaggregated prefill/decode chat TTFT p95 vs interleaved"
+        seq = os.environ.get("BENCH_DISAGG_ROUNDS", "5")
+        unit = "x interleaved TTFT p95"
     elif os.environ.get("BENCH_MODEL", "bert") == "kernels":
         label = "kernel-tier microbench"
         seq = os.environ.get("BENCH_KERNELS_ITERS", "10")

@@ -21,6 +21,8 @@ router (health-aware front-door with failover/drain/shedding).
 
 from deepspeed_tpu.inference.serving.config import (  # noqa: F401
     FleetConfig,
+    HandoffConfig,
+    RolesConfig,
     RolloutConfig,
     ServingConfig,
 )
@@ -28,8 +30,19 @@ from deepspeed_tpu.inference.serving.engine import ServingEngine  # noqa: F401
 from deepspeed_tpu.inference.serving.fault_injection import (  # noqa: F401
     ServingFaultInjector,
 )
+from deepspeed_tpu.inference.serving.handoff import (  # noqa: F401
+    HandoffError,
+    HandoffFrameError,
+    HandoffReceiver,
+    HandoffRejectedError,
+    HandoffRetryError,
+    HandoffSender,
+    HandoffSizeError,
+    HandoffTimeoutError,
+)
 from deepspeed_tpu.inference.serving.kv_pool import (  # noqa: F401
     KVCachePool,
+    PageStateError,
     PoolExhaustedError,
 )
 from deepspeed_tpu.inference.serving.metrics import (  # noqa: F401
@@ -46,10 +59,12 @@ from deepspeed_tpu.inference.serving.rollout import (  # noqa: F401
     RolloutController,
 )
 from deepspeed_tpu.inference.serving.router import (  # noqa: F401
+    REPLICA_ROLES,
     FleetOverloadError,
     ReplicaEndpoint,
     RequestPoisonedError,
     Router,
+    WrongRoleError,
 )
 from deepspeed_tpu.inference.serving.scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
@@ -69,4 +84,8 @@ __all__ = [
     "default_buckets", "FleetConfig", "Router", "ReplicaEndpoint",
     "ReplicaServer", "FleetOverloadError", "RequestPoisonedError",
     "RolloutConfig", "RolloutController", "RolloutMetrics",
+    "RolesConfig", "HandoffConfig", "PageStateError", "REPLICA_ROLES",
+    "WrongRoleError", "HandoffError", "HandoffSizeError",
+    "HandoffFrameError", "HandoffTimeoutError", "HandoffRejectedError",
+    "HandoffRetryError", "HandoffSender", "HandoffReceiver",
 ]

@@ -43,7 +43,12 @@ import sys
 import threading
 import time
 
-from deepspeed_tpu.inference.serving.config import AutoscaleConfig
+from dataclasses import replace as _dc_replace
+
+from deepspeed_tpu.inference.serving.config import (
+    AutoscaleConfig,
+    RolesConfig,
+)
 from deepspeed_tpu.inference.serving.degrade import DegradeLadder, MAX_RUNG
 from deepspeed_tpu.inference.serving.router import (
     ReplicaEndpoint,
@@ -69,7 +74,7 @@ def replica_op(host, port, doc, timeout_s=5.0):
 class SpawnedReplica:
     """Handle on one replica subprocess the spawner owns."""
 
-    def __init__(self, name, host, port, proc, generation="0"):
+    def __init__(self, name, host, port, proc, generation="0", role="mixed"):
         self.name = str(name)
         self.host = str(host)
         self.port = int(port)
@@ -77,6 +82,8 @@ class SpawnedReplica:
         # weight-version tag the replica was booted on (which committed
         # checkpoint generation it serves)
         self.generation = str(generation if generation is not None else "0")
+        # disaggregation role the worker was booted with
+        self.role = str(role or "mixed")
 
     @property
     def pid(self):
@@ -87,11 +94,11 @@ class SpawnedReplica:
 
     def endpoint(self):
         return ReplicaEndpoint(self.name, self.host, self.port,
-                               generation=self.generation)
+                               generation=self.generation, role=self.role)
 
     def __repr__(self):
         return (f"SpawnedReplica({self.name}, {self.host}:{self.port}, "
-                f"gen={self.generation}, "
+                f"gen={self.generation}, role={self.role}, "
                 f"pid={self.pid}, alive={self.alive()})")
 
 
@@ -120,13 +127,15 @@ class ProcessReplicaSpawner:
         self._lock = threading.Lock()
         self._seq = 0
 
-    def spawn(self, name=None, generation=None):
+    def spawn(self, name=None, generation=None, role=None):
         """Start one replica and wait for its ready line. ``generation``
         boots the replica on that weight tag (via the resolver) and
-        stamps the handle so the router can pin retries to it."""
+        stamps the handle so the router can pin retries to it; ``role``
+        boots it as a disaggregated prefill/decode worker."""
         with self._lock:
             self._seq += 1
-            name = name or f"replica-{self._seq}"
+            name = name or (f"{role}-{self._seq}" if role
+                            else f"replica-{self._seq}")
         config_path = self.config_path
         if generation is not None and self.config_for_generation is not None:
             config_path = str(self.config_for_generation(str(generation)))
@@ -137,11 +146,14 @@ class ProcessReplicaSpawner:
             os.path.dirname(os.path.abspath(__file__)))))
         env["PYTHONPATH"] = pkg_root + (
             os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        argv = [sys.executable, "-m",
+                "deepspeed_tpu.inference.serving.replica",
+                "--config", config_path, "--port", "0",
+                "--host", self.host]
+        if role is not None:
+            argv += ["--role", str(role)]
         proc = subprocess.Popen(
-            [sys.executable, "-m", "deepspeed_tpu.inference.serving.replica",
-             "--config", config_path, "--port", "0",
-             "--host", self.host],
-            env=env, stdout=subprocess.PIPE,
+            argv, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL, text=True)
         deadline = time.monotonic() + self.ready_timeout_s
         line = ""
@@ -162,7 +174,8 @@ class ProcessReplicaSpawner:
             proc.kill()
             raise RuntimeError(f"replica {name} not ready: {ready}")
         handle = SpawnedReplica(name, self.host, int(ready["port"]), proc,
-                                generation=generation)
+                                generation=generation,
+                                role=ready.get("role") or role or "mixed")
         with self._lock:
             self._spawned.append(handle)
         return handle
@@ -507,3 +520,119 @@ class Autoscaler:
             telemetry.instant(name, cat="fleet", args=args)
         except Exception:
             pass
+
+
+class _RoleBoundSpawner:
+    """Spawner facade that pins every spawn to one disaggregation role
+    (drain/kill/stop_all pass through untouched) — lets the unmodified
+    :class:`Autoscaler` control loop grow a single role pool."""
+
+    def __init__(self, spawner, role):
+        self._spawner = spawner
+        self.role = str(role)
+
+    def spawn(self, name=None, generation=None):
+        return self._spawner.spawn(name=name, generation=generation,
+                                   role=self.role)
+
+    def __getattr__(self, attr):
+        return getattr(self._spawner, attr)
+
+
+class _NoopLadder:
+    """Inert DegradeLadder stand-in (rung pinned to 0)."""
+
+    rung = 0
+
+    def update(self, firing, now=None):
+        return 0
+
+    def export_gauges(self, registry):
+        return registry
+
+
+class _RolePoolView:
+    """Router facade scoped to one role: ``endpoints()`` counts only
+    that pool, so the wrapped Autoscaler's min/max bounds apply per role
+    instead of fleet-wide. Mutations hit the real router."""
+
+    def __init__(self, router, role):
+        self._router = router
+        self.role = str(role)
+
+    def endpoints(self):
+        return [e for e in self._router.endpoints()
+                if getattr(e, "role", "mixed") == self.role]
+
+    def __getattr__(self, attr):
+        return getattr(self._router, attr)
+
+
+class RolePoolAutoscaler:
+    """Two role-scoped SLO control loops over ONE router.
+
+    Disaggregated pools have disaggregated bottlenecks: queued prompts
+    inflate TTFT on the prefill side while decode throughput is fine,
+    and vice versa. So this controller runs TWO independent
+    :class:`Autoscaler` loops against the same router — ``ttft_alerts``
+    (TTFT p95 over budget) grows the prefill pool, ``decode_alerts``
+    (decode tokens/s under floor) grows the decode pool — each bounded
+    by its half of the ``fleet.roles`` config. Degrade-ladder escalation
+    stays with the decode loop (the rung fans out fleet-wide anyway;
+    two ladders would fight over the shared rung)."""
+
+    def __init__(self, router, spawner, roles_config=None,
+                 autoscale_config=None, ttft_alerts=None, decode_alerts=None,
+                 prefill_replicas=(), decode_replicas=(), registry=None,
+                 clock=time.monotonic):
+        self.roles = roles_config or RolesConfig(enabled=True)
+        base = autoscale_config or AutoscaleConfig(enabled=True)
+        self.prefill = Autoscaler(
+            _RolePoolView(router, "prefill"),
+            _RoleBoundSpawner(spawner, "prefill"),
+            config=_dc_replace(
+                base, enabled=True,
+                min_replicas=int(self.roles.prefill_replicas),
+                max_replicas=int(self.roles.max_prefill_replicas)),
+            alerts=ttft_alerts, replicas=prefill_replicas,
+            clock=clock)
+        # only ONE loop may own the fleet-wide degrade rung (two ladders
+        # on one shared rung would fight): decode keeps the real ladder,
+        # prefill gets an inert one
+        self.prefill.ladder = _NoopLadder()
+        self.decode = Autoscaler(
+            _RolePoolView(router, "decode"),
+            _RoleBoundSpawner(spawner, "decode"),
+            config=_dc_replace(
+                base, enabled=True,
+                min_replicas=int(self.roles.decode_replicas),
+                max_replicas=int(self.roles.max_decode_replicas)),
+            alerts=decode_alerts, replicas=decode_replicas,
+            clock=clock)
+        if registry is not None:
+            self.export_gauges(registry)
+
+    def step(self, now=None):
+        """One tick of both loops; returns {"prefill": act, "decode": act}."""
+        return {"prefill": self.prefill.step(now),
+                "decode": self.decode.step(now)}
+
+    def start(self):
+        self.prefill.start()
+        self.decode.start()
+        return self
+
+    def stop(self, drain_spares=True):
+        self.prefill.stop(drain_spares=drain_spares)
+        self.decode.stop(drain_spares=drain_spares)
+
+    def stats(self):
+        out = {f"prefill_{k}": v for k, v in self.prefill.stats().items()}
+        out.update({f"decode_{k}": v
+                    for k, v in self.decode.stats().items()})
+        return out
+
+    def export_gauges(self, registry):
+        registry.gauge_fn("Fleet/role_autoscaler", self.stats,
+                          help="per-role (prefill/decode) autoscaler state")
+        return registry

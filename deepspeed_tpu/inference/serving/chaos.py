@@ -307,6 +307,164 @@ class ChaosHarness:
         return bool(healthy and self.router.degrade_rung == 0)
 
 
+DISAGG_FAULT_KINDS = ("kill_prefill_mid_handoff", "kill_decode_post_ack",
+                      "corrupt_handoff_frame")
+
+# which serving fault point each disagg episode arms on its victim
+_DISAGG_POINTS = {
+    "kill_prefill_mid_handoff": "handoff_kill_mid_transfer",
+    "kill_decode_post_ack": "handoff_kill_post_ack",
+    "corrupt_handoff_frame": "handoff_corrupt_frame",
+}
+
+
+class DisaggChaosHarness(ChaosHarness):
+    """Chaos arms for disaggregated prefill/decode serving, on top of
+    the base invariants (bitwise exactly-once, no stuck, bounded
+    recovery, convergence) plus one of its own — **zero orphaned KV
+    pages**: after every episode each replica's pool occupancy returns
+    to zero in-use and its handoff receiver holds no pending claims.
+
+    ``kill_prefill_mid_handoff``
+        Arm ``handoff_kill_mid_transfer`` on a prefill worker: it dies
+        after writing one page frame of a transfer. The router sees the
+        hop-1 EOF and re-routes plain; the decode side's half-fed claim
+        must be TTL-reaped (run with short ``claim_ttl_s`` so the
+        zero-orphan check can observe it).
+    ``kill_decode_post_ack``
+        Arm ``handoff_kill_post_ack`` on a decode worker: it dies right
+        after acking a transfer. The prefill side reports
+        ``handoff_done``, hop 2 fails to connect, and the router replays
+        plain from its delivered high-water mark — bitwise.
+    ``corrupt_handoff_frame``
+        Arm ``handoff_corrupt_frame`` on a prefill worker: one page
+        frame is bit-flipped after its crc was computed. The receiver's
+        crc check rejects it, the claim survives, and the sender's
+        bounded retry lands the transfer — nobody dies.
+
+    Lethal episodes respawn the victim **role-preserving** (a decode
+    worker comes back as a decode worker) so the fleet topology the
+    router scaled for survives the schedule."""
+
+    def __init__(self, router, spawner, reference_fn, replicas, seed=0,
+                 faults=DISAGG_FAULT_KINDS, **kw):
+        super().__init__(router, spawner, reference_fn, replicas,
+                         seed=seed, faults=(), **kw)
+        self.faults = tuple(faults)
+        unknown = set(self.faults) - set(FAULT_KINDS + DISAGG_FAULT_KINDS)
+        if unknown:
+            raise ValueError(f"unknown fault kinds: {sorted(unknown)}")
+
+    def _handles_by_role(self, role):
+        return [h for h in self._routed_handles()
+                if getattr(h, "role", "mixed") == role]
+
+    def _respawn(self, old):
+        """Role-preserving respawn: the replacement worker keeps the
+        victim's disaggregation role."""
+        self._respawn_seq += 1
+        handle = self.spawner.spawn(
+            f"{old.name}.r{self._respawn_seq}",
+            role=getattr(old, "role", None))
+        self._replicas.pop(old.name, None)
+        self._replicas[handle.name] = handle
+        self.router.add_endpoint(handle.endpoint())
+        return handle
+
+    def run_episode(self, kind=None):
+        kind = kind or self.rng.choice(self.faults)
+        if kind not in DISAGG_FAULT_KINDS:
+            return super().run_episode(kind)
+        record = {"kind": kind, "completed": 0, "shed": 0, "errors": 0,
+                  "stuck": 0, "bitwise_mismatch": 0}
+        role = "decode" if kind == "kill_decode_post_ack" else "prefill"
+        victims = self._handles_by_role(role)
+        if not victims:
+            # a degenerate fleet (pool scaled to zero): the episode
+            # degrades to pure traffic — still invariant-checked
+            record["victim"] = None
+            self._collect(self._submit_batch(self.rng.randint(2, 4),
+                                             shed_retries=3), record)
+            record["pages_clean"] = self._pages_clean()
+            self.episodes.append(record)
+            return record
+        victim = self.rng.choice(victims)
+        record["victim"] = victim.name
+        args = {"op": "inject", "point": _DISAGG_POINTS[kind], "times": 1}
+        if kind == "kill_prefill_mid_handoff":
+            args["at_step"] = 1         # die after the first page frame
+        try:
+            replica_op(victim.host, victim.port, args)
+        except OSError:
+            record["inject_failed"] = True
+        # traffic while the arm is live: some of these requests cross the
+        # victim and trip the fault mid-handoff
+        during = self._submit_batch(self.rng.randint(2, 4), shed_retries=3)
+        lethal = kind != "corrupt_handoff_frame"
+        if lethal:
+            # the kill fires only when a handoff actually crosses the
+            # victim; give it a window, then respawn role-preserving so
+            # in-flight retries have somewhere to land
+            deadline = time.monotonic() + self.request_timeout_s / 4.0
+            while victim.alive() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            record["fired"] = not victim.alive()
+            if record["fired"]:
+                try:
+                    self.router.remove_endpoint(victim.name)
+                except ValueError:
+                    pass
+                self._respawn(victim)
+        if not lethal or not record.get("fired"):
+            try:                        # disarm a survivor: a stale arm
+                replica_op(victim.host, victim.port,   # must not leak into
+                           {"op": "inject", "point": None})  # later episodes
+            except OSError:
+                pass
+        self._collect(during, record)
+        self._await_recovery(record)
+        record["pages_clean"] = self._pages_clean()
+        self.episodes.append(record)
+        return record
+
+    def _pages_clean(self, timeout_s=None):
+        """The zero-orphan invariant: poll every routed replica's health
+        until its KV pool shows zero lanes in use and its handoff
+        receiver zero pending claims. Polling IS the reaper heartbeat
+        (the receiver reaps on every health probe), so an orphaned claim
+        clears as soon as its TTL expires."""
+        deadline = time.monotonic() + (
+            self.recovery_timeout_s if timeout_s is None else timeout_s)
+        while time.monotonic() < deadline:
+            clean = True
+            for ep in self.router.endpoints():
+                try:
+                    doc = replica_op(ep.host, ep.port, {"op": "health"})
+                except OSError:
+                    clean = False
+                    break
+                pool = doc.get("kv_pool") or {}
+                if int(pool.get("in_use", 0)) != 0 \
+                        or int(doc.get("handoff_pending", 0)) != 0:
+                    clean = False
+                    break
+            if clean:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def report(self):
+        rep = super().report()
+        disagg = [e for e in self.episodes
+                  if e["kind"] in DISAGG_FAULT_KINDS]
+        rep["disagg_episodes"] = len(disagg)
+        rep["handoff_faults_fired"] = sum(
+            1 for e in disagg if e.get("fired"))
+        rep["invariant_pages_clean"] = all(
+            e.get("pages_clean", True) for e in self.episodes)
+        return rep
+
+
 ROLLOUT_FAULT_KINDS = ("kill_canary_mid_swap", "corrupt_new_tag")
 
 

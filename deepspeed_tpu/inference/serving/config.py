@@ -190,6 +190,51 @@ class RolloutConfig:
 
 
 @dataclass
+class RolesConfig:
+    """The ``fleet.roles`` sub-block: disaggregated prefill/decode
+    role pools (router scoring + per-role autoscaling). Opt-in:
+    presence enables."""
+
+    enabled: bool = False
+    # Replicas launched per role pool. A replica's own role still comes
+    # from its spawn (--role / spec["role"]); these size launch/bench
+    # wiring and the per-role autoscaler floors.
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    # Per-role autoscaler ceilings: TTFT pressure grows the prefill
+    # pool, decode-throughput pressure grows the decode pool — two
+    # control loops on two SLO signals.
+    max_prefill_replicas: int = 4
+    max_decode_replicas: int = 4
+
+
+@dataclass
+class HandoffConfig:
+    """The ``fleet.handoff`` sub-block: the crash-safe KV-page transfer
+    between prefill and decode workers (inference/serving/handoff.py).
+    Opt-in: presence enables (role routing works without it via the
+    defaults)."""
+
+    enabled: bool = False
+    # Hard cap on one binary page frame; an oversize length prefix is
+    # refused (HandoffSizeError) before any payload is read.
+    max_frame_bytes: int = 8 << 20
+    # Per-attempt deadline over the whole claim→transfer→ack exchange.
+    attempt_timeout_s: float = 30.0
+    # Bounded retry: total attempts per handoff (>= 1), with exponential
+    # backoff + jitter between them.
+    retries: int = 3
+    backoff_s: float = 0.05
+    backoff_max_s: float = 2.0
+    # Orphan-reaper TTLs on the decode side: a claim whose transfer
+    # never finished (prefill death mid-handoff) is freed after
+    # claim_ttl_s; an installed lane the router never resumed after
+    # resume_ttl_s.
+    claim_ttl_s: float = 30.0
+    resume_ttl_s: float = 60.0
+
+
+@dataclass
 class FleetConfig:
     """The ``fleet`` block: router + replica-fleet policy
     (inference/serving/router.py, replica.py). Opt-in like ``serving``:
@@ -242,3 +287,5 @@ class FleetConfig:
     degrade: DegradeConfig = field(default_factory=DegradeConfig)
     breaker: BreakerConfig = field(default_factory=BreakerConfig)
     rollout: RolloutConfig = field(default_factory=RolloutConfig)
+    roles: RolesConfig = field(default_factory=RolesConfig)
+    handoff: HandoffConfig = field(default_factory=HandoffConfig)

@@ -108,6 +108,7 @@ continuous-vs-``generate()`` oracle as its XLA twin (bitwise for
 fp32/bf16-compute parity classes, threshold for int8).
 """
 
+import queue as _queue_mod
 import threading
 import time
 from contextlib import nullcontext
@@ -1159,6 +1160,11 @@ class ServingEngine:
         self._loop_thread = None
         self._stop = threading.Event()
         self._draining = False              # planned restart: admit nothing
+        # the pool has no lock: every mutation happens on the serving-loop
+        # thread. Handoff pool ops (claim/install/free/resume) arrive on
+        # replica connection threads and are marshaled here, drained at
+        # the top of step().
+        self._loop_ops = _queue_mod.Queue()
 
         # telemetry: an explicit block arms the process-global tracer and
         # registry; an absent block leaves them untouched. Hot-path guard
@@ -1341,6 +1347,118 @@ class ServingEngine:
             submitted_at=submitted_at)
         return req.future
 
+    # -- disaggregated prefill/decode handoff ---------------------------
+    def submit_handoff(self, prompt_ids, reserve_new_tokens,
+                       eos_token_id=None, timeout_s=None, stream_cb=None,
+                       age_s=0.0):
+        """Prefill-only submit: run prefill for ``prompt_ids``, emit the
+        first token, then retire immediately (``max_new_tokens=1``) while
+        exporting the lane's KV pages as ``req.export_payload`` for a
+        decode-worker handoff.
+
+        ``reserve_new_tokens`` is the ORIGINAL request's generation
+        budget — the page allocation spans the full request so the
+        exported layout (and int8 scales, which quantize over the whole
+        allocated span) is bit-identical to what a mixed-mode admission
+        would have produced. Returns the Request (the caller reads
+        ``export_payload`` after ``future.result()``)."""
+        if self._draining:
+            raise EngineDrainingError(
+                "engine is draining for a planned restart; "
+                "route this request to another replica")
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if len(prompt) < 1:
+            raise ValueError("prompt must contain at least one token")
+        reserve = int(reserve_new_tokens)
+        if reserve < 1:
+            raise ValueError(
+                f"reserve_new_tokens must be >= 1, got {reserve}")
+        bucket_for(len(prompt), self.scheduler.buckets)
+        total = len(prompt) + reserve
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + reserve_new_tokens ({reserve}) "
+                f"= {total} exceeds serving max_seq_len={self.max_seq_len}")
+        if eos_token_id is not None and not (
+                0 <= int(eos_token_id) < self.model_config.vocab_size):
+            raise ValueError(
+                f"eos_token_id={eos_token_id} outside vocab "
+                f"[0, {self.model_config.vocab_size})")
+        if self._degrade_rung >= 2:
+            budget = self._degrade_queue_budget()
+            if self.scheduler.queue_depth() >= budget:
+                raise QueueFullError(
+                    f"admission queue shrunk to {budget} at degrade rung "
+                    f"{self._degrade_rung}")
+        submitted_at = (time.monotonic() - float(age_s)
+                        if age_s and age_s > 0 else None)
+        req = self.scheduler.adopt(
+            prompt, max_new_tokens=1,
+            eos_token_id=None if eos_token_id is None else int(eos_token_id),
+            timeout_s=timeout_s, stream_cb=stream_cb,
+            submitted_at=submitted_at)
+        # flags set BEFORE the request becomes loop-visible
+        req.handoff_export = True
+        req.alloc_tokens_override = min(total, self.max_seq_len)
+        self.scheduler.enqueue(req)
+        return req
+
+    def handoff_claim(self, n_tokens):
+        """Decode-side phase 1: allocate a pool slot sized for the full
+        request span. Raises PoolExhaustedError under pressure. Mirrors
+        ``_alloc_tokens``: an armed injector forces full-lane claims, so
+        the claim always holds at least as many pages as the (also
+        full-lane) prefill-side export ships."""
+        n = None if self.injector is not None else int(n_tokens)
+        return self._run_on_loop(lambda: self.pool.allocate(n))
+
+    def handoff_install(self, slot, meta, frames, handoff_key=None):
+        """Decode-side phase 2: install transferred pages into the
+        claimed slot. Returns False on an idempotent duplicate."""
+        def _do():
+            fresh = self.pool.install_raw(slot, meta, frames,
+                                          handoff_key=handoff_key)
+            self.metrics.record_handoff("install" if fresh else "dup_install")
+            return fresh
+        return self._run_on_loop(_do)
+
+    def handoff_release(self, slot):
+        """Free a claimed/installed slot (orphan reap, failed resume)."""
+        return self._run_on_loop(lambda: self.pool.free(slot))
+
+    def resume_handoff(self, slot, prompt_ids, first_token, max_new_tokens,
+                       eos_token_id=None, timeout_s=None, stream_cb=None,
+                       age_s=0.0):
+        """Activate a lane whose KV pages were installed by a handoff and
+        continue decoding exactly where prefill left off. The first
+        generated token was already delivered by the prefill worker, so
+        it is recorded (``emitted=1``, appended to the future) but NOT
+        re-streamed through ``stream_cb``. Returns the Request."""
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        submitted_at = (time.monotonic() - float(age_s)
+                        if age_s and age_s > 0 else None)
+        req = self.scheduler.adopt(
+            prompt, max_new_tokens=int(max_new_tokens),
+            eos_token_id=None if eos_token_id is None else int(eos_token_id),
+            timeout_s=timeout_s, stream_cb=stream_cb,
+            submitted_at=submitted_at)
+
+        def _do():
+            req.attn_impl = self._impl_for_len(len(prompt))
+            now = time.monotonic()
+            req.first_token_time = now
+            self._activate(req, slot, int(first_token), emit=False)
+            req.future._append(int(first_token))
+            req.emitted = 1
+            self.metrics.record_handoff("resume")
+            # defensively retire right away if the first token already
+            # ended the request (the router short-circuits these, but a
+            # direct caller may not)
+            self._maybe_retire(req, int(first_token), now)
+            return None
+        self._run_on_loop(_do)
+        return req
+
     # -- the serving loop ----------------------------------------------
     def step(self):
         """One scheduler iteration: expire, advance any chunked prefill,
@@ -1349,6 +1467,8 @@ class ServingEngine:
         now = time.monotonic()
         stats = {"admitted": 0, "decoded": 0, "retired": 0,
                  "prefill_chunks": 0}
+
+        self._drain_loop_ops()
 
         for req in self.scheduler.pop_expired(now):
             self._finish_timeout(req, phase="queued")
@@ -1739,6 +1859,43 @@ class ServingEngine:
         it."""
         self._draining = True
 
+    # -- loop-thread marshaling -----------------------------------------
+    def _drain_loop_ops(self):
+        """Run pool ops posted by other threads (handoff claim/install/
+        free/resume) on the serving-loop thread, where all pool mutation
+        belongs."""
+        while True:
+            try:
+                fn, done, box = self._loop_ops.get_nowait()
+            except _queue_mod.Empty:
+                return
+            try:
+                box.append(("ok", fn()))
+            except BaseException as exc:  # marshal, don't kill the loop
+                box.append(("err", exc))
+            finally:
+                done.set()
+
+    def _run_on_loop(self, fn, timeout_s=30.0):
+        """Execute ``fn`` on the serving-loop thread and return its
+        result (re-raising its exception here). Runs inline when no
+        background loop is active or when already on the loop thread."""
+        t = self._loop_thread
+        if t is None or not t.is_alive() \
+                or t is threading.current_thread():
+            return fn()
+        done = threading.Event()
+        box = []
+        self._loop_ops.put((fn, done, box))
+        if not done.wait(timeout_s):
+            raise TimeoutError(
+                f"serving loop did not service a marshaled op within "
+                f"{timeout_s}s (loop stalled?)")
+        kind, val = box[0]
+        if kind == "err":
+            raise val
+        return val
+
     # -- background mode ------------------------------------------------
     def start(self, idle_sleep_s=0.001):
         """Run the serving loop on a daemon thread until ``stop()``."""
@@ -1762,6 +1919,7 @@ class ServingEngine:
         self._stop.set()
         self._loop_thread.join(timeout_s)
         self._loop_thread = None
+        self._drain_loop_ops()   # release any waiter the loop left behind
 
     def close(self):
         self.stop()
@@ -1799,9 +1957,18 @@ class ServingEngine:
         """Page budget claimed for a request at admission: the exact
         prompt + generation span (rounded up to whole pages by the
         allocator). Under fault injection, stuck/runaway lanes may
-        decode past their natural length, so claim the full lane."""
+        decode past their natural length, so claim the full lane.
+
+        A handoff-export request overrides the budget with the ORIGINAL
+        request's full reserve: int8 install quantizes over the whole
+        allocated span, so the exported pages must be laid out exactly
+        as a mixed-mode admission of the original request would lay
+        them out — bit-for-bit."""
         if self.injector is not None:
             return None
+        override = getattr(req, "alloc_tokens_override", None)
+        if override is not None:
+            return int(override)
         return min(len(req.prompt) + req.max_new_tokens, self.max_seq_len)
 
     def _admit_from_queue_now(self, stats):
@@ -2137,7 +2304,7 @@ class ServingEngine:
         return ek, ev
 
     # -- internals ------------------------------------------------------
-    def _activate(self, req, slot, first_tok):
+    def _activate(self, req, slot, first_tok, emit=True):
         req.slot = slot
         self._active[slot] = req
         self._lane_tokens[slot] = first_tok
@@ -2154,7 +2321,8 @@ class ServingEngine:
             row[:len(req.prompt)] = req.prompt
             row[len(req.prompt)] = first_tok
         self._lane_dirty = True
-        self._emit(req, first_tok)
+        if emit:
+            self._emit(req, first_tok)
 
     def _emit(self, req, token):
         req.emitted += 1
@@ -2195,6 +2363,14 @@ class ServingEngine:
         self.metrics.record_timeout()
 
     def _release_slot(self, req):
+        if req.slot is not None and getattr(req, "handoff_export", False):
+            # snapshot the lane's pages before the slot is freed; the
+            # replica's handoff sender ships them to the decode worker
+            try:
+                req.export_payload = self.pool.export_lane(req.slot)
+                self.metrics.record_handoff("export")
+            except Exception as exc:
+                req.export_error = exc
         if req.slot is not None:
             self._lane_active[req.slot] = False
             self._lane_impl_window[req.slot] = False

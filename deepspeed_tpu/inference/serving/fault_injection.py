@@ -78,7 +78,8 @@ from deepspeed_tpu.runtime.resilience.fault_injection import StepFaultInjector
 
 SERVING_POINTS = ("slow_decode", "stuck_request", "evict_under_decode",
                   "corrupt_draft", "kill_replica", "slow_replica",
-                  "reject_admission")
+                  "reject_admission", "handoff_corrupt_frame",
+                  "handoff_kill_mid_transfer", "handoff_kill_post_ack")
 
 
 class _ServingArm:
@@ -218,6 +219,55 @@ class ServingFaultInjector(StepFaultInjector):
             arm.times -= 1
         self._fire("reject_admission")
         return True
+
+    # -- disaggregated-handoff hooks (handoff.py / replica.py) ----------
+    def corrupt_handoff_frame(self):
+        """True while the handoff_corrupt_frame arm has shots left: the
+        sender flips a byte of the NEXT page frame after computing its
+        crc header — simulated wire damage the receiver's crc32 check
+        must catch (times=1 lets the bounded retry then succeed)."""
+        arm = self._serving_arms.get("handoff_corrupt_frame")
+        if arm is None:
+            return False
+        if arm.times is not None:
+            if arm.times <= 0:
+                return False
+            arm.times -= 1
+        self._fire("handoff_corrupt_frame")
+        return True
+
+    def maybe_kill_mid_transfer(self, frames_sent):
+        """SIGKILL the PREFILL worker after it has written ``at_step``
+        page frames of a handoff — mid-transfer death with a half-sent
+        claim on the decode side (the decode worker's orphan reaper must
+        free it). Kill primitive swappable via ``_kill``."""
+        arm = self._serving_arms.get("handoff_kill_mid_transfer")
+        if arm is None:
+            return
+        if arm.at_step is not None and frames_sent != arm.at_step:
+            return
+        if arm.times is not None:
+            if arm.times <= 0:
+                return
+            arm.times -= 1
+        self._fire("handoff_kill_mid_transfer")
+        self._kill()
+
+    def maybe_kill_post_ack(self):
+        """SIGKILL the DECODE worker right after it wrote a handoff ack
+        — the prefill side believes the transfer landed, then the resume
+        target dies; the router must re-route from its delivered
+        high-water mark bitwise. Kill primitive swappable via
+        ``_kill``."""
+        arm = self._serving_arms.get("handoff_kill_post_ack")
+        if arm is None:
+            return
+        if arm.times is not None:
+            if arm.times <= 0:
+                return
+            arm.times -= 1
+        self._fire("handoff_kill_post_ack")
+        self._kill()
 
     def request_is_stuck(self, request_id):
         """True while the stuck_request arm pins ``request_id`` (persistent

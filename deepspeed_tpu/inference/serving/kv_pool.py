@@ -58,6 +58,16 @@ class PoolExhaustedError(RuntimeError):
     -1 style sentinels for a slot id."""
 
 
+class PageStateError(ValueError):
+    """A page/slot lifecycle violation: freeing a slot that is already
+    free, installing into a slot that was never allocated, or raw-
+    installing over a live lane under a DIFFERENT handoff key. Named so
+    the disaggregated handoff path can distinguish a state-machine bug
+    from silent free-list corruption (the failure mode it replaces).
+    Subclasses ValueError so pre-existing double-free callers keep
+    their except clauses."""
+
+
 def _install_pages(pool_k, pool_v, new_k, new_v, dest_pages, page_tokens):
     """Scatter a prefilled single-request cache ([L, 1, nh, S, hd],
     S >= pages_per_lane * page_tokens) into the pool's pages at
@@ -179,6 +189,10 @@ class KVCachePool:
         self.page_tables = np.zeros((self.max_slots, self.pages_per_lane),
                                     np.int32)
         self._lane_pages = [[] for _ in range(self.max_slots)]
+        # handoff idempotency: key -> slot for lanes installed via
+        # install_raw(); a re-sent handoff under a live key is a no-op
+        self._handoff_keys = {}
+        self._slot_handoff_key = {}
         # per-slot NEXT write/read position (== tokens cached so far)
         self.positions = np.zeros(self.max_slots, np.int32)
         self.allocations = 0
@@ -244,7 +258,11 @@ class KVCachePool:
         if not 0 <= slot < self.max_slots:
             raise ValueError(f"slot {slot} outside [0, {self.max_slots})")
         if slot in self._free:
-            raise ValueError(f"slot {slot} is already free (double free)")
+            raise PageStateError(
+                f"slot {slot} is already free (double free)")
+        key = self._slot_handoff_key.pop(slot, None)
+        if key is not None:
+            self._handoff_keys.pop(key, None)
         self.frees += 1
         self.positions[slot] = 0
         # zero the table row BEFORE returning pages: the freed lane's
@@ -268,6 +286,9 @@ class KVCachePool:
         if not 0 <= position < self.max_seq_len:
             raise ValueError(
                 f"position {position} outside [0, {self.max_seq_len})")
+        if slot in self._free:
+            raise PageStateError(
+                f"install into slot {slot} which is not allocated")
         dest = jnp.asarray(self.page_tables[slot], jnp.int32)
         if self.kv_cache_dtype == "int8":
             (self.k, self.v, self.k_scale,
@@ -287,6 +308,106 @@ class KVCachePool:
         install compiles."""
         self.install(batch_k[:, lane:lane + 1], batch_v[:, lane:lane + 1],
                      slot, position)
+
+    # -- raw page export / install (disaggregated handoff) --------------
+    def export_lane(self, slot):
+        """Snapshot a live lane's pages AS STORED (storage dtype bytes,
+        no dequant — the transfer must be bitwise) into host memory.
+        Returns ``(meta, frames)``: ``frames`` is one ``bytes`` payload
+        per logical page (k-page bytes then v-page bytes, fixed length),
+        plus one trailing scales frame in int8 mode; ``meta`` carries
+        everything install_raw() needs to rebuild the lane bit-for-bit
+        on another pool with the same geometry."""
+        if slot in self._free:
+            raise PageStateError(
+                f"export from slot {slot} which is not allocated")
+        pages = self._lane_pages[slot]
+        idx = np.asarray(pages, np.int32)
+        lane_k = np.asarray(self.k[:, idx])   # [L, n, nh, pt, hd]
+        lane_v = np.asarray(self.v[:, idx])
+        frames = [lane_k[:, i].tobytes() + lane_v[:, i].tobytes()
+                  for i in range(len(pages))]
+        meta = {
+            "pages": len(pages),
+            "position": int(self.positions[slot]),
+            "page_tokens": self.page_tokens,
+            "kv_cache_dtype": self.kv_cache_dtype,
+            "page_nbytes": len(frames[0]) if frames else 0,
+            "scales": self.k_scale is not None,
+        }
+        if self.k_scale is not None:
+            sk = np.asarray(self.k_scale[:, slot], np.float32)
+            sv = np.asarray(self.v_scale[:, slot], np.float32)
+            frames.append(sk.tobytes() + sv.tobytes())
+        return meta, frames
+
+    def install_raw(self, slot, meta, frames, handoff_key=None):
+        """Install exported pages into an allocated ``slot`` WITHOUT
+        re-quantizing — the bytes land in storage exactly as the sender
+        stored them, so the resumed lane is bit-identical to the lane
+        the prefill worker built. Idempotent under ``handoff_key``: a
+        re-sent handoff whose key is already live returns False and
+        touches nothing (never double-installs); installing over a live
+        lane registered under a DIFFERENT key raises PageStateError."""
+        if slot in self._free:
+            raise PageStateError(
+                f"install_raw into slot {slot} which is not allocated")
+        if handoff_key is not None and handoff_key in self._handoff_keys:
+            return False                         # idempotent re-send
+        held = self._slot_handoff_key.get(slot)
+        if held is not None and held != handoff_key:
+            raise PageStateError(
+                f"slot {slot} already holds handoff key {held!r}; "
+                f"refusing install over a live lane under "
+                f"{handoff_key!r}")
+        n = int(meta["pages"])
+        if meta["kv_cache_dtype"] != self.kv_cache_dtype:
+            raise PageStateError(
+                f"handoff dtype {meta['kv_cache_dtype']!r} does not "
+                f"match pool dtype {self.kv_cache_dtype!r}")
+        if n > len(self._lane_pages[slot]):
+            raise PageStateError(
+                f"handoff carries {n} pages but slot {slot} has only "
+                f"{len(self._lane_pages[slot])} allocated")
+        position = int(meta["position"])
+        if not 0 <= position < self.max_seq_len:
+            raise ValueError(
+                f"position {position} outside [0, {self.max_seq_len})")
+        storage = np.dtype(self.k.dtype)
+        pshape = (self.n_layers, self.n_heads, self.page_tokens,
+                  self.head_dim)
+        half = storage.itemsize * int(np.prod(pshape))
+        ks, vs = [], []
+        for payload in frames[:n]:
+            ks.append(np.frombuffer(payload[:half], storage)
+                      .reshape(pshape))
+            vs.append(np.frombuffer(payload[half:], storage)
+                      .reshape(pshape))
+        dest = np.asarray(self._lane_pages[slot][:n], np.int32)
+        lane_k = np.stack(ks, axis=1)            # [L, n, nh, pt, hd]
+        lane_v = np.stack(vs, axis=1)
+        self.k = self.k.at[:, dest].set(jnp.asarray(lane_k))
+        self.v = self.v.at[:, dest].set(jnp.asarray(lane_v))
+        if meta.get("scales"):
+            if self.k_scale is None:
+                raise PageStateError(
+                    "handoff carries scales but pool is not int8")
+            sshape = (self.n_layers, self.n_heads, 1, 1)
+            shalf = 4 * int(np.prod(sshape))
+            sbuf = frames[n]
+            sk = np.frombuffer(sbuf[:shalf], np.float32).reshape(sshape)
+            sv = np.frombuffer(sbuf[shalf:], np.float32).reshape(sshape)
+            self.k_scale = self.k_scale.at[:, slot].set(jnp.asarray(sk))
+            self.v_scale = self.v_scale.at[:, slot].set(jnp.asarray(sv))
+        self.positions[slot] = position
+        if handoff_key is not None:
+            self._handoff_keys[handoff_key] = slot
+            self._slot_handoff_key[slot] = handoff_key
+        return True
+
+    def handoff_slot(self, handoff_key):
+        """Slot currently holding ``handoff_key``, or None."""
+        return self._handoff_keys.get(handoff_key)
 
     def advance(self, slot):
         """Bump a slot's position after a decode step wrote its token.

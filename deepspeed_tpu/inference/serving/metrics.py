@@ -61,6 +61,14 @@ class ServingMetrics:
         self.pages_in_use = 0
         self.page_fragmentation = 0.0
         self._admitted_by_bucket = {}
+        # disaggregated prefill/decode handoff (engine calls
+        # record_handoff; events beyond these four still count as a
+        # dict entry so a new event kind never raises)
+        self.handoff_exports = 0
+        self.handoff_installs = 0
+        self.handoff_dup_installs = 0
+        self.handoff_resumes = 0
+        self.handoff_reaped = 0
         # TTFT: time from submit() to the request's first token
         self._ttft_sum = 0.0
         self._ttft_count = 0
@@ -153,6 +161,17 @@ class ServingMetrics:
             self._record("Serving/accept_rate",
                          accepted_tokens / proposed_tokens, step)
 
+    def record_handoff(self, event):
+        """One KV-handoff lifecycle event: 'export' (prefill side,
+        pages snapshotted at retire), 'install' / 'dup_install' (decode
+        side, pages landed / idempotent re-send dropped), 'resume'
+        (lane activated from installed pages), 'reaped' (orphaned
+        claim freed by the TTL reaper)."""
+        attr = f"handoff_{event}s" if not event.endswith("ed") \
+            else f"handoff_{event}"
+        setattr(self, attr, getattr(self, attr, 0) + 1)
+        self._record(f"Serving/{attr}", getattr(self, attr), 1)
+
     def record_kv_pool_bytes(self, nbytes):
         """Pool storage footprint (KV + scales) — a construction-time
         constant, re-recordable if a pool is ever rebuilt."""
@@ -230,6 +249,12 @@ class ServingMetrics:
             "kv_pool_bytes": self.kv_pool_bytes,
             "pages_in_use": self.pages_in_use,
             "page_fragmentation": self.page_fragmentation,
+            # disaggregated prefill/decode handoff lifecycle
+            "handoff_exports": self.handoff_exports,
+            "handoff_installs": self.handoff_installs,
+            "handoff_dup_installs": self.handoff_dup_installs,
+            "handoff_resumes": self.handoff_resumes,
+            "handoff_reaped": self.handoff_reaped,
             "uptime_s": time.monotonic() - self._started,
         }
         # flattened per-bucket admitted-prompt-length histogram: numeric

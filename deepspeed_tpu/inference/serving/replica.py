@@ -52,6 +52,11 @@ import threading
 import time
 from collections import OrderedDict
 
+from deepspeed_tpu.inference.serving.handoff import (
+    HandoffError,
+    HandoffReceiver,
+    HandoffSender,
+)
 from deepspeed_tpu.inference.serving.scheduler import (
     EngineDrainingError,
     QueueFullError,
@@ -59,6 +64,7 @@ from deepspeed_tpu.inference.serving.scheduler import (
 )
 from deepspeed_tpu.inference.serving.router import (
     PROTOCOL_VERSION,
+    REPLICA_ROLES,
     read_line,
     send_line,
 )
@@ -128,10 +134,26 @@ class ReplicaServer:
     """Line-JSON socket front on one ServingEngine (one op/connection)."""
 
     def __init__(self, engine, host="127.0.0.1", port=0, injector=None,
-                 drain_timeout_s=30.0):
+                 drain_timeout_s=30.0, role="mixed", handoff_config=None):
+        role = str(role or "mixed")
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"role must be one of {REPLICA_ROLES}, got {role!r}")
         self.engine = engine
         self.injector = injector if injector is not None else engine.injector
         self.drain_timeout_s = float(drain_timeout_s)
+        self.role = role
+        # handoff plumbing is always built (it is cheap and stateless
+        # until used): a mixed replica may be the decode target of a
+        # prefill worker, and a prefill worker only sends
+        self._handoff_sender = HandoffSender(
+            config=handoff_config, injector=self.injector)
+        self._handoff_receiver = HandoffReceiver(
+            handoff_config,
+            allocate_fn=engine.handoff_claim,
+            install_fn=engine.handoff_install,
+            free_fn=engine.handoff_release,
+            on_event=self._handoff_event)
         self._flights = OrderedDict()       # key -> _Flight
         self._flights_lock = threading.Lock()
         self._tokens_total = 0
@@ -197,18 +219,30 @@ class ReplicaServer:
     # -- health ----------------------------------------------------------
     def _replica_health(self):
         eng = self.engine
+        # the health probe doubles as the orphan reaper's heartbeat:
+        # the router probes every replica on a TTL, so expired handoff
+        # claims are freed even on an otherwise-idle decode worker
+        self._handoff_receiver.reap()
         with self._flights_lock:
             flights = len(self._flights)
         doc = dict(eng._loop_health())
         doc.update({
             "port": self.port,
+            "role": self.role,
             "flights": flights,
             "tokens_total": self._tokens_total,
             "process_cpu_s": time.process_time(),
             "pid": os.getpid(),
+            # the chaos harness's zero-leak invariant reads these
+            "kv_pool": eng.occupancy(),
+            "handoff_pending": self._handoff_receiver.pending(),
             # the affinity test's evidence: hits survive scale-out
             "prefix_cache": eng.prefix_stats()})
         return doc
+
+    def _handoff_event(self, name):
+        if name == "reaped":
+            self.engine.metrics.record_handoff("reaped")
 
     # -- socket plumbing -------------------------------------------------
     def _accept_loop(self):
@@ -232,7 +266,12 @@ class ReplicaServer:
         try:
             with conn:
                 conn.settimeout(30.0)
-                op = read_line(conn.makefile("rb"))
+                # ONE buffered stream per connection: the handoff op's
+                # binary page frames follow the claim line on the same
+                # socket, so bytes the line reader buffered must stay
+                # readable (a second makefile would lose them)
+                stream = conn.makefile("rb")
+                op = read_line(stream)
                 if op is None:
                     return
                 kind = op.get("op")
@@ -242,6 +281,9 @@ class ReplicaServer:
                         self._handle_submit(conn, op)
                     finally:
                         self._active_conns -= 1
+                elif kind == "handoff":
+                    self._handoff_receiver.handle(
+                        conn, stream, op, self._handoff_reply)
                 elif kind == "health":
                     self._reply(conn, self._replica_health())
                 elif kind == "drain":
@@ -260,6 +302,15 @@ class ReplicaServer:
                                        "etype": "ValueError"})
         except (OSError, ValueError):
             pass                        # peer went away mid-reply
+
+    def _handoff_reply(self, conn, doc):
+        """Handoff-op replies, plus the kill-decode-post-ack arm: the
+        injected death fires AFTER the ack hit the wire — the prefill
+        side believes the transfer landed, then the resume target
+        disappears."""
+        self._reply(conn, doc)
+        if doc.get("acked") and self.injector is not None:
+            self.injector.maybe_kill_post_ack()
 
     # -- the inject op (the chaos harness's remote arm) ------------------
     def _handle_inject(self, conn, op):
@@ -291,9 +342,33 @@ class ReplicaServer:
             self._reply(conn, {"error": "submit without key",
                                "etype": "ValueError"})
             return
+        if op.get("handoff_key"):
+            self._handle_resume(conn, op)
+            return
+        if op.get("handoff"):
+            self._handle_submit_handoff(conn, op)
+            return
+        if self.role == "decode" and not op.get("force"):
+            # role is a scheduling policy, not a capability: the router
+            # learns/refreshes this endpoint's role from the rejection
+            # and re-picks; a deliberate degraded-mode route carries
+            # "force" and is served. Retries of accepted keys attach.
+            with self._flights_lock:
+                accepted = key in self._flights
+            if not accepted:
+                self._reply(conn, {"rejected": "wrong_role",
+                                   "role": self.role})
+                return
         flight, created = self._flight_for(key, op, conn)
         if flight is None:
             return                      # rejection/error already sent
+        self._stream_flight(conn, flight, start)
+
+    def _stream_flight(self, conn, flight, start):
+        """Drain a flight's frames to the connection: tokens, then ONE
+        terminal doc — the flight's error/terminal doc if set (a timeout
+        doc, a ``handoff_done``/``handoff_failed`` verdict), else plain
+        ``done``."""
         q = flight.attach(start)
         while True:
             frame = q.get()
@@ -306,6 +381,146 @@ class ReplicaServer:
                 return
             _, i, token = frame
             self._reply(conn, {"t": token, "i": i})
+
+    # -- disaggregated handoff: hop 1 (prefill side) ---------------------
+    def _handle_submit_handoff(self, conn, op):
+        """Prefill-only submit: run prefill, stream the first token the
+        moment it exists (TTFT ends BEFORE any page transfer), then ship
+        the exported pages to the decode worker named in
+        ``op["handoff"]`` and reply ``handoff_done`` (the router's cue
+        to resume on the decode side) or ``handoff_failed`` (its cue to
+        fall back to a plain route). Flights are keyed by the
+        per-attempt handoff key, NEVER the request key — a 1-token
+        hop-1 flight must not satisfy a later full re-route."""
+        ho = dict(op.get("handoff") or {})
+        hkey = str(ho.get("key") or "")
+        if not hkey or not ho.get("host") or not ho.get("port"):
+            self._reply(conn, {"error": "handoff without host/port/key",
+                               "etype": "ValueError"})
+            return
+        fkey = "ho1:" + hkey
+        with self._flights_lock:
+            flight = self._flights.get(fkey)
+        if flight is None:
+            if self.injector is not None \
+                    and self.injector.admission_rejected():
+                self._reply(conn, {"rejected": "injected"})
+                return
+            flight = _Flight(fkey)
+            try:
+                req = self.engine.submit_handoff(
+                    op.get("prompt") or [],
+                    reserve_new_tokens=int(op.get("max_new_tokens") or 1),
+                    eos_token_id=op.get("eos_token_id"),
+                    timeout_s=op.get("timeout_s"),
+                    stream_cb=lambda _rid, tok: self._emit(flight, tok),
+                    age_s=float(op.get("age_s", 0.0)))
+            except EngineDrainingError:
+                self._reply(conn, {"rejected": "draining"})
+                return
+            except QueueFullError:
+                self._reply(conn, {"rejected": "queue_full"})
+                return
+            except (ValueError, TypeError) as e:
+                self._reply(conn, _error_doc(e))
+                return
+            self._register_flight(fkey, flight)
+            threading.Thread(
+                target=self._await_handoff, args=(flight, req, ho, op),
+                name=f"handoff-{hkey[:8]}", daemon=True).start()
+        self._stream_flight(conn, flight, int(op.get("from", 0)))
+
+    def _await_handoff(self, flight, req, ho, op):
+        """Hop-1 completion driver: wait for the prefill-only request to
+        retire, then run the claim→transfer→ack protocol against the
+        decode worker and publish the verdict as the flight's terminal
+        doc."""
+        try:
+            tokens = req.future.result()
+        except Exception as e:          # timeout/terminal: plain error
+            flight.finish(_error_doc(e))
+            return
+        first = int(tokens[0])
+        eos = op.get("eos_token_id")
+        max_new = int(op.get("max_new_tokens") or 1)
+        if max_new <= 1 or (eos is not None and first == int(eos)):
+            flight.finish()             # complete at its first token
+            return
+        payload = getattr(req, "export_payload", None)
+        if payload is None:
+            exc = getattr(req, "export_error", None)
+            flight.finish({"handoff_failed": True, "key": ho.get("key"),
+                           "etype": "HandoffError",
+                           "error": f"lane export missing: {exc}",
+                           "n": len(flight.tokens)})
+            return
+        meta, frames = payload
+        meta = dict(meta)
+        prompt = op.get("prompt") or []
+        meta["reserve_tokens"] = min(len(prompt) + max_new,
+                                     self.engine.max_seq_len)
+        meta["first_token"] = first
+        meta["prompt_len"] = len(prompt)
+        try:
+            self._handoff_sender.send(
+                str(ho["host"]), int(ho["port"]), str(ho["key"]),
+                meta, frames)
+        except (HandoffError, OSError) as e:
+            flight.finish({"handoff_failed": True, "key": ho.get("key"),
+                           "etype": type(e).__name__, "error": str(e),
+                           "n": len(flight.tokens)})
+            return
+        flight.finish({"handoff_done": True, "key": ho.get("key"),
+                       "n": len(flight.tokens)})
+
+    # -- disaggregated handoff: hop 2 (decode side) ----------------------
+    def _handle_resume(self, conn, op):
+        """Resume a request whose pages an earlier handoff installed:
+        take the installed claim, activate the lane, and stream tokens
+        from index 1 (index 0 — the first token — was delivered by the
+        prefill worker; the flight is pre-seeded with it so the done
+        count covers the whole generation)."""
+        hkey = str(op.get("handoff_key"))
+        fkey = "ho2:" + hkey
+        with self._flights_lock:
+            flight = self._flights.get(fkey)
+        if flight is None:
+            taken = self._handoff_receiver.take(hkey)
+            if taken is None:
+                # unknown/unfinished/reaped claim: the router re-routes
+                # the whole request as a plain submit, losing nothing
+                self._reply(conn, {"rejected": "handoff_unknown"})
+                return
+            slot, meta = taken
+            flight = _Flight(fkey)
+            first = int(meta.get("first_token",
+                                 op.get("first_token", 0)))
+            flight.tokens = [first]     # index 0, delivered by hop 1
+            try:
+                req = self.engine.resume_handoff(
+                    slot, op.get("prompt") or [], first,
+                    max_new_tokens=op.get("max_new_tokens"),
+                    eos_token_id=op.get("eos_token_id"),
+                    timeout_s=op.get("timeout_s"),
+                    stream_cb=lambda _rid, tok: self._emit(flight, tok),
+                    age_s=float(op.get("age_s", 0.0)))
+            except Exception as e:      # resume failed pre-activation:
+                self._handoff_receiver.restore(hkey, slot, meta)
+                self._reply(conn, _error_doc(e))
+                return
+            self._register_flight(fkey, flight)
+            threading.Thread(target=self._await, args=(flight, req.future),
+                             name=f"resume-{hkey[:8]}", daemon=True).start()
+        self._stream_flight(conn, flight, int(op.get("from", 1)))
+
+    def _register_flight(self, key, flight):
+        with self._flights_lock:
+            self._flights[key] = flight
+            while len(self._flights) > _FLIGHT_CACHE:
+                old_key, old = next(iter(self._flights.items()))
+                if not old.done:
+                    break               # never evict live work
+                self._flights.pop(old_key)
 
     def _flight_for(self, key, op, conn):
         """Existing flight for ``key``, or a freshly-submitted one.
@@ -342,13 +557,7 @@ class ReplicaServer:
         # one attempt per request at a time, so no concurrent FIRST
         # submit for this key exists; tokens can't be missed because
         # emission goes through the flight from token zero.
-        with self._flights_lock:
-            self._flights[key] = flight
-            while len(self._flights) > _FLIGHT_CACHE:
-                old_key, old = next(iter(self._flights.items()))
-                if not old.done:
-                    break               # never evict live work
-                self._flights.pop(old_key)
+        self._register_flight(key, flight)
         threading.Thread(target=self._await, args=(flight, future),
                          name=f"flight-{key[:8]}", daemon=True).start()
         return flight, True
@@ -413,6 +622,9 @@ def replica_main(argv=None):
         "--port", type=int,
         default=int(os.environ.get(REPLICA_PORT_ENV, "0")))
     parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument(
+        "--role", default=None, choices=list(REPLICA_ROLES),
+        help="disaggregated-serving role (default: spec['role'] or mixed)")
     args = parser.parse_args(argv)
     if not args.config:
         parser.error(f"--config or {REPLICA_CONFIG_ENV} is required")
@@ -421,9 +633,15 @@ def replica_main(argv=None):
 
     engine = _build_engine(spec)
     fleet = dict(spec.get("ds_config", {}).get("fleet") or {})
+    handoff_config = None
+    if fleet.get("handoff") is not None:
+        from deepspeed_tpu.runtime.config import _get_fleet_handoff
+        handoff_config = _get_fleet_handoff(fleet)
     server = ReplicaServer(
         engine, host=args.host, port=args.port,
-        drain_timeout_s=float(fleet.get("drain_timeout_s", 30.0)))
+        drain_timeout_s=float(fleet.get("drain_timeout_s", 30.0)),
+        role=args.role or spec.get("role") or "mixed",
+        handoff_config=handoff_config)
 
     # PreemptionHandler's signal discipline, serving-shaped: the handler
     # only flips a flag; the main thread notices and drains. check() is
@@ -434,7 +652,8 @@ def replica_main(argv=None):
 
     server.start()
     print(json.dumps({"ready": True, "port": server.port,
-                      "pid": os.getpid(), "v": PROTOCOL_VERSION}),
+                      "pid": os.getpid(), "role": server.role,
+                      "v": PROTOCOL_VERSION}),
           flush=True)
     try:
         while not term.is_set():

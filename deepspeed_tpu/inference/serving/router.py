@@ -51,6 +51,7 @@ launcher. Wire protocol (one JSON object per line)::
 import json
 import random
 import socket
+import sys
 import threading
 import time
 import uuid
@@ -65,6 +66,14 @@ from deepspeed_tpu.inference.serving.scheduler import (
 )
 
 PROTOCOL_VERSION = 1
+
+# replica roles for disaggregated prefill/decode serving. "mixed" runs
+# both phases interleaved (the classic topology and the wire default: a
+# health snapshot with no role field is treated as mixed so pre-role
+# replicas keep routing unchanged). "prefill" workers run prompt
+# processing and hand finished KV pages to a "decode" worker; "decode"
+# workers only accept handoff installs + resumes, never fresh submits.
+REPLICA_ROLES = ("prefill", "decode", "mixed")
 
 # terminal error types a replica may report; anything else degrades to
 # RuntimeError with the replica's message
@@ -86,6 +95,21 @@ class FleetOverloadError(RuntimeError):
         super().__init__(
             f"fleet overloaded ({reason}, class={request_class!r}); "
             f"retry after {retry_after_s:.2f}s")
+
+
+class WrongRoleError(RuntimeError):
+    """The fleet cannot serve this request kind at all: every attached
+    endpoint is the wrong role (e.g. a plain submit against a fleet of
+    pure decode workers). Structured — carries the request kind and the
+    per-endpoint role map — so callers can tell a topology bug from a
+    transient outage."""
+
+    def __init__(self, request_kind, roles):
+        self.request_kind = str(request_kind)
+        self.roles = dict(roles)
+        super().__init__(
+            f"no endpoint can serve a {request_kind!r} request: "
+            f"fleet roles are {self.roles}")
 
 
 class RequestPoisonedError(RuntimeError):
@@ -128,10 +152,20 @@ def _http_json(url, timeout_s):
 class ReplicaEndpoint:
     """One replica's addresses + the router's live view of it."""
 
-    def __init__(self, name, host, port, health_url=None, generation="0"):
+    def __init__(self, name, host, port, health_url=None, generation="0",
+                 role="mixed"):
         self.name = str(name)
         self.host = str(host)
         self.port = int(port)
+        # disaggregation role ("prefill" | "decode" | "mixed"); refreshed
+        # from health probes — a snapshot without a role field means a
+        # pre-role replica and maps to "mixed"
+        role = str(role or "mixed")
+        if role not in REPLICA_ROLES:
+            raise ValueError(
+                f"unknown replica role {role!r} "
+                f"(known: {', '.join(REPLICA_ROLES)})")
+        self.role = role
         # telemetry endpoint ("http://127.0.0.1:9100"); None = probe the
         # serving socket with {"op": "health"} instead
         self.health_url = health_url.rstrip("/") if health_url else None
@@ -160,7 +194,7 @@ class ReplicaEndpoint:
 
     def __repr__(self):
         return (f"ReplicaEndpoint({self.name}, {self.host}:{self.port}, "
-                f"gen={self.generation}, "
+                f"gen={self.generation}, role={self.role}, "
                 f"healthy={self.healthy}, draining={self.draining}, "
                 f"load={self.load_hint}+{self.inflight})")
 
@@ -226,7 +260,15 @@ class Router:
             "failed": 0,        # requests finished with a terminal error
             "poisoned": 0,      # requests quarantined
             "canary_routed": 0,  # attempts landed on the canary generation
+            "handoff_routed": 0,     # two-hop prefill->decode routes tried
+            "handoff_completed": 0,  # requests finished via the decode hop
+            "handoff_failed": 0,     # page transfers that never acked
+            "handoff_degraded": 0,   # edge-triggered falls to mixed mode
         }
+        # edge state for the handoff-degraded instant: set when a decode
+        # pool exists but cannot be routed to (requests fall back to the
+        # interleaved plain path), cleared when a handoff routes again
+        self._handoff_degraded_flag = False
         if registry is not None:
             self.export_gauges(registry)
 
@@ -275,12 +317,15 @@ class Router:
                 ep.healthy = doc.get("status") == "ok"
                 ep.load_hint = (int(loop.get("queue_depth", 0))
                                 + int(loop.get("active_requests", 0)))
+                # missing role = pre-role replica = mixed (wire compat)
+                ep.role = str(rep.get("role") or "mixed")
             else:
                 doc = self._socket_health(ep)
                 ep.draining = bool(doc.get("draining"))
                 ep.healthy = bool(doc.get("healthy", True))
                 ep.load_hint = (int(doc.get("queue_depth", 0))
                                 + int(doc.get("active_requests", 0)))
+                ep.role = str(doc.get("role") or "mixed")
             ep.failures = 0
             ep.last_ok = now
         except (OSError, ValueError):
@@ -435,9 +480,18 @@ class Router:
         prefix = ",".join(str(int(t)) for t in prompt[:n]).encode("ascii")
         return eps[zlib.crc32(prefix) % len(eps)]
 
-    def _pick(self, rr, avoid=None, eps=None):
+    def _pick(self, rr, avoid=None, eps=None, role="submit"):
         """Affinity target when healthy and unsaturated; else the
         least-loaded routable replica; None when nothing is routable.
+
+        Role rules (disaggregated fleets): ``role="submit"`` — a plain
+        interleaved request — never lands on a pure decode worker (those
+        only accept handoff installs; routing one a fresh prompt is the
+        wrong-role bug the replica would reject anyway).
+        ``role="prefill"`` prefers strict prefill workers and falls back
+        to mixed ones; ``role="decode"`` selects pure decode workers
+        only. Mixed fleets (every role "mixed", the pre-disaggregation
+        default) behave exactly as before.
 
         Generation rules: a request that has delivered tokens is pinned
         to the generation that produced them — a cross-generation replay
@@ -453,6 +507,14 @@ class Router:
         for ep in eps:
             self._probe(ep, now=now)
         candidates = [ep for ep in eps if self._routable(ep, now=now)]
+        if role == "decode":
+            candidates = [ep for ep in candidates if ep.role == "decode"]
+        elif role == "prefill":
+            strict = [ep for ep in candidates if ep.role == "prefill"]
+            candidates = strict or [ep for ep in candidates
+                                    if ep.role != "decode"]
+        else:   # plain submit: anything that can run a full request
+            candidates = [ep for ep in candidates if ep.role != "decode"]
         if avoid is not None and len(candidates) > 1:
             candidates = [ep for ep in candidates if ep is not avoid]
         if not candidates:
@@ -613,8 +675,35 @@ class Router:
         reroutes = 0
         avoid = None
         while True:
-            ep = self._pick(rr, avoid=avoid)
+            ep = None
+            decode_ep = None
+            if self._handoff_wanted(rr):
+                decode_ep = self._pick(rr, avoid=avoid, role="decode")
+                if decode_ep is not None:
+                    pre = self._pick(rr, avoid=avoid, role="prefill")
+                    # generation guard: both hops replay within ONE weight
+                    # generation or the spliced output is not bitwise
+                    if (pre is not None and pre is not decode_ep
+                            and pre.generation == decode_ep.generation):
+                        ep = pre
+                if ep is None:
+                    # decode pool configured but unroutable right now:
+                    # fall back to interleaved mixed mode (edge-triggered
+                    # instant; requests keep flowing, just slower TTFT)
+                    decode_ep = None
+                    self._handoff_degraded(True)
             if ep is None:
+                ep = self._pick(rr, avoid=avoid)
+            if ep is None:
+                eps = [e for e in self._endpoints if not e.removed]
+                if eps and all(e.role == "decode" for e in eps):
+                    # topology bug, not a transient outage: nothing in
+                    # the fleet can EVER take a fresh prompt
+                    with self._lock:
+                        self._counters["failed"] += 1
+                    rr.future._finish(WrongRoleError(
+                        "submit", {e.name: e.role for e in eps}))
+                    return
                 failures += 1
                 if failures > cfg.retry_budget:
                     self._finish_poisoned(rr, failures,
@@ -625,7 +714,38 @@ class Router:
                 avoid = None
                 self._backoff(failures)
                 continue
-            outcome, detail = self._attempt(rr, ep)
+            blame = ep
+            if decode_ep is not None:
+                self._handoff_degraded(False)
+                outcome, detail, blame = self._attempt_handoff(
+                    rr, ep, decode_ep)
+                if outcome == "handoff_failed":
+                    # the transfer never landed (or the installed claim
+                    # was lost): the prefill hop already streamed token 0,
+                    # so re-route plain from the delivered high-water mark.
+                    # Like a rejection this burns no retry budget — the
+                    # request did nothing wrong — but rides the same
+                    # bounded carousel.
+                    with self._lock:
+                        self._counters["handoff_failed"] += 1
+                    blame.healthy = False
+                    blame.failures += 1
+                    avoid = None
+                    reroutes += 1
+                    if reroutes > max(4, 2 * len(self._endpoints)):
+                        reroutes = 0
+                        failures += 1
+                        if failures > cfg.retry_budget:
+                            self._finish_poisoned(
+                                rr, failures,
+                                f"handoff failed everywhere ({detail})")
+                            return
+                        with self._lock:
+                            self._counters["retried"] += 1
+                        self._backoff(failures)
+                    continue
+            else:
+                outcome, detail = self._attempt(rr, ep)
             if outcome == "done":
                 with self._lock:
                     self._counters["completed"] += 1
@@ -657,8 +777,8 @@ class Router:
                         "drained" if detail == "draining"
                         else "rejected"] += 1
                 if detail == "draining":
-                    ep.draining = True
-                avoid = ep
+                    blame.draining = True
+                avoid = blame
                 reroutes += 1
                 if reroutes > max(4, 2 * len(self._endpoints)):
                     reroutes = 0
@@ -672,16 +792,92 @@ class Router:
                     self._backoff(failures)
                 continue
             # outcome == "failed": the replica died / wedged mid-attempt
-            ep.healthy = False
-            ep.failures += 1
+            # (``blame`` is the hop that actually failed — the decode
+            # worker on a post-ack death, not the innocent prefill)
+            blame.healthy = False
+            blame.failures += 1
             failures += 1
             if failures > cfg.retry_budget:
                 self._finish_poisoned(rr, failures, detail)
                 return
             with self._lock:
                 self._counters["retried"] += 1
-            avoid = ep
+            avoid = blame
             self._backoff(failures)
+
+    # -- disaggregated prefill/decode routing ----------------------------
+    def _handoff_wanted(self, rr):
+        """Plan a two-hop prefill->decode route? Only for FRESH requests
+        (``delivered == 0`` — a retry with delivered tokens replays plain
+        from its high-water mark), only when decoding will actually
+        happen (``max_new_tokens > 1``; a 1-token request IS its prefill),
+        and only when the fleet has a decode pool at all."""
+        return (rr.delivered == 0
+                and rr.max_new_tokens is not None
+                and int(rr.max_new_tokens) > 1
+                and any(e.role == "decode" and not e.removed
+                        for e in self._endpoints))
+
+    def _attempt_handoff(self, rr, pre_ep, decode_ep):
+        """One two-hop attempt: prefill on ``pre_ep`` (which streams the
+        first token, then ships the KV pages to ``decode_ep``), then
+        resume on ``decode_ep``. Returns (outcome, detail, blame) where
+        ``blame`` is the endpoint at fault for a non-done outcome.
+
+        The handoff key is fresh per attempt — the replica-side
+        idempotency (dup-ack on re-send, installed-claim takeover) keys
+        on it, and reusing a key across logically different attempts
+        would alias unrelated transfers."""
+        hkey = f"{rr.key}:{uuid.uuid4().hex[:8]}"
+        with self._lock:
+            self._counters["handoff_routed"] += 1
+        outcome, detail = self._attempt(rr, pre_ep, extra={
+            "handoff": {"host": decode_ep.host, "port": decode_ep.port,
+                        "key": hkey}})
+        if outcome != "handoff_done":
+            if outcome == "handoff_failed":
+                why = (detail or {}).get("error", "page transfer failed")
+                # the prefill worker exhausted its bounded retries against
+                # the decode worker: the decode side is the suspect
+                return "handoff_failed", why, decode_ep
+            return outcome, detail, pre_ep
+        # hop 2: resume on the decode worker from the installed pages
+        outcome, detail = self._attempt(rr, decode_ep, extra={
+            "handoff_key": hkey})
+        if outcome == "rejected" and detail == "handoff_unknown":
+            # acked but gone (reaped, or the decode worker restarted
+            # between ack and resume): fall back to a plain replay
+            return "handoff_failed", "installed claim lost", decode_ep
+        if outcome == "done":
+            with self._lock:
+                self._counters["handoff_completed"] += 1
+        return outcome, detail, decode_ep
+
+    def _handoff_degraded(self, degraded, reason="decode pool unroutable"):
+        """Edge-triggered degraded-mode bookkeeping: the first fall from
+        disaggregated to interleaved routing bumps the counter and emits
+        a ``fleet/handoff_degraded`` instant; recovery re-arms the edge
+        (and emits the matching restore instant)."""
+        if degraded and not self._handoff_degraded_flag:
+            self._handoff_degraded_flag = True
+            with self._lock:
+                self._counters["handoff_degraded"] += 1
+            self._note("fleet/handoff_degraded", reason=reason)
+        elif not degraded and self._handoff_degraded_flag:
+            self._handoff_degraded_flag = False
+            self._note("fleet/handoff_restored")
+
+    def _note(self, name, **args):
+        """Emit a telemetry instant IF the telemetry subsystem is already
+        imported (the router is stdlib-only by design — it must never be
+        the first importer of anything heavy)."""
+        if "deepspeed_tpu.telemetry" not in sys.modules:
+            return
+        try:
+            from deepspeed_tpu import telemetry
+            telemetry.instant(name, cat="fleet", args=args)
+        except Exception:
+            pass    # observation must not affect routing
 
     def _backoff(self, n):
         base = self.config.retry_backoff_s * (2 ** max(0, n - 1))
@@ -706,10 +902,13 @@ class Router:
         exc_cls = _TERMINAL_ERRORS.get(etype) or RuntimeError
         return exc_cls(doc.get("error", "replica error"))
 
-    def _attempt(self, rr, ep):
+    def _attempt(self, rr, ep, extra=None):
         """One routed attempt. Returns (outcome, detail): "done",
         ("terminal", error-doc), ("rejected", reason), or
-        ("failed", why) — only "failed" burns retry budget."""
+        ("failed", why) — only "failed" burns retry budget. With a
+        handoff ``extra`` two more outcomes appear: ("handoff_done", doc)
+        — the prefill hop streamed its token and the pages acked on the
+        decode side, proceed to hop 2 — and ("handoff_failed", doc)."""
         timeout = self.config.attempt_timeout_s or None
         canary = self._canary
         with self._lock:
@@ -721,12 +920,15 @@ class Router:
         try:
             sock = socket.create_connection(ep.address, timeout=timeout)
             sock.settimeout(timeout)
-            send_line(sock, {
+            doc = {
                 "op": "submit", "v": PROTOCOL_VERSION, "key": rr.key,
                 "prompt": rr.prompt, "max_new_tokens": rr.max_new_tokens,
                 "eos_token_id": rr.eos_token_id, "timeout_s": rr.timeout_s,
                 "from": rr.delivered,
-                "age_s": max(0.0, time.monotonic() - rr.t0)})
+                "age_s": max(0.0, time.monotonic() - rr.t0)}
+            if extra:
+                doc.update(extra)
+            send_line(sock, doc)
             stream = sock.makefile("rb")
             while True:
                 doc = read_line(stream)
@@ -747,7 +949,17 @@ class Router:
                         return "failed", (
                             f"done at n={n} but delivered {rr.delivered}")
                     return "done", None
+                elif doc.get("handoff_done"):
+                    return "handoff_done", doc
+                elif doc.get("handoff_failed"):
+                    return "handoff_failed", doc
                 elif "rejected" in doc:
+                    if doc["rejected"] == "wrong_role" and doc.get("role"):
+                        # the router's role view was stale — adopt the
+                        # replica's own answer so the re-pick is informed
+                        role = str(doc["role"])
+                        if role in REPLICA_ROLES:
+                            ep.role = role
                     return "rejected", str(doc["rejected"])
                 elif "error" in doc:
                     return "terminal", doc

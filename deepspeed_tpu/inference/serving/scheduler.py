@@ -193,6 +193,33 @@ class ContinuousBatchingScheduler:
             self._queue.append(req)
         return req
 
+    def enqueue(self, req):
+        """Enqueue an ``adopt()``-minted request whose flags were set
+        before it became visible to the serving loop (submit() races:
+        the loop may admit between the append and any attribute write)."""
+        with self._lock:
+            if len(self._queue) >= self.max_queue:
+                raise QueueFullError(
+                    f"admission queue is full ({self.max_queue} waiting); "
+                    f"request rejected — retry with backpressure")
+            self._queue.append(req)
+        return req
+
+    def adopt(self, prompt, max_new_tokens=None, eos_token_id=None,
+              timeout_s=None, stream_cb=None, submitted_at=None):
+        """Mint a Request WITHOUT enqueueing it — for requests that
+        bypass admission because their KV state already exists (a
+        disaggregated handoff resume installs prefill-produced pages
+        directly, so there is no prefill to queue for). The caller is
+        responsible for activating the request on a pool slot."""
+        if max_new_tokens is None:
+            max_new_tokens = self.default_max_new_tokens
+        if timeout_s is None and self.request_timeout_s > 0:
+            timeout_s = self.request_timeout_s
+        return Request(next(self._ids), list(prompt), max_new_tokens,
+                       eos_token_id=eos_token_id, timeout_s=timeout_s,
+                       stream_cb=stream_cb, submitted_at=submitted_at)
+
     def pop_expired(self, now):
         """Remove and return queued requests whose deadline passed while
         waiting (they must not waste a prefill)."""

@@ -928,6 +928,117 @@ def _get_fleet_rollout(params):
     )
 
 
+def _get_fleet_roles(params):
+    """fleet.roles sub-block: disaggregated prefill/decode role pools."""
+    from deepspeed_tpu.inference.serving.config import RolesConfig
+
+    section = params.get(FLEET_ROLES, None)
+    if section is not None and not isinstance(section, dict):
+        raise ValueError(
+            f"fleet.{FLEET_ROLES} must be a dict, "
+            f"got {type(section).__name__}"
+        )
+    sub = section or {}
+    enabled = bool(get_scalar_param(sub, FLEET_ROLES_ENABLED, section is not None))
+    ints = (
+        (FLEET_ROLES_PREFILL_REPLICAS, FLEET_ROLES_PREFILL_REPLICAS_DEFAULT,
+         "replicas booted into the prefill pool"),
+        (FLEET_ROLES_DECODE_REPLICAS, FLEET_ROLES_DECODE_REPLICAS_DEFAULT,
+         "replicas booted into the decode pool"),
+        (FLEET_ROLES_MAX_PREFILL_REPLICAS,
+         FLEET_ROLES_MAX_PREFILL_REPLICAS_DEFAULT,
+         "autoscaler ceiling for the prefill pool"),
+        (FLEET_ROLES_MAX_DECODE_REPLICAS,
+         FLEET_ROLES_MAX_DECODE_REPLICAS_DEFAULT,
+         "autoscaler ceiling for the decode pool"),
+    )
+    ivals = {}
+    for key, default, what in ints:
+        v = get_scalar_param(sub, key, default)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            raise ValueError(
+                f"fleet.{FLEET_ROLES}.{key} must be an int >= 1 "
+                f"({what}), got {v!r}"
+            )
+        ivals[key] = v
+    for key, floor_key in (
+            (FLEET_ROLES_MAX_PREFILL_REPLICAS, FLEET_ROLES_PREFILL_REPLICAS),
+            (FLEET_ROLES_MAX_DECODE_REPLICAS, FLEET_ROLES_DECODE_REPLICAS)):
+        if ivals[key] < ivals[floor_key]:
+            raise ValueError(
+                f"fleet.{FLEET_ROLES}.{key} must be >= "
+                f"fleet.{FLEET_ROLES}.{floor_key} "
+                f"({ivals[key]} < {ivals[floor_key]})"
+            )
+    return RolesConfig(
+        enabled=enabled,
+        prefill_replicas=ivals[FLEET_ROLES_PREFILL_REPLICAS],
+        decode_replicas=ivals[FLEET_ROLES_DECODE_REPLICAS],
+        max_prefill_replicas=ivals[FLEET_ROLES_MAX_PREFILL_REPLICAS],
+        max_decode_replicas=ivals[FLEET_ROLES_MAX_DECODE_REPLICAS],
+    )
+
+
+def _get_fleet_handoff(params):
+    """fleet.handoff sub-block: crash-safe KV-page transfer."""
+    from deepspeed_tpu.inference.serving.config import HandoffConfig
+
+    section = params.get(FLEET_HANDOFF, None)
+    if section is not None and not isinstance(section, dict):
+        raise ValueError(
+            f"fleet.{FLEET_HANDOFF} must be a dict, "
+            f"got {type(section).__name__}"
+        )
+    sub = section or {}
+    enabled = bool(get_scalar_param(sub, FLEET_HANDOFF_ENABLED, section is not None))
+    max_frame = get_scalar_param(sub, FLEET_HANDOFF_MAX_FRAME_BYTES,
+                                 FLEET_HANDOFF_MAX_FRAME_BYTES_DEFAULT)
+    if not isinstance(max_frame, int) or isinstance(max_frame, bool) \
+            or max_frame < 1:
+        raise ValueError(
+            f"fleet.{FLEET_HANDOFF}.{FLEET_HANDOFF_MAX_FRAME_BYTES} must be "
+            f"an int >= 1 (binary page-frame size cap), got {max_frame!r}"
+        )
+    retries = get_scalar_param(sub, FLEET_HANDOFF_RETRIES,
+                               FLEET_HANDOFF_RETRIES_DEFAULT)
+    if not isinstance(retries, int) or isinstance(retries, bool) or retries < 1:
+        raise ValueError(
+            f"fleet.{FLEET_HANDOFF}.{FLEET_HANDOFF_RETRIES} must be an "
+            f"int >= 1 (total transfer attempts), got {retries!r}"
+        )
+    numbers = (
+        (FLEET_HANDOFF_ATTEMPT_TIMEOUT, FLEET_HANDOFF_ATTEMPT_TIMEOUT_DEFAULT,
+         "per-attempt socket deadline"),
+        (FLEET_HANDOFF_BACKOFF, FLEET_HANDOFF_BACKOFF_DEFAULT,
+         "base retry backoff"),
+        (FLEET_HANDOFF_BACKOFF_MAX, FLEET_HANDOFF_BACKOFF_MAX_DEFAULT,
+         "retry backoff cap"),
+        (FLEET_HANDOFF_CLAIM_TTL, FLEET_HANDOFF_CLAIM_TTL_DEFAULT,
+         "orphaned claim reap deadline"),
+        (FLEET_HANDOFF_RESUME_TTL, FLEET_HANDOFF_RESUME_TTL_DEFAULT,
+         "installed-but-unresumed reap deadline"),
+    )
+    fvals = {}
+    for key, default, what in numbers:
+        v = get_scalar_param(sub, key, default)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            raise ValueError(
+                f"fleet.{FLEET_HANDOFF}.{key} must be a number >= 0 "
+                f"({what}), got {v!r}"
+            )
+        fvals[key] = float(v)
+    return HandoffConfig(
+        enabled=enabled,
+        max_frame_bytes=max_frame,
+        attempt_timeout_s=fvals[FLEET_HANDOFF_ATTEMPT_TIMEOUT],
+        retries=retries,
+        backoff_s=fvals[FLEET_HANDOFF_BACKOFF],
+        backoff_max_s=fvals[FLEET_HANDOFF_BACKOFF_MAX],
+        claim_ttl_s=fvals[FLEET_HANDOFF_CLAIM_TTL],
+        resume_ttl_s=fvals[FLEET_HANDOFF_RESUME_TTL],
+    )
+
+
 def get_fleet_config(param_dict):
     """fleet: routing front-door over N serving replicas
     (inference/serving/router.py, replica.py). Opt-in like the serving
@@ -1029,6 +1140,8 @@ def get_fleet_config(param_dict):
         degrade=_get_fleet_degrade(params),
         breaker=_get_fleet_breaker(params),
         rollout=_get_fleet_rollout(params),
+        roles=_get_fleet_roles(params),
+        handoff=_get_fleet_handoff(params),
     )
 
 

@@ -346,6 +346,41 @@ FLEET_ROLLOUT_POLL_INTERVAL_DEFAULT = 0.5
 FLEET_ROLLOUT_RECOVERY_BOUND = "recovery_bound_s"
 FLEET_ROLLOUT_RECOVERY_BOUND_DEFAULT = 30.0
 
+# fleet.roles: disaggregated prefill/decode role pools
+# (inference/serving/router.py role scoring + autoscaler.py
+# RolePoolAutoscaler). Opt-in by sub-block presence.
+FLEET_ROLES = "roles"
+FLEET_ROLES_ENABLED = "enabled"
+FLEET_ROLES_PREFILL_REPLICAS = "prefill_replicas"
+FLEET_ROLES_PREFILL_REPLICAS_DEFAULT = 1
+FLEET_ROLES_DECODE_REPLICAS = "decode_replicas"
+FLEET_ROLES_DECODE_REPLICAS_DEFAULT = 1
+FLEET_ROLES_MAX_PREFILL_REPLICAS = "max_prefill_replicas"
+FLEET_ROLES_MAX_PREFILL_REPLICAS_DEFAULT = 4
+FLEET_ROLES_MAX_DECODE_REPLICAS = "max_decode_replicas"
+FLEET_ROLES_MAX_DECODE_REPLICAS_DEFAULT = 4
+# the role attribute values a replica may carry
+FLEET_ROLE_VALUES = ("prefill", "decode", "mixed")
+
+# fleet.handoff: crash-safe KV-page transfer between prefill and decode
+# workers (inference/serving/handoff.py). Opt-in by sub-block presence.
+FLEET_HANDOFF = "handoff"
+FLEET_HANDOFF_ENABLED = "enabled"
+FLEET_HANDOFF_MAX_FRAME_BYTES = "max_frame_bytes"
+FLEET_HANDOFF_MAX_FRAME_BYTES_DEFAULT = 8 << 20
+FLEET_HANDOFF_ATTEMPT_TIMEOUT = "attempt_timeout_s"
+FLEET_HANDOFF_ATTEMPT_TIMEOUT_DEFAULT = 30.0
+FLEET_HANDOFF_RETRIES = "retries"
+FLEET_HANDOFF_RETRIES_DEFAULT = 3  # total attempts, >= 1
+FLEET_HANDOFF_BACKOFF = "backoff_s"
+FLEET_HANDOFF_BACKOFF_DEFAULT = 0.05
+FLEET_HANDOFF_BACKOFF_MAX = "backoff_max_s"
+FLEET_HANDOFF_BACKOFF_MAX_DEFAULT = 2.0
+FLEET_HANDOFF_CLAIM_TTL = "claim_ttl_s"
+FLEET_HANDOFF_CLAIM_TTL_DEFAULT = 30.0
+FLEET_HANDOFF_RESUME_TTL = "resume_ttl_s"
+FLEET_HANDOFF_RESUME_TTL_DEFAULT = 60.0
+
 #############################################
 # Sparse attention
 #############################################

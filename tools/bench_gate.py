@@ -9,7 +9,8 @@ Two modes:
     baselines (``SERVING_BENCH_CPU.json`` + ``BENCH_r05.json`` +
     ``LONGDOC_BENCH_CPU.json`` + ``FLEET_BENCH_CPU.json`` +
     ``KERNEL_BENCH_CPU.json`` + ``CHAOS_BENCH_CPU.json`` +
-    ``ROLLOUT_BENCH_CPU.json`` + ``TRAIN_BENCH_CPU.json``). This is the
+    ``ROLLOUT_BENCH_CPU.json`` + ``DISAGG_BENCH_CPU.json`` +
+    ``TRAIN_BENCH_CPU.json``). This is the
     CI step: it needs no jax and takes milliseconds.
 
 ``compare FRESH BASELINE``
@@ -22,7 +23,9 @@ Artifact kinds are auto-detected: a dict with a ``parsed`` key is a
 driver wrapper (``BENCH_r05.json``) and is unwrapped;
 ``speedup_sparse_vs_dense_16k`` marks a long-document serving artifact
 (``LONGDOC_BENCH_CPU.json``); ``fleet_scaling_2x`` marks a fleet
-scale-out artifact (``FLEET_BENCH_CPU.json``); ``chaos_episodes`` marks
+scale-out artifact (``FLEET_BENCH_CPU.json``); ``disagg_ttft_p95_s``
+marks a disaggregated prefill/decode artifact
+(``DISAGG_BENCH_CPU.json``); ``chaos_episodes`` marks
 a chaos-harness artifact (``CHAOS_BENCH_CPU.json``);
 ``canary_routed_total`` marks a weight-rollout artifact
 (``ROLLOUT_BENCH_CPU.json``);
@@ -57,7 +60,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_ARTIFACTS = ("SERVING_BENCH_CPU.json", "BENCH_r05.json",
                      "LONGDOC_BENCH_CPU.json", "FLEET_BENCH_CPU.json",
                      "KERNEL_BENCH_CPU.json", "CHAOS_BENCH_CPU.json",
-                     "ROLLOUT_BENCH_CPU.json", "TRAIN_BENCH_CPU.json")
+                     "ROLLOUT_BENCH_CPU.json", "DISAGG_BENCH_CPU.json",
+                     "TRAIN_BENCH_CPU.json")
 
 # -- tolerance profiles -------------------------------------------------
 # key -> (direction, rel_tol). direction "higher" means bigger is better:
@@ -160,6 +164,20 @@ ROLLOUT_TOLERANCES = {
     "rollback_recovery_s": ("lower", 10.00),
 }
 
+# Disaggregation leg: absolute TTFTs on a shared CPU runner are noisy;
+# the gate-worthy signal is the interleaved/disagg TTFT p95 ratio (same
+# box, same run, same seeded workload — noise largely cancels). The
+# exactly-once and zero-orphan counters are enforced by the schema, not
+# a band.
+DISAGG_TOLERANCES = {
+    "interleaved_ttft_p95_s":  ("lower", 3.00),
+    "disagg_ttft_p95_s":       ("lower", 3.00),
+    "ttft_improvement":        ("higher", 0.40),
+    "interleaved_decode_tok_s": ("higher", 0.50),
+    "disagg_decode_tok_s":     ("higher", 0.50),
+    "completed_total":         ("higher", 0.50),
+}
+
 # context keys that must match exactly for numbers to be comparable
 SERVING_CONTEXT = ("platform", "model", "requests", "max_slots",
                    "max_new_tokens", "speculative_k", "kv_cache_dtype",
@@ -192,6 +210,10 @@ TRAINSTEP_CONTEXT = ("platform", "model", "n_devices", "zero_stage",
 # share of it.
 ROLLOUT_CONTEXT = ("platform", "model", "requests_total", "rollout_seed",
                    "canary_fraction")
+# rounds and per-kind token budgets are load-bearing: the TTFT ratio is
+# only meaningful against the identical seeded longdoc+chat schedule.
+DISAGG_CONTEXT = ("platform", "model", "rounds", "long_new_tokens",
+                  "chat_new_tokens")
 
 # -- schema -------------------------------------------------------------
 SERVING_REQUIRED = {
@@ -276,6 +298,24 @@ ROLLOUT_REQUIRED = {
     "complete": bool,
 }
 
+DISAGG_REQUIRED = {
+    "platform": str, "model": str, "rounds": int, "requests_per_leg": int,
+    "long_new_tokens": int, "chat_new_tokens": int,
+    "interleaved_ttft_p95_s": (int, float),
+    "disagg_ttft_p95_s": (int, float),
+    "ttft_improvement": (int, float),
+    "interleaved_decode_tok_s": (int, float),
+    "disagg_decode_tok_s": (int, float),
+    "handoffs_total": int, "handoffs_completed": int,
+    "handoffs_failed": int,
+    "completed_total": int, "dropped_total": int, "duplicated_total": int,
+    "bitwise_mismatch_total": int, "leaked_pages_total": int,
+    "chaos_episodes": int, "chaos_faults_fired": int,
+    "chaos_bitwise_ok": bool, "chaos_no_stuck": bool,
+    "chaos_recovery_bounded": bool, "chaos_pages_clean": bool,
+    "complete": bool,
+}
+
 # chaos acceptance floor: the committed schedule must compose at least
 # this many episodes (the issue's bar) to count as evidence
 CHAOS_MIN_EPISODES = 20
@@ -292,27 +332,32 @@ FLEET_MIN_SCALING_2X = 1.8
 # gradient set — a single bucket is the monolithic reduce wearing a hat
 TRAINSTEP_MIN_BUCKETS = 2
 
+# disagg acceptance floor: the prefill/decode split must actually beat
+# the interleaved baseline's chat TTFT p95 on the same workload — a
+# ratio at or below 1.0 means the handoff bought nothing
+DISAGG_MIN_TTFT_IMPROVEMENT = 1.0
+
 TOLERANCES = {"serving": SERVING_TOLERANCES, "train": TRAIN_TOLERANCES,
               "longdoc": LONGDOC_TOLERANCES, "fleet": FLEET_TOLERANCES,
               "kernels": KERNELS_TOLERANCES, "chaos": CHAOS_TOLERANCES,
-              "rollout": ROLLOUT_TOLERANCES,
+              "rollout": ROLLOUT_TOLERANCES, "disagg": DISAGG_TOLERANCES,
               "trainstep": TRAINSTEP_TOLERANCES}
 CONTEXTS = {"serving": SERVING_CONTEXT, "train": TRAIN_CONTEXT,
             "longdoc": LONGDOC_CONTEXT, "fleet": FLEET_CONTEXT,
             "kernels": KERNELS_CONTEXT, "chaos": CHAOS_CONTEXT,
-            "rollout": ROLLOUT_CONTEXT,
+            "rollout": ROLLOUT_CONTEXT, "disagg": DISAGG_CONTEXT,
             "trainstep": TRAINSTEP_CONTEXT}
 REQUIRED = {"serving": SERVING_REQUIRED, "train": TRAIN_REQUIRED,
             "longdoc": LONGDOC_REQUIRED, "fleet": FLEET_REQUIRED,
             "kernels": KERNELS_REQUIRED, "chaos": CHAOS_REQUIRED,
-            "rollout": ROLLOUT_REQUIRED,
+            "rollout": ROLLOUT_REQUIRED, "disagg": DISAGG_REQUIRED,
             "trainstep": TRAINSTEP_REQUIRED}
 
 
 def load_artifact(path):
     """Read + unwrap one artifact; returns (kind, payload). kind is
-    "serving", "train", "longdoc", "fleet", "chaos", "rollout",
-    "kernels" or "trainstep"."""
+    "serving", "train", "longdoc", "fleet", "disagg", "chaos",
+    "rollout", "kernels" or "trainstep"."""
     with open(path) as f:
         doc = json.load(f)
     if not isinstance(doc, dict):
@@ -326,6 +371,10 @@ def load_artifact(path):
         return "longdoc", doc
     if "fleet_scaling_2x" in doc:
         return "fleet", doc
+    # disagg before chaos: its artifact embeds the chaos mini-leg's
+    # "chaos_episodes" rollup, but the TTFT ratio is the kind marker
+    if "disagg_ttft_p95_s" in doc:
+        return "disagg", doc
     if "chaos_episodes" in doc:
         return "chaos", doc
     if "canary_routed_total" in doc:
@@ -342,9 +391,10 @@ def load_artifact(path):
         return "train", doc
     raise ValueError(
         f"{path}: unrecognized artifact (no 'speedup_sparse_vs_dense_16k', "
-        f"'fleet_scaling_2x', 'chaos_episodes', 'canary_routed_total', "
-        f"'decode_pallas_us', 'train_fusion', 'tokens_per_sec' or "
-        f"'metric' key; top-level keys: {sorted(doc)[:8]})")
+        f"'fleet_scaling_2x', 'disagg_ttft_p95_s', 'chaos_episodes', "
+        f"'canary_routed_total', 'decode_pallas_us', 'train_fusion', "
+        f"'tokens_per_sec' or 'metric' key; "
+        f"top-level keys: {sorted(doc)[:8]})")
 
 
 def check_schema(path):
@@ -490,6 +540,51 @@ def check_schema(path):
                 f"{path}: 'rollback_recovery_s' ({rec}) exceeds "
                 f"'recovery_bound_s' ({bound}) — an unbounded rollback is "
                 f"downtime wearing a hat")
+    elif kind == "disagg":
+        if doc.get("complete") is not True:
+            problems.append(f"{path}: 'complete' is not true — a partial "
+                            f"disagg bench run must not be committed as a "
+                            f"baseline")
+        for key in ("dropped_total", "duplicated_total",
+                    "bitwise_mismatch_total"):
+            v = doc.get(key)
+            if isinstance(v, int) and not isinstance(v, bool) and v != 0:
+                problems.append(
+                    f"{path}: '{key}' is {v} — a disaggregated fleet that "
+                    f"drops, duplicates, or corrupts a request breaks "
+                    f"exactly-once and must never become a baseline")
+        leaked = doc.get("leaked_pages_total")
+        if isinstance(leaked, int) and not isinstance(leaked, bool) \
+                and leaked != 0:
+            problems.append(
+                f"{path}: 'leaked_pages_total' is {leaked} — orphaned KV "
+                f"pages after drain mean the handoff claim/reap contract "
+                f"is broken")
+        for key in ("chaos_bitwise_ok", "chaos_no_stuck",
+                    "chaos_recovery_bounded", "chaos_pages_clean"):
+            if doc.get(key) is not True:
+                problems.append(
+                    f"{path}: '{key}' is not true — a disagg chaos leg "
+                    f"with a failed invariant must never become a baseline")
+        imp = doc.get("ttft_improvement")
+        if isinstance(imp, (int, float)) and not isinstance(imp, bool) \
+                and imp <= DISAGG_MIN_TTFT_IMPROVEMENT:
+            problems.append(
+                f"{path}: 'ttft_improvement' is {imp}, at or below the "
+                f"{DISAGG_MIN_TTFT_IMPROVEMENT}x floor — the prefill/"
+                f"decode split must beat the interleaved baseline's chat "
+                f"TTFT p95 on the same workload")
+        done = doc.get("handoffs_completed")
+        if isinstance(done, int) and not isinstance(done, bool) \
+                and done <= 0:
+            problems.append(
+                f"{path}: 'handoffs_completed' must be > 0 — a disagg leg "
+                f"that never moved a KV page proves nothing")
+        comp = doc.get("completed_total")
+        if isinstance(comp, int) and not isinstance(comp, bool) and comp <= 0:
+            problems.append(
+                f"{path}: 'completed_total' must be > 0 — a workload where "
+                f"nothing completed proves nothing")
     elif kind == "trainstep":
         if doc.get("complete") is not True:
             problems.append(f"{path}: 'complete' is not true — a partial "
@@ -668,7 +763,8 @@ def main(argv=None):
                              "json + LONGDOC_BENCH_CPU.json + "
                              "FLEET_BENCH_CPU.json + KERNEL_BENCH_CPU.json "
                              "+ CHAOS_BENCH_CPU.json + ROLLOUT_BENCH_CPU."
-                             "json + TRAIN_BENCH_CPU.json")
+                             "json + DISAGG_BENCH_CPU.json + "
+                             "TRAIN_BENCH_CPU.json")
     parser.add_argument("mode", nargs="?", choices=["compare"],
                         help="compare FRESH BASELINE under tolerance bands")
     parser.add_argument("fresh", nargs="?", help="fresh bench JSON")
