@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving test-router test-disagg lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels bench-train trace-smoke bench-gate chaos-smoke bench-rollout bench-disagg
+.PHONY: test test-fast test-faults test-cluster test-serving test-router test-disagg test-memtier lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels bench-train trace-smoke bench-gate chaos-smoke bench-rollout bench-disagg bench-memtier
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -44,6 +44,15 @@ test-router:
 # kill a prefill worker mid-handoff and a decode worker post-ack.
 test-disagg:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_disagg.py -q
+
+# Memory-tier suite: spill blob codec round-trips (fp32/bf16/int8 +
+# scales, bitwise), checksum/torn-write detection dropping — never
+# serving — corrupt entries, RAM->disk demotion + promotion, the
+# host-RSS pressure guard (shed -> pause inserts -> degrade ladder,
+# with hysteresis), OOM-safe admission relief, and the bitwise oracle
+# with the spill tier on, off, and under the three memory fault arms.
+test-memtier:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_memtier.py -q
 
 # Static JAX hazard analysis (tools/jaxlint): recompile, host-sync,
 # leaked-tracer, donation, fp16-dtype, collective-axis, RNG-reuse,
@@ -139,6 +148,20 @@ bench-rollout:
 bench-disagg:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=disagg python bench.py --child
 	python -m tools.bench_gate --check-schema DISAGG_BENCH_CPU.json
+
+# Memory-tier leg: two long shared prompts alternate through a live
+# prefix cache sized for ONE entry, so every serve after the first two
+# promotes its KV from the host-RAM spill tier — spilled-hit TTFT vs
+# the cold re-prefill TTFT of disjoint same-length prompts, decode
+# tok/s held equal, bitwise generate() oracle asserted in-run, plus a
+# corrupt-a-spilled-blob mini-leg (dropped + re-prefilled, never
+# served). Writes MEMTIER_BENCH_CPU.json; the bench gate's schema
+# check refuses a false integrity flag, a served corrupt entry, or a
+# TTFT ratio at/below 1.0. Knobs: BENCH_MEMTIER_ROUNDS (default 6),
+# BENCH_MEMTIER_NEW_TOKENS (default 16), BENCH_MEMTIER_OUT.
+bench-memtier:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=memtier python bench.py --child
+	python -m tools.bench_gate --check-schema MEMTIER_BENCH_CPU.json
 
 # Kernel-tier microbench: Pallas (interpret on CPU) vs the composed-XLA
 # fallback for the fused paged decode (fp32 + int8) and banded sparse

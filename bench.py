@@ -132,6 +132,8 @@ def child_main():
         return gpt2_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "serving":
         return serving_child_main()
+    if os.environ.get("BENCH_MODEL", "bert") == "memtier":
+        return memtier_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "longdoc":
         return longdoc_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "fleet":
@@ -462,6 +464,201 @@ def serving_child_main():
                                   "accept_rate", "tokens_per_step",
                                   "prefill_tokens_per_sec",
                                   "prefix_hit_rate")},
+    }))
+    return 0
+
+
+def memtier_child_main():
+    """Memory-tier leg: spilled-hit TTFT vs cold re-prefill TTFT.
+
+    A deliberately tiny live prefix cache (holds ONE long-prompt entry)
+    plus a generous host-RAM spill tier forces every alternation between
+    two long shared prompts through demote->promote: serving prompt A
+    evicts B's entry to spill and vice versa, so after the first two
+    serves every request is a spilled hit whose computed suffix is a
+    single token (bucket 16 prefill) instead of the full 448-token
+    bucket. The cold leg serves the same-length but mutually disjoint
+    prompts on an identically configured engine, so its TTFT is the
+    re-prefill cost the spill tier avoids — decode cost is identical in
+    both legs (same decode program, same max_new_tokens), so the TTFT
+    ratio isolates the prefill saved. Every output is asserted bitwise
+    against one-shot generate() (fp32 KV), and a corruption mini-leg
+    flips a byte in a spilled blob and re-serves: the entry must be
+    dropped (counted), the request must still complete bitwise via a
+    normal prefill, and corrupt_entries_served must stay 0. Writes
+    MEMTIER_BENCH[_CPU].json (BENCH_MEMTIER_OUT redirects). Knobs:
+    BENCH_MEMTIER_ROUNDS / BENCH_MEMTIER_NEW_TOKENS."""
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference import generate
+    from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    n_rounds = int(os.environ.get("BENCH_MEMTIER_ROUNDS", "6"))
+    max_new = int(os.environ.get("BENCH_MEMTIER_NEW_TOKENS", "16"))
+
+    cfg = GPT2Config(
+        vocab_size=512, hidden_size=128, num_hidden_layers=4,
+        num_attention_heads=4, max_position_embeddings=1024,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    _, params = init_gpt2(cfg, batch_size=1, seq_len=8, seed=0)
+
+    prompt_len = 440                    # bucket 448 when prefilled cold
+    # one 440-token fp32 entry is ~1.8MB (2 * L4 * hidden128 * 4B/tok);
+    # 2.2MB holds exactly one, so the second prompt's insert always
+    # demotes the first to spill — the alternation below then promotes
+    # on every serve.
+    live_mb, spill_mb = 2.2, 32.0
+
+    def make_engine():
+        return ServingEngine(params, cfg, ServingConfig(
+            max_slots=2, max_queue=8, max_seq_len=512,
+            prompt_buckets=(16, 448), prefix_cache_mb=live_mb,
+            prefix_spill_mb=spill_mb))
+
+    rng = np.random.RandomState(0)
+    prompt_a = rng.randint(0, cfg.vocab_size, (prompt_len,)).tolist()
+    prompt_b = rng.randint(0, cfg.vocab_size, (prompt_len,)).tolist()
+    cold_prompts = [rng.randint(0, cfg.vocab_size, (prompt_len,)).tolist()
+                    for _ in range(n_rounds)]
+
+    def serve_timed(eng, prompt):
+        """One request at a time: returns (output_tokens, its TTFT)."""
+        fut = eng.submit(prompt, max_new_tokens=max_new)
+        eng.drain(max_steps=50 * max_new)
+        out = fut.result(timeout=10)
+        return out, eng.metrics._ttft_window[-1]
+
+    # warm engine: pays every compile (bucket 448 + bucket 16 prefill +
+    # the decode program — shared process-wide) and anchors correctness
+    # against one-shot generate() at both bucket shapes.
+    warm = make_engine()
+    short_p = rng.randint(0, cfg.vocab_size, (12,)).tolist()    # bucket 16
+    for p in (prompt_a, short_p):
+        out, _ = serve_timed(warm, p)
+        want = np.asarray(generate(
+            params, cfg, np.asarray([p], np.int32), max_new))[0].tolist()
+        assert out == want, "memtier warmup diverged from generate()"
+
+    want_a = np.asarray(generate(
+        params, cfg, np.asarray([prompt_a], np.int32),
+        max_new))[0].tolist()
+    want_b = np.asarray(generate(
+        params, cfg, np.asarray([prompt_b], np.int32),
+        max_new))[0].tolist()
+
+    # cold leg: disjoint prompts -> every serve is a full 448-bucket
+    # re-prefill (the engine config is identical, so the only variable
+    # vs the spill leg is where the prefix KV comes from)
+    cold_eng = make_engine()
+    cold_ttfts = []
+    for p in cold_prompts:
+        _, ttft = serve_timed(cold_eng, p)
+        cold_ttfts.append(ttft)
+    cold_snap = cold_eng.metrics.snapshot()
+
+    # spill leg: A and B alternate through the one-entry live tier, so
+    # every serve after the first two promotes its prefix from spill
+    # and prefills a single-token suffix
+    eng = make_engine()
+    oracle_ok = True
+    out, _ = serve_timed(eng, prompt_a)             # cold: inserts A
+    oracle_ok &= out == want_a
+    out, _ = serve_timed(eng, prompt_b)             # inserts B, spills A
+    oracle_ok &= out == want_b
+    spill_ttfts = []
+    for _ in range(n_rounds):
+        for prompt, want in ((prompt_a, want_a), (prompt_b, want_b)):
+            out, ttft = serve_timed(eng, prompt)
+            spill_ttfts.append(ttft)
+            oracle_ok &= out == want
+    stats = eng.prefix_cache.stats()
+    spill_snap = eng.metrics.snapshot()
+
+    # corruption mini-leg: flip a byte in a spilled blob, then serve the
+    # matching prompt — the store must drop the corrupt entry (counted)
+    # and the request must still complete bitwise via a normal prefill
+    corrupt_before = eng.prefix_cache.spill.stats()["corrupt_dropped"]
+    assert eng.prefix_cache.corrupt_spilled(), "nothing spilled to corrupt"
+    spilled_key = next(iter(eng.prefix_cache.spill._records))
+    victim = list(spilled_key[1:])
+    want_v = want_a if victim == prompt_a else want_b
+    out, _ = serve_timed(eng, victim)
+    corrupt_dropped = (eng.prefix_cache.spill.stats()["corrupt_dropped"]
+                       - corrupt_before)
+    corrupt_entries_served = 0 if out == want_v else 1
+    spill_integrity_ok = bool(corrupt_dropped >= 1
+                              and corrupt_entries_served == 0)
+
+    cold_ttft = sum(cold_ttfts) / len(cold_ttfts)
+    spilled_ttft = sum(spill_ttfts) / len(spill_ttfts)
+    result = {
+        "platform": platform,
+        "model": "gpt2-tiny(L4,H128)",
+        "rounds": n_rounds,
+        "max_new_tokens": max_new,
+        "prompt_len": prompt_len,
+        "prefix_cache_mb": live_mb,
+        "prefix_spill_mb": spill_mb,
+        "cold_ttft_s": round(cold_ttft, 4),
+        "spilled_hit_ttft_s": round(spilled_ttft, 4),
+        "ttft_improvement": round(cold_ttft / spilled_ttft, 2),
+        "decode_tokens_per_sec_cold": round(
+            cold_snap["tokens_per_sec"] or 0.0, 1),
+        "decode_tokens_per_sec": round(
+            spill_snap["tokens_per_sec"] or 0.0, 1),
+        "spill_hits": stats["spill_hits"],
+        "spill_promotions": stats["spill_promotions"],
+        "spill_demotions": stats["spill"]["demotions"],
+        "spill_hit_rate": (None if stats["spill_hit_rate"] is None
+                           else round(stats["spill_hit_rate"], 3)),
+        "spill_corrupt_dropped": corrupt_dropped,
+        "corrupt_entries_served": corrupt_entries_served,
+        "oracle_ok": bool(oracle_ok),
+        "spill_integrity_ok": spill_integrity_ok,
+        "complete": True,
+    }
+    suffix = "" if platform == "tpu" else f"_{platform.upper()}"
+    # BENCH_MEMTIER_OUT redirects the artifact (tools/bench_gate.py runs
+    # a fresh bench to a temp path and diffs against the committed JSON)
+    out_path = os.environ.get("BENCH_MEMTIER_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"MEMTIER_BENCH{suffix}.json")
+    previous = None
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                previous = json.load(f)
+        except (OSError, ValueError):
+            previous = None
+    with open(out_path, "w") as f:
+        f.write(json.dumps(result, indent=1) + "\n")
+    if previous and previous.get("ttft_improvement"):
+        print(f"# spilled-hit TTFT advantage: "
+              f"{previous['ttft_improvement']:.2f}x -> "
+              f"{result['ttft_improvement']:.2f}x")
+    print(f"# cold re-prefill TTFT {cold_ttft:.4f}s vs spilled-hit TTFT "
+          f"{spilled_ttft:.4f}s ({result['ttft_improvement']:.2f}x); "
+          f"{stats['spill_hits']} spilled hits, "
+          f"{corrupt_dropped} corrupt entries dropped, "
+          f"{corrupt_entries_served} served")
+
+    print(json.dumps({
+        "metric": f"prefix-KV spill tier TTFT advantage ({platform})",
+        "value": result["ttft_improvement"],
+        "unit": "x cold re-prefill TTFT",
+        "vs_baseline": None,
+        **{k: result[k] for k in ("cold_ttft_s", "spilled_hit_ttft_s",
+                                  "spill_hits", "spill_promotions",
+                                  "spill_demotions", "spill_hit_rate",
+                                  "decode_tokens_per_sec",
+                                  "decode_tokens_per_sec_cold",
+                                  "spill_corrupt_dropped",
+                                  "corrupt_entries_served",
+                                  "oracle_ok", "spill_integrity_ok")},
     }))
     return 0
 
@@ -2209,6 +2406,10 @@ def main():
         label = "disaggregated prefill/decode chat TTFT p95 vs interleaved"
         seq = os.environ.get("BENCH_DISAGG_ROUNDS", "5")
         unit = "x interleaved TTFT p95"
+    elif os.environ.get("BENCH_MODEL", "bert") == "memtier":
+        label = "prefix-KV spill tier TTFT advantage"
+        seq = os.environ.get("BENCH_MEMTIER_ROUNDS", "6")
+        unit = "x cold re-prefill TTFT"
     elif os.environ.get("BENCH_MODEL", "bert") == "kernels":
         label = "kernel-tier microbench"
         seq = os.environ.get("BENCH_KERNELS_ITERS", "10")

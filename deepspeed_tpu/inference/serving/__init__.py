@@ -50,7 +50,12 @@ from deepspeed_tpu.inference.serving.metrics import (  # noqa: F401
     ServingMetrics,
 )
 from deepspeed_tpu.inference.serving.prefix_cache import (  # noqa: F401
+    MemoryPressureGuard,
     PrefixKVCache,
+    SpillStore,
+    decode_spill_blob,
+    encode_spill_blob,
+    read_host_rss_mb,
 )
 from deepspeed_tpu.inference.serving.replica import (  # noqa: F401
     ReplicaServer,
@@ -88,4 +93,6 @@ __all__ = [
     "WrongRoleError", "HandoffError", "HandoffSizeError",
     "HandoffFrameError", "HandoffTimeoutError", "HandoffRejectedError",
     "HandoffRetryError", "HandoffSender", "HandoffReceiver",
+    "SpillStore", "MemoryPressureGuard", "encode_spill_blob",
+    "decode_spill_blob", "read_host_rss_mb",
 ]

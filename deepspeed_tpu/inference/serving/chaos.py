@@ -465,6 +465,132 @@ class DisaggChaosHarness(ChaosHarness):
         return rep
 
 
+MEMTIER_FAULT_KINDS = ("corrupt_spill_entry", "torn_spill_write",
+                       "host_mem_pressure")
+
+
+class MemtierChaosHarness(ChaosHarness):
+    """Chaos arms for the prefix-cache memory tier (spill store +
+    pressure guard), on top of the base invariants (bitwise
+    exactly-once, no stuck, bounded recovery, convergence) plus one of
+    its own — **spill faults are invisible**: a corrupt blob, a torn
+    disk write, or a memory-pressure escalation may cost a re-prefill,
+    but must never error, stall, or bitwise-perturb a single request.
+
+    ``corrupt_spill_entry``
+        Flip a byte in a spilled prefix blob on a live replica. The next
+        promotion of that entry must fail its crc32, drop the record,
+        and fall through to a normal suffix prefill.
+    ``torn_spill_write``
+        The victim's next spill-to-disk writes land truncated under
+        their final names (a crash mid-write without the atomic rename
+        discipline). The framed reload must reject them on promotion.
+    ``host_mem_pressure``
+        The victim's ``MemoryPressureGuard`` reads a fake
+        over-watermark RSS for several checks, walking
+        shed-spill -> pause-inserts -> degrade-rung under live traffic;
+        with the arm exhausted the guard (and ladder) must recover.
+
+    Traffic is steered through a small pool of SHARED prompt prefixes
+    (``shared_prefix_frac``) so the prefix cache — and therefore its
+    spill tier — actually carries state worth corrupting; the rest stays
+    fully random like the base harness. All three arms are non-lethal:
+    episodes arm over the socket ``inject`` op and disarm after."""
+
+    def __init__(self, router, spawner, reference_fn, replicas, seed=0,
+                 faults=MEMTIER_FAULT_KINDS, shared_prefix_len=6,
+                 shared_prefix_frac=0.7, vocab=100, **kw):
+        super().__init__(router, spawner, reference_fn, replicas,
+                         seed=seed, faults=(), vocab=vocab, **kw)
+        self.faults = tuple(faults)
+        unknown = set(self.faults) - set(FAULT_KINDS + MEMTIER_FAULT_KINDS)
+        if unknown:
+            raise ValueError(f"unknown fault kinds: {sorted(unknown)}")
+        self.shared_prefix_frac = float(shared_prefix_frac)
+        # a couple of fixed bases, seeded: enough to force live-tier
+        # eviction (hence demotion) without every prompt colliding
+        self._bases = [[self.rng.randint(1, vocab - 1)
+                        for _ in range(int(shared_prefix_len))]
+                       for _ in range(3)]
+        self.make_prompt = self._memtier_prompt
+
+    def _memtier_prompt(self, rng):
+        if rng.random() < self.shared_prefix_frac:
+            base = rng.choice(self._bases)
+            tail = [rng.randint(1, 99) for _ in range(rng.randint(1, 3))]
+            return list(base) + tail
+        return default_make_prompt(rng)
+
+    def _victim_spill_stats(self, victim):
+        """Cumulative spill counters from the victim's health doc, {}
+        when unreachable or spill-less."""
+        try:
+            doc = replica_op(victim.host, victim.port, {"op": "health"})
+        except OSError:
+            return {}
+        spill = (doc.get("prefix_cache") or {}).get("spill") or {}
+        return {k: int(spill.get(k, 0))
+                for k in ("demotions", "promotions", "corrupt_dropped")}
+
+    def run_episode(self, kind=None):
+        kind = kind or self.rng.choice(self.faults)
+        if kind not in MEMTIER_FAULT_KINDS:
+            return super().run_episode(kind)
+        record = {"kind": kind, "completed": 0, "shed": 0, "errors": 0,
+                  "stuck": 0, "bitwise_mismatch": 0}
+        handles = self._routed_handles()
+        if not handles:
+            record["victim"] = None
+            self._collect(self._submit_batch(self.rng.randint(2, 4),
+                                             shed_retries=3), record)
+            self.episodes.append(record)
+            return record
+        victim = self.rng.choice(handles)
+        record["victim"] = victim.name
+        spill_before = self._victim_spill_stats(victim)
+        # warm traffic FIRST: the spill tier needs demoted state before
+        # corrupting/tearing it means anything
+        before = self._submit_batch(self.rng.randint(2, 4))
+        self._collect(before, record)
+        args = {"op": "inject", "point": kind}
+        if kind == "host_mem_pressure":
+            args["times"] = self.rng.randint(4, 8)  # pressured guard ticks
+        else:
+            args["times"] = self.rng.randint(1, 3)
+        try:
+            replica_op(victim.host, victim.port, args)
+        except OSError:
+            record["inject_failed"] = True
+        during = self._submit_batch(self.rng.randint(2, 4), shed_retries=3)
+        self._collect(during, record)
+        try:                            # a stale arm must not leak into
+            replica_op(victim.host, victim.port,     # later episodes
+                       {"op": "inject", "point": None})
+        except OSError:
+            pass
+        self._await_recovery(record)
+        spill_after = self._victim_spill_stats(victim)
+        record["spill_delta"] = {
+            k: spill_after.get(k, 0) - spill_before.get(k, 0)
+            for k in spill_after}
+        self.episodes.append(record)
+        return record
+
+    def report(self):
+        rep = super().report()
+        mem = [e for e in self.episodes if e["kind"] in MEMTIER_FAULT_KINDS]
+        rep["memtier_episodes"] = len(mem)
+        rep["spill_corrupt_dropped_total"] = sum(
+            e.get("spill_delta", {}).get("corrupt_dropped", 0) for e in mem)
+        rep["spill_demotions_total"] = sum(
+            e.get("spill_delta", {}).get("demotions", 0) for e in mem)
+        # the tentpole's bar: a spill fault may cost a re-prefill, never
+        # an errored or stuck request (bitwise is already asserted base)
+        rep["invariant_spill_clean"] = all(
+            e["errors"] == 0 and e["stuck"] == 0 for e in mem)
+        return rep
+
+
 ROLLOUT_FAULT_KINDS = ("kill_canary_mid_swap", "corrupt_new_tag")
 
 

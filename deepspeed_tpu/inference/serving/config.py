@@ -42,6 +42,22 @@ class ServingConfig:
     # KV keyed by token prefix so shared system-prompt prefixes skip
     # recomputation. 0 = disabled.
     prefix_cache_mb: float = 0.0
+    # Spill tier for evicted prefix-cache entries: instead of destroying
+    # cold entries, demote their (already-quantized) bytes into a
+    # crc32-framed host-RAM store under this MiB budget; a later hit
+    # verifies the checksum and promotes the entry back, paying one host
+    # decode instead of re-prefilling the shared prefix. 0 = disabled
+    # (eviction destroys, the pre-tiering behavior).
+    prefix_spill_mb: float = 0.0
+    # Optional disk tier under the spill tier: RAM-overflow spill
+    # records are written here with the checkpoint atomic-write
+    # discipline (tmp -> fsync -> rename). None = RAM-only spill.
+    prefix_spill_dir: str = None
+    # Host-RSS watermark (MiB) for the MemoryPressureGuard: sustained
+    # RSS at/above it sheds the spill tier, then pauses prefix inserts,
+    # then climbs the fleet DegradeLadder — staged degradation instead
+    # of an OOM kill. 0 = guard disabled.
+    host_mem_watermark_mb: float = 0.0
     # Speculative decoding: propose up to this many self-drafted tokens
     # per lane per step (n-gram lookup over the lane's own history) and
     # verify them all in ONE batched forward — each step emits 1..k+1

@@ -158,7 +158,11 @@ from deepspeed_tpu.inference.serving.kv_pool import (
     PoolExhaustedError,
 )
 from deepspeed_tpu.inference.serving.metrics import ServingMetrics
-from deepspeed_tpu.inference.serving.prefix_cache import PrefixKVCache
+from deepspeed_tpu.inference.serving.prefix_cache import (
+    MemoryPressureGuard,
+    PrefixKVCache,
+    read_host_rss_mb,
+)
 from deepspeed_tpu.inference.serving.degrade import DegradeLadder
 from deepspeed_tpu.inference.serving.scheduler import (
     ContinuousBatchingScheduler,
@@ -910,6 +914,25 @@ class _ChunkedPrefill:
         self.prefill_s = 0.0
 
 
+class _EngineLadderShim:
+    """Ladder facade handed to MemoryPressureGuard: the engine creates
+    its DegradeLadder lazily (configure_degrade), so the guard must not
+    capture the ladder object at construction — it reads/writes through
+    the engine, which creates the ladder on first set_rung."""
+
+    __slots__ = ("_engine",)
+
+    def __init__(self, engine):
+        self._engine = engine
+
+    @property
+    def rung(self):
+        return self._engine._degrade_rung
+
+    def set_rung(self, rung, reason="forced"):
+        return self._engine.set_degrade_rung(rung, reason=reason)
+
+
 class ServingEngine:
     """Request queue + KV pool + the single compiled decode loop.
 
@@ -947,6 +970,23 @@ class ServingEngine:
             raise ValueError(
                 f"serving.prefix_cache_mb must be >= 0 "
                 f"(0 disables the prefix cache), got {cfg.prefix_cache_mb}")
+        if cfg.prefix_spill_mb < 0:
+            raise ValueError(
+                f"serving.prefix_spill_mb must be >= 0 "
+                f"(0 disables the spill tier), got {cfg.prefix_spill_mb}")
+        if cfg.prefix_spill_mb > 0 and cfg.prefix_cache_mb <= 0:
+            raise ValueError(
+                f"serving.prefix_spill_mb={cfg.prefix_spill_mb} needs a "
+                f"live prefix cache (prefix_cache_mb > 0) to spill from")
+        if cfg.prefix_spill_dir is not None and cfg.prefix_spill_mb <= 0:
+            raise ValueError(
+                f"serving.prefix_spill_dir={cfg.prefix_spill_dir!r} needs "
+                f"a spill tier (prefix_spill_mb > 0) above it")
+        if cfg.host_mem_watermark_mb < 0:
+            raise ValueError(
+                f"serving.host_mem_watermark_mb must be >= 0 "
+                f"(0 disables the memory-pressure guard), "
+                f"got {cfg.host_mem_watermark_mb}")
         if (isinstance(cfg.speculative_k, bool)
                 or not isinstance(cfg.speculative_k, int)
                 or cfg.speculative_k < 0):
@@ -1047,12 +1087,43 @@ class ServingEngine:
             request_timeout_s=cfg.request_timeout_s)
         self.metrics = ServingMetrics(monitor)
         self.metrics.record_kv_pool_bytes(self.pool.nbytes())
-        self.prefix_cache = (
-            PrefixKVCache(max(1, int(cfg.prefix_cache_mb * 2 ** 20)))
-            if cfg.prefix_cache_mb > 0 else None)
         if injector is None and cfg.fault_injection:
             injector = ServingFaultInjector(cfg.fault_injection)
         self.injector = injector
+        self.prefix_cache = (
+            PrefixKVCache(max(1, int(cfg.prefix_cache_mb * 2 ** 20)),
+                          spill_budget_bytes=int(
+                              cfg.prefix_spill_mb * 2 ** 20),
+                          spill_dir=cfg.prefix_spill_dir,
+                          listener=self._on_spill_event)
+            if cfg.prefix_cache_mb > 0 else None)
+        if (self.prefix_cache is not None
+                and self.prefix_cache.spill is not None):
+            # torn-write fault surface: consulted per disk write, False
+            # while unarmed — re-wired live if an injector arrives later
+            # over the replica inject op (same object, arm-time only)
+            if self.injector is not None:
+                self.prefix_cache.spill.torn_write_hook = (
+                    self.injector.torn_spill_write)
+            self.metrics.set_spill_sources(
+                spill_stats_fn=self.prefix_cache.spill.stats,
+                host_rss_mb_fn=self._host_rss_mb)
+        elif cfg.host_mem_watermark_mb > 0:
+            self.metrics.set_spill_sources(host_rss_mb_fn=self._host_rss_mb)
+        # host-memory watchdog: one check per step(); sheds the spill
+        # tier, pauses prefix inserts, then climbs the degrade ladder
+        self._mem_guard = (
+            MemoryPressureGuard(cfg.host_mem_watermark_mb,
+                                cache=self.prefix_cache,
+                                ladder=_EngineLadderShim(self),
+                                read_rss_mb=self._guard_rss_mb,
+                                listener=self._on_mem_pressure_level)
+            if cfg.host_mem_watermark_mb > 0 else None)
+        # edge-trigger memo for the serving/spill_corrupt instant
+        self._spill_corrupt_seen = 0
+        # pool-pressure relief: one evict+shed attempt per exhaustion
+        # event (satellite: requeue-after-relief instead of plain requeue)
+        self._pool_relief_attempts = 0
 
         self._active = {}                                   # slot -> Request
         self._lane_tokens = np.zeros(cfg.max_slots, np.int32)
@@ -1180,6 +1251,15 @@ class ServingEngine:
         if telemetry_config is not None and telemetry_config.enabled:
             self._trace_file = telemetry_config.trace_file
             self.metrics.export_to(telemetry.get_registry())
+            if (self.prefix_cache is not None
+                    and self.prefix_cache.spill is not None):
+                telemetry.get_registry().gauge_fn(
+                    "Serving/SpillTier", self.prefix_cache.spill.stats,
+                    help="host-RAM/disk spill tier occupancy")
+            if self._mem_guard is not None or cfg.host_mem_watermark_mb > 0:
+                telemetry.get_registry().gauge_fn(
+                    "Serving/HostRssMb", self._host_rss_mb,
+                    help="process resident set size (MiB)")
             if self._kernel_impl:
                 # per-kernel selected-backend gauges next to the
                 # Kernels/<name>/calls counters at /metrics
@@ -1203,6 +1283,7 @@ class ServingEngine:
         srv.add_snapshot_provider("serving", self.metrics.snapshot)
         srv.add_snapshot_provider("kv_pool", self.occupancy)
         srv.add_snapshot_provider("prefix_cache", self.prefix_stats)
+        srv.add_snapshot_provider("memtier", self.memtier_stats)
         srv.add_snapshot_provider("kernels", kernels.registry_snapshot)
         srv.add_health_provider("serving_loop", self._loop_health)
         return srv.start()
@@ -1272,6 +1353,66 @@ class ServingEngine:
         if self._degrade_rung >= 2:
             return max(1, self.config.max_queue // 2)
         return self.config.max_queue
+
+    # -- memory tiering & pressure (spill tier + guard) ------------------
+    def _host_rss_mb(self):
+        """Current host RSS (MiB) — the snapshot/gauge source."""
+        return read_host_rss_mb()
+
+    def _guard_rss_mb(self):
+        """RSS reader the MemoryPressureGuard ticks on: the
+        host_mem_pressure fault arm substitutes a fake over-watermark
+        value while armed, so chaos drives the escalation path without
+        actually ballooning the process."""
+        if (self.injector is not None
+                and self.injector.host_mem_pressure_active()):
+            return self.config.host_mem_watermark_mb * 4.0
+        return read_host_rss_mb()
+
+    def _on_spill_event(self, event):
+        """Spill-tier listener (fires under the cache lock — metrics and
+        tracer only, never back into the cache)."""
+        if event == "spill_hit":
+            self.metrics.record_spill_lookup(True)
+        elif event == "spill_miss":
+            self.metrics.record_spill_lookup(False)
+        elif event == "spill_corrupt":
+            self.metrics.record_spill_corrupt()
+            tracer = getattr(self, "_tracer", None)
+            if tracer is not None and tracer.enabled:
+                tracer.instant("serving/spill_corrupt", args={
+                    "total": self.metrics.spill_corrupt_total})
+
+    def _on_mem_pressure_level(self, level, rss_mb):
+        """Edge-triggered on every MemoryPressureGuard level change."""
+        tracer = getattr(self, "_tracer", None)
+        if tracer is not None and tracer.enabled:
+            tracer.instant("serving/mem_pressure", args={
+                "level": level,
+                "level_name": MemoryPressureGuard.LEVELS[level],
+                "rss_mb": None if rss_mb is None else round(rss_mb, 1)})
+
+    def _relieve_memory_pressure(self):
+        """One-shot relief when admission hits pool/page exhaustion:
+        evict every unreferenced live prefix entry (demoting to spill)
+        and shed the spill tier, so transient pressure self-heals before
+        the request round-trips through requeue backpressure. Returns
+        True when anything was actually released."""
+        if self.prefix_cache is None:
+            return False
+        self._pool_relief_attempts += 1
+        evicted = self.prefix_cache.evict_unreferenced()
+        shed = self.prefix_cache.shed_spill()
+        return bool(evicted or shed)
+
+    def memtier_stats(self):
+        """Spill-tier + pressure-guard snapshot (telemetry provider)."""
+        out = {"pool_relief_attempts": self._pool_relief_attempts}
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
+        if self._mem_guard is not None:
+            out["mem_guard"] = self._mem_guard.stats()
+        return out
 
     @classmethod
     def from_config(cls, params, model_config, ds_config, rank=0,
@@ -1484,6 +1625,10 @@ class ServingEngine:
         if self.injector is not None:
             self.injector.maybe_evict_prefix(self._step_count,
                                              self.prefix_cache)
+            self.injector.maybe_corrupt_spill(self._step_count,
+                                              self.prefix_cache)
+        if self._mem_guard is not None:
+            self._mem_guard.check()
         if self._active:
             # busy steps (not raw _step_count, which idles forward between
             # requests in background mode): the kill_replica arm's at_step
@@ -1977,9 +2122,16 @@ class ServingEngine:
             if head is None:
                 return
             if not self.pool.can_allocate(self._alloc_tokens(head)):
-                # page-pool backpressure: FIFO head waits for frees
-                self.scheduler.requeue_front(head)
-                return
+                # page-pool backpressure: release host-side ballast once
+                # (unreferenced prefix entries demote to spill, spill
+                # tier sheds) before parking the FIFO head — transient
+                # memory pressure self-heals instead of round-tripping
+                # through requeue backpressure
+                if (not self._relieve_memory_pressure()
+                        or not self.pool.can_allocate(
+                            self._alloc_tokens(head))):
+                    self.scheduler.requeue_front(head)
+                    return
             if self._needs_chunking(head):
                 if self._chunking is not None:
                     self.scheduler.requeue_front(head)   # chunk lane is busy
@@ -2034,7 +2186,12 @@ class ServingEngine:
             try:
                 slot = self.pool.allocate(self._alloc_tokens(req))
             except PoolExhaustedError:
-                break
+                if not self._relieve_memory_pressure():
+                    break
+                try:        # one retry after shedding host-side ballast
+                    slot = self.pool.allocate(self._alloc_tokens(req))
+                except PoolExhaustedError:
+                    break
             i = len(plan)
             req.attn_impl = impl
             reuse, entry = self._acquire_prefix(req)
@@ -2153,11 +2310,18 @@ class ServingEngine:
             # reserved up front: completion can't stall on a full pool
             slot = self.pool.allocate(self._alloc_tokens(req))
         except PoolExhaustedError:
-            if entry is not None and self.prefix_cache is not None:
-                self.prefix_cache.release(entry)
-                req.prefix_entry = None
-            self.scheduler.requeue_front(req)
-            return False
+            slot = None
+            if self._relieve_memory_pressure():
+                try:    # one retry after shedding host-side ballast
+                    slot = self.pool.allocate(self._alloc_tokens(req))
+                except PoolExhaustedError:
+                    slot = None
+            if slot is None:
+                if entry is not None and self.prefix_cache is not None:
+                    self.prefix_cache.release(entry)
+                    req.prefix_entry = None
+                self.scheduler.requeue_front(req)
+                return False
         self.metrics.record_admission(
             bucket_for(self._suffix_len(req), self.scheduler.buckets),
             len(req.prompt))
@@ -2271,6 +2435,10 @@ class ServingEngine:
         if self._degrade_rung >= 2:
             # budget_shrink rung: stop growing the host-RAM trie under
             # overload (lookups/hits still work — reuse stays free)
+            return
+        if self._mem_guard is not None and self._mem_guard.inserts_paused:
+            # host-RSS watermark breached: stop allocating host memory
+            # for new entries until the guard recovers (hits still work)
             return
         n = len(req.prompt)
         if reuse >= n - 1:

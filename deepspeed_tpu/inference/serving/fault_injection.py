@@ -49,6 +49,20 @@ so router failover paths are drivable from config:
                     the router must re-route WITHOUT burning the
                     request's retry budget)
 
+Memory-tier arms (spill tier + pressure guard, prefix_cache.py)::
+
+    corrupt_spill_entry   flip a byte in one spilled prefix-cache blob at
+                          scheduler iteration N — the next promotion must
+                          fail its crc32, drop the entry, and fall
+                          through to a normal prefill (never an error)
+    torn_spill_write      the spill store's next disk write lands
+                          truncated under its final name (crash
+                          mid-write); the framed reload must drop it
+    host_mem_pressure     the MemoryPressureGuard reads a fake
+                          over-watermark RSS for the next ``times``
+                          checks, driving shed-spill / pause-inserts /
+                          degrade-rung escalation without real memory
+
 Arms take ``at_step``/``times`` like the step arms (``slow_decode``,
 ``evict_under_decode``) or ``request_id`` (``stuck_request``, persistent
 by default). Because the class sits at the bottom of the injector
@@ -79,7 +93,9 @@ from deepspeed_tpu.runtime.resilience.fault_injection import StepFaultInjector
 SERVING_POINTS = ("slow_decode", "stuck_request", "evict_under_decode",
                   "corrupt_draft", "kill_replica", "slow_replica",
                   "reject_admission", "handoff_corrupt_frame",
-                  "handoff_kill_mid_transfer", "handoff_kill_post_ack")
+                  "handoff_kill_mid_transfer", "handoff_kill_post_ack",
+                  "corrupt_spill_entry", "torn_spill_write",
+                  "host_mem_pressure")
 
 
 class _ServingArm:
@@ -268,6 +284,54 @@ class ServingFaultInjector(StepFaultInjector):
             arm.times -= 1
         self._fire("handoff_kill_post_ack")
         self._kill()
+
+    # -- memory-tier hooks (prefix_cache.py spill tier / engine) --------
+    def maybe_corrupt_spill(self, step, prefix_cache):
+        """Flip a byte in one spilled prefix-cache blob when the
+        corrupt_spill_entry arm matches ``step`` (no-op without a cache
+        or spill tier). The next promotion of that entry must fail its
+        crc32 and fall through to a normal prefill — never an error."""
+        arm = self._serving_arms.get("corrupt_spill_entry")
+        if arm is None or prefix_cache is None:
+            return
+        if arm.at_step is not None and step != arm.at_step:
+            return
+        if arm.times is not None:
+            if arm.times <= 0:
+                return
+            arm.times -= 1
+        self._fire("corrupt_spill_entry")
+        prefix_cache.corrupt_spilled()
+
+    def torn_spill_write(self):
+        """True while the torn_spill_write arm has shots left: the spill
+        store's NEXT disk write lands truncated under its final name —
+        the crash-mid-write the atomic rename protocol normally rules
+        out — so the reload path must catch it by framing."""
+        arm = self._serving_arms.get("torn_spill_write")
+        if arm is None:
+            return False
+        if arm.times is not None:
+            if arm.times <= 0:
+                return False
+            arm.times -= 1
+        self._fire("torn_spill_write")
+        return True
+
+    def host_mem_pressure_active(self):
+        """True while the host_mem_pressure arm has shots left — each
+        call is one MemoryPressureGuard check that should read a fake
+        over-watermark RSS (``times`` bounds how many guard ticks stay
+        pressured, so an episode recovers deterministically)."""
+        arm = self._serving_arms.get("host_mem_pressure")
+        if arm is None:
+            return False
+        if arm.times is not None:
+            if arm.times <= 0:
+                return False
+            arm.times -= 1
+        self._fire("host_mem_pressure")
+        return True
 
     def request_is_stuck(self, request_id):
         """True while the stuck_request arm pins ``request_id`` (persistent

@@ -123,6 +123,55 @@ _install_pages_int8_jit = jax.jit(_install_pages_int8,
                                   static_argnums=(8,))
 
 
+# -- host entry frame export / import (prefix-cache spill tier) ---------
+def export_entry_frames(k, v, k_scale=None, v_scale=None):
+    """Serialize a host-side KV entry (numpy ``[L, nh, P, hd]`` pair in
+    its STORAGE dtype — fp32/bf16/int8 — plus optional per-(layer, head)
+    fp32 scales) into ``(meta, frames)``: raw ``bytes`` payloads the
+    spill tier can frame/checksum individually, and the meta dict
+    ``import_entry_frames`` needs to rebuild the arrays bit-for-bit.
+    The generalization of ``export_lane``'s tobytes/frombuffer discipline
+    to entries that never lived in the pool."""
+    meta = {
+        "dtype": str(np.dtype(k.dtype)),
+        "shape": list(k.shape),
+        "scales": k_scale is not None,
+    }
+    frames = [k.tobytes(), v.tobytes()]
+    if k_scale is not None:
+        meta["scale_shape"] = list(k_scale.shape)
+        frames.append(np.ascontiguousarray(k_scale, np.float32).tobytes())
+        frames.append(np.ascontiguousarray(v_scale, np.float32).tobytes())
+    return meta, frames
+
+
+def import_entry_frames(meta, frames):
+    """Inverse of ``export_entry_frames``: rebuild ``(k, v, k_scale,
+    v_scale)`` from a meta dict and its byte frames. Raises ValueError
+    when a frame's byte count disagrees with the advertised shape/dtype
+    (a framing-level corruption the crc missed structurally)."""
+    dtype = np.dtype(str(meta["dtype"]))
+    shape = tuple(int(d) for d in meta["shape"])
+    expect = dtype.itemsize * int(np.prod(shape))
+    if len(frames[0]) != expect or len(frames[1]) != expect:
+        raise ValueError(
+            f"entry frame carries {len(frames[0])}/{len(frames[1])} bytes "
+            f"but shape {shape} x {dtype} needs {expect}")
+    k = np.frombuffer(frames[0], dtype).reshape(shape)
+    v = np.frombuffer(frames[1], dtype).reshape(shape)
+    k_scale = v_scale = None
+    if meta.get("scales"):
+        sshape = tuple(int(d) for d in meta["scale_shape"])
+        sexpect = 4 * int(np.prod(sshape))
+        if len(frames[2]) != sexpect or len(frames[3]) != sexpect:
+            raise ValueError(
+                f"scale frame carries {len(frames[2])}/{len(frames[3])} "
+                f"bytes but shape {sshape} x float32 needs {sexpect}")
+        k_scale = np.frombuffer(frames[2], np.float32).reshape(sshape)
+        v_scale = np.frombuffer(frames[3], np.float32).reshape(sshape)
+    return k, v, k_scale, v_scale
+
+
 class KVCachePool:
     """Fixed-capacity paged KV storage plus its host-side allocator."""
 

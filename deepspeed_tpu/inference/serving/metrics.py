@@ -50,6 +50,14 @@ class ServingMetrics:
         # snapshot works without reaching into the engine)
         self.prefix_hits = 0
         self.prefix_misses = 0
+        # spill tier: promotion hit rate, dropped-corrupt counter, and
+        # pull sources for live byte/entry/RSS gauges (engine wires
+        # set_spill_sources; snapshot degrades gracefully unwired)
+        self.spill_hits = 0
+        self.spill_misses = 0
+        self.spill_corrupt_total = 0
+        self._spill_stats_fn = None
+        self._host_rss_mb_fn = None
         # speculative decoding: drafts proposed/accepted across steps and
         # the pool's storage footprint (recorded once, at engine build)
         self.draft_proposed = 0
@@ -107,6 +115,35 @@ class ServingMetrics:
         lookups = self.prefix_hits + self.prefix_misses
         self._record("Serving/PrefixHitRate",
                      self.prefix_hits / lookups, lookups)
+
+    def record_spill_lookup(self, hit):
+        """One spill-tier consult on the counted (acquire) path: ``hit``
+        when the returned entry was just promoted out of the spill
+        tier — ``Serving/SpillHitRate`` is the fraction of prefix
+        lookups the demotion tier saved from a cold re-prefill."""
+        if hit:
+            self.spill_hits += 1
+        else:
+            self.spill_misses += 1
+        lookups = self.spill_hits + self.spill_misses
+        self._record("Serving/SpillHitRate",
+                     self.spill_hits / lookups, lookups)
+
+    def record_spill_corrupt(self):
+        """A spilled entry failed its checksum/framing on promotion and
+        was dropped (the request fell through to a normal prefill)."""
+        self.spill_corrupt_total += 1
+        self._record("Serving/spill_corrupt_total",
+                     self.spill_corrupt_total, self.spill_corrupt_total)
+
+    def set_spill_sources(self, spill_stats_fn=None, host_rss_mb_fn=None):
+        """Wire pull sources for the live gauges: ``spill_stats_fn`` ->
+        the SpillStore ``stats()`` dict (bytes/entries), and
+        ``host_rss_mb_fn`` -> current host RSS in MiB (the guard's
+        reader). Both surface in ``snapshot()`` and therefore in the
+        ``Serving/Snapshot`` Prometheus exposition."""
+        self._spill_stats_fn = spill_stats_fn
+        self._host_rss_mb_fn = host_rss_mb_fn
 
     def record_admission(self, bucket, prompt_len):
         """One admitted prompt: tally its TRUE length (not the padded
@@ -207,6 +244,10 @@ class ServingMetrics:
         lookups = self.prefix_hits + self.prefix_misses
         return self.prefix_hits / lookups if lookups else None
 
+    def spill_hit_rate(self):
+        lookups = self.spill_hits + self.spill_misses
+        return self.spill_hits / lookups if lookups else None
+
     def accept_rate(self):
         """Cumulative draft acceptance rate, None before any
         speculative step (or with speculation disabled)."""
@@ -255,8 +296,24 @@ class ServingMetrics:
             "handoff_dup_installs": self.handoff_dup_installs,
             "handoff_resumes": self.handoff_resumes,
             "handoff_reaped": self.handoff_reaped,
+            # spill tier + memory pressure (pull gauges: live bytes and
+            # host RSS are read at snapshot time, not last-recorded)
+            "spill_hit_rate": self.spill_hit_rate(),
+            "spill_corrupt_total": self.spill_corrupt_total,
             "uptime_s": time.monotonic() - self._started,
         }
+        if self._spill_stats_fn is not None:
+            try:
+                sstats = self._spill_stats_fn() or {}
+            except Exception:
+                sstats = {}
+            snap["spill_bytes"] = sstats.get("bytes", 0)
+            snap["spill_disk_bytes"] = sstats.get("disk_bytes", 0)
+            snap["spill_entries"] = sstats.get("entries", 0)
+        if self._host_rss_mb_fn is not None:
+            rss = self._host_rss_mb_fn()
+            if rss is not None:
+                snap["host_rss_mb"] = rss
         # flattened per-bucket admitted-prompt-length histogram: numeric
         # keys so export_to's gauge filter picks them up unchanged
         for bucket in sorted(self._admitted_by_bucket):

@@ -26,9 +26,45 @@ Entries hold NUMPY arrays (shape [L, nh, P, hd]): host RAM is the cheap
 pool, and the engine assembles the seeded device cache in one transfer
 per admission batch — a deliberate host-device copy traded against
 recomputing the prefix.
+
+Memory tiering (the ZeRO-Offload / ZeRO-Infinity hierarchy brought to
+serving): with a ``SpillStore`` attached, eviction DEMOTES entries
+instead of destroying them — the already-quantized bytes move into a
+host-RAM tier of crc32-framed blobs under its own byte budget, whose
+own LRU overflow demotes once more to an optional disk tier written
+with the checkpoint discipline (tmp -> fsync -> rename). A later
+lookup that would miss the live trie but hits a spilled prefix
+verifies the checksum and PROMOTES the entry back — one host decode
+instead of re-prefilling thousands of shared tokens. A corrupt or torn
+blob is dropped (counted, listener-notified), never an error: the
+request falls through to a normal suffix prefill. ``MemoryPressureGuard``
+watches host RSS against a watermark and sheds the spill tier first,
+pauses live inserts second, and climbs the fleet ``DegradeLadder``
+last, so host memory pressure becomes a degrade rung instead of an
+OOM kill.
 """
 
+import io
+import json
+import os
 import threading
+from collections import OrderedDict
+
+from deepspeed_tpu.inference.serving.handoff import (
+    HandoffFrameError,
+    HandoffSizeError,
+    read_frame,
+    write_frame,
+)
+from deepspeed_tpu.inference.serving.kv_pool import (
+    export_entry_frames,
+    import_entry_frames,
+)
+
+# One spill blob is a handful of frames; entries are bounded by the live
+# tier's budget, so this cap only guards against an insane length prefix
+# from a corrupted header — not a tuning knob.
+SPILL_MAX_FRAME_BYTES = 1 << 30
 
 
 class PrefixEntry:
@@ -38,7 +74,7 @@ class PrefixEntry:
     entry charges against the budget, dequantized at seed time."""
 
     __slots__ = ("tokens", "k", "v", "k_scale", "v_scale", "impl",
-                 "nbytes", "refs", "last_used")
+                 "nbytes", "refs", "last_used", "from_spill")
 
     def __init__(self, tokens, k, v, k_scale=None, v_scale=None,
                  impl="dense"):
@@ -58,6 +94,10 @@ class PrefixEntry:
             self.nbytes += int(k_scale.nbytes) + int(v_scale.nbytes)
         self.refs = 0
         self.last_used = 0
+        # set when a lookup just promoted this entry out of the spill
+        # tier; consumed by the first counted acquire() so SpillHitRate
+        # attributes exactly one hit per promotion
+        self.from_spill = False
 
 
 class _Node:
@@ -68,10 +108,455 @@ class _Node:
         self.covering = set()                   # entries passing through
 
 
-class PrefixKVCache:
-    """Trie-indexed, ref-counted, byte-budgeted prompt-prefix KV store."""
+class _ByteSink:
+    """Adapter so the handoff codec's ``write_frame`` (which expects a
+    socket-like ``sendall``) can frame into a host buffer."""
 
-    def __init__(self, budget_bytes):
+    __slots__ = ("buf",)
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def sendall(self, data):
+        self.buf += data
+
+
+def encode_spill_blob(entry):
+    """Serialize a ``PrefixEntry`` into one self-describing blob: a JSON
+    meta frame followed by the entry's array frames, each length-prefixed
+    and crc32'd by the PR 17 handoff codec — the integrity story the
+    handoff lane already proved, reused byte-for-byte."""
+    meta, frames = export_entry_frames(entry.k, entry.v,
+                                       entry.k_scale, entry.v_scale)
+    meta["impl"] = entry.impl
+    meta["tokens"] = list(entry.tokens)
+    sink = _ByteSink()
+    write_frame(sink, json.dumps(meta).encode("utf-8"),
+                max_bytes=SPILL_MAX_FRAME_BYTES)
+    for payload in frames:
+        write_frame(sink, payload, max_bytes=SPILL_MAX_FRAME_BYTES)
+    return bytes(sink.buf)
+
+
+def decode_spill_blob(blob):
+    """Rebuild a ``PrefixEntry`` from ``encode_spill_blob`` output,
+    verifying every frame's length prefix and crc32. Raises
+    ``HandoffFrameError``/``HandoffSizeError``/``ValueError`` on any
+    truncation, bit flip, or shape/byte-count disagreement — the caller
+    (``SpillStore.take``) turns every failure into a dropped entry,
+    never an error to the serving path."""
+    stream = io.BytesIO(blob)
+    meta = json.loads(
+        read_frame(stream, max_bytes=SPILL_MAX_FRAME_BYTES).decode("utf-8"))
+    n_frames = 4 if meta.get("scales") else 2
+    frames = [read_frame(stream, max_bytes=SPILL_MAX_FRAME_BYTES)
+              for _ in range(n_frames)]
+    if stream.read(1):
+        raise HandoffFrameError("trailing bytes after spill entry frames")
+    k, v, k_scale, v_scale = import_entry_frames(meta, frames)
+    tokens = tuple(int(t) for t in meta["tokens"])
+    if not tokens:
+        raise ValueError("spill entry carries an empty token key")
+    return PrefixEntry(tokens, k, v, k_scale=k_scale, v_scale=v_scale,
+                       impl=str(meta["impl"]))
+
+
+class _SpillRecord:
+    __slots__ = ("nbytes", "blob", "path")
+
+    def __init__(self, nbytes, blob=None, path=None):
+        self.nbytes = int(nbytes)
+        self.blob = blob            # bytearray (RAM tier) | None
+        self.path = path            # final file path (disk tier) | None
+
+
+class SpillStore:
+    """Demotion tier for evicted prefix entries: crc32-framed blobs in
+    host RAM under ``budget_bytes``, whose own LRU overflow demotes to
+    an optional disk directory (atomic tmp/fsync/rename writes — a
+    reader never sees a torn file under its final name unless the write
+    itself was injected torn, which the framing then catches on load).
+
+    Integrity contract: ``take()`` either returns a bitwise-verified
+    entry or drops the record and reports ``spill_corrupt`` — it NEVER
+    raises to the serving path and never serves unverified bytes.
+    """
+
+    def __init__(self, budget_bytes, spill_dir=None, listener=None):
+        if budget_bytes < 1:
+            raise ValueError(
+                f"budget_bytes must be >= 1, got {budget_bytes}")
+        self.budget_bytes = int(budget_bytes)
+        self.spill_dir = spill_dir
+        self._listener = listener
+        # key (impl,)+tokens -> _SpillRecord, LRU order (oldest first)
+        self._records = OrderedDict()
+        self._lock = threading.RLock()
+        self._seq = 0               # unique disk filenames
+        self.ram_bytes = 0
+        self.disk_bytes = 0
+        self.demotions = 0          # entries accepted from the live tier
+        self.disk_demotions = 0     # RAM records pushed to the disk tier
+        self.promotions = 0         # records handed back via take()
+        self.corrupt_dropped = 0    # failed verification on take()
+        self.rejections = 0         # blobs that could not be kept at all
+        self.sheds = 0
+        # fault surface: a truthy return makes the NEXT disk write land
+        # torn (truncated, under its final name — simulating a crash
+        # mid-write without the atomic rename discipline). Wired to
+        # ``ServingFaultInjector.torn_spill_write`` by the engine.
+        self.torn_write_hook = None
+        if spill_dir is not None:
+            os.makedirs(spill_dir, exist_ok=True)
+
+    # -- store / lookup -------------------------------------------------
+    def put(self, entry):
+        """Demote ``entry`` into the tier. Returns True when stored
+        (RAM), False when it could not be kept (bigger than the whole
+        budget and no disk tier, or a failed disk write)."""
+        blob = encode_spill_blob(entry)
+        key = (entry.impl,) + entry.tokens
+        with self._lock:
+            self._discard_locked(key)
+            if len(blob) > self.budget_bytes:
+                # never fits in RAM: straight to disk or gone
+                if self._write_disk_locked(key, blob):
+                    self.demotions += 1
+                    return True
+                self.rejections += 1
+                return False
+            while self.ram_bytes + len(blob) > self.budget_bytes:
+                victim = next((k for k, r in self._records.items()
+                               if r.blob is not None), None)
+                if victim is None:
+                    break
+                self._demote_to_disk_locked(victim)
+            rec = _SpillRecord(len(blob), blob=bytearray(blob))
+            self._records[key] = rec
+            self.ram_bytes += rec.nbytes
+            self.demotions += 1
+            return True
+
+    def match(self, tokens, impl="dense"):
+        """Longest stored key produced by ``impl`` that is a prefix of
+        ``tokens``: (match_len, key) or (0, None). Pure — verification
+        and removal happen in ``take``."""
+        toks = tuple(int(t) for t in tokens)
+        best_len, best_key = 0, None
+        with self._lock:
+            for key in self._records:
+                if key[0] != impl:
+                    continue
+                stored = key[1:]
+                n = len(stored)
+                if n > best_len and n <= len(toks) and toks[:n] == stored:
+                    best_len, best_key = n, key
+        return best_len, best_key
+
+    def take(self, key):
+        """Remove ``key``'s record, verify every frame checksum, and
+        return the rebuilt ``PrefixEntry`` — or None when the record is
+        corrupt/torn/missing (dropped + counted + listener-notified;
+        the caller falls through to a normal prefill)."""
+        with self._lock:
+            rec = self._records.pop(key, None)
+            if rec is None:
+                return None
+            blob = self._load_locked(rec)
+        if blob is None:
+            self._note_corrupt()
+            return None
+        try:
+            entry = decode_spill_blob(bytes(blob))
+        except (HandoffFrameError, HandoffSizeError, ValueError, KeyError):
+            self._note_corrupt()
+            return None
+        if (entry.impl,) + entry.tokens != key:
+            # decoded cleanly but describes a different prefix: treat a
+            # lying-but-self-consistent blob exactly like a torn one
+            self._note_corrupt()
+            return None
+        with self._lock:
+            self.promotions += 1
+        return entry
+
+    def discard(self, key):
+        """Drop ``key``'s record without verification (e.g. the live
+        tier just re-inserted the same prefix)."""
+        with self._lock:
+            self._discard_locked(key)
+
+    def shed(self):
+        """Drop every record, both tiers (the first memory-pressure
+        response and the chaos ``host_mem_pressure`` action). Returns
+        how many records were shed."""
+        with self._lock:
+            n = len(self._records)
+            for key in list(self._records):
+                self._discard_locked(key)
+            if n:
+                self.sheds += 1
+            return n
+
+    # -- fault surface ---------------------------------------------------
+    def corrupt_one(self):
+        """Flip one payload byte in the most-recently-stored record (RAM
+        blob mutated in place; disk file rewritten) — the
+        ``corrupt_spill_entry`` fault arm. Returns the corrupted key or
+        None when the tier is empty. The flipped byte sits past both
+        frame headers, so the next ``take`` fails its crc32, not its
+        length prefix."""
+        with self._lock:
+            for key in reversed(self._records):
+                rec = self._records[key]
+                blob = self._peek_locked(rec)
+                if blob is None:
+                    continue
+                flipped = bytearray(blob)
+                flipped[len(flipped) // 2] ^= 0xFF
+                if rec.blob is not None:
+                    rec.blob = flipped
+                else:
+                    try:
+                        with open(rec.path, "wb") as f:
+                            f.write(bytes(flipped))
+                    except OSError:
+                        continue
+                return key
+            return None
+
+    # -- internals -------------------------------------------------------
+    def _note_corrupt(self):
+        with self._lock:
+            self.corrupt_dropped += 1
+        if self._listener is not None:
+            self._listener("spill_corrupt")
+
+    def _discard_locked(self, key):
+        rec = self._records.pop(key, None)
+        if rec is None:
+            return
+        if rec.blob is not None:
+            self.ram_bytes -= rec.nbytes
+        else:
+            self.disk_bytes -= rec.nbytes
+            try:
+                os.remove(rec.path)
+            except OSError:
+                pass
+
+    def _peek_locked(self, rec):
+        """Read a record's bytes WITHOUT touching accounting or removing
+        anything (the fault surface mutates records in place)."""
+        if rec.blob is not None:
+            return rec.blob
+        try:
+            with open(rec.path, "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def _load_locked(self, rec):
+        if rec.blob is not None:
+            self.ram_bytes -= rec.nbytes
+            return rec.blob
+        self.disk_bytes -= rec.nbytes
+        try:
+            with open(rec.path, "rb") as f:
+                blob = f.read()
+        except OSError:
+            blob = None
+        try:
+            os.remove(rec.path)
+        except OSError:
+            pass
+        return blob
+
+    def _demote_to_disk_locked(self, key):
+        rec = self._records.pop(key)
+        self.ram_bytes -= rec.nbytes
+        if self._write_disk_locked(key, bytes(rec.blob)):
+            self.disk_demotions += 1
+
+    def _write_disk_locked(self, key, blob):
+        if self.spill_dir is None:
+            return False
+        self._seq += 1
+        path = os.path.join(self.spill_dir, f"spill-{self._seq:08d}.bin")
+        torn = self.torn_write_hook is not None and self.torn_write_hook()
+        try:
+            if torn:
+                # injected crash mid-write: a truncated file appears
+                # under its FINAL name — exactly what the atomic rename
+                # protocol prevents — so reload must catch it by framing
+                with open(path, "wb") as f:
+                    f.write(blob[:max(1, len(blob) // 2)])
+            else:
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+        except OSError:
+            return False
+        rec = _SpillRecord(len(blob), path=path)
+        self._records[key] = rec
+        self.disk_bytes += rec.nbytes
+        return True
+
+    # -- stats -----------------------------------------------------------
+    def __len__(self):
+        with self._lock:
+            return len(self._records)
+
+    def stats(self):
+        with self._lock:
+            ram = sum(1 for r in self._records.values()
+                      if r.blob is not None)
+            return {
+                "entries": len(self._records),
+                "ram_entries": ram,
+                "disk_entries": len(self._records) - ram,
+                "bytes": self.ram_bytes,
+                "disk_bytes": self.disk_bytes,
+                "budget_bytes": self.budget_bytes,
+                "demotions": self.demotions,
+                "disk_demotions": self.disk_demotions,
+                "promotions": self.promotions,
+                "corrupt_dropped": self.corrupt_dropped,
+                "rejections": self.rejections,
+                "sheds": self.sheds,
+            }
+
+
+def read_host_rss_mb():
+    """Resident set size of this process in MiB via ``/proc/self/statm``
+    (stdlib only). Returns None where the proc file is unavailable —
+    the guard goes inert rather than guessing."""
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE") / float(1 << 20)
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+class MemoryPressureGuard:
+    """Host-RSS watchdog that turns memory pressure into staged,
+    reversible degradation instead of an OOM kill.
+
+    ``check()`` runs once per engine step. Sustained RSS at or above
+    ``watermark_mb`` climbs one LEVEL per sustained window; sustained
+    RSS below ``recover_frac * watermark_mb`` descends one level per
+    quiet window (the in-between band holds — hysteresis):
+
+    - level 1 ``shed_spill``: drop the spill tier (the cheapest bytes —
+      pure opportunistic state);
+    - level 2 ``pause_inserts``: the live trie stops growing (lookups,
+      promotions, and in-flight refs untouched);
+    - level 3 ``degrade``: climb the fleet ``DegradeLadder`` one rung —
+      the same spec-off/budget-shrink/class-shed path queue pressure
+      takes, so recovery rides the ladder's own hysteresis.
+
+    Windows are counted in CHECKS, not seconds, so tests and chaos
+    episodes are deterministic. ``listener(level, rss_mb)`` fires
+    edge-triggered on level changes.
+    """
+
+    LEVELS = ("healthy", "shed_spill", "pause_inserts", "degrade")
+
+    def __init__(self, watermark_mb, cache=None, ladder=None,
+                 read_rss_mb=None, listener=None, recover_frac=0.9,
+                 sustain_checks=2, recover_checks=2):
+        if watermark_mb <= 0:
+            raise ValueError(
+                f"watermark_mb must be > 0, got {watermark_mb}")
+        if not 0 < recover_frac <= 1:
+            raise ValueError(
+                f"recover_frac must be in (0, 1], got {recover_frac}")
+        self.watermark_mb = float(watermark_mb)
+        self.recover_frac = float(recover_frac)
+        self.sustain_checks = max(1, int(sustain_checks))
+        self.recover_checks = max(1, int(recover_checks))
+        self._cache = cache
+        self._ladder = ladder
+        self._read_rss_mb = read_rss_mb or read_host_rss_mb
+        self._listener = listener
+        self.level = 0
+        self.last_rss_mb = None
+        self.escalations = 0
+        self.recoveries = 0
+        self._over = 0
+        self._under = 0
+
+    @property
+    def inserts_paused(self):
+        return self.level >= 2
+
+    @property
+    def level_name(self):
+        return self.LEVELS[self.level]
+
+    def check(self):
+        """One watchdog tick; returns the (possibly new) level."""
+        rss = self._read_rss_mb()
+        if rss is None:
+            return self.level                   # inert without a signal
+        self.last_rss_mb = float(rss)
+        if rss >= self.watermark_mb:
+            self._over += 1
+            self._under = 0
+        elif rss <= self.watermark_mb * self.recover_frac:
+            self._under += 1
+            self._over = 0
+        else:
+            self._over = 0
+            self._under = 0
+        if self._over >= self.sustain_checks and self.level < 3:
+            self._set_level(self.level + 1)
+            self._over = 0                      # next rung needs its own window
+        elif self._under >= self.recover_checks and self.level > 0:
+            self._set_level(self.level - 1)
+            self._under = 0
+        return self.level
+
+    def _set_level(self, level):
+        up = level > self.level
+        self.level = level
+        if up:
+            self.escalations += 1
+            if level == 1 and self._cache is not None:
+                self._cache.shed_spill()
+            elif level == 3 and self._ladder is not None:
+                self._ladder.set_rung(self._ladder.rung + 1,
+                                      reason="host_mem_pressure")
+        else:
+            self.recoveries += 1
+            # level 3 -> 2 does NOT force the ladder down: the ladder
+            # recovers rung-by-rung on its own hysteresis once the
+            # engine's pressure signal clears
+        if self._listener is not None:
+            self._listener(self.level, self.last_rss_mb)
+
+    def stats(self):
+        return {
+            "level": self.level,
+            "level_name": self.level_name,
+            "watermark_mb": self.watermark_mb,
+            "rss_mb": self.last_rss_mb,
+            "escalations": self.escalations,
+            "recoveries": self.recoveries,
+            "inserts_paused": self.inserts_paused,
+        }
+
+
+class PrefixKVCache:
+    """Trie-indexed, ref-counted, byte-budgeted prompt-prefix KV store,
+    optionally backed by a ``SpillStore`` demotion tier."""
+
+    def __init__(self, budget_bytes, spill_budget_bytes=0, spill_dir=None,
+                 listener=None):
         if budget_bytes < 1:
             raise ValueError(
                 f"budget_bytes must be >= 1, got {budget_bytes}")
@@ -80,20 +565,78 @@ class PrefixKVCache:
         self._by_key = {}                       # tuple[int] -> PrefixEntry
         self._lock = threading.Lock()
         self._clock = 0
+        self._listener = listener
         self.total_bytes = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
         self.insert_rejections = 0
+        self.spill = (SpillStore(int(spill_budget_bytes),
+                                 spill_dir=spill_dir, listener=listener)
+                      if spill_budget_bytes > 0 else None)
+        self.spill_hits = 0
+        self.spill_misses = 0
+        self.spill_promotions = 0
 
     # -- lookup ----------------------------------------------------------
     def match(self, tokens, impl="dense"):
         """Longest stored prefix of ``tokens`` produced by ``impl``:
-        (match_len, entry) or (0, None). Pure — no counters, no refs
-        (grouping decisions call this; ``acquire`` is the counted
-        path)."""
+        (match_len, entry) or (0, None). No hit/miss counters, no refs
+        (grouping decisions call this; ``acquire`` is the counted path) —
+        but with a spill tier attached a spilled prefix longer than the
+        live match IS promoted here, so the length this returns and the
+        length a subsequent ``acquire`` sees agree (the engine's bucket
+        grouping depends on that: reuse may only GROW between the two)."""
         with self._lock:
-            return self._match_locked(tokens, impl)
+            length, entry = self._lookup_locked(tokens, impl)
+            return length, entry
+
+    def acquire(self, tokens, impl="dense"):
+        """Counted lookup: returns (match_len, entry) and takes a ref on
+        the entry so eviction cannot reclaim it while the requester is in
+        flight. Release with ``release(entry)``."""
+        with self._lock:
+            length, entry = self._lookup_locked(tokens, impl)
+            if entry is None:
+                self.misses += 1
+                if self.spill is not None:
+                    self.spill_misses += 1
+                    self._notify("spill_miss")
+                return 0, None
+            self.hits += 1
+            entry.refs += 1
+            self._touch(entry)
+            if self.spill is not None:
+                if entry.from_spill:
+                    entry.from_spill = False
+                    self.spill_hits += 1
+                    self._notify("spill_hit")
+                else:
+                    self.spill_misses += 1
+                    self._notify("spill_miss")
+            return length, entry
+
+    def _lookup_locked(self, tokens, impl):
+        length, entry = self._match_locked(tokens, impl)
+        if self.spill is None:
+            return length, entry
+        s_len, s_key = self.spill.match(tokens, impl)
+        if s_len <= length:
+            return length, entry
+        promoted = self.spill.take(s_key)
+        if promoted is None:
+            # corrupt/torn — already dropped + counted by the store;
+            # serve whatever the live tier had
+            return length, entry
+        if not self._index_locked(promoted):
+            # no room in the live tier even after demoting LRU entries:
+            # put it back (unverified-state-free: it re-encodes freshly)
+            # and serve the live result
+            self.spill.put(promoted)
+            return length, entry
+        self.spill_promotions += 1
+        promoted.from_spill = True
+        return len(promoted.tokens), promoted
 
     def _match_locked(self, tokens, impl):
         node, depth, best = self._root, 0, (0, None)
@@ -108,20 +651,6 @@ class PrefixKVCache:
                 # identical KV for positions < depth)
                 best = (depth, max(here, key=lambda e: e.last_used))
         return best
-
-    def acquire(self, tokens, impl="dense"):
-        """Counted lookup: returns (match_len, entry) and takes a ref on
-        the entry so eviction cannot reclaim it while the requester is in
-        flight. Release with ``release(entry)``."""
-        with self._lock:
-            length, entry = self._match_locked(tokens, impl)
-            if entry is None:
-                self.misses += 1
-                return 0, None
-            self.hits += 1
-            entry.refs += 1
-            self._touch(entry)
-            return length, entry
 
     def release(self, entry):
         with self._lock:
@@ -149,20 +678,30 @@ class PrefixKVCache:
                 return existing
             entry = PrefixEntry(key, k, v, k_scale=k_scale, v_scale=v_scale,
                                 impl=impl)
-            if entry.nbytes > self.budget_bytes:
+            if not self._index_locked(entry):
                 self.insert_rejections += 1
                 return None
-            if not self._make_room_locked(entry.nbytes):
-                self.insert_rejections += 1
-                return None
-            node = self._root
-            for tok in key:
-                node = node.children.setdefault(tok, _Node())
-                node.covering.add(entry)
-            self._by_key[(impl,) + key] = entry
-            self.total_bytes += entry.nbytes
-            self._touch(entry)
+            if self.spill is not None:
+                # a stale spilled twin of this exact prefix is now
+                # strictly worse than the live entry — drop it
+                self.spill.discard((impl,) + key)
             return entry
+
+    def _index_locked(self, entry):
+        """Budget-check + trie-index ``entry``; shared by insert and
+        spill promotion. False when it cannot fit."""
+        if entry.nbytes > self.budget_bytes:
+            return False
+        if not self._make_room_locked(entry.nbytes):
+            return False
+        node = self._root
+        for tok in entry.tokens:
+            node = node.children.setdefault(tok, _Node())
+            node.covering.add(entry)
+        self._by_key[(entry.impl,) + entry.tokens] = entry
+        self.total_bytes += entry.nbytes
+        self._touch(entry)
+        return True
 
     def _make_room_locked(self, need):
         """Evict LRU unreferenced entries until ``need`` bytes fit."""
@@ -173,7 +712,7 @@ class PrefixKVCache:
             self._evict_locked(min(victims, key=lambda e: e.last_used))
         return True
 
-    def _evict_locked(self, entry):
+    def _evict_locked(self, entry, demote=True):
         del self._by_key[(entry.impl,) + entry.tokens]
         self.total_bytes -= entry.nbytes
         node, path = self._root, []
@@ -187,25 +726,48 @@ class PrefixKVCache:
             if not node.covering and not node.children:
                 del parent.children[tok]
         self.evictions += 1
+        if demote and self.spill is not None:
+            self.spill.put(entry)
 
     def evict_unreferenced(self):
-        """Drop every unreferenced entry (the ``evict_under_decode``
-        fault arm — in-flight lanes already copied their KV, so this must
-        be output-invisible). Returns how many were evicted."""
+        """Drop every unreferenced entry from the live tier (the
+        ``evict_under_decode`` fault arm and the pool-pressure relief
+        path — in-flight lanes already copied their KV, so this must be
+        output-invisible). Entries demote to the spill tier when one is
+        attached. Returns how many were evicted."""
         with self._lock:
             victims = [e for e in self._by_key.values() if e.refs == 0]
             for e in victims:
                 self._evict_locked(e)
             return len(victims)
 
+    # -- spill surface ---------------------------------------------------
+    def shed_spill(self):
+        """Drop the whole spill tier (memory-pressure relief). Returns
+        how many records were shed; 0 without a spill tier."""
+        return self.spill.shed() if self.spill is not None else 0
+
+    def corrupt_spilled(self):
+        """Fault surface for the ``corrupt_spill_entry`` arm: flip a
+        byte in one spilled blob. Returns the corrupted key or None."""
+        return self.spill.corrupt_one() if self.spill is not None else None
+
     def _touch(self, entry):
         self._clock += 1
         entry.last_used = self._clock
+
+    def _notify(self, event):
+        if self._listener is not None:
+            self._listener(event)
 
     # -- stats -----------------------------------------------------------
     def hit_rate(self):
         lookups = self.hits + self.misses
         return self.hits / lookups if lookups else 0.0
+
+    def spill_hit_rate(self):
+        lookups = self.spill_hits + self.spill_misses
+        return self.spill_hits / lookups if lookups else 0.0
 
     @property
     def referenced(self):
@@ -217,7 +779,7 @@ class PrefixKVCache:
 
     def stats(self):
         with self._lock:
-            return {
+            out = {
                 "entries": len(self._by_key),
                 "bytes": self.total_bytes,
                 "budget_bytes": self.budget_bytes,
@@ -229,3 +791,10 @@ class PrefixKVCache:
                 "insert_rejections": self.insert_rejections,
                 "hit_rate": self.hit_rate(),
             }
+            if self.spill is not None:
+                out["spill"] = self.spill.stats()
+                out["spill_hits"] = self.spill_hits
+                out["spill_misses"] = self.spill_misses
+                out["spill_promotions"] = self.spill_promotions
+                out["spill_hit_rate"] = self.spill_hit_rate()
+            return out

@@ -237,7 +237,9 @@ class ReplicaServer:
             "kv_pool": eng.occupancy(),
             "handoff_pending": self._handoff_receiver.pending(),
             # the affinity test's evidence: hits survive scale-out
-            "prefix_cache": eng.prefix_stats()})
+            "prefix_cache": eng.prefix_stats(),
+            # spill tier + memory-pressure guard (memtier chaos reads it)
+            "memtier": eng.memtier_stats()})
         return doc
 
     def _handoff_event(self, name):

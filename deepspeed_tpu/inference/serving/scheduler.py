@@ -164,6 +164,10 @@ class ContinuousBatchingScheduler:
         # retirement counters (metrics reads these)
         self.completed = 0
         self.timed_out = 0
+        # admission backpressure: requeue_front() calls (pool/chunk-lane
+        # filled between pop and placement; pool-exhaustion requeues only
+        # happen AFTER the engine attempted memory-pressure relief)
+        self.requeues = 0
 
     def queue_depth(self):
         with self._lock:
@@ -260,6 +264,7 @@ class ContinuousBatchingScheduler:
         pool filled between pop and placement)."""
         with self._lock:
             self._queue.appendleft(req)
+            self.requeues += 1
 
     # -- retirement policy ---------------------------------------------
     def should_retire(self, req, token, stuck=False):

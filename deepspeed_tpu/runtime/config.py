@@ -546,6 +546,36 @@ def get_serving_config(param_dict):
             f"serving.{SERVING_PREFIX_CACHE_MB} must be a number >= 0 "
             f"(0 disables the prefix KV cache), got {prefix_cache_mb!r}"
         )
+    prefix_spill_mb = get_scalar_param(
+        params, SERVING_PREFIX_SPILL_MB, SERVING_PREFIX_SPILL_MB_DEFAULT
+    )
+    if not isinstance(prefix_spill_mb, (int, float)) or isinstance(
+            prefix_spill_mb, bool) or prefix_spill_mb < 0:
+        raise ValueError(
+            f"serving.{SERVING_PREFIX_SPILL_MB} must be a number >= 0 "
+            f"(0 disables the prefix-cache spill tier), got "
+            f"{prefix_spill_mb!r}"
+        )
+    prefix_spill_dir = get_scalar_param(
+        params, SERVING_PREFIX_SPILL_DIR, SERVING_PREFIX_SPILL_DIR_DEFAULT
+    )
+    if prefix_spill_dir is not None and not isinstance(prefix_spill_dir, str):
+        raise ValueError(
+            f"serving.{SERVING_PREFIX_SPILL_DIR} must be a directory path "
+            f"string or null (null disables the disk tier), got "
+            f"{prefix_spill_dir!r}"
+        )
+    host_mem_watermark_mb = get_scalar_param(
+        params, SERVING_HOST_MEM_WATERMARK_MB,
+        SERVING_HOST_MEM_WATERMARK_MB_DEFAULT
+    )
+    if not isinstance(host_mem_watermark_mb, (int, float)) or isinstance(
+            host_mem_watermark_mb, bool) or host_mem_watermark_mb < 0:
+        raise ValueError(
+            f"serving.{SERVING_HOST_MEM_WATERMARK_MB} must be a number >= 0 "
+            f"(0 disables the memory-pressure guard), got "
+            f"{host_mem_watermark_mb!r}"
+        )
     speculative_k = get_scalar_param(
         params, SERVING_SPECULATIVE_K, SERVING_SPECULATIVE_K_DEFAULT
     )
@@ -651,6 +681,9 @@ def get_serving_config(param_dict):
         request_timeout_s=float(request_timeout_s),
         prefill_chunk_tokens=prefill_chunk,
         prefix_cache_mb=float(prefix_cache_mb),
+        prefix_spill_mb=float(prefix_spill_mb),
+        prefix_spill_dir=prefix_spill_dir,
+        host_mem_watermark_mb=float(host_mem_watermark_mb),
         speculative_k=speculative_k,
         kv_cache_dtype=kv_cache_dtype,
         fault_injection=fault_injection,

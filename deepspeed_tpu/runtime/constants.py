@@ -224,6 +224,12 @@ SERVING_PREFILL_CHUNK_TOKENS = "prefill_chunk_tokens"
 SERVING_PREFILL_CHUNK_TOKENS_DEFAULT = 0  # 0 = always single-pass prefill
 SERVING_PREFIX_CACHE_MB = "prefix_cache_mb"
 SERVING_PREFIX_CACHE_MB_DEFAULT = 0.0  # 0 = prefix KV cache disabled
+SERVING_PREFIX_SPILL_MB = "prefix_spill_mb"
+SERVING_PREFIX_SPILL_MB_DEFAULT = 0.0  # 0 = no spill tier (evict destroys)
+SERVING_PREFIX_SPILL_DIR = "prefix_spill_dir"
+SERVING_PREFIX_SPILL_DIR_DEFAULT = None  # None = no disk tier
+SERVING_HOST_MEM_WATERMARK_MB = "host_mem_watermark_mb"
+SERVING_HOST_MEM_WATERMARK_MB_DEFAULT = 0.0  # 0 = pressure guard off
 SERVING_SPECULATIVE_K = "speculative_k"
 SERVING_SPECULATIVE_K_DEFAULT = 0  # 0 = classic one-token decode
 SERVING_KV_CACHE_DTYPE = "kv_cache_dtype"

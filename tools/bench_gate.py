@@ -10,7 +10,7 @@ Two modes:
     ``LONGDOC_BENCH_CPU.json`` + ``FLEET_BENCH_CPU.json`` +
     ``KERNEL_BENCH_CPU.json`` + ``CHAOS_BENCH_CPU.json`` +
     ``ROLLOUT_BENCH_CPU.json`` + ``DISAGG_BENCH_CPU.json`` +
-    ``TRAIN_BENCH_CPU.json``). This is the
+    ``MEMTIER_BENCH_CPU.json`` + ``TRAIN_BENCH_CPU.json``). This is the
     CI step: it needs no jax and takes milliseconds.
 
 ``compare FRESH BASELINE``
@@ -25,7 +25,9 @@ driver wrapper (``BENCH_r05.json``) and is unwrapped;
 (``LONGDOC_BENCH_CPU.json``); ``fleet_scaling_2x`` marks a fleet
 scale-out artifact (``FLEET_BENCH_CPU.json``); ``disagg_ttft_p95_s``
 marks a disaggregated prefill/decode artifact
-(``DISAGG_BENCH_CPU.json``); ``chaos_episodes`` marks
+(``DISAGG_BENCH_CPU.json``); ``spilled_hit_ttft_s`` marks a
+memory-tier spill artifact (``MEMTIER_BENCH_CPU.json``);
+``chaos_episodes`` marks
 a chaos-harness artifact (``CHAOS_BENCH_CPU.json``);
 ``canary_routed_total`` marks a weight-rollout artifact
 (``ROLLOUT_BENCH_CPU.json``);
@@ -61,7 +63,7 @@ DEFAULT_ARTIFACTS = ("SERVING_BENCH_CPU.json", "BENCH_r05.json",
                      "LONGDOC_BENCH_CPU.json", "FLEET_BENCH_CPU.json",
                      "KERNEL_BENCH_CPU.json", "CHAOS_BENCH_CPU.json",
                      "ROLLOUT_BENCH_CPU.json", "DISAGG_BENCH_CPU.json",
-                     "TRAIN_BENCH_CPU.json")
+                     "MEMTIER_BENCH_CPU.json", "TRAIN_BENCH_CPU.json")
 
 # -- tolerance profiles -------------------------------------------------
 # key -> (direction, rel_tol). direction "higher" means bigger is better:
@@ -178,6 +180,21 @@ DISAGG_TOLERANCES = {
     "completed_total":         ("higher", 0.50),
 }
 
+# Memory-tier leg: absolute TTFTs on a shared CPU runner are noisy; the
+# gate-worthy signal is the cold-vs-spilled-hit TTFT ratio (same box,
+# same run, same prompts — noise largely cancels) plus decode tok/s
+# staying flat across the two legs. The integrity flags (no corrupt
+# entry ever served, bitwise oracle) are enforced by the schema, not a
+# band.
+MEMTIER_TOLERANCES = {
+    "cold_ttft_s":                 ("lower", 3.00),
+    "spilled_hit_ttft_s":          ("lower", 3.00),
+    "ttft_improvement":            ("higher", 0.40),
+    "decode_tokens_per_sec":       ("higher", 0.50),
+    "decode_tokens_per_sec_cold":  ("higher", 0.50),
+    "spill_hit_rate":              ("higher", 0.20),
+}
+
 # context keys that must match exactly for numbers to be comparable
 SERVING_CONTEXT = ("platform", "model", "requests", "max_slots",
                    "max_new_tokens", "speculative_k", "kv_cache_dtype",
@@ -214,6 +231,10 @@ ROLLOUT_CONTEXT = ("platform", "model", "requests_total", "rollout_seed",
 # only meaningful against the identical seeded longdoc+chat schedule.
 DISAGG_CONTEXT = ("platform", "model", "rounds", "long_new_tokens",
                   "chat_new_tokens")
+# prompt length and the cache/spill budgets are load-bearing: the TTFT
+# ratio is a pure function of how much prefill the promotion avoids.
+MEMTIER_CONTEXT = ("platform", "model", "rounds", "max_new_tokens",
+                   "prompt_len", "prefix_cache_mb", "prefix_spill_mb")
 
 # -- schema -------------------------------------------------------------
 SERVING_REQUIRED = {
@@ -316,6 +337,19 @@ DISAGG_REQUIRED = {
     "complete": bool,
 }
 
+MEMTIER_REQUIRED = {
+    "platform": str, "model": str, "rounds": int, "max_new_tokens": int,
+    "prompt_len": int,
+    "cold_ttft_s": (int, float), "spilled_hit_ttft_s": (int, float),
+    "ttft_improvement": (int, float),
+    "decode_tokens_per_sec": (int, float),
+    "decode_tokens_per_sec_cold": (int, float),
+    "spill_hits": int, "spill_promotions": int, "spill_demotions": int,
+    "spill_corrupt_dropped": int, "corrupt_entries_served": int,
+    "oracle_ok": bool, "spill_integrity_ok": bool,
+    "complete": bool,
+}
+
 # chaos acceptance floor: the committed schedule must compose at least
 # this many episodes (the issue's bar) to count as evidence
 CHAOS_MIN_EPISODES = 20
@@ -332,6 +366,12 @@ FLEET_MIN_SCALING_2X = 1.8
 # gradient set — a single bucket is the monolithic reduce wearing a hat
 TRAINSTEP_MIN_BUCKETS = 2
 
+# memtier acceptance floor: a spilled hit must actually beat a cold
+# re-prefill on the same prompts — a ratio at or below 1.0 means the
+# spill tier's decode+verify+promote costs more than the prefill it
+# skips, and the tier is overhead wearing a hat
+MEMTIER_MIN_TTFT_IMPROVEMENT = 1.0
+
 # disagg acceptance floor: the prefill/decode split must actually beat
 # the interleaved baseline's chat TTFT p95 on the same workload — a
 # ratio at or below 1.0 means the handoff bought nothing
@@ -341,23 +381,26 @@ TOLERANCES = {"serving": SERVING_TOLERANCES, "train": TRAIN_TOLERANCES,
               "longdoc": LONGDOC_TOLERANCES, "fleet": FLEET_TOLERANCES,
               "kernels": KERNELS_TOLERANCES, "chaos": CHAOS_TOLERANCES,
               "rollout": ROLLOUT_TOLERANCES, "disagg": DISAGG_TOLERANCES,
+              "memtier": MEMTIER_TOLERANCES,
               "trainstep": TRAINSTEP_TOLERANCES}
 CONTEXTS = {"serving": SERVING_CONTEXT, "train": TRAIN_CONTEXT,
             "longdoc": LONGDOC_CONTEXT, "fleet": FLEET_CONTEXT,
             "kernels": KERNELS_CONTEXT, "chaos": CHAOS_CONTEXT,
             "rollout": ROLLOUT_CONTEXT, "disagg": DISAGG_CONTEXT,
+            "memtier": MEMTIER_CONTEXT,
             "trainstep": TRAINSTEP_CONTEXT}
 REQUIRED = {"serving": SERVING_REQUIRED, "train": TRAIN_REQUIRED,
             "longdoc": LONGDOC_REQUIRED, "fleet": FLEET_REQUIRED,
             "kernels": KERNELS_REQUIRED, "chaos": CHAOS_REQUIRED,
             "rollout": ROLLOUT_REQUIRED, "disagg": DISAGG_REQUIRED,
+            "memtier": MEMTIER_REQUIRED,
             "trainstep": TRAINSTEP_REQUIRED}
 
 
 def load_artifact(path):
     """Read + unwrap one artifact; returns (kind, payload). kind is
-    "serving", "train", "longdoc", "fleet", "disagg", "chaos",
-    "rollout", "kernels" or "trainstep"."""
+    "serving", "train", "longdoc", "fleet", "disagg", "memtier",
+    "chaos", "rollout", "kernels" or "trainstep"."""
     with open(path) as f:
         doc = json.load(f)
     if not isinstance(doc, dict):
@@ -375,6 +418,10 @@ def load_artifact(path):
     # "chaos_episodes" rollup, but the TTFT ratio is the kind marker
     if "disagg_ttft_p95_s" in doc:
         return "disagg", doc
+    # memtier before the generic markers: its "ttft_improvement" also
+    # appears in disagg artifacts, so the spilled-hit key is the marker
+    if "spilled_hit_ttft_s" in doc:
+        return "memtier", doc
     if "chaos_episodes" in doc:
         return "chaos", doc
     if "canary_routed_total" in doc:
@@ -391,7 +438,8 @@ def load_artifact(path):
         return "train", doc
     raise ValueError(
         f"{path}: unrecognized artifact (no 'speedup_sparse_vs_dense_16k', "
-        f"'fleet_scaling_2x', 'disagg_ttft_p95_s', 'chaos_episodes', "
+        f"'fleet_scaling_2x', 'disagg_ttft_p95_s', 'spilled_hit_ttft_s', "
+        f"'chaos_episodes', "
         f"'canary_routed_total', 'decode_pallas_us', 'train_fusion', "
         f"'tokens_per_sec' or 'metric' key; "
         f"top-level keys: {sorted(doc)[:8]})")
@@ -585,6 +633,46 @@ def check_schema(path):
             problems.append(
                 f"{path}: 'completed_total' must be > 0 — a workload where "
                 f"nothing completed proves nothing")
+    elif kind == "memtier":
+        if doc.get("complete") is not True:
+            problems.append(f"{path}: 'complete' is not true — a partial "
+                            f"memtier bench run must not be committed as a "
+                            f"baseline")
+        if doc.get("oracle_ok") is not True:
+            problems.append(
+                f"{path}: 'oracle_ok' is not true — spilled-hit serving "
+                f"must stay bitwise-identical to one-shot generate()")
+        if doc.get("spill_integrity_ok") is not True:
+            problems.append(
+                f"{path}: 'spill_integrity_ok' is not true — a corrupted "
+                f"spill entry must be detected, dropped and re-prefilled, "
+                f"never served")
+        served = doc.get("corrupt_entries_served")
+        if isinstance(served, int) and not isinstance(served, bool) \
+                and served != 0:
+            problems.append(
+                f"{path}: 'corrupt_entries_served' is {served} — serving "
+                f"KV from a checksum-failed blob is silent corruption and "
+                f"must never become a baseline")
+        imp = doc.get("ttft_improvement")
+        if isinstance(imp, (int, float)) and not isinstance(imp, bool) \
+                and imp <= MEMTIER_MIN_TTFT_IMPROVEMENT:
+            problems.append(
+                f"{path}: 'ttft_improvement' is {imp}, at or below the "
+                f"{MEMTIER_MIN_TTFT_IMPROVEMENT}x floor — a spilled hit "
+                f"must beat a cold re-prefill on the same prompts")
+        hits = doc.get("spill_hits")
+        if isinstance(hits, int) and not isinstance(hits, bool) \
+                and hits <= 0:
+            problems.append(
+                f"{path}: 'spill_hits' must be > 0 — a run where nothing "
+                f"was ever promoted from spill proves nothing")
+        for key in ("decode_tokens_per_sec", "decode_tokens_per_sec_cold",
+                    "cold_ttft_s", "spilled_hit_ttft_s"):
+            v = doc.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v <= 0:
+                problems.append(f"{path}: '{key}' must be > 0, got {v}")
     elif kind == "trainstep":
         if doc.get("complete") is not True:
             problems.append(f"{path}: 'complete' is not true — a partial "
@@ -764,7 +852,7 @@ def main(argv=None):
                              "FLEET_BENCH_CPU.json + KERNEL_BENCH_CPU.json "
                              "+ CHAOS_BENCH_CPU.json + ROLLOUT_BENCH_CPU."
                              "json + DISAGG_BENCH_CPU.json + "
-                             "TRAIN_BENCH_CPU.json")
+                             "MEMTIER_BENCH_CPU.json + TRAIN_BENCH_CPU.json")
     parser.add_argument("mode", nargs="?", choices=["compare"],
                         help="compare FRESH BASELINE under tolerance bands")
     parser.add_argument("fresh", nargs="?", help="fresh bench JSON")
