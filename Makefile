@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving test-router test-disagg test-memtier lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels bench-train trace-smoke bench-gate chaos-smoke bench-rollout bench-disagg bench-memtier
+.PHONY: test test-fast test-faults test-cluster test-serving test-router test-disagg test-memtier test-sharding lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels bench-train trace-smoke bench-gate chaos-smoke bench-rollout bench-disagg bench-memtier bench-mesh
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -53,6 +53,12 @@ test-disagg:
 # with the spill tier on, off, and under the three memory fault arms.
 test-memtier:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_memtier.py -q
+
+# Sharding-spec registry: ordered first-match rules, named validation
+# errors, the bitwise shard->gather round-trip on the virtual CPU mesh,
+# and the `parallel` ds_config block that feeds it.
+test-sharding:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -m pytest tests/unit/test_sharding_registry.py -q
 
 # Static JAX hazard analysis (tools/jaxlint): recompile, host-sync,
 # leaked-tracer, donation, fp16-dtype, collective-axis, RNG-reuse,
@@ -162,6 +168,18 @@ bench-disagg:
 bench-memtier:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=memtier python bench.py --child
 	python -m tools.bench_gate --check-schema MEMTIER_BENCH_CPU.json
+
+# Mesh-sharded serving: tensor-parallel engine at mesh shapes (1,1),
+# (1,2), (1,4) on a 4-device virtual CPU mesh; asserts the bitwise
+# continuous-vs-generate() oracle SHARDED (dense + pallas decode tier,
+# speculation off/on) and writes MESH_BENCH_CPU.json with per-shape
+# tok/s, TTFT and per-device KV-pool bytes. The gate's schema check
+# refuses a false sharded_oracle_ok, a retention collapse vs (1,1), and
+# a pool that doesn't shrink per device. Knobs: BENCH_MESH_REQUESTS /
+# BENCH_MESH_NEW_TOKENS / BENCH_MESH_SPEC_K / BENCH_MESH_OUT.
+bench-mesh:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=4" BENCH_MODEL=mesh python bench.py --child
+	python -m tools.bench_gate --check-schema MESH_BENCH_CPU.json
 
 # Kernel-tier microbench: Pallas (interpret on CPU) vs the composed-XLA
 # fallback for the fused paged decode (fp32 + int8) and banded sparse

@@ -148,6 +148,8 @@ def child_main():
         return kernels_child_main()
     if os.environ.get("BENCH_MODEL", "bert") == "train":
         return train_child_main()
+    if os.environ.get("BENCH_MODEL", "bert") == "mesh":
+        return mesh_child_main()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -464,6 +466,175 @@ def serving_child_main():
                                   "accept_rate", "tokens_per_step",
                                   "prefill_tokens_per_sec",
                                   "prefix_hit_rate")},
+    }))
+    return 0
+
+
+def mesh_child_main():
+    """Mesh-sharded serving leg: tensor-parallel oracle + throughput on a
+    virtual multi-device CPU mesh.
+
+    Runs the SAME continuous-batching engine at mesh shapes (1,1), (1,2)
+    and (1,4) — params sharded per the registry's Megatron split, the
+    paged KV pool sharded over heads on the ``model`` axis — and asserts
+    the bitwise continuous-vs-``generate()`` oracle holds SHARDED for
+    dense and the pallas decode kernel tier, speculation off and on.
+    CPU-emulated SPMD is slower than single-device (GSPMD inserts real
+    collectives and the "devices" share one socket), so the artifact
+    records tok/s retention vs the (1,1) leg rather than a speedup;
+    tools/bench_gate.py refuses a false ``sharded_oracle_ok`` and
+    retention collapse. Writes MESH_BENCH_CPU.json (BENCH_MESH_OUT
+    redirects). Knobs: BENCH_MESH_REQUESTS / BENCH_MESH_NEW_TOKENS /
+    BENCH_MESH_SPEC_K."""
+    # the device-virtualization flag must land before jax initializes;
+    # bench.py's parent never imports jax, so setting it here works for
+    # direct ``BENCH_MODEL=mesh python bench.py --child`` runs too
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=4").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference import generate
+    from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, init_gpt2
+
+    n_requests = int(os.environ.get("BENCH_MESH_REQUESTS", "8"))
+    max_new = int(os.environ.get("BENCH_MESH_NEW_TOKENS", "16"))
+    spec_k = int(os.environ.get("BENCH_MESH_SPEC_K", "4"))
+    shapes = ((1, 1), (1, 2), (1, 4))
+
+    cfg = GPT2Config(
+        vocab_size=256, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=512,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    _, params = init_gpt2(cfg, batch_size=1, seq_len=8, seed=0)
+
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, (int(n),)).tolist()
+               for n in rng.randint(4, 13, size=n_requests)]  # buckets 8/16
+
+    def progress(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    # single-device greedy references, one per (prompt, impl): the oracle
+    # every sharded engine run must reproduce token-for-token
+    refs = {}
+
+    def reference(p, impl):
+        key = (tuple(p), impl)
+        if key not in refs:
+            refs[key] = np.asarray(generate(
+                params, cfg, np.asarray([p], np.int32), max_new,
+                attn_impl=impl))[0].tolist()
+        return refs[key]
+
+    def pool_bytes_per_device(eng):
+        dev0 = jax.devices()[0]
+        total = 0
+        for arr in (eng.pool.k, eng.pool.v):
+            total += sum(s.data.nbytes for s in arr.addressable_shards
+                         if s.device == dev0)
+        return total
+
+    def run_leg(shape, impl, k):
+        eng = ServingEngine(params, cfg, ServingConfig(
+            max_slots=4, max_queue=n_requests, max_seq_len=64,
+            prompt_buckets=(8, 16), speculative_k=k,
+            attention_impl={"default": impl}, mesh_shape=shape))
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+        eng.drain(max_steps=400 * max_new)
+        wall = time.perf_counter() - t0
+        outs = [f.result(timeout=5) for f in futs]
+        oracle_ok = all(out == reference(p, impl)
+                        for out, p in zip(outs, prompts))
+        tokens = sum(len(out) for out in outs)
+        snap = eng.metrics.snapshot()
+        return {
+            "mesh_shape": list(shape),
+            "attention_impl": impl,
+            "speculative_k": k,
+            "oracle_ok": oracle_ok,
+            "tokens_per_sec": round(tokens / wall, 1),
+            "avg_ttft_s": round(snap["avg_ttft_s"], 4),
+            "kv_pool_bytes_per_device": pool_bytes_per_device(eng),
+        }
+
+    legs = []
+    for shape in shapes:
+        for impl in ("dense", "pallas_decode"):
+            for k in (0, spec_k) if spec_k > 0 else (0,):
+                leg = run_leg(shape, impl, k)
+                legs.append(leg)
+                progress(
+                    f"mesh={shape} impl={impl} k={k}: "
+                    f"oracle={'OK' if leg['oracle_ok'] else 'MISMATCH'} "
+                    f"{leg['tokens_per_sec']:.1f} tok/s "
+                    f"ttft={leg['avg_ttft_s']:.4f}s "
+                    f"pool/dev={leg['kv_pool_bytes_per_device']}")
+
+    oracle_ok = all(leg["oracle_ok"] for leg in legs)
+    assert oracle_ok, "sharded serving diverged from generate()"
+
+    def agg(shape):
+        rows = [l for l in legs if tuple(l["mesh_shape"]) == shape]
+        return {
+            "tokens_per_sec": round(
+                sum(l["tokens_per_sec"] for l in rows) / len(rows), 1),
+            "avg_ttft_s": round(
+                sum(l["avg_ttft_s"] for l in rows) / len(rows), 4),
+            "kv_pool_bytes_per_device": rows[0]["kv_pool_bytes_per_device"],
+        }
+
+    base = agg((1, 1))
+    per_shape = {"x".join(map(str, s)): agg(s) for s in shapes}
+    retention = {
+        name: round(row["tokens_per_sec"] / base["tokens_per_sec"], 3)
+        for name, row in per_shape.items()
+    }
+    result = {
+        "platform": "cpu",
+        "model": "gpt2-tiny(L2,H64,heads4)",
+        "n_devices": len(jax.devices()),
+        "requests": n_requests,
+        "max_new_tokens": max_new,
+        "speculative_k": spec_k,
+        "mesh_shapes": ["x".join(map(str, s)) for s in shapes],
+        "sharded_oracle_ok": oracle_ok,
+        "per_shape": per_shape,
+        "legs": legs,
+        "complete": True,
+    }
+    # flat copies of the gate-worthy numbers: tools/bench_gate.py's
+    # compare() reads top-level keys only
+    for name, row in per_shape.items():
+        result[f"tokens_per_sec_{name}"] = row["tokens_per_sec"]
+        result[f"avg_ttft_s_{name}"] = row["avg_ttft_s"]
+        result[f"kv_pool_bytes_per_device_{name}"] = \
+            row["kv_pool_bytes_per_device"]
+        result[f"retention_{name}"] = retention[name]
+    out = os.environ.get("BENCH_MESH_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "MESH_BENCH_CPU.json")
+    with open(out, "w") as f:
+        f.write(json.dumps(result, indent=1) + "\n")
+    kv11 = per_shape["1x1"]["kv_pool_bytes_per_device"]
+    kv14 = per_shape["1x4"]["kv_pool_bytes_per_device"]
+    print(json.dumps({
+        "metric": "mesh-sharded serving tok/s retention (1x4 vs 1x1, cpu)",
+        "value": retention["1x4"],
+        "unit": "x single-device tokens/sec",
+        "vs_baseline": None,
+        "sharded_oracle_ok": oracle_ok,
+        "kv_pool_bytes_per_device_1x1": kv11,
+        "kv_pool_bytes_per_device_1x4": kv14,
+        "kv_pool_shard_factor": round(kv11 / kv14, 2) if kv14 else None,
+        **{f"tokens_per_sec_{n}": r["tokens_per_sec"]
+           for n, r in per_shape.items()},
     }))
     return 0
 
@@ -2418,6 +2589,10 @@ def main():
         label = "fused train step overlapped vs sequential reduce"
         seq = os.environ.get("BENCH_TRAIN_STEPS", "30")
         unit = "ms/step"
+    elif os.environ.get("BENCH_MODEL", "bert") == "mesh":
+        label = "mesh-sharded serving tok/s retention (1x4 vs 1x1)"
+        seq = os.environ.get("BENCH_MESH_NEW_TOKENS", "16")
+        unit = "x single-device tokens/sec"
     else:
         label = "bert-large pretrain samples/sec/chip"
         seq = os.environ.get("BENCH_SEQ", "128")

@@ -101,6 +101,21 @@ class ServingConfig:
     # MaxSlots × S_max bytes — admission backpressures when pages
     # run out instead of over-allocating.
     kv_pool_tokens: int = None
+    # Tensor-parallel mesh shape as (data, model) — e.g. (1, 4) shards
+    # attention heads and MLP columns over 4 devices. None = the
+    # single-device engine (no mesh, byte-identical to the pre-mesh
+    # layout). Parsed/validated from the ds_config `parallel` block by
+    # runtime/config.py::get_parallel_config.
+    mesh_shape: tuple = None
+    # Ordered (path-regex, spec-elements) overrides consulted BEFORE
+    # the registry's built-in SERVING_PARTITION_RULES (first match
+    # wins). Spec elements are axis names / None, e.g.
+    # (("wte/embedding$", ("model", None)),). None/() = built-ins only.
+    partition_rules: tuple = None
+    # Unmatched param-tree paths replicate instead of raising
+    # UnmatchedPathError (the built-in table ends in a catch-all, so
+    # this only matters for custom partition_rules tables).
+    replicate_unmatched: bool = True
 
 
 @dataclass

@@ -141,6 +141,12 @@ from deepspeed_tpu.inference.generation import (
 )
 from deepspeed_tpu.profiling.sentinels import CompileSentinel, transfer_free
 from deepspeed_tpu import kernels, telemetry
+from deepspeed_tpu.parallel.mesh import mp_world_size
+from deepspeed_tpu.parallel.sharding_registry import (
+    create_serving_mesh,
+    serving_registry,
+    serving_sharding,
+)
 from deepspeed_tpu.inference.quantization import (
     dequantize_kv,
     dequantize_kv_np,
@@ -1058,12 +1064,43 @@ class ServingEngine:
             self._kernel_impl[be] = ki
             self._kernel_interpret[be] = kint
 
+        # Tensor-parallel mesh (serving.mesh_shape / the ds_config
+        # `parallel` block): build the mesh and the shared sharding
+        # registry ONCE, shard the params per the registry rules, and
+        # hand both to the pool so KV pages split their heads dim over
+        # the `model` axis. The decode/prefill/spec programs are
+        # unchanged — jit compiles them SPMD from the operand shardings
+        # (GSPMD), so each lane class still compiles exactly once.
+        # mesh_shape=None keeps the single-device engine byte-identical.
+        self.mesh = None
+        self.registry = None
+        self._replicated_sharding = None
+        self._prefill_kv_sharding = None
+        if cfg.mesh_shape is not None:
+            self.registry = serving_registry(
+                extra_rules=cfg.partition_rules,
+                replicate_unmatched=cfg.replicate_unmatched)
+            self.mesh = create_serving_mesh(cfg.mesh_shape)
+            self.registry.validate_axes(self.mesh)
+            mp = mp_world_size(self.mesh)
+            if self.n_heads % mp != 0:
+                raise ValueError(
+                    f"serving.mesh_shape model axis {mp} must divide "
+                    f"num_attention_heads={self.n_heads} (the KV pool "
+                    f"shards heads)")
+            self.params = self.registry.shard(self.mesh, params)
+            self._replicated_sharding = serving_sharding(
+                self.mesh, "serving/lane_state", registry=self.registry)
+            self._prefill_kv_sharding = serving_sharding(
+                self.mesh, "serving/prefill_kv", registry=self.registry)
+
         dtype = _cache_dtype(params)
         self.pool = KVCachePool(self.n_layers, cfg.max_slots, self.n_heads,
                                 self.max_seq_len, self.head_dim, dtype=dtype,
                                 kv_cache_dtype=cfg.kv_cache_dtype,
                                 page_tokens=cfg.kv_page_tokens,
-                                pool_tokens=cfg.kv_pool_tokens)
+                                pool_tokens=cfg.kv_pool_tokens,
+                                mesh=self.mesh, registry=self.registry)
         # _qmode: storage<->compute conversion the decode programs need.
         # "fp32" stores the compute dtype directly, and "bf16" on a bf16
         # checkpoint is ALSO storage==compute — both take the plain
@@ -1424,8 +1461,19 @@ class ServingEngine:
 
         if isinstance(ds_config, dict):
             ds_config = DeepSpeedConfig(ds_config, world_size=1)
+        serving_cfg = ds_config.serving_config
+        parallel = getattr(ds_config, "parallel_config", None)
+        if parallel is not None and parallel.enabled:
+            # the validated `parallel` block arms tensor parallelism for
+            # the serving engine; replace() keeps the frozen-ish config
+            # object semantics (serving_cfg may be shared across engines)
+            import dataclasses
+            serving_cfg = dataclasses.replace(
+                serving_cfg, mesh_shape=parallel.mesh_shape,
+                partition_rules=parallel.partition_rules,
+                replicate_unmatched=parallel.replicate_unmatched)
         eng = cls(params, model_config,
-                  serving_config=ds_config.serving_config,
+                  serving_config=serving_cfg,
                   monitor=monitor_from_config(ds_config, rank),
                   injector=injector,
                   sentinel_config=ds_config.sentinel_config,
@@ -1868,6 +1916,16 @@ class ServingEngine:
                 vals[f"Serving/{k}"] = v
         return vals
 
+    def _put_host(self, tree):
+        """Sharding-aware host upload: on a mesh, commit to the
+        registry's replicated lane-state sharding — a default-device
+        put on a >1-device mesh would land on device 0 and force a
+        reshard inside the next jitted step, breaking the
+        ``transfer_free()`` steady-state contract."""
+        if self._replicated_sharding is None:
+            return jax.device_put(tree)
+        return jax.device_put(tree, self._replicated_sharding)
+
     def _upload_lane_state(self):
         """Lane churn: ONE explicit upload of the lane vectors, both
         per-class active masks, the page tables, and the drafter history
@@ -1886,16 +1944,16 @@ class ServingEngine:
             (self._dev_tokens, self._dev_positions, self._dev_active,
              self._dev_active_win, self._dev_active_kfull,
              self._dev_active_kwin, self._dev_page_tables,
-             self._dev_history) = jax.device_put(
+             self._dev_history) = self._put_host(
                 (self._lane_tokens, pos, full, win, kfull, kwin, tables,
                  self._lane_history))
             if self._dev_noise is None:
-                self._dev_noise = jax.device_put(
+                self._dev_noise = self._put_host(
                     np.zeros((self.pool.max_slots, self._spec_k), np.int32))
         else:
             (self._dev_tokens, self._dev_positions, self._dev_active,
              self._dev_active_win, self._dev_active_kfull,
-             self._dev_active_kwin, self._dev_page_tables) = jax.device_put(
+             self._dev_active_kwin, self._dev_page_tables) = self._put_host(
                 (self._lane_tokens, pos, full, win, kfull, kwin, tables))
         self._lane_dirty = False
 
@@ -1965,12 +2023,12 @@ class ServingEngine:
         noise = self.injector.corrupt_draft_noise(
             self._step_count, self._spec_k, self.model_config.vocab_size)
         if noise is not None:
-            self._dev_noise = jax.device_put(np.ascontiguousarray(
+            self._dev_noise = self._put_host(np.ascontiguousarray(
                 np.broadcast_to(np.asarray(noise, np.int32),
                                 (self.pool.max_slots, self._spec_k))))
             self._noise_armed = True
         elif self._noise_armed:
-            self._dev_noise = jax.device_put(
+            self._dev_noise = self._put_host(
                 np.zeros((self.pool.max_slots, self._spec_k), np.int32))
             self._noise_armed = False
 
@@ -1990,6 +2048,19 @@ class ServingEngine:
         """Requests still owed work: queued + chunking + in flight."""
         return (len(self._active) + (1 if self._chunking is not None else 0)
                 + self.scheduler.queue_depth())
+
+    def _put_prefill_kv(self, arr):
+        """Host prefix-KV seed -> device, heads-sharded on a mesh (dims
+        [L, B, nh, S, hd] split at nh like the pool) so prefill starts
+        from the layout its outputs and the pool install already use."""
+        if self._prefill_kv_sharding is None:
+            return jnp.asarray(arr)
+        return jax.device_put(np.asarray(arr), self._prefill_kv_sharding)
+
+    def _zeros_prefill_kv(self, shape, dtype):
+        if self._prefill_kv_sharding is None:
+            return jnp.zeros(shape, dtype)
+        return jnp.zeros(shape, dtype, device=self._prefill_kv_sharding)
 
     @property
     def draining(self):
@@ -2220,15 +2291,17 @@ class ServingEngine:
                     ek, ev = self._entry_prefix_kv(entry, reuse)
                     init_k[:, i, :, :reuse] = ek
                     init_v[:, i, :, :reuse] = ev
-            init_k, init_v = jnp.asarray(init_k), jnp.asarray(init_v)
+            init_k = self._put_prefill_kv(init_k)
+            init_v = self._put_prefill_kv(init_v)
         else:
-            init_k = jnp.zeros(shape, cdtype)
-            init_v = jnp.zeros(shape, cdtype)
+            init_k = self._zeros_prefill_kv(shape, cdtype)
+            init_v = self._zeros_prefill_kv(shape, cdtype)
 
         t0 = time.monotonic()
         k, v, first = self._run_prefill(impl, init_k, init_v,
-                                        jnp.asarray(ids), jnp.asarray(starts),
-                                        jnp.asarray(lens))
+                                        self._put_host(ids),
+                                        self._put_host(starts),
+                                        self._put_host(lens))
         first_host = np.asarray(first)             # sync: TTFT endpoint
         prefill_s = time.monotonic() - t0
         self.metrics.record_prefill(
@@ -2334,10 +2407,10 @@ class ServingEngine:
             ek, ev = self._entry_prefix_kv(entry, reuse)
             k0[:, 0, :, :reuse] = ek
             v0[:, 0, :, :reuse] = ev
-            k0, v0 = jnp.asarray(k0), jnp.asarray(v0)
+            k0, v0 = self._put_prefill_kv(k0), self._put_prefill_kv(v0)
         else:
-            k0 = jnp.zeros(shape, cdtype)
-            v0 = jnp.zeros(shape, cdtype)
+            k0 = self._zeros_prefill_kv(shape, cdtype)
+            v0 = self._zeros_prefill_kv(shape, cdtype)
         self._chunking = _ChunkedPrefill(req, k0, v0, pos=reuse, reuse=reuse,
                                          slot=slot)
         return True
@@ -2375,9 +2448,9 @@ class ServingEngine:
         t0 = time.monotonic()
         with cspan:
             st.k, st.v, first = self._run_prefill(
-                impl, st.k, st.v, jnp.asarray(ids),
-                jnp.asarray([st.pos], jnp.int32),
-                jnp.asarray([len(req.prompt)], jnp.int32))
+                impl, st.k, st.v, self._put_host(ids),
+                self._put_host(np.asarray([st.pos], np.int32)),
+                self._put_host(np.asarray([len(req.prompt)], np.int32)))
         st.pos += len(chunk)
         stats["prefill_chunks"] += 1
         if st.pos < len(req.prompt):

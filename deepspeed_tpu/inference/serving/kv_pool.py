@@ -47,6 +47,8 @@ import jax.numpy as jnp
 
 from ..generation import DEFAULT_PAGE_TOKENS, resolve_page_tokens
 from ..quantization import quantize_kv
+from deepspeed_tpu.parallel.mesh import mp_world_size
+from deepspeed_tpu.parallel.sharding_registry import serving_sharding
 
 KV_CACHE_DTYPES = ("fp32", "bf16", "int8")
 
@@ -177,7 +179,8 @@ class KVCachePool:
 
     def __init__(self, n_layers, max_slots, n_heads, max_seq_len, head_dim,
                  dtype=jnp.float32, kv_cache_dtype="fp32",
-                 page_tokens=None, pool_tokens=None):
+                 page_tokens=None, pool_tokens=None, mesh=None,
+                 registry=None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if max_seq_len < 2:
@@ -216,15 +219,43 @@ class KVCachePool:
                  self.page_tokens, self.head_dim)
         storage = {"fp32": dtype, "bf16": jnp.bfloat16,
                    "int8": jnp.int8}[kv_cache_dtype]
-        self.k = jnp.zeros(shape, storage)
-        self.v = jnp.zeros(shape, storage)
+        # Tensor-parallel pool: the heads dim splits over the mesh's
+        # `model` axis (specs resolved through the sharding registry —
+        # the single source both engines consume). mesh=None keeps the
+        # single-device layout byte-identical.
+        self.mesh = mesh
+        self.kv_sharding = None
+        self.replicated_sharding = None
+        if mesh is not None:
+            mp = mp_world_size(mesh)
+            if self.n_heads % mp != 0:
+                raise ValueError(
+                    f"n_heads={self.n_heads} not divisible by the mesh's "
+                    f"model axis size {mp}; the KV pool shards heads")
+            self.kv_sharding = serving_sharding(mesh, "serving/kv_pool",
+                                                registry=registry)
+            self.replicated_sharding = serving_sharding(
+                mesh, "serving/lane_state", registry=registry)
+            self.k = jnp.zeros(shape, storage, device=self.kv_sharding)
+            self.v = jnp.zeros(shape, storage, device=self.kv_sharding)
+        else:
+            self.k = jnp.zeros(shape, storage)
+            self.v = jnp.zeros(shape, storage)
         if kv_cache_dtype == "int8":
             # one symmetric scale per (layer, slot, head) — per LANE, not
             # per page: pages are never shared across lanes, and keeping
             # the old shape keeps dequantize_kv broadcasting unchanged
             sshape = (self.n_layers, self.max_slots, self.n_heads, 1, 1)
-            self.k_scale = jnp.ones(sshape, jnp.float32)
-            self.v_scale = jnp.ones(sshape, jnp.float32)
+            if mesh is not None:
+                scale_sh = serving_sharding(mesh, "serving/kv_scale",
+                                            registry=registry)
+                self.k_scale = jnp.ones(sshape, jnp.float32,
+                                        device=scale_sh)
+                self.v_scale = jnp.ones(sshape, jnp.float32,
+                                        device=scale_sh)
+            else:
+                self.k_scale = jnp.ones(sshape, jnp.float32)
+                self.v_scale = jnp.ones(sshape, jnp.float32)
         else:
             self.k_scale = None
             self.v_scale = None
@@ -248,6 +279,18 @@ class KVCachePool:
         self.frees = 0
         self.peak_in_use = 0
         self.peak_pages_in_use = 0
+
+    def host_put(self, x, dtype=None, sharded=False):
+        """Sharding-aware host->device placement: on a mesh, commit to
+        the registry-resolved sharding (replicated lane state, or the
+        pool's heads-sharded layout when ``sharded``) instead of the
+        default device — a default-device put on a >1-device mesh would
+        force a reshard inside the next jitted step."""
+        arr = np.asarray(x, dtype) if dtype is not None else np.asarray(x)
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        target = self.kv_sharding if sharded else self.replicated_sharding
+        return jax.device_put(arr, target)
 
     # -- slot lifecycle -------------------------------------------------
     @property
@@ -338,7 +381,7 @@ class KVCachePool:
         if slot in self._free:
             raise PageStateError(
                 f"install into slot {slot} which is not allocated")
-        dest = jnp.asarray(self.page_tables[slot], jnp.int32)
+        dest = self.host_put(self.page_tables[slot], jnp.int32)
         if self.kv_cache_dtype == "int8":
             (self.k, self.v, self.k_scale,
              self.v_scale) = _install_pages_int8_jit(
@@ -435,8 +478,8 @@ class KVCachePool:
         dest = np.asarray(self._lane_pages[slot][:n], np.int32)
         lane_k = np.stack(ks, axis=1)            # [L, n, nh, pt, hd]
         lane_v = np.stack(vs, axis=1)
-        self.k = self.k.at[:, dest].set(jnp.asarray(lane_k))
-        self.v = self.v.at[:, dest].set(jnp.asarray(lane_v))
+        self.k = self.k.at[:, dest].set(self.host_put(lane_k, sharded=True))
+        self.v = self.v.at[:, dest].set(self.host_put(lane_v, sharded=True))
         if meta.get("scales"):
             if self.k_scale is None:
                 raise PageStateError(
@@ -446,8 +489,8 @@ class KVCachePool:
             sbuf = frames[n]
             sk = np.frombuffer(sbuf[:shalf], np.float32).reshape(sshape)
             sv = np.frombuffer(sbuf[shalf:], np.float32).reshape(sshape)
-            self.k_scale = self.k_scale.at[:, slot].set(jnp.asarray(sk))
-            self.v_scale = self.v_scale.at[:, slot].set(jnp.asarray(sv))
+            self.k_scale = self.k_scale.at[:, slot].set(self.host_put(sk))
+            self.v_scale = self.v_scale.at[:, slot].set(self.host_put(sv))
         self.positions[slot] = position
         if handoff_key is not None:
             self._handoff_keys[handoff_key] = slot

@@ -14,6 +14,8 @@ divided by model- and pipeline-parallel degrees.
 
 import json
 import os
+import re
+from dataclasses import dataclass
 
 from deepspeed_tpu.runtime.constants import *
 from deepspeed_tpu.runtime.config_utils import (
@@ -695,6 +697,116 @@ def get_serving_config(param_dict):
     )
 
 
+@dataclass
+class ParallelConfig:
+    """Typed view of the ``parallel`` block: the tensor-parallel mesh
+    shape plus optional sharding-registry rule overrides. Import-light
+    like ServingConfig — mesh construction happens in the engines
+    (parallel/sharding_registry.py), never in the config layer."""
+
+    enabled: bool = False
+    mesh_shape: tuple = PARALLEL_MESH_SHAPE_DEFAULT   # (data, model)
+    partition_rules: tuple = None   # ((pattern, spec-elements), ...)
+    replicate_unmatched: bool = True
+
+
+def get_parallel_config(param_dict):
+    """parallel: mesh shape + sharding-registry rule overrides
+    (parallel/sharding_registry.py). Opt-in like serving: the block
+    being present enables it. Validation is shape-only and jax-free;
+    axis semantics (divisibility of heads, device counts) are checked
+    by the engines, which know the model and the device topology."""
+    section = param_dict.get(PARALLEL, None)
+    params = section or {}
+    enabled = bool(get_scalar_param(params, PARALLEL_ENABLED,
+                                    section is not None))
+
+    mesh_shape = get_scalar_param(params, PARALLEL_MESH_SHAPE,
+                                  PARALLEL_MESH_SHAPE_DEFAULT)
+    if isinstance(mesh_shape, dict):
+        unknown = [k for k in mesh_shape if k not in PARALLEL_MESH_AXES]
+        if unknown:
+            raise ValueError(
+                f"parallel.{PARALLEL_MESH_SHAPE} names unknown axes "
+                f"{unknown!r}; the serving mesh defines {PARALLEL_MESH_AXES}"
+            )
+        sizes = [mesh_shape.get(ax, 1) for ax in PARALLEL_MESH_AXES]
+    elif isinstance(mesh_shape, (list, tuple)) and len(mesh_shape) == 2:
+        sizes = list(mesh_shape)
+    else:
+        raise ValueError(
+            f"parallel.{PARALLEL_MESH_SHAPE} must be a (data, model) pair "
+            f"or a {{axis: size}} dict over {PARALLEL_MESH_AXES}, "
+            f"got {mesh_shape!r}"
+        )
+    for ax, size in zip(PARALLEL_MESH_AXES, sizes):
+        if isinstance(size, bool) or not isinstance(size, int) or size < 1:
+            raise ValueError(
+                f"parallel.{PARALLEL_MESH_SHAPE} {ax!r} size must be an "
+                f"int >= 1, got {size!r}"
+            )
+    mesh_shape = tuple(sizes)
+    # axes a rule may name: every axis in the mesh shape (a dict that
+    # omits an axis leaves it size 1 but still defined — rules naming it
+    # shard over a 1-element axis, which is legal); unknown axis names
+    # were rejected above, so the allowed set is simply PARALLEL_MESH_AXES
+    allowed_axes = PARALLEL_MESH_AXES
+
+    rules = get_scalar_param(params, PARALLEL_PARTITION_RULES,
+                             PARALLEL_PARTITION_RULES_DEFAULT)
+    if rules is not None:
+        if not isinstance(rules, (list, tuple)):
+            raise ValueError(
+                f"parallel.{PARALLEL_PARTITION_RULES} must be a list of "
+                f"[pattern, spec] pairs, got {rules!r}"
+            )
+        norm = []
+        for i, rule in enumerate(rules):
+            if (not isinstance(rule, (list, tuple)) or len(rule) != 2
+                    or not isinstance(rule[0], str)
+                    or not isinstance(rule[1], (list, tuple))):
+                raise ValueError(
+                    f"parallel.{PARALLEL_PARTITION_RULES}[{i}] must be a "
+                    f"[pattern, [axis-or-null, ...]] pair, got {rule!r}"
+                )
+            pattern, spec = rule
+            try:
+                re.compile(pattern)
+            except re.error as exc:
+                raise ValueError(
+                    f"parallel.{PARALLEL_PARTITION_RULES}[{i}] pattern "
+                    f"{pattern!r} is not a valid regex: {exc}"
+                )
+            elems = []
+            for elem in spec:
+                if elem is not None and elem not in allowed_axes:
+                    raise ValueError(
+                        f"parallel.{PARALLEL_PARTITION_RULES}[{i}] names "
+                        f"axis {elem!r} absent from "
+                        f"{PARALLEL_MESH_SHAPE}={mesh_shape} "
+                        f"(axes: {allowed_axes})"
+                    )
+                elems.append(elem)
+            norm.append((pattern, tuple(elems)))
+        rules = tuple(norm)
+
+    replicate_unmatched = get_scalar_param(
+        params, PARALLEL_REPLICATE_UNMATCHED,
+        PARALLEL_REPLICATE_UNMATCHED_DEFAULT)
+    if not isinstance(replicate_unmatched, bool):
+        raise ValueError(
+            f"parallel.{PARALLEL_REPLICATE_UNMATCHED} must be a bool, "
+            f"got {replicate_unmatched!r}"
+        )
+
+    return ParallelConfig(
+        enabled=enabled,
+        mesh_shape=mesh_shape,
+        partition_rules=rules,
+        replicate_unmatched=replicate_unmatched,
+    )
+
+
 def _get_fleet_autoscale(params):
     """fleet.autoscale sub-block: the SLO-driven control loop. Opt-in
     by presence, like every fleet sub-block."""
@@ -1343,6 +1455,7 @@ class DeepSpeedConfig:
         self.checkpoint_config = get_checkpoint_config(param_dict)
         self.resilience_config = get_resilience_config(param_dict)
         self.serving_config = get_serving_config(param_dict)
+        self.parallel_config = get_parallel_config(param_dict)
         self.fleet_config = get_fleet_config(param_dict)
 
         (

@@ -251,6 +251,22 @@ SERVING_KV_POOL_TOKENS = "kv_pool_tokens"
 SERVING_KV_POOL_TOKENS_DEFAULT = None  # None = max_slots * max_seq_len
 
 #############################################
+# Parallel (parallel/sharding_registry.py: the shared regex ->
+# PartitionSpec rule table + tensor-parallel mesh both engines resolve
+# placements from). Opt-in like serving: the block being present
+# enables it; absent means single-device engines (no mesh).
+#############################################
+PARALLEL = "parallel"
+PARALLEL_ENABLED = "enabled"
+PARALLEL_MESH_SHAPE = "mesh_shape"
+PARALLEL_MESH_SHAPE_DEFAULT = (1, 1)  # (data, model); dict form allowed
+PARALLEL_MESH_AXES = ("data", "model")  # axes mesh_shape may name
+PARALLEL_PARTITION_RULES = "partition_rules"
+PARALLEL_PARTITION_RULES_DEFAULT = None  # None = built-in registry rules
+PARALLEL_REPLICATE_UNMATCHED = "replicate_unmatched"
+PARALLEL_REPLICATE_UNMATCHED_DEFAULT = True
+
+#############################################
 # Fleet (inference/serving/router.py + replica.py: routing front-door
 # over N supervised ServingEngine replicas). Opt-in like serving: the
 # block being present enables it.

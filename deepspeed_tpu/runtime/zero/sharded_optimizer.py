@@ -38,7 +38,11 @@ from deepspeed_tpu.ops.utils_op import (
     tree_spec,
     unflatten_dense_tensors,
 )
-from deepspeed_tpu.parallel.mesh import DATA_AXIS, dp_world_size
+from deepspeed_tpu.parallel.mesh import dp_world_size
+from deepspeed_tpu.parallel.sharding_registry import (
+    train_sharding,
+    train_spec,
+)
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -85,12 +89,17 @@ def zero3_param_shardings(mesh, params):
     use, so XLA inserts the all-gather exactly where the reference would have
     issued its prefetch all-gathers, and re-shards on update output."""
     dp = dp_world_size(mesh)
+    # leading-dim axis comes from the shared sharding registry
+    # (parallel/sharding_registry.py) — the one spec table both engines
+    # resolve placements from
+    lead = train_spec("zero3/stacked_leading")
 
     def spec(p):
         shape = getattr(p, "shape", ())
         if len(shape) >= 1 and shape[0] >= dp and shape[0] % dp == 0:
-            return NamedSharding(mesh, PartitionSpec(DATA_AXIS, *([None] * (len(shape) - 1))))
-        return NamedSharding(mesh, PartitionSpec())
+            return NamedSharding(
+                mesh, PartitionSpec(*lead, *([None] * (len(shape) - 1))))
+        return train_sharding(mesh, "zero/gathered")
 
     return jax.tree_util.tree_map(spec, params)
 
@@ -163,7 +172,7 @@ class ZeroShardedOptimizer:
 
     # -- layout -----------------------------------------------------------
     def _shard_sharding(self):
-        return NamedSharding(self.mesh, PartitionSpec(DATA_AXIS))
+        return train_sharding(self.mesh, "zero/flat_shard")
 
     def _ensure_buckets(self, params=None):
         """Leaf-range bucket plan for overlap_comm (lazily derivable from a
@@ -203,7 +212,7 @@ class ZeroShardedOptimizer:
         if not self.overlap_comm:
             return None
         dp = self.dp
-        out_sharding = NamedSharding(self.mesh, PartitionSpec())
+        out_sharding = train_sharding(self.mesh, "zero/grad_bucket")
 
         @jax.custom_vjp
         def _bucket_tap(*leaves):
@@ -347,7 +356,8 @@ class ZeroShardedOptimizer:
             # Stages 1/2: XLA inserts the all-gather over ICI here (the
             # reference's sharded sequential all_gather, stage2.py:1444-1477).
             full = jax.lax.with_sharding_constraint(
-                new_master[: self._numel], NamedSharding(self.mesh, PartitionSpec())
+                new_master[: self._numel],
+                train_sharding(self.mesh, "zero/gathered")
             )
             new_params = unflatten_dense_tensors(full, treedef, shapes, out_dtypes)
         if not self.keep_master:
@@ -383,7 +393,7 @@ class ZeroShardedOptimizer:
                 except Exception:  # noqa: BLE001 — backend without async copy
                     pass
 
-        repl = NamedSharding(self.mesh, PartitionSpec())
+        repl = train_sharding(self.mesh, "zero/gathered")
         lr_f = lr
         master = self._host_master
         new_leaves = []

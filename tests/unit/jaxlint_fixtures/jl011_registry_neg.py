@@ -1,0 +1,19 @@
+"""JL011(c) negatives: registry rules plus agreeing or unrelated specs."""
+from jax.sharding import Mesh, PartitionSpec
+
+MESH = Mesh((), ("data", "model"))
+
+MODEL_PARTITION_RULES = {
+    "decoder/qkv/kernel": PartitionSpec(None, "model"),
+    "decoder/ff2/kernel": PartitionSpec("model", None),
+}
+
+MIRROR = {
+    # same path, SAME spec as the rule table: agreement is fine
+    "decoder/qkv/kernel": PartitionSpec(None, "model"),
+}
+
+OTHER = {
+    # path the registry does not cover: plain (a) semantics apply
+    "decoder/embed": PartitionSpec("data", None),
+}

@@ -1,0 +1,23 @@
+"""JL011(c) positives: spec literals conflicting with the registry.
+
+A dict assigned to a ``*_PARTITION_RULES`` name is a canonical rule
+table; other dict-literal specs for the same tree path must match it
+regardless of file order — even when the stray literal sorts first.
+"""
+from jax.sharding import Mesh, PartitionSpec
+
+MESH = Mesh((), ("data", "model"))
+
+# sorts before the rule table by line, but the registry still wins
+AD_HOC = {
+    "decoder/qkv/kernel": PartitionSpec("model", None),   # JL011: conflicts
+}
+
+MODEL_PARTITION_RULES = {
+    "decoder/qkv/kernel": PartitionSpec(None, "model"),
+    "decoder/ff2/kernel": PartitionSpec("model", None),
+}
+
+ENGINE_OVERRIDES = {
+    "decoder/ff2/kernel": PartitionSpec(None, "model"),   # JL011: conflicts
+}

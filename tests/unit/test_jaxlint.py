@@ -98,6 +98,24 @@ def test_findings_carry_symbol_and_text():
         assert f.text  # the anchor line is embedded for fingerprinting
 
 
+def test_jl011_registry_is_single_source_of_truth():
+    """JL011(c): a dict assigned to *_PARTITION_RULES is canonical for
+    the paths it registers — disagreeing literals are flagged even when
+    they sort before the rule table, with a registry-specific message."""
+    findings = _lint("jl011_registry_pos.py")
+    assert [f.code for f in findings] == ["JL011"] * 2, \
+        [f.render() for f in findings]
+    for f in findings:
+        assert "single source of truth" in f.message, f.render()
+    # the ad-hoc literals are flagged, never the rule table itself
+    assert all("PARTITION_RULES" not in f.text for f in findings)
+
+
+def test_jl011_registry_negative_is_clean():
+    findings = _lint("jl011_registry_neg.py")
+    assert findings == [], [f.render() for f in findings]
+
+
 def test_jl006_only_fires_on_fp16_paths():
     src = "import jax.numpy as jnp\n\ndef f(shape):\n    return jnp.zeros(shape)\n"
     assert analyze_source(src, rel_path="deepspeed_tpu/runtime/fp16/x.py")

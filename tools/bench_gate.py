@@ -10,7 +10,8 @@ Two modes:
     ``LONGDOC_BENCH_CPU.json`` + ``FLEET_BENCH_CPU.json`` +
     ``KERNEL_BENCH_CPU.json`` + ``CHAOS_BENCH_CPU.json`` +
     ``ROLLOUT_BENCH_CPU.json`` + ``DISAGG_BENCH_CPU.json`` +
-    ``MEMTIER_BENCH_CPU.json`` + ``TRAIN_BENCH_CPU.json``). This is the
+    ``MEMTIER_BENCH_CPU.json`` + ``TRAIN_BENCH_CPU.json`` +
+    ``MESH_BENCH_CPU.json``). This is the
     CI step: it needs no jax and takes milliseconds.
 
 ``compare FRESH BASELINE``
@@ -33,7 +34,9 @@ a chaos-harness artifact (``CHAOS_BENCH_CPU.json``);
 (``ROLLOUT_BENCH_CPU.json``);
 ``decode_pallas_us`` marks a kernel-tier microbench artifact
 (``KERNEL_BENCH_CPU.json``); ``train_fusion`` marks a train-step
-fusion artifact (``TRAIN_BENCH_CPU.json``); ``tokens_per_sec`` marks
+fusion artifact (``TRAIN_BENCH_CPU.json``); ``sharded_oracle_ok``
+marks a mesh-sharded serving artifact (``MESH_BENCH_CPU.json``);
+``tokens_per_sec`` marks
 a serving artifact; ``metric`` marks a train artifact. Contexts
 must match before numbers are compared — platform, model and workload
 knobs for serving; the metric string for train — otherwise the compare
@@ -63,7 +66,8 @@ DEFAULT_ARTIFACTS = ("SERVING_BENCH_CPU.json", "BENCH_r05.json",
                      "LONGDOC_BENCH_CPU.json", "FLEET_BENCH_CPU.json",
                      "KERNEL_BENCH_CPU.json", "CHAOS_BENCH_CPU.json",
                      "ROLLOUT_BENCH_CPU.json", "DISAGG_BENCH_CPU.json",
-                     "MEMTIER_BENCH_CPU.json", "TRAIN_BENCH_CPU.json")
+                     "MEMTIER_BENCH_CPU.json", "TRAIN_BENCH_CPU.json",
+                     "MESH_BENCH_CPU.json")
 
 # -- tolerance profiles -------------------------------------------------
 # key -> (direction, rel_tol). direction "higher" means bigger is better:
@@ -195,6 +199,23 @@ MEMTIER_TOLERANCES = {
     "spill_hit_rate":              ("higher", 0.20),
 }
 
+# Mesh-sharded serving leg: CPU-emulated SPMD throughput is noisy and
+# NOT expected to beat single-device (the "devices" share one socket and
+# GSPMD inserts real collectives), so absolute tok/s bands are loose and
+# the retention floor is low — the gate-worthy signals are the bitwise
+# sharded oracle and the per-device KV-pool shrink, both enforced by the
+# schema, not a band.
+MESH_TOLERANCES = {
+    "tokens_per_sec_1x1": ("higher", 0.50),
+    "tokens_per_sec_1x2": ("higher", 0.50),
+    "tokens_per_sec_1x4": ("higher", 0.50),
+    "retention_1x2":      ("higher", 0.40),
+    "retention_1x4":      ("higher", 0.40),
+    "avg_ttft_s_1x1":     ("lower", 2.00),
+    "avg_ttft_s_1x2":     ("lower", 2.00),
+    "avg_ttft_s_1x4":     ("lower", 2.00),
+}
+
 # context keys that must match exactly for numbers to be comparable
 SERVING_CONTEXT = ("platform", "model", "requests", "max_slots",
                    "max_new_tokens", "speculative_k", "kv_cache_dtype",
@@ -235,6 +256,10 @@ DISAGG_CONTEXT = ("platform", "model", "rounds", "long_new_tokens",
 # ratio is a pure function of how much prefill the promotion avoids.
 MEMTIER_CONTEXT = ("platform", "model", "rounds", "max_new_tokens",
                    "prompt_len", "prefix_cache_mb", "prefix_spill_mb")
+# n_devices and the shape list are load-bearing: retention vs (1,1) is
+# only meaningful on the same virtual-device layout and workload.
+MESH_CONTEXT = ("platform", "model", "n_devices", "requests",
+                "max_new_tokens", "speculative_k", "mesh_shapes")
 
 # -- schema -------------------------------------------------------------
 SERVING_REQUIRED = {
@@ -350,6 +375,22 @@ MEMTIER_REQUIRED = {
     "complete": bool,
 }
 
+MESH_REQUIRED = {
+    "platform": str, "model": str, "n_devices": int, "requests": int,
+    "max_new_tokens": int, "speculative_k": int,
+    "sharded_oracle_ok": bool,
+    "tokens_per_sec_1x1": (int, float),
+    "tokens_per_sec_1x2": (int, float),
+    "tokens_per_sec_1x4": (int, float),
+    "retention_1x2": (int, float), "retention_1x4": (int, float),
+    "avg_ttft_s_1x1": (int, float), "avg_ttft_s_1x2": (int, float),
+    "avg_ttft_s_1x4": (int, float),
+    "kv_pool_bytes_per_device_1x1": int,
+    "kv_pool_bytes_per_device_1x2": int,
+    "kv_pool_bytes_per_device_1x4": int,
+    "complete": bool,
+}
+
 # chaos acceptance floor: the committed schedule must compose at least
 # this many episodes (the issue's bar) to count as evidence
 CHAOS_MIN_EPISODES = 20
@@ -377,23 +418,30 @@ MEMTIER_MIN_TTFT_IMPROVEMENT = 1.0
 # ratio at or below 1.0 means the handoff bought nothing
 DISAGG_MIN_TTFT_IMPROVEMENT = 1.0
 
+# mesh acceptance floor: sharded tok/s retention vs the single-device
+# (1,1) leg. Deliberately low — CPU-emulated SPMD pays real collective
+# costs on one socket — but a collapse below it means sharding broke
+# steady-state decode (e.g. lane churn falling off the transfer-free
+# path), which is exactly the regression this artifact exists to catch.
+MESH_MIN_RETENTION = 0.10
+
 TOLERANCES = {"serving": SERVING_TOLERANCES, "train": TRAIN_TOLERANCES,
               "longdoc": LONGDOC_TOLERANCES, "fleet": FLEET_TOLERANCES,
               "kernels": KERNELS_TOLERANCES, "chaos": CHAOS_TOLERANCES,
               "rollout": ROLLOUT_TOLERANCES, "disagg": DISAGG_TOLERANCES,
-              "memtier": MEMTIER_TOLERANCES,
+              "memtier": MEMTIER_TOLERANCES, "mesh": MESH_TOLERANCES,
               "trainstep": TRAINSTEP_TOLERANCES}
 CONTEXTS = {"serving": SERVING_CONTEXT, "train": TRAIN_CONTEXT,
             "longdoc": LONGDOC_CONTEXT, "fleet": FLEET_CONTEXT,
             "kernels": KERNELS_CONTEXT, "chaos": CHAOS_CONTEXT,
             "rollout": ROLLOUT_CONTEXT, "disagg": DISAGG_CONTEXT,
-            "memtier": MEMTIER_CONTEXT,
+            "memtier": MEMTIER_CONTEXT, "mesh": MESH_CONTEXT,
             "trainstep": TRAINSTEP_CONTEXT}
 REQUIRED = {"serving": SERVING_REQUIRED, "train": TRAIN_REQUIRED,
             "longdoc": LONGDOC_REQUIRED, "fleet": FLEET_REQUIRED,
             "kernels": KERNELS_REQUIRED, "chaos": CHAOS_REQUIRED,
             "rollout": ROLLOUT_REQUIRED, "disagg": DISAGG_REQUIRED,
-            "memtier": MEMTIER_REQUIRED,
+            "memtier": MEMTIER_REQUIRED, "mesh": MESH_REQUIRED,
             "trainstep": TRAINSTEP_REQUIRED}
 
 
@@ -432,6 +480,10 @@ def load_artifact(path):
     # "metric" line shape must never demote the artifact to kind "train"
     if "train_fusion" in doc:
         return "trainstep", doc
+    # mesh before serving: the mesh artifact carries per-shape
+    # tokens_per_sec_* keys and must never demote to kind "serving"
+    if "sharded_oracle_ok" in doc:
+        return "mesh", doc
     if "tokens_per_sec" in doc:
         return "serving", doc
     if "metric" in doc:
@@ -441,7 +493,7 @@ def load_artifact(path):
         f"'fleet_scaling_2x', 'disagg_ttft_p95_s', 'spilled_hit_ttft_s', "
         f"'chaos_episodes', "
         f"'canary_routed_total', 'decode_pallas_us', 'train_fusion', "
-        f"'tokens_per_sec' or 'metric' key; "
+        f"'sharded_oracle_ok', 'tokens_per_sec' or 'metric' key; "
         f"top-level keys: {sorted(doc)[:8]})")
 
 
@@ -673,6 +725,46 @@ def check_schema(path):
             if isinstance(v, (int, float)) and not isinstance(v, bool) \
                     and v <= 0:
                 problems.append(f"{path}: '{key}' must be > 0, got {v}")
+    elif kind == "mesh":
+        if doc.get("complete") is not True:
+            problems.append(f"{path}: 'complete' is not true — a partial "
+                            f"mesh bench run must not be committed as a "
+                            f"baseline")
+        if doc.get("sharded_oracle_ok") is not True:
+            problems.append(
+                f"{path}: 'sharded_oracle_ok' is not true — tensor-parallel "
+                f"serving must stay bitwise-identical to single-device "
+                f"generate() at every mesh shape")
+        for key in ("tokens_per_sec_1x1", "tokens_per_sec_1x2",
+                    "tokens_per_sec_1x4"):
+            v = doc.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v <= 0:
+                problems.append(f"{path}: '{key}' must be > 0, got {v}")
+        for key in ("retention_1x2", "retention_1x4"):
+            v = doc.get(key)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v < MESH_MIN_RETENTION:
+                problems.append(
+                    f"{path}: '{key}' is {v}, below the "
+                    f"{MESH_MIN_RETENTION}x retention floor vs the "
+                    f"single-device leg — sharding broke steady-state "
+                    f"decode throughput")
+        per1 = doc.get("kv_pool_bytes_per_device_1x1")
+        for name in ("1x2", "1x4"):
+            perN = doc.get(f"kv_pool_bytes_per_device_{name}")
+            if all(isinstance(v, int) and not isinstance(v, bool)
+                   for v in (per1, perN)) and not perN < per1:
+                problems.append(
+                    f"{path}: 'kv_pool_bytes_per_device_{name}' ({perN}) "
+                    f"must be strictly below the single-device pool "
+                    f"({per1}) — a model-axis shard that doesn't shrink "
+                    f"per-device KV bytes isn't sharding anything")
+        nd = doc.get("n_devices")
+        if isinstance(nd, int) and not isinstance(nd, bool) and nd < 4:
+            problems.append(
+                f"{path}: 'n_devices' is {nd} — the leg needs >= 4 virtual "
+                f"devices to exercise the (1,4) shape")
     elif kind == "trainstep":
         if doc.get("complete") is not True:
             problems.append(f"{path}: 'complete' is not true — a partial "
@@ -852,7 +944,8 @@ def main(argv=None):
                              "FLEET_BENCH_CPU.json + KERNEL_BENCH_CPU.json "
                              "+ CHAOS_BENCH_CPU.json + ROLLOUT_BENCH_CPU."
                              "json + DISAGG_BENCH_CPU.json + "
-                             "MEMTIER_BENCH_CPU.json + TRAIN_BENCH_CPU.json")
+                             "MEMTIER_BENCH_CPU.json + TRAIN_BENCH_CPU.json"
+                             " + MESH_BENCH_CPU.json")
     parser.add_argument("mode", nargs="?", choices=["compare"],
                         help="compare FRESH BASELINE under tolerance bands")
     parser.add_argument("fresh", nargs="?", help="fresh bench JSON")

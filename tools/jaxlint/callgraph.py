@@ -25,7 +25,10 @@ rule families check against:
 - ``mesh_axes``: axis names appearing in an actual Mesh construction
   (the PartitionSpec validity domain);
 - ``spec_registry``: param-tree path -> {resolved spec signature ->
-  [(path, line, qualname, text)]} harvested from dict-literal spec maps.
+  [(path, line, qualname, text, is_registry)]} harvested from
+  dict-literal spec maps; ``is_registry`` marks entries from canonical
+  rule tables (dicts assigned to a ``*_PARTITION_RULES`` name), which
+  JL011(c) treats as the single source of truth for that path.
 
 One propagation sweep pushes per-function facts a single call level:
 key-consuming params, quantized returns, donated-through params.
@@ -176,15 +179,20 @@ class ProjectGraph:
         self.defined_axes.update(self.axis_constants)
 
     def _build_spec_registry(self):
-        self.spec_registry = {}   # tree path -> {signature: [sites]}
+        # tree path -> {signature: [sites]}; a site is
+        # (rel, line, qual, text, is_registry) where is_registry marks
+        # entries from a canonical rule table (a dict assigned to a
+        # name ending _PARTITION_RULES, e.g. SERVING_PARTITION_RULES)
+        self.spec_registry = {}
         for rel in sorted(self.files):
             fs = self.files[rel]
-            for path_key, elems, line, qual, text in fs.spec_entries:
+            for path_key, elems, line, qual, text, target in fs.spec_entries:
                 sig = self._resolve_spec_signature(fs, elems)
                 if sig is None:
                     continue
+                is_registry = target.endswith("_PARTITION_RULES")
                 self.spec_registry.setdefault(path_key, {}).setdefault(
-                    sig, []).append((rel, line, qual, text))
+                    sig, []).append((rel, line, qual, text, is_registry))
 
     def _resolve_spec_signature(self, fs, elems):
         """Tuple of axis names/None, or None when any element is

@@ -1,7 +1,7 @@
 """JL011: PartitionSpec / sharding consistency over the project graph.
 
-Two failure shapes, both pre-flight checks for the ROADMAP-1 sharding
-registry:
+Three failure shapes, all pre-flight checks for the sharding registry
+(deepspeed_tpu/parallel/sharding_registry.py):
 
 (a) two dict-literal spec registrations for the same param-tree path
     resolve to different specs: whichever module imports last wins and
@@ -12,7 +12,15 @@ registry:
     mesh, usually on the multi-host job. Elements are resolved through
     module constants; starred/computed elements and the no-mesh-at-all
     case stay silent (a library of specs without topology code is not a
-    bug).
+    bug);
+(c) a spec literal elsewhere in the project disagrees with the
+    registry's rule for the same tree path. Rule tables — dict literals
+    assigned to a name ending ``_PARTITION_RULES`` — are the single
+    source of truth: when one registers a path, any other dict-literal
+    spec for that path must match it regardless of file order. Engine
+    code should resolve shardings through the registry, not restate
+    them. When no registry entry exists for a path, (a)'s
+    first-registration-wins ordering applies instead.
 """
 
 from tools.jaxlint.findings import Finding
@@ -24,18 +32,36 @@ def _render_sig(sig):
 
 
 def check_project(graph, findings):
-    # (a) conflicting registrations per param-tree path
+    # (a)/(c) conflicting registrations per param-tree path. When a
+    # canonical rule table (dict assigned to *_PARTITION_RULES) covers
+    # the path, it is authoritative regardless of file order (c);
+    # otherwise the first (path, line)-ordered entry wins (a).
     for path_key in sorted(graph.spec_registry):
         sigs = graph.spec_registry[path_key]
         if len(sigs) < 2:
             continue
-        entries = []   # (rel, line, qual, text, sig)
+        entries = []   # (rel, line, qual, text, sig, is_registry)
         for sig, sites in sigs.items():
-            for rel, line, qual, text in sites:
-                entries.append((rel, line, qual, text, sig))
+            for rel, line, qual, text, is_registry in sites:
+                entries.append((rel, line, qual, text, sig, is_registry))
         entries.sort(key=lambda e: (e[0], e[1]))
-        rel0, line0, _q0, _t0, sig0 = entries[0]
-        for rel, line, qual, text, sig in entries[1:]:
+        registry_entries = [e for e in entries if e[5]]
+        if registry_entries:
+            rel0, line0, _q0, _t0, sig0, _r0 = registry_entries[0]
+            for rel, line, qual, text, sig, is_registry in entries:
+                if sig == sig0 or (rel, line) == (rel0, line0):
+                    continue
+                findings.append(Finding(
+                    rel, line, "JL011", qual,
+                    f"PartitionSpec for param-tree path '{path_key}' is "
+                    f"{_render_sig(sig)} here but the sharding registry "
+                    f"rule at {rel0}:{line0} says {_render_sig(sig0)} — "
+                    f"the registry is the single source of truth; "
+                    f"resolve the spec through it instead of restating "
+                    f"it", text))
+            continue
+        rel0, line0, _q0, _t0, sig0, _r0 = entries[0]
+        for rel, line, qual, text, sig, _is_registry in entries[1:]:
             if sig == sig0:
                 continue
             findings.append(Finding(

@@ -131,7 +131,7 @@ class FileSummary:
     axis_sites: list = field(default_factory=list)    # [AxisSite]
     mesh_defs: list = field(default_factory=list)     # [(elements, line)]
     pmap_axes: list = field(default_factory=list)     # [str]
-    spec_entries: list = field(default_factory=list)  # [(key, elems, line, qual, text)]
+    spec_entries: list = field(default_factory=list)  # [(key, elems, line, qual, text, target)]
     spec_sites: list = field(default_factory=list)    # [(elems, line, qual, text)]
     uses_rng: bool = False      # any jax.random spend/derive in this file
     uses_quant: bool = False    # any quantization-codec call in this file
@@ -459,6 +459,7 @@ def _record_pmap(summary, index, node, qual):
 def _scan_scope(summary, index, owner, qual, params):
     fn_summary = summary.functions.get(qual)
     quant_taint = set()
+    dict_targets = {}   # id(ast.Dict) -> Name it was assigned to
     calls = []
 
     def record_axis_use(op, node, line, collective):
@@ -503,9 +504,17 @@ def _scan_scope(summary, index, owner, qual, params):
 
         if isinstance(node, ast.Assign):
             _track_quant_assign(node, quant_taint)
+            # remember dict-literal assignment targets: pre-order DFS
+            # visits the Assign before its Dict child, so the Dict
+            # branch below can recover the name it was bound to
+            if len(node.targets) == 1 and isinstance(
+                    node.targets[0], ast.Name) and isinstance(
+                    node.value, ast.Dict):
+                dict_targets[id(node.value)] = node.targets[0].id
 
         if isinstance(node, ast.Dict):
             # dict-literal spec registries: {"tree/path": PartitionSpec(...)}
+            target = dict_targets.get(id(node), "")
             for k, v in zip(node.keys, node.values):
                 path_key = literal(k) if k is not None else None
                 if not isinstance(path_key, str):
@@ -514,7 +523,7 @@ def _scan_scope(summary, index, owner, qual, params):
                         summary, v.func, "PartitionSpec"):
                     summary.spec_entries.append((
                         path_key, _spec_elements(summary, v), v.lineno,
-                        qual, index.line_text(v.lineno)))
+                        qual, index.line_text(v.lineno), target))
 
         if not isinstance(node, ast.Call):
             continue
