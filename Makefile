@@ -1,6 +1,6 @@
 # Developer entry points.
 
-.PHONY: test test-fast test-faults test-cluster test-serving test-router test-disagg test-memtier test-sharding lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels bench-train trace-smoke bench-gate chaos-smoke bench-rollout bench-disagg bench-memtier bench-mesh
+.PHONY: test test-fast test-faults test-cluster test-serving test-router test-disagg test-memtier test-sharding lint-jax lint-jax-diff lint-jax-baseline ops bench bench-serving bench-longdoc bench-fleet bench-kernels bench-train bench-offload trace-smoke bench-gate chaos-smoke bench-rollout bench-disagg bench-memtier bench-mesh
 
 # Unit tests run on a virtual 8-device CPU mesh; the axon TPU plugin must be
 # kept out of test processes (see tests/conftest.py).
@@ -195,6 +195,15 @@ bench-kernels:
 bench-train:
 	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=train python bench.py --child
 	python -m tools.bench_gate --check-schema TRAIN_BENCH_CPU.json
+
+# Bucket-streamed ZeRO-Offload bench: the three-stage host-optimizer
+# pipeline (per-bucket D2H -> ping-pong out-of-place host Adam -> H2D
+# commit of adopted views) vs the sequential offload step — losses,
+# params AND host master bitwise-asserted in-run, one compile enforced.
+# Writes OFFLOAD_BENCH_CPU.json (see docs/training_perf.md).
+bench-offload:
+	PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu BENCH_MODEL=offload python bench.py --child
+	python -m tools.bench_gate --check-schema OFFLOAD_BENCH_CPU.json
 
 # Benchmark on the real TPU chip (default platform).
 bench:

@@ -41,6 +41,43 @@ void ds_adam_step(float* param, const float* grad, float* exp_avg, float* exp_av
     }
 }
 
+// Out-of-place variant: identical per-element arithmetic to ds_adam_step
+// (bitwise-equal results), but the updated params land in param_out and the
+// source params are left untouched. This is what lets the bucket-streamed
+// offload path ping-pong two master buffers and hand param_out views
+// straight to the device runtime (zero-copy adoption) with no snapshot
+// copy — the in-place kernel would mutate the adopted buffer on the next
+// step while the previous step's params still alias it.
+void ds_adam_step_out(const float* param, float* param_out, const float* grad,
+                      float* exp_avg, float* exp_avg_sq, int64_t n, float lr,
+                      float beta1, float beta2, float eps, float weight_decay,
+                      int adamw, int step, int bias_correction) {
+    float bc1 = 1.0f, bc2 = 1.0f;
+    if (bias_correction) {
+        bc1 = 1.0f - powf(beta1, (float)step);
+        bc2 = 1.0f - powf(beta2, (float)step);
+    }
+    const float one_m_b1 = 1.0f - beta1;
+    const float one_m_b2 = 1.0f - beta2;
+    const float inv_bc1 = 1.0f / bc1;
+    const float sqrt_bc2 = sqrtf(bc2);
+
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grad[i];
+        float p = param[i];
+        if (!adamw && weight_decay != 0.0f) g += weight_decay * p;
+        float m = beta1 * exp_avg[i] + one_m_b1 * g;
+        float v = beta2 * exp_avg_sq[i] + one_m_b2 * g * g;
+        exp_avg[i] = m;
+        exp_avg_sq[i] = v;
+        float denom = sqrtf(v) / sqrt_bc2 + eps;
+        float update = (m * inv_bc1) / denom;
+        if (adamw && weight_decay != 0.0f) update += weight_decay * p;
+        param_out[i] = p - lr * update;
+    }
+}
+
 // Adam step fused with a cast of the updated params into a bf16 (uint16)
 // shadow buffer — the reference overlaps its fp16 copy-back the same way
 // (cpu_adam.cpp:98-109 double-buffered pinned copies).

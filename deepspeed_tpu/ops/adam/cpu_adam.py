@@ -46,6 +46,14 @@ def _load_lib():
             ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
             ctypes.c_float, ctypes.c_float, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ]
+        if hasattr(lib, "ds_adam_step_out"):  # absent in pre-streaming .so builds
+            lib.ds_adam_step_out.argtypes = [
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                ctypes.c_float, ctypes.c_float, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ]
         _LIB = lib
     except OSError as e:
         logger.warning(f"failed to load cpu_adam native kernel: {e}; using numpy fallback")
@@ -81,14 +89,23 @@ class DeepSpeedCPUAdam(FusedAdam):
         self._host_state = HostAdamState(flat_master.shape[0])
         return self._host_state
 
-    def step_host(self, master, grads, lr=None, lo=0, hi=None, advance_step=True):
-        """In-place Adam step over the host fp32 master (numpy arrays).
+    def step_host(self, master, grads, lr=None, lo=0, hi=None, advance_step=True,
+                  master_out=None):
+        """Adam step over the host fp32 master (numpy arrays).
 
         ``lo``/``hi`` restrict the step to a contiguous slice of the flat
         vector so ZeRO-Offload can pipeline D2H / compute / H2D at leaf
         granularity; ``grads`` may be the full vector or exactly the slice.
         ``advance_step=False`` keeps the shared Adam step counter (bias
         correction) fixed for the 2nd..Nth slice of one logical step.
+
+        With ``master_out=None`` the step is in place. When ``master_out``
+        is a buffer of master's shape, updated params are written to
+        ``master_out[lo:hi]`` and ``master`` is left untouched (bitwise
+        the same values — the kernels share per-element arithmetic); the
+        streamed offload path ping-pongs two masters this way so the H2D
+        commit can hand out views with no snapshot copy. Moments update
+        in place either way.
         """
         st = self._host_state
         assert st is not None, "call init_host first"
@@ -102,6 +119,7 @@ class DeepSpeedCPUAdam(FusedAdam):
         )
         g = grads if grads.shape[0] == n else grads[lo:hi]
         m = master[lo:hi]
+        out = None if master_out is None else master_out[lo:hi]
         ea = st.exp_avg[lo:hi]
         es = st.exp_avg_sq[lo:hi]
         lr = float(self.lr if lr is None else lr)
@@ -109,14 +127,25 @@ class DeepSpeedCPUAdam(FusedAdam):
         beta1, beta2 = self.betas
         if lib is not None:
             fp = ctypes.POINTER(ctypes.c_float)
-            lib.ds_adam_step(
-                m.ctypes.data_as(fp), np.ascontiguousarray(g).ctypes.data_as(fp),
-                ea.ctypes.data_as(fp), es.ctypes.data_as(fp),
+            common = (
                 ctypes.c_int64(n), ctypes.c_float(lr),
                 ctypes.c_float(beta1), ctypes.c_float(beta2), ctypes.c_float(self.eps),
                 ctypes.c_float(self.weight_decay), ctypes.c_int(1 if self.adam_w_mode else 0),
                 ctypes.c_int(st.step), ctypes.c_int(1 if self.bias_correction else 0),
             )
+            gp = np.ascontiguousarray(g).ctypes.data_as(fp)
+            if out is None:
+                lib.ds_adam_step(m.ctypes.data_as(fp), gp,
+                                 ea.ctypes.data_as(fp), es.ctypes.data_as(fp), *common)
+            elif hasattr(lib, "ds_adam_step_out"):
+                lib.ds_adam_step_out(m.ctypes.data_as(fp), out.ctypes.data_as(fp), gp,
+                                     ea.ctypes.data_as(fp), es.ctypes.data_as(fp), *common)
+            else:
+                # stale .so without the out-of-place symbol: copy-then-step
+                # keeps the exact in-place arithmetic (bitwise identical)
+                np.copyto(out, m)
+                lib.ds_adam_step(out.ctypes.data_as(fp), gp,
+                                 ea.ctypes.data_as(fp), es.ctypes.data_as(fp), *common)
         else:
             if self.weight_decay and not self.adam_w_mode:
                 g = g + self.weight_decay * m
@@ -132,5 +161,8 @@ class DeepSpeedCPUAdam(FusedAdam):
                 update = ea / (np.sqrt(es) + self.eps)
             if self.weight_decay and self.adam_w_mode:
                 update = update + self.weight_decay * m
-            m -= lr * update
-        return master
+            if out is None:
+                m -= lr * update
+            else:
+                np.subtract(m, lr * update, out=out)
+        return master if master_out is None else master_out

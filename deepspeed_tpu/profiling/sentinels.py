@@ -29,9 +29,60 @@ from deepspeed_tpu import telemetry
 __all__ = [
     "CompileBudgetExceededError",
     "CompileSentinel",
+    "allowed_transfer",
+    "allowed_transfer_names",
     "compile_cache_size",
+    "register_allowed_transfer",
     "transfer_free",
 ]
+
+# Named transfer allowlist: the only sanctioned escape hatch from a
+# transfer_free() region. Subsystems that MUST page data host<->device in a
+# hot path (ZeRO-Offload's grad/param streams) register a name at import
+# time; the region that performs the traffic opens allowed_transfer(name).
+# An unregistered name raises — traffic can never go implicit by typo, and
+# the registry is greppable documentation of every deliberate paging site.
+_ALLOWED_TRANSFERS = set()
+_ALLOWED_TRANSFERS_LOCK = threading.Lock()
+
+
+def register_allowed_transfer(name):
+    """Register ``name`` as a sanctioned transfer site (idempotent).
+
+    Returns the name so call sites can do
+    ``_H2D = register_allowed_transfer("zero/offload_h2d")``."""
+    if not isinstance(name, str) or not name:
+        raise ValueError(f"transfer allowlist name must be a non-empty str, got {name!r}")
+    with _ALLOWED_TRANSFERS_LOCK:
+        _ALLOWED_TRANSFERS.add(name)
+    return name
+
+
+def allowed_transfer_names():
+    """Snapshot of the registered allowlist (for tests/telemetry)."""
+    with _ALLOWED_TRANSFERS_LOCK:
+        return frozenset(_ALLOWED_TRANSFERS)
+
+
+@contextmanager
+def allowed_transfer(name):
+    """Open a sanctioned transfer window inside a ``transfer_free()`` region.
+
+    ``name`` must have been registered with ``register_allowed_transfer`` —
+    an unknown name raises KeyError instead of silently allowing traffic.
+    The guard level is thread-local (jax.transfer_guard), so a background
+    host worker opening its own window never loosens the training thread's.
+    """
+    with _ALLOWED_TRANSFERS_LOCK:
+        known = name in _ALLOWED_TRANSFERS
+    if not known:
+        raise KeyError(
+            f"transfer site {name!r} is not on the allowlist — call "
+            f"register_allowed_transfer({name!r}) at import time of the "
+            f"subsystem that owns this traffic (registered: "
+            f"{sorted(_ALLOWED_TRANSFERS)})")
+    with jax.transfer_guard("allow"):
+        yield
 
 
 class CompileBudgetExceededError(RuntimeError):

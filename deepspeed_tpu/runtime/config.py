@@ -1552,6 +1552,19 @@ class DeepSpeedConfig:
                 raise DeepSpeedConfigError(
                     "DeepSpeedConfig: zero_optimization.overlap_comm must be a "
                     f"boolean, got {self.zero_config.overlap_comm!r}")
+            k = self.zero_config.offload_stream_buckets
+            if not isinstance(k, int) or isinstance(k, bool) or k < 1:
+                raise DeepSpeedConfigError(
+                    "DeepSpeedConfig: zero_optimization.offload_stream_buckets "
+                    f"must be an integer >= 1, got {k!r}")
+            if not isinstance(self.zero_config.offload_pin_host, bool):
+                raise DeepSpeedConfigError(
+                    "DeepSpeedConfig: zero_optimization.offload_pin_host must "
+                    f"be a boolean, got {self.zero_config.offload_pin_host!r}")
+            if k > 1 and not self.zero_config.cpu_offload:
+                raise DeepSpeedConfigError(
+                    "DeepSpeedConfig: zero_optimization.offload_stream_buckets "
+                    f"> 1 requires cpu_offload: true (got {k} without offload)")
         chunks = self.pipeline.get(PIPELINE_NUM_MODEL_CHUNKS, PIPELINE_NUM_MODEL_CHUNKS_DEFAULT)
         if not isinstance(chunks, int) or isinstance(chunks, bool) or chunks < 1:
             raise DeepSpeedConfigError(

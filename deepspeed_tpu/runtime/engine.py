@@ -442,6 +442,12 @@ class DeepSpeedEngine:
     def zero_cpu_offload(self):
         return self._config.zero_config.cpu_offload
 
+    def zero_offload_stream_buckets(self):
+        return self._config.zero_config.offload_stream_buckets
+
+    def zero_offload_pin_host(self):
+        return self._config.zero_config.offload_pin_host
+
     def zero_reduce_bucket_size(self):
         return self._config.zero_config.reduce_bucket_size
 
@@ -690,6 +696,9 @@ class DeepSpeedEngine:
                 basic_optimizer, stage=stage, mesh=self.mesh,
                 clip_grad=self.gradient_clipping(),
                 keep_master=keep_master,
+                cpu_offload=self.zero_cpu_offload(),
+                offload_stream_buckets=self.zero_offload_stream_buckets(),
+                offload_pin_host=self.zero_offload_pin_host(),
             )
         # contiguous_gradients schedules eager IPG buffers in the reference
         # (stage2.py); under XLA grads are compiler-managed buffers — accepted
@@ -717,6 +726,8 @@ class DeepSpeedEngine:
             clip_grad=self.gradient_clipping(),
             keep_master=keep_master,
             overlap_comm=self.zero_overlap_comm(),
+            offload_stream_buckets=self.zero_offload_stream_buckets(),
+            offload_pin_host=self.zero_offload_pin_host(),
         )
 
     def _configure_lr_scheduler(self, client_lr_scheduler):
@@ -1207,6 +1218,11 @@ class DeepSpeedEngine:
             self.flops_profiler.start_profile()
 
         if self.training:
+            # home the loss-scale scalar BEFORE its first jitted use: fresh
+            # init scalars are uncommitted while post-step homing (see
+            # _home_small_state) leaves them mesh-replicated, so without
+            # this the 3-call path compiles fwd_bwd twice (step 1 vs 2)
+            self._home_small_state()
             theta = jnp.asarray(
                 self.progressive_layer_drop.get_theta() if self.progressive_layer_drop else 1.0,
                 jnp.float32,
@@ -1322,6 +1338,20 @@ class DeepSpeedEngine:
                 else self._loss_sum + self._last_loss
             )
         self.micro_steps += 1
+
+        if (self.zero_optimization() and self.zero_cpu_offload()
+                and self.is_gradient_accumulation_boundary()
+                and not self.fp16_enabled()
+                and self.gradient_clipping() == 0
+                and not self._sparse_grad_paths):
+            # ZeRO-Offload prefetch: on this config the accumulated grads
+            # reach update_host UNCHANGED (no scale divide, clip, or CSR
+            # rewrite replaces the arrays), so their D2H can start under the
+            # tail of the backward dispatch instead of at optimizer-step
+            # time. update_host re-kicks the same copies — idempotent.
+            from deepspeed_tpu.runtime.zero.sharded_optimizer import _kick_async_copies
+
+            _kick_async_copies(jax.tree_util.tree_leaves(self._acc_grads))
 
         if self.wall_clock_breakdown():
             self.timers("backward").stop(sync=False)
@@ -1451,6 +1481,14 @@ class DeepSpeedEngine:
                 b = len(self.optimizer.bucket_numels or ())
                 frac = (b - 1) / b if b > 0 else 0.0
             self.monitor.record("Train/comm_overlap_frac", frac, samples)
+        offload_stats = getattr(self.optimizer, "last_offload_stats", None)
+        if offload_stats is not None:
+            # MEASURED (not schedule-derived, unlike comm_overlap_frac):
+            # fraction of the offload pipeline's summed stage time (D2H +
+            # host Adam + H2D) hidden by the stages running concurrently.
+            self.monitor.record(
+                "Train/offload_overlap_frac",
+                offload_stats["overlap_frac"], samples)
         if self.fp16_enabled():
             # Device-side COPY: the monitor host-syncs only at flush, and the
             # live scaler_state buffer gets DONATED into the next fused

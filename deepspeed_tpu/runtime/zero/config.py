@@ -19,6 +19,8 @@ class DeepSpeedZeroConfig:
         self.allgather_bucket_size = None
         self.overlap_comm = None
         self.cpu_offload = None
+        self.offload_stream_buckets = None
+        self.offload_pin_host = None
         self.elastic_checkpoint = None
 
         if ZERO_OPTIMIZATION in param_dict:
@@ -67,6 +69,16 @@ class DeepSpeedZeroConfig:
         self.cpu_offload = get_scalar_param(
             zero_config_dict, ZERO_OPTIMIZATION_CPU_OFFLOAD, ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT
         )
+        self.offload_stream_buckets = get_scalar_param(
+            zero_config_dict,
+            ZERO_OPTIMIZATION_OFFLOAD_STREAM_BUCKETS,
+            ZERO_OPTIMIZATION_OFFLOAD_STREAM_BUCKETS_DEFAULT,
+        )
+        self.offload_pin_host = get_scalar_param(
+            zero_config_dict,
+            ZERO_OPTIMIZATION_OFFLOAD_PIN_HOST,
+            ZERO_OPTIMIZATION_OFFLOAD_PIN_HOST_DEFAULT,
+        )
         self.elastic_checkpoint = get_scalar_param(
             zero_config_dict, ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT, ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT
         )
@@ -81,6 +93,8 @@ class DeepSpeedZeroConfig:
             allgather_bucket_size=self.allgather_bucket_size,
             overlap_comm=self.overlap_comm,
             cpu_offload=self.cpu_offload,
+            offload_stream_buckets=self.offload_stream_buckets,
+            offload_pin_host=self.offload_pin_host,
             elastic_checkpoint=self.elastic_checkpoint,
         )
 

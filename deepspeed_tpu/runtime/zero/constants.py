@@ -11,6 +11,8 @@ ZeRO optimization should be enabled as:
   "reduce_bucket_size": 500000000,
   "contiguous_gradients": [true|false],
   "cpu_offload": [true|false],
+  "offload_stream_buckets": 1,
+  "offload_pin_host": [true|false],
   "elastic_checkpoint": [true|false]
 }
 """
@@ -48,6 +50,20 @@ ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEPRECATED = "allgather_size"
 
 ZERO_OPTIMIZATION_CPU_OFFLOAD = "cpu_offload"
 ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT = False
+
+# Number of grad/param buckets the offloaded host step streams through its
+# D2H -> host-Adam -> H2D pipeline. 1 (default) keeps the sequential
+# leaf-at-a-time host step; >= 2 enables the double-buffered stream.
+ZERO_OPTIMIZATION_OFFLOAD_STREAM_BUCKETS = "offload_stream_buckets"
+ZERO_OPTIMIZATION_OFFLOAD_STREAM_BUCKETS_DEFAULT = 1
+
+# Keep the streamed path's ping-pong master pair persistent across steps
+# (the pinned-double-buffer discipline of ZeRO-Offload): the out-of-place
+# host step alternates between two preallocated full masters, steady-state
+# zero allocation. With it off a fresh partner buffer is allocated every
+# step, which also avoids any aliasing between param generations.
+ZERO_OPTIMIZATION_OFFLOAD_PIN_HOST = "offload_pin_host"
+ZERO_OPTIMIZATION_OFFLOAD_PIN_HOST_DEFAULT = True
 
 ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT = True

@@ -12,10 +12,34 @@ matching THIS framework's mechanism (runtime/zero/sharded_optimizer.py):
 - flat fp32 gradients:         4P (stage < 2, replicated)
                                4P / dp (stage 2+: reduce-scattered —
                                only the owner shard materializes)
-- fp32 master:                 4P / dp (stages 1/2; HOST under offload;
-                               absent for fp32 compute)
+- fp32 master:                 4P / dp (stages 1/2; absent for fp32 compute)
 - Adam moments (m, v):         8P / dp (with the master)
 - stage 3: compute params live sharded, 2P / dp at rest
+
+Under ``cpu_offload`` the optimizer tier moves to HOST RAM and follows the
+offload implementation's actual layout (sharded_optimizer.py ``init``/
+``update_host``), not the generic sharded one:
+
+- fp32 master (host):          4P FULL per process — the host step owns the
+                               whole flat vector (always stored, even for
+                               fp32 compute)
+- master ping-pong partner:    4P FULL per process when K >= 2 — the
+                               streamed pipeline steps OUT-OF-PLACE into a
+                               second master so the H2D commit can hand out
+                               adopted views with no snapshot copy
+                               (``offload_pin_host`` keeps the pair
+                               persistent; with it off a fresh partner is
+                               allocated each step — same high-water mark)
+- Adam moments (host):         8P FULL per process
+- grad staging (host):         the step fetches grads host-side, so the
+                               fp32-flat gradient buffer leaves the device
+                               entirely; its host high-water mark is
+                               4P for the sequential leaf-at-a-time path
+                               (K == 1), or 2 * ceil(4P / K) under the
+                               streamed pipeline (at most two buckets of
+                               grads in flight; on CPU backends the views
+                               are zero-copy and the true footprint is
+                               lower still)
 
 Activations are model/batch-dependent and NOT included — measure those
 with the flops profiler or the autotuner's OOM ladder.
@@ -31,13 +55,18 @@ def _fmt(n):
 
 
 def estimate_zero_model_states_mem_needs(
-        num_params, stage=2, dp=1, cpu_offload=False, compute_bytes=2):
+        num_params, stage=2, dp=1, cpu_offload=False, compute_bytes=2,
+        offload_stream_buckets=1):
     """Model-state memory for one training replica.
 
     Returns ``{"device_bytes", "host_bytes", "breakdown"}`` — per-device
     HBM and per-host RAM for params + gradients + optimizer states.
     ``compute_bytes=2`` is bf16/fp16 compute; use 4 for fp32 compute
     (then no separate master is stored — master_from_params).
+    ``offload_stream_buckets`` selects the offload tier's host layout:
+    K >= 2 bounds grad staging at two in-flight buckets of ceil(4P/K)
+    bytes but adds the 4P ping-pong master partner the out-of-place
+    streamed step commits into.
     """
     if stage not in (0, 1, 2, 3):
         raise ValueError(f"stage must be 0..3, got {stage}")
@@ -45,6 +74,9 @@ def estimate_zero_model_states_mem_needs(
         raise ValueError("cpu_offload composes with ZeRO stage 1/2 only")
     if dp < 1:
         raise ValueError(f"dp must be >= 1, got {dp}")
+    K = int(offload_stream_buckets)
+    if K < 1:
+        raise ValueError(f"offload_stream_buckets must be >= 1, got {K}")
     P = int(num_params)
     keep_master = compute_bytes != 4
 
@@ -58,6 +90,27 @@ def estimate_zero_model_states_mem_needs(
         param_bytes = compute_bytes * P
         breakdown["params (replicated)"] = param_bytes
     device += param_bytes
+
+    if cpu_offload:
+        # The offload tier follows the implementation, not the generic
+        # sharded layout: the host step fetches the compute-dtype grad
+        # leaves directly (no flat fp32 grad buffer ever materializes on
+        # device — this row used to over-report HBM), and the host master/
+        # moments are FULL per-process vectors (always stored, even for
+        # fp32 compute), plus the bucketed staging high-water mark.
+        breakdown["gradients (compute, transient)"] = compute_bytes * P
+        device += compute_bytes * P
+        breakdown["fp32 master (host)"] = 4 * P
+        # K >= 2: the streamed step writes out-of-place into a second full
+        # master (ping-pong) so the H2D commit can adopt views copy-free
+        pingpong = 4 * P if K >= 2 else 0
+        breakdown["master ping-pong partner (host)"] = pingpong
+        breakdown["Adam moments (host)"] = 8 * P
+        staging = 4 * P if K == 1 else 2 * (-(-4 * P // K))
+        breakdown["grad staging (host, high-water)"] = staging
+        host += 4 * P + pingpong + 8 * P + staging
+        return {"device_bytes": device, "host_bytes": host,
+                "breakdown": breakdown}
 
     if compute_bytes != 4:
         # backward's compute-dtype grads exist transiently alongside the
@@ -73,23 +126,20 @@ def estimate_zero_model_states_mem_needs(
     if stage == 0:
         master_bytes = 4 * P if keep_master else 0
         moments_bytes = 8 * P
-    if cpu_offload:
-        breakdown["fp32 master (host)"] = master_bytes
-        breakdown["Adam moments (host)"] = moments_bytes
-        host += master_bytes + moments_bytes
-    else:
-        breakdown["fp32 master"] = master_bytes
-        breakdown["Adam moments"] = moments_bytes
-        device += master_bytes + moments_bytes
+    breakdown["fp32 master"] = master_bytes
+    breakdown["Adam moments"] = moments_bytes
+    device += master_bytes + moments_bytes
 
     return {"device_bytes": device, "host_bytes": host,
             "breakdown": breakdown}
 
 
-def estimate_zero2_model_states_mem_needs(num_params, dp=1, cpu_offload=False):
+def estimate_zero2_model_states_mem_needs(num_params, dp=1, cpu_offload=False,
+                                          offload_stream_buckets=1):
     """The reference-family entry point name (later DeepSpeed API)."""
     return estimate_zero_model_states_mem_needs(
-        num_params, stage=2, dp=dp, cpu_offload=cpu_offload)
+        num_params, stage=2, dp=dp, cpu_offload=cpu_offload,
+        offload_stream_buckets=offload_stream_buckets)
 
 
 def mem_needs_report(num_params, dp_sizes=(1, 8, 64), stages=(0, 1, 2, 3)):

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
+from deepspeed_tpu.ops.utils_op import flatten_dense_tensors, tree_spec
 from deepspeed_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, dp_world_size
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -47,13 +48,26 @@ def _master_spec(leaf_shape, tp_spec, dp):
 class ZeroPytreeOptimizer:
     """ZeRO-1/2 over a param pytree; composes with TP param shardings."""
 
-    def __init__(self, inner, stage=2, mesh=None, clip_grad=0.0, keep_master=True, **unused):
+    def __init__(self, inner, stage=2, mesh=None, clip_grad=0.0, keep_master=True,
+                 cpu_offload=False, offload_stream_buckets=1,
+                 offload_pin_host=True, **unused):
         assert mesh is not None
         self.inner = inner
         self.stage = stage
         self.mesh = mesh
         self.dp = dp_world_size(mesh)
         self.clip_grad = clip_grad
+        # ZeRO-Offload under TP: host-resident flat fp32 master + host Adam
+        # state, stepped bucket-by-bucket (the flat-vector variant's layout,
+        # so DeepSpeedCPUAdam's slice stepping applies unchanged); updated
+        # leaves stream back at their TP shardings.
+        self.cpu_offload = bool(cpu_offload)
+        self.offload_stream_buckets = max(1, int(offload_stream_buckets))
+        self.offload_pin_host = bool(offload_pin_host)
+        self._spec = None          # (treedef, shapes, dtypes, sizes) under offload
+        self._numel = None
+        self._host_master = None
+        self._host_inner = None
         # keep_master=False (fp32 compute): params are already fp32 — storing a
         # second sharded fp32 master would double-store them; the step derives
         # the local master shard from params instead.
@@ -77,6 +91,18 @@ class ZeroPytreeOptimizer:
 
     def init(self, params):
         self._collect_specs(params)
+        if self.cpu_offload:
+            self._spec = tree_spec(params)
+            flat = flatten_dense_tensors(params, jnp.float32)
+            self._numel = int(flat.shape[0])
+            self._host_master = np.asarray(jax.device_get(flat), np.float32)
+            self._host_inner = (self.inner.init_host(self._host_master)
+                                if hasattr(self.inner, "init_host") else None)
+            log_dist(
+                f"ZeRO(pytree)-Offload: {self._host_master.nbytes / 1e6:.1f} "
+                f"MB master on host "
+                f"({self.offload_stream_buckets} stream bucket(s))", ranks=[0])
+            return ZeroPytreeState(master=None, inner_state=None)
         if self.keep_master:
             master = jax.tree_util.tree_map(
                 # jnp.copy: a master leaf whose spec equals the param's would
@@ -141,11 +167,67 @@ class ZeroPytreeOptimizer:
             new_master = None
         return new_params, ZeroPytreeState(master=new_master, inner_state=new_inner)
 
+    # -- host path (ZeRO-Offload under TP) ---------------------------------
+    def update_host(self, grads, opt_state, params, lr=None):
+        """Bucketed sequential host step: the flat host master slice-steps
+        one bucket at a time (``offload_stream_buckets`` near-equal element
+        splits; bitwise identical to any other split because slice-stepping
+        == full-vector stepping), and each bucket's updated leaves commit
+        back H2D at the params' own TP shardings while later buckets fetch.
+        All traffic goes through the named transfer allowlist."""
+        from deepspeed_tpu.profiling.sentinels import allowed_transfer
+        from deepspeed_tpu.runtime.zero.sharded_optimizer import (
+            OFFLOAD_D2H,
+            OFFLOAD_H2D,
+            _fetch_flat_grad,
+            _kick_async_copies,
+            _note_sync_fetches,
+            compute_bucket_ranges,
+        )
+
+        treedef, shapes, dtypes, sizes = self._spec
+        leaves = jax.tree_util.tree_leaves(grads)
+        param_leaves = jax.tree_util.tree_leaves(params)
+        nleaf = [int(np.prod(s)) if s else 1 for s in shapes]
+        ele_off = [0]
+        for n in nleaf:
+            ele_off.append(ele_off[-1] + n)
+        total = ele_off[-1]
+        bucket_size = max(1, -(-total // self.offload_stream_buckets))
+        buckets = compute_bucket_ranges(sizes, bucket_size)
+
+        _note_sync_fetches(_kick_async_copies(leaves), len(leaves))
+        master = self._host_master
+        new_leaves = [None] * len(leaves)
+        for b, (lo_l, hi_l) in enumerate(buckets):
+            lo_e, hi_e = ele_off[lo_l], ele_off[hi_l]
+            buf = np.empty(hi_e - lo_e, np.float32)
+            with allowed_transfer(OFFLOAD_D2H):
+                for i in range(lo_l, hi_l):
+                    _fetch_flat_grad(
+                        leaves[i], buf[ele_off[i] - lo_e:ele_off[i + 1] - lo_e])
+            self.inner.step_host(
+                master, buf, lr=lr, lo=lo_e, hi=hi_e, advance_step=(b == 0))
+            with allowed_transfer(OFFLOAD_H2D):
+                for i in range(lo_l, hi_l):
+                    # copy=True: device_put may adopt aligned numpy buffers
+                    # zero-copy; a view into the live master would mutate
+                    # these params on the next in-place step_host
+                    upd = np.array(
+                        master[ele_off[i]:ele_off[i + 1]].reshape(shapes[i]),
+                        dtype=dtypes[i], copy=True)
+                    sh = getattr(param_leaves[i], "sharding", None)
+                    new_leaves[i] = (jax.device_put(upd, sh) if sh is not None
+                                     else jax.device_put(upd))
+        return jax.tree_util.tree_unflatten(treedef, new_leaves), opt_state
+
     # -- elastic checkpointing ---------------------------------------------
     def shard_state_dicts(self, opt_state):
         """Layout-agnostic save: full logical arrays in ONE shard file —
         re-partitioning on load is free because shardings are re-derived from
         the target mesh (the reference's 'lean' elastic states)."""
+        if self.cpu_offload:
+            return self._host_shard_state_dicts()
         return [{
             "rank": 0,
             "dp_world_size": self.dp,
@@ -153,7 +235,38 @@ class ZeroPytreeOptimizer:
             "state": jax.device_get(opt_state),
         }]
 
+    def _host_shard_state_dicts(self):
+        """Offload variant: the shard comes from the HOST master + host Adam
+        state (no device-side optimizer state exists under cpu_offload)."""
+        hs = getattr(self.inner, "_host_state", None)
+        return [{
+            "rank": 0,
+            "dp_world_size": self.dp,
+            "pytree_zero": True,
+            "cpu_offload": True,
+            "numel": self._numel,
+            "flat_master": self._host_master[: self._numel].copy(),
+            "inner": [] if hs is None else [
+                np.asarray([hs.step]),
+                hs.exp_avg[: self._numel].copy(),
+                hs.exp_avg_sq[: self._numel].copy(),
+            ],
+        }]
+
     def load_shard_state_dicts(self, opt_state, shards):
+        if self.cpu_offload or shards[0].get("cpu_offload"):
+            s = shards[0]
+            assert s.get("pytree_zero") and s.get("cpu_offload"), \
+                "incompatible zero checkpoint (expected pytree offload shard)"
+            assert s["numel"] == self._numel, \
+                f"checkpoint numel {s['numel']} != model numel {self._numel}"
+            self._host_master[: self._numel] = s["flat_master"]
+            if s["inner"]:
+                hs = self.inner.init_host(self._host_master)
+                hs.step = int(s["inner"][0][0])
+                hs.exp_avg = np.asarray(s["inner"][1], np.float32).copy()
+                hs.exp_avg_sq = np.asarray(s["inner"][2], np.float32).copy()
+            return opt_state
         assert shards and shards[0].get("pytree_zero"), "incompatible zero checkpoint"
         blob = shards[0]["state"]
         leaves_t, treedef = jax.tree_util.tree_flatten(opt_state)

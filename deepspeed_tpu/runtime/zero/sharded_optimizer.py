@@ -24,6 +24,9 @@ Capability parity with the reference's ``FP16_DeepSpeedZeroOptimizer_Stage1``
   (reference stage2.py:1648-1841).
 """
 
+import queue
+import threading
+import time
 from typing import NamedTuple
 
 import numpy as np
@@ -32,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
+from deepspeed_tpu import telemetry
 from deepspeed_tpu.ops.utils_op import (
     flatten_dense_tensors,
     pad_to_multiple,
@@ -43,11 +47,121 @@ from deepspeed_tpu.parallel.sharding_registry import (
     train_sharding,
     train_spec,
 )
+from deepspeed_tpu.profiling.sentinels import (
+    allowed_transfer,
+    register_allowed_transfer,
+)
 from deepspeed_tpu.utils.logging import log_dist
 
 
 # reference default (stage2.py); the warn loop below keys off this constant
 DEFAULT_BUCKET_SIZE = 500000000
+
+# The ONLY sanctioned paging sites of the ZeRO-Offload host step: grad
+# buckets stream D2H and updated param buckets stream H2D through these
+# named windows, so a transfer_free() region around the training step stays
+# honest — offload traffic is explicit and greppable, never implicit.
+OFFLOAD_D2H = register_allowed_transfer("zero/offload_d2h")
+OFFLOAD_H2D = register_allowed_transfer("zero/offload_h2d")
+
+# Edge-triggered, per process: flips on the FIRST grad leaf whose async D2H
+# could not be kicked, so benches on backends without copy_to_host_async
+# are visibly honest instead of silently degrading to sync fetches.
+_SYNC_FALLBACK_SEEN = False
+
+
+class _NullSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def _span(tracer, name, **args):
+    return tracer.span(name, cat="offload", args=args) if tracer.enabled \
+        else _NULL_SPAN
+
+
+def _start_async_copy(leaf):
+    """Kick ``leaf``'s async D2H; False means the later ``device_get`` will
+    be a synchronous fetch (no ``copy_to_host_async``, or the backend
+    refused it)."""
+    fn = getattr(leaf, "copy_to_host_async", None)
+    if fn is None:
+        return False
+    try:
+        fn()
+    except Exception:  # noqa: BLE001 — backend without async copy
+        return False
+    return True
+
+
+def _kick_async_copies(leaves):
+    """Start D2H for every grad leaf up front (transfers run while earlier
+    buckets compute); returns how many leaves will fall back to a
+    synchronous fetch. CSR leaves kick their index/value components."""
+    from deepspeed_tpu.runtime.csr_tensor import CSRTensor
+
+    sync = 0
+    for leaf in leaves:
+        if isinstance(leaf, CSRTensor):
+            ok = _start_async_copy(leaf.indices)
+            ok = _start_async_copy(leaf.values) and ok
+        else:
+            ok = _start_async_copy(leaf)
+        if not ok:
+            sync += 1
+    return sync
+
+
+def _note_sync_fetches(count, total):
+    """Account the silent-degrade path: a monotonic counter every step it
+    happens, plus ONE edge-triggered trace instant per process."""
+    global _SYNC_FALLBACK_SEEN
+    if count <= 0:
+        return
+    telemetry.get_registry().counter(
+        "Train/offload_sync_fetch_total",
+        help="offload grad fetches that fell back to a synchronous "
+             "device_get (copy_to_host_async unavailable or refused)",
+    ).inc(count)
+    if not _SYNC_FALLBACK_SEEN:
+        _SYNC_FALLBACK_SEEN = True
+        telemetry.instant(
+            "train/offload_sync_fallback", cat="train",
+            args={"leaves": count, "total": total})
+
+
+def _fetch_flat_grad(leaf, out):
+    """device_get one grad leaf into ``out`` (a flat fp32 staging slice of
+    exactly the leaf's numel). CSR leaves (sparse embedding grads) rebuild
+    their dense layout host-side — only touched rows cross D2H."""
+    from deepspeed_tpu.runtime.csr_tensor import CSRTensor
+
+    if isinstance(leaf, CSRTensor):
+        out[:] = 0.0
+        idx = np.asarray(jax.device_get(leaf.indices))
+        if idx.size:
+            dense = out.reshape(leaf.dense_size)
+            dense[idx] = np.asarray(jax.device_get(leaf.values), np.float32)
+    else:
+        out[:] = np.asarray(jax.device_get(leaf), np.float32).reshape(-1)
+
+
+def _offload_stage_loop(q):
+    """Generic stage loop of the offload pipeline workers ('zero-offload-
+    adam', 'zero-offload-h2d'): tasks are closures that trap their own
+    errors into the per-call state, so the loop itself never dies; ``None``
+    shuts the worker down."""
+    while True:
+        task = q.get()
+        if task is None:
+            return
+        task()
 
 
 def compute_bucket_ranges(sizes, bucket_size):
@@ -112,13 +226,20 @@ class ZeroShardedOptimizer:
                  allgather_bucket_size=DEFAULT_BUCKET_SIZE,
                  elastic_checkpoint=True, clip_grad=0.0, postscale_gradients=True,
                  gradient_predivide_factor=1.0, keep_master=True,
-                 param_shardings=None, overlap_comm=False):
+                 param_shardings=None, overlap_comm=False,
+                 offload_stream_buckets=1, offload_pin_host=True):
         assert mesh is not None, "ZeroShardedOptimizer requires a mesh"
         self.inner = inner
         self.stage = stage
         self.mesh = mesh
         self.dp = dp_world_size(mesh)
         self.cpu_offload = cpu_offload
+        # offload_stream_buckets >= 2 turns the host step into the three-
+        # stage per-bucket pipeline (_update_host_streamed); 1 keeps the
+        # sequential leaf-at-a-time path bit-for-bit.
+        self.offload_stream_buckets = max(1, int(offload_stream_buckets))
+        self.offload_pin_host = bool(offload_pin_host)
+        self._offload_streaming = bool(cpu_offload) and self.offload_stream_buckets > 1
         self.reduce_scatter = reduce_scatter
         # overlap_comm=False (default): bucket-size knobs are accepted for
         # config parity but are NO-OPS, by design rather than omission — the
@@ -134,13 +255,22 @@ class ZeroShardedOptimizer:
         # post-reduce layout INSIDE the backward pass, so XLA emits one
         # collective per bucket as soon as that bucket's grads exist and
         # schedules it against the remaining backward compute.
-        self.overlap_comm = overlap_comm and not cpu_offload
+        # Under cpu_offload, overlap_comm only survives when the offload
+        # stream is on: the streamed host step reuses grad_overlap_tap's
+        # per-bucket backward pins (tap buckets == stream buckets), so each
+        # bucket's grads are reduced AND ready to page out mid-backward.
+        self.overlap_comm = overlap_comm and (not cpu_offload or self._offload_streaming)
         self.reduce_bucket_size = reduce_bucket_size
         self.allgather_bucket_size = allgather_bucket_size
-        ignored = (("allgather_bucket_size", allgather_bucket_size),) if self.overlap_comm else (
-            ("reduce_bucket_size", reduce_bucket_size),
-            ("allgather_bucket_size", allgather_bucket_size),
-        )
+        if self.overlap_comm and not self._offload_streaming:
+            ignored = (("allgather_bucket_size", allgather_bucket_size),)
+        else:
+            # offload streaming derives its bucket plan from
+            # offload_stream_buckets, not reduce_bucket_size
+            ignored = (
+                ("reduce_bucket_size", reduce_bucket_size),
+                ("allgather_bucket_size", allgather_bucket_size),
+            )
         for knob, val in ignored:
             if val != DEFAULT_BUCKET_SIZE:
                 log_dist(
@@ -149,11 +279,13 @@ class ZeroShardedOptimizer:
                     "XLA program (see ZeroShardedOptimizer docstring)",
                     ranks=[0],
                 )
-        if overlap_comm and cpu_offload:
+        if overlap_comm and cpu_offload and not self._offload_streaming:
             log_dist(
                 "ZeRO: overlap_comm is IGNORED under cpu_offload — the host "
                 "step fetches whole grad leaves; there is no in-program "
-                "backward to interleave collectives into", ranks=[0],
+                "backward to interleave collectives into (set "
+                "offload_stream_buckets >= 2 to stream the host step against "
+                "the backward)", ranks=[0],
             )
         self._buckets = None       # [(lo, hi)] leaf ranges, set by init()
         self.bucket_numels = None  # per-bucket element counts (telemetry)
@@ -167,6 +299,14 @@ class ZeroShardedOptimizer:
         self._numel = None
         self._padded = None
         self._param_shardings = param_shardings  # stage-3 storage layout
+        # streamed-offload pipeline state (workers start lazily, daemonized;
+        # constructing an optimizer never spawns threads)
+        self._offload_queues = None
+        self._offload_threads = None
+        # ping-pong partner for the streamed out-of-place host step; kept
+        # across steps under offload_pin_host (steady-state zero allocation)
+        self._offload_master_next = None
+        self.last_offload_stats = None  # per-step stage timings + overlap_frac
         self.lr = getattr(inner, "lr", 1e-3)
         self.name = getattr(inner, "name", "zero")
 
@@ -175,13 +315,21 @@ class ZeroShardedOptimizer:
         return train_sharding(self.mesh, "zero/flat_shard")
 
     def _ensure_buckets(self, params=None):
-        """Leaf-range bucket plan for overlap_comm (lazily derivable from a
-        params pytree before ``init`` runs, e.g. at trace time)."""
+        """Leaf-range bucket plan (lazily derivable from a params pytree
+        before ``init`` runs, e.g. at trace time). Under offload streaming
+        the plan is ``offload_stream_buckets`` near-equal element splits —
+        and it is the SAME plan ``grad_overlap_tap`` pins, so the backward's
+        reduce buckets line up 1:1 with the host pipeline's stream buckets."""
         if self._buckets is not None:
             return self._buckets
         spec = self._spec if self._spec is not None else tree_spec(params)
         _, _, _, sizes = spec
-        self._buckets = compute_bucket_ranges(sizes, self.reduce_bucket_size)
+        if self._offload_streaming:
+            total = int(sum(int(s) for s in sizes))
+            bucket_size = max(1, -(-total // self.offload_stream_buckets))
+        else:
+            bucket_size = self.reduce_bucket_size
+        self._buckets = compute_bucket_ranges(sizes, bucket_size)
         self.bucket_numels = [int(sum(sizes[lo:hi])) for lo, hi in self._buckets]
         return self._buckets
 
@@ -251,12 +399,21 @@ class ZeroShardedOptimizer:
 
     def init(self, params):
         self._spec = tree_spec(params)
-        if self.overlap_comm:
+        if self.overlap_comm or self._offload_streaming:
             self._ensure_buckets(params)
-            log_dist(
-                f"ZeRO overlap_comm: {len(self._buckets)} reduce bucket(s) of "
-                f"at most {self.reduce_bucket_size} elements "
-                f"(numels={self.bucket_numels})", ranks=[0])
+            if self._offload_streaming:
+                log_dist(
+                    f"ZeRO-Offload stream: {len(self._buckets)} bucket(s) "
+                    f"(requested {self.offload_stream_buckets}, "
+                    f"numels={self.bucket_numels}, "
+                    f"pin_host={self.offload_pin_host}, "
+                    f"backward taps={'on' if self.overlap_comm else 'off'})",
+                    ranks=[0])
+            else:
+                log_dist(
+                    f"ZeRO overlap_comm: {len(self._buckets)} reduce bucket(s) of "
+                    f"at most {self.reduce_bucket_size} elements "
+                    f"(numels={self.bucket_numels})", ranks=[0])
         if getattr(self.inner, "no_decay_names", None):
             if self.cpu_offload:
                 # ValueError, not assert: must fire under python -O too (a
@@ -285,7 +442,10 @@ class ZeroShardedOptimizer:
         if self.cpu_offload:
             # ZeRO-Offload: master AND optimizer state live on host only — no
             # device-side copies (that HBM is exactly what offload frees).
-            self._host_master = np.asarray(jax.device_get(flat), np.float32)
+            # np.array (not asarray): device_get can hand back a READ-ONLY
+            # zero-copy view of the runtime's buffer; the master must be an
+            # owned writable array (in-place sequential steps, ping-pong)
+            self._host_master = np.array(jax.device_get(flat), np.float32)
             self._host_inner = self.inner.init_host(self._host_master) if hasattr(self.inner, "init_host") else None
             log_dist(f"ZeRO-Offload: {self._host_master.nbytes/1e6:.1f} MB master on host", ranks=[0])
             return ZeroState(flat_master=jnp.zeros((0,), jnp.float32), inner_state=None)
@@ -366,12 +526,25 @@ class ZeroShardedOptimizer:
 
     # -- host path (ZeRO-Offload) -----------------------------------------
     def update_host(self, grads, opt_state, params, lr=None):
-        """Host-side step with a pipelined D2H / compute / H2D boundary
+        """Host-side step (ZeRO-Offload). ``offload_stream_buckets >= 2``
+        runs the three-stage per-bucket pipeline (_update_host_streamed);
+        the default collapses to the sequential leaf-at-a-time path — the
+        two are bitwise-identical because slice-stepping the host Adam over
+        any disjoint cover of [0, numel) equals the full-vector step
+        (pinned by tests/unit/test_cpu_adam.py)."""
+        if self._offload_streaming:
+            return self._update_host_streamed(grads, opt_state, params, lr=lr)
+        return self._update_host_sequential(grads, opt_state, params, lr=lr)
+
+    def _update_host_sequential(self, grads, opt_state, params, lr=None):
+        """Sequential host step with a pipelined D2H / compute / H2D boundary
         (reference overlaps via pinned double buffers, csrc/adam/cpu_adam.cpp):
 
         1. async D2H is kicked off for EVERY dense grad leaf up front
            (``copy_to_host_async``) — transfers run while earlier leaves
-           compute;
+           compute; leaves that cannot kick one are counted
+           (Train/offload_sync_fetch_total) and flagged once per process
+           (train/offload_sync_fallback) instead of degrading silently;
         2. leaves step the host master slice-by-slice (C++ Adam on the leaf's
            [lo, hi) range; one shared Adam step counter per logical step);
         3. each leaf's updated params start their async H2D (``device_put``)
@@ -386,12 +559,7 @@ class ZeroShardedOptimizer:
         leaves = jax.tree_util.tree_leaves(grads)
 
         # (1) start all D2H transfers before any host compute
-        for leaf in leaves:
-            if hasattr(leaf, "copy_to_host_async"):
-                try:
-                    leaf.copy_to_host_async()
-                except Exception:  # noqa: BLE001 — backend without async copy
-                    pass
+        _note_sync_fetches(_kick_async_copies(leaves), len(leaves))
 
         repl = train_sharding(self.mesh, "zero/gathered")
         lr_f = lr
@@ -400,14 +568,15 @@ class ZeroShardedOptimizer:
         offset = 0
         for i, (leaf, shape, dtype) in enumerate(zip(leaves, shapes, dtypes)):
             n = int(np.prod(shape)) if shape else 1
-            if isinstance(leaf, CSRTensor):
-                g = np.zeros(leaf.dense_size, np.float32)
-                idx = np.asarray(jax.device_get(leaf.indices))
-                if idx.size:
-                    g[idx] = np.asarray(jax.device_get(leaf.values), np.float32)
-                g = g.reshape(-1)
-            else:
-                g = np.asarray(jax.device_get(leaf), np.float32).reshape(-1)
+            with allowed_transfer(OFFLOAD_D2H):
+                if isinstance(leaf, CSRTensor):
+                    g = np.zeros(leaf.dense_size, np.float32)
+                    idx = np.asarray(jax.device_get(leaf.indices))
+                    if idx.size:
+                        g[idx] = np.asarray(jax.device_get(leaf.values), np.float32)
+                    g = g.reshape(-1)
+                else:
+                    g = np.asarray(jax.device_get(leaf), np.float32).reshape(-1)
             # (2) C++/numpy Adam on this leaf's master range
             self.inner.step_host(
                 master, g, lr=lr_f, lo=offset, hi=offset + n, advance_step=(i == 0)
@@ -422,8 +591,200 @@ class ZeroShardedOptimizer:
             upd = np.array(
                 master[offset:offset + n].reshape(shape), dtype=dtype, copy=True
             )
-            new_leaves.append(jax.device_put(upd, repl))
+            with allowed_transfer(OFFLOAD_H2D):
+                new_leaves.append(jax.device_put(upd, repl))
             offset += n
+        # padding tail (if any) never holds real params; leave it untouched
+        new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        return new_params, opt_state
+
+    def _ensure_offload_pipeline(self):
+        """The two persistent daemon stage workers of the streamed host step:
+        'zero-offload-adam' (stage 2, host optimizer) and 'zero-offload-h2d'
+        (stage 3, param commit). Started lazily on the first streamed step;
+        restarted if a previous worker died with the interpreter shutdown."""
+        if self._offload_queues is not None and \
+                all(t.is_alive() for t in self._offload_threads):
+            return self._offload_queues
+        adam_q, h2d_q = queue.Queue(), queue.Queue()
+        threads = (
+            threading.Thread(target=_offload_stage_loop, args=(adam_q,),
+                             name="zero-offload-adam", daemon=True),
+            threading.Thread(target=_offload_stage_loop, args=(h2d_q,),
+                             name="zero-offload-h2d", daemon=True),
+        )
+        for t in threads:
+            t.start()
+        self._offload_queues = (adam_q, h2d_q)
+        self._offload_threads = threads
+        return self._offload_queues
+
+    def _update_host_streamed(self, grads, opt_state, params, lr=None):
+        """Three-stage per-bucket pipeline (ZeRO-Offload/ZeRO-Infinity's
+        overlapped optimizer traffic, reference stage2.py:743-900 plus the
+        csrc pinned double buffers):
+
+          stage 1 (training thread): per-bucket D2H — async copies were
+            kicked for every leaf up front, so each fetch materializes a
+            host view/copy of an already-landed buffer (on CPU backends a
+            zero-copy view);
+          stage 2 ('zero-offload-adam' worker): host Adam over each leaf's
+            [lo, hi) master range — bitwise identical to the sequential
+            path (slice-stepping == full-vector stepping; shared step
+            counter advances once, on the first leaf). The step is OUT-OF-
+            PLACE (``master_out``): params for this step land in the ping-
+            pong partner buffer while the current master stays untouched;
+          stage 3 ('zero-offload-h2d' worker): the partner buffer's leaf
+            views committed back via sharding-aware device_put with NO
+            snapshot copy — the runtime may adopt the buffer zero-copy,
+            which is safe exactly because the out-of-place step never
+            rewrites it until two steps later, when the adopted arrays
+            are dead. (The in-place sequential path must pay a full
+            master copy per step for the same safety; eliminating that
+            copy is the streamed path's single-core win, on top of the
+            multi-core stage overlap.)
+
+        Host Adam for bucket i overlaps the D2H of bucket i+1 AND the H2D
+        of bucket i-1. A two-token semaphore bounds stage 1 to two buckets
+        in flight, so host grad staging high-water stays bounded on
+        backends where device_get materializes copies. After the last
+        commit the buffers swap: the partner becomes the master. Under
+        ``offload_pin_host`` the pair is persistent (steady-state zero
+        allocation; param arrays from two updates ago alias the recycled
+        buffer — the engine never reads that old generation, but external
+        holders of stale param trees must copy); with it off a fresh
+        partner is allocated every step (no aliasing across updates, one
+        full-master allocation per step). Every transfer goes through the
+        named allowlist (zero/offload_d2h, zero/offload_h2d) — a
+        surrounding transfer_free() region stays honest. The call is
+        synchronous: it returns only after every bucket committed, so
+        checkpoint/rollback state is always step-consistent."""
+        treedef, shapes, dtypes, _ = self._spec
+        leaves = jax.tree_util.tree_leaves(grads)
+        buckets = self._ensure_buckets()
+        nleaf = [int(np.prod(s)) if s else 1 for s in shapes]  # jaxlint: disable=JL002(static host-side shape arithmetic)
+        ele_off = [0]
+        for n in nleaf:
+            ele_off.append(ele_off[-1] + n)
+
+        tracer = telemetry.get_tracer()
+        t_wall = time.perf_counter()
+        _note_sync_fetches(_kick_async_copies(leaves), len(leaves))
+
+        adam_q, h2d_q = self._ensure_offload_pipeline()
+        src = self._host_master
+        if self.offload_pin_host and self._offload_master_next is not None \
+                and self._offload_master_next.shape == src.shape \
+                and self._offload_master_next.flags.writeable:
+            dst = self._offload_master_next
+        else:
+            dst = np.empty_like(src)
+        # buckets cover [0, numel); carry the alignment-padding tail over so
+        # the swapped-in master stays bitwise-equal to the sequential one
+        if ele_off[-1] < src.shape[0]:
+            dst[ele_off[-1]:] = src[ele_off[-1]:]
+
+        repl = train_sharding(self.mesh, "zero/gathered")
+        lr_f = lr
+        fetched = [None] * len(leaves)
+        new_leaves = [None] * len(leaves)
+        state = {"error": None, "host_s": 0.0, "h2d_s": 0.0}
+        slot_free = threading.Semaphore(2)
+        done = threading.Event()
+
+        def h2d_task(b, lo_l, hi_l):
+            if state["error"] is not None:
+                return
+            t0 = time.perf_counter()
+            try:
+                with _span(tracer, "train/offload_h2d",
+                           bucket=b, leaves=hi_l - lo_l,
+                           numel=ele_off[hi_l] - ele_off[lo_l]):
+                    with allowed_transfer(OFFLOAD_H2D):
+                        for i in range(lo_l, hi_l):
+                            # a VIEW of dst, deliberately: dst is written
+                            # out-of-place and not recycled until these
+                            # arrays are dead, so zero-copy adoption is
+                            # safe and the per-leaf snapshot copy the
+                            # sequential path pays is eliminated
+                            upd = dst[ele_off[i]:ele_off[i + 1]].reshape(shapes[i])
+                            if upd.dtype != dtypes[i]:
+                                upd = np.asarray(upd, dtype=dtypes[i])  # jaxlint: disable=JL002(host-side dtype cast, no device traffic)
+                            new_leaves[i] = jax.device_put(upd, repl)  # jaxlint: disable=JL002(the offload H2D commit itself, allowlisted zero/offload_h2d)
+            except BaseException as e:  # noqa: BLE001 — re-raised on the training thread
+                state["error"] = e
+            finally:
+                state["h2d_s"] += time.perf_counter() - t0
+
+        def adam_task(b, lo_l, hi_l, first):
+            t0 = time.perf_counter()
+            try:
+                if state["error"] is None:
+                    with _span(tracer, "train/offload_host_step",
+                               bucket=b,
+                               numel=ele_off[hi_l] - ele_off[lo_l]):
+                        for i in range(lo_l, hi_l):
+                            self.inner.step_host(
+                                src, fetched[i], lr=lr_f,
+                                lo=ele_off[i], hi=ele_off[i + 1],
+                                advance_step=first and i == lo_l,
+                                master_out=dst)
+                            fetched[i] = None  # release the grad buffer
+            except BaseException as e:  # noqa: BLE001 — re-raised on the training thread
+                state["error"] = e
+            finally:
+                state["host_s"] += time.perf_counter() - t0
+                # stage 2 consumed this bucket's grads; stage 1 may advance
+                slot_free.release()
+            h2d_q.put(lambda: h2d_task(b, lo_l, hi_l))
+
+        # stage 1: per-bucket D2H on the training thread
+        d2h_s = 0.0
+        for b, (lo_l, hi_l) in enumerate(buckets):
+            slot_free.acquire()
+            if state["error"] is not None:
+                slot_free.release()
+                break
+            # timed AFTER the slot wait: blocking on backpressure is hidden
+            # time, not D2H work — counting it would inflate overlap_frac
+            t0 = time.perf_counter()
+            with _span(tracer, "train/offload_d2h", bucket=b,
+                       numel=ele_off[hi_l] - ele_off[lo_l]):
+                with allowed_transfer(OFFLOAD_D2H):
+                    for i in range(lo_l, hi_l):
+                        leaf = leaves[i]
+                        if hasattr(leaf, "dense_size"):  # CSR: densify
+                            buf = np.empty(nleaf[i], np.float32)
+                            _fetch_flat_grad(leaf, buf)
+                            fetched[i] = buf
+                        else:
+                            fetched[i] = np.asarray(  # jaxlint: disable=JL002(the offload D2H fetch itself, allowlisted zero/offload_d2h)
+                                jax.device_get(leaf), np.float32).reshape(-1)  # jaxlint: disable=JL002(async copy kicked up front; zero-copy view on CPU)
+            d2h_s += time.perf_counter() - t0
+            adam_q.put(lambda b=b, lo=lo_l, hi=hi_l,
+                       first=(b == 0): adam_task(b, lo, hi, first))
+        # flush: FIFO queues + single workers mean this runs strictly after
+        # every bucket's stage 2, which enqueued every bucket's stage 3
+        adam_q.put(lambda: h2d_q.put(done.set))
+        done.wait()
+
+        wall_s = time.perf_counter() - t_wall
+        if state["error"] is not None:
+            raise state["error"]
+        # commit the ping-pong swap only on success: on error the master is
+        # untouched (out-of-place step) and dst is next step's scratch
+        self._host_master = dst
+        self._offload_master_next = src if self.offload_pin_host else None
+        busy = d2h_s + state["host_s"] + state["h2d_s"]
+        overlap = max(0.0, min(1.0, (busy - wall_s) / busy)) if busy > 0 else 0.0
+        self.last_offload_stats = {
+            "buckets": len(buckets),
+            "d2h_ms": d2h_s * 1000.0,
+            "host_step_ms": state["host_s"] * 1000.0,
+            "h2d_ms": state["h2d_s"] * 1000.0,
+            "wall_ms": wall_s * 1000.0,
+            "overlap_frac": overlap,
+        }
         # padding tail (if any) never holds real params; leave it untouched
         new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
         return new_params, opt_state
@@ -487,6 +848,9 @@ class ZeroShardedOptimizer:
         full = np.concatenate([s["flat_master"] for s in shards])[:numel]
         pad = self._host_master.shape[0] - numel
         self._host_master = np.concatenate([full, np.zeros(pad, np.float32)]) if pad > 0 else full
+        # drop the ping-pong partner: it may still back param arrays from the
+        # abandoned timeline, and the loaded master deserves a clean pair
+        self._offload_master_next = None
         if shards[0]["inner"]:
             hs = self.inner.init_host(self._host_master)
             hs.step = int(shards[0]["inner"][0][0])

@@ -127,6 +127,48 @@ def test_zero_offload_checkpoint_roundtrip(tmpdir):
     np.testing.assert_allclose(float(jax.device_get(l1)), float(jax.device_get(l2)), rtol=1e-4)
 
 
+def test_zero_offload_streamed_checkpoint_resume_bitwise(tmpdir):
+    """Checkpoint-under-offload with the bucket-streamed pipeline: a save
+    taken mid-stream (_host_shard_state_dicts) must resume EXACTLY — into a
+    streamed engine and into an unstreamed (K=1) one — landing bitwise on
+    the uninterrupted run. fp32 compute so 'exact' means array_equal."""
+    save_dir = str(tmpdir.join("ckpt"))
+    cfg = _cfg(zero_stage=2)
+    cfg["zero_optimization"]["cpu_offload"] = True
+    cfg["zero_optimization"]["offload_stream_buckets"] = 3
+
+    engine = make_simple_engine(tmpdir, cfg)
+    _train_steps(engine, 4)
+    engine.save_checkpoint(save_dir)
+
+    resumed = {}
+    for label, k in (("streamed", 3), ("sequential", 1)):
+        c = _cfg(zero_stage=2)
+        c["zero_optimization"]["cpu_offload"] = True
+        c["zero_optimization"]["offload_stream_buckets"] = k
+        e = make_simple_engine(tmpdir, c, seed=99)
+        tag, _ = e.load_checkpoint(save_dir)
+        assert tag is not None
+        # host-resident Adam state restored exactly, not just params
+        hs = e.optimizer.inner._host_state
+        ref = engine.optimizer.inner._host_state
+        assert hs.step == ref.step
+        np.testing.assert_array_equal(hs.exp_avg, ref.exp_avg)
+        np.testing.assert_array_equal(hs.exp_avg_sq, ref.exp_avg_sq)
+        resumed[label] = e
+
+    _train_steps(engine, 3, seed=21)
+    for e in resumed.values():
+        _train_steps(e, 3, seed=21)
+    for e in resumed.values():
+        for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(engine.params)),
+                        jax.tree_util.tree_leaves(jax.device_get(e.params))):
+            np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(
+        resumed["streamed"].optimizer._host_master,
+        resumed["sequential"].optimizer._host_master)
+
+
 def test_zero_checkpoint_save_before_step(tmpdir):
     """Saving immediately after initialize (before any step) must work."""
     save_dir = str(tmpdir.join("ckpt"))

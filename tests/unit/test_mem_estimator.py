@@ -36,8 +36,38 @@ def test_stage2_accounting():
 def test_offload_moves_states_to_host():
     on = estimate_zero2_model_states_mem_needs(P, dp=8)
     off = estimate_zero2_model_states_mem_needs(P, dp=8, cpu_offload=True)
-    assert off["host_bytes"] == 12 * P // 8  # master + moments
-    assert off["device_bytes"] == on["device_bytes"] - 12 * P // 8
+    b = off["breakdown"]
+    # host tier follows the implementation: FULL per-process master + moments
+    # (sharded_optimizer.init device_gets the whole flat vector), plus the
+    # sequential path's grad-staging upper bound of one full fp32 grad vector
+    assert b["fp32 master (host)"] == 4 * P
+    assert b["master ping-pong partner (host)"] == 0  # sequential: in-place
+    assert b["Adam moments (host)"] == 8 * P
+    assert b["grad staging (host, high-water)"] == 4 * P
+    assert off["host_bytes"] == 16 * P
+    # device keeps params + transient compute-dtype grads ONLY: the flat
+    # fp32 grad buffer never materializes on device under offload (this row
+    # used to be over-reported)
+    assert off["device_bytes"] == 2 * P + 2 * P
+    assert off["device_bytes"] < on["device_bytes"]
+    assert "gradients (fp32 flat)" not in b
+
+
+def test_offload_streaming_bounds_staging():
+    seq = estimate_zero2_model_states_mem_needs(P, dp=8, cpu_offload=True)
+    k4 = estimate_zero2_model_states_mem_needs(
+        P, dp=8, cpu_offload=True, offload_stream_buckets=4)
+    # K=4: grad staging bounded at two in-flight buckets of ceil(4P/4)
+    # bytes — half the sequential upper bound — but the out-of-place
+    # streamed step adds the full 4P ping-pong master partner; device
+    # accounting is unchanged by streaming
+    assert k4["breakdown"]["grad staging (host, high-water)"] == 2 * (4 * P // 4)
+    assert k4["breakdown"]["master ping-pong partner (host)"] == 4 * P
+    assert k4["host_bytes"] == seq["host_bytes"] + 4 * P - 2 * P
+    assert k4["device_bytes"] == seq["device_bytes"]
+    with pytest.raises(ValueError, match="offload_stream_buckets"):
+        estimate_zero_model_states_mem_needs(
+            P, stage=2, cpu_offload=True, offload_stream_buckets=0)
 
 
 def test_stage3_shards_params():

@@ -11,7 +11,7 @@ Two modes:
     ``KERNEL_BENCH_CPU.json`` + ``CHAOS_BENCH_CPU.json`` +
     ``ROLLOUT_BENCH_CPU.json`` + ``DISAGG_BENCH_CPU.json`` +
     ``MEMTIER_BENCH_CPU.json`` + ``TRAIN_BENCH_CPU.json`` +
-    ``MESH_BENCH_CPU.json``). This is the
+    ``MESH_BENCH_CPU.json`` + ``OFFLOAD_BENCH_CPU.json``). This is the
     CI step: it needs no jax and takes milliseconds.
 
 ``compare FRESH BASELINE``
@@ -34,7 +34,9 @@ a chaos-harness artifact (``CHAOS_BENCH_CPU.json``);
 (``ROLLOUT_BENCH_CPU.json``);
 ``decode_pallas_us`` marks a kernel-tier microbench artifact
 (``KERNEL_BENCH_CPU.json``); ``train_fusion`` marks a train-step
-fusion artifact (``TRAIN_BENCH_CPU.json``); ``sharded_oracle_ok``
+fusion artifact (``TRAIN_BENCH_CPU.json``); ``streamed_step_ms``
+marks a bucket-streamed ZeRO-Offload artifact
+(``OFFLOAD_BENCH_CPU.json``); ``sharded_oracle_ok``
 marks a mesh-sharded serving artifact (``MESH_BENCH_CPU.json``);
 ``tokens_per_sec`` marks
 a serving artifact; ``metric`` marks a train artifact. Contexts
@@ -67,7 +69,7 @@ DEFAULT_ARTIFACTS = ("SERVING_BENCH_CPU.json", "BENCH_r05.json",
                      "KERNEL_BENCH_CPU.json", "CHAOS_BENCH_CPU.json",
                      "ROLLOUT_BENCH_CPU.json", "DISAGG_BENCH_CPU.json",
                      "MEMTIER_BENCH_CPU.json", "TRAIN_BENCH_CPU.json",
-                     "MESH_BENCH_CPU.json")
+                     "MESH_BENCH_CPU.json", "OFFLOAD_BENCH_CPU.json")
 
 # -- tolerance profiles -------------------------------------------------
 # key -> (direction, rel_tol). direction "higher" means bigger is better:
@@ -150,6 +152,17 @@ TRAINSTEP_TOLERANCES = {
     "bubble_1f1b":         ("lower", 0.01),
     "bubble_interleaved":  ("lower", 0.01),
     "comm_overlap_frac":   ("higher", 0.10),
+}
+
+# Bucket-streamed ZeRO-Offload leg: absolute step_ms on a shared CPU
+# runner is noisy, so its bands are loose; the streamed/sequential ratio
+# (same box, same run — noise cancels) is the gate-worthy signal, and
+# the bitwise parity flags are schema-checked, not toleranced.
+OFFLOAD_TOLERANCES = {
+    "seq_step_ms":          ("lower", 1.00),
+    "streamed_step_ms":     ("lower", 1.00),
+    "streamed_vs_seq":      ("lower", 0.12),
+    "offload_overlap_frac": ("higher", 0.60),
 }
 
 # Chaos leg: recovery times on a shared CPU runner are pure noise, so
@@ -243,6 +256,11 @@ CHAOS_CONTEXT = ("platform", "model", "chaos_seed", "chaos_episodes")
 TRAINSTEP_CONTEXT = ("platform", "model", "n_devices", "zero_stage",
                      "reduce_bucket_size", "pipe_stages",
                      "pipe_micro_batches")
+# the bucket plan and model size are load-bearing: a different K (or a
+# different host-optimizer tier share of the step) measures a different
+# pipeline, so its ratio is not comparable.
+OFFLOAD_CONTEXT = ("platform", "model", "zero_stage", "stream_buckets",
+                   "params", "parity_steps")
 # the seed and canary fraction are load-bearing: a different seed runs a
 # different traffic schedule, and a different slice carries a different
 # share of it.
@@ -375,6 +393,19 @@ MEMTIER_REQUIRED = {
     "complete": bool,
 }
 
+OFFLOAD_REQUIRED = {
+    "platform": str, "model": str, "zero_stage": int, "cpu_offload": bool,
+    "stream_buckets": int, "params": int, "parity_steps": int,
+    "parity_ok": bool, "master_parity_ok": bool, "one_compile": bool,
+    "seq_step_ms": (int, float), "streamed_step_ms": (int, float),
+    "streamed_vs_seq": (int, float),
+    "offload_overlap_frac": (int, float),
+    "offload_d2h_ms": (int, float), "offload_host_step_ms": (int, float),
+    "offload_h2d_ms": (int, float),
+    "sync_fetch_fallbacks": int,
+    "complete": bool,
+}
+
 MESH_REQUIRED = {
     "platform": str, "model": str, "n_devices": int, "requests": int,
     "max_new_tokens": int, "speculative_k": int,
@@ -407,6 +438,10 @@ FLEET_MIN_SCALING_2X = 1.8
 # gradient set — a single bucket is the monolithic reduce wearing a hat
 TRAINSTEP_MIN_BUCKETS = 2
 
+# offload acceptance floor: the streamed step plan must actually split
+# the host master — one bucket is the sequential path wearing a hat
+OFFLOAD_MIN_BUCKETS = 2
+
 # memtier acceptance floor: a spilled hit must actually beat a cold
 # re-prefill on the same prompts — a ratio at or below 1.0 means the
 # spill tier's decode+verify+promote costs more than the prefill it
@@ -430,19 +465,20 @@ TOLERANCES = {"serving": SERVING_TOLERANCES, "train": TRAIN_TOLERANCES,
               "kernels": KERNELS_TOLERANCES, "chaos": CHAOS_TOLERANCES,
               "rollout": ROLLOUT_TOLERANCES, "disagg": DISAGG_TOLERANCES,
               "memtier": MEMTIER_TOLERANCES, "mesh": MESH_TOLERANCES,
-              "trainstep": TRAINSTEP_TOLERANCES}
+              "trainstep": TRAINSTEP_TOLERANCES,
+              "offload": OFFLOAD_TOLERANCES}
 CONTEXTS = {"serving": SERVING_CONTEXT, "train": TRAIN_CONTEXT,
             "longdoc": LONGDOC_CONTEXT, "fleet": FLEET_CONTEXT,
             "kernels": KERNELS_CONTEXT, "chaos": CHAOS_CONTEXT,
             "rollout": ROLLOUT_CONTEXT, "disagg": DISAGG_CONTEXT,
             "memtier": MEMTIER_CONTEXT, "mesh": MESH_CONTEXT,
-            "trainstep": TRAINSTEP_CONTEXT}
+            "trainstep": TRAINSTEP_CONTEXT, "offload": OFFLOAD_CONTEXT}
 REQUIRED = {"serving": SERVING_REQUIRED, "train": TRAIN_REQUIRED,
             "longdoc": LONGDOC_REQUIRED, "fleet": FLEET_REQUIRED,
             "kernels": KERNELS_REQUIRED, "chaos": CHAOS_REQUIRED,
             "rollout": ROLLOUT_REQUIRED, "disagg": DISAGG_REQUIRED,
             "memtier": MEMTIER_REQUIRED, "mesh": MESH_REQUIRED,
-            "trainstep": TRAINSTEP_REQUIRED}
+            "trainstep": TRAINSTEP_REQUIRED, "offload": OFFLOAD_REQUIRED}
 
 
 def load_artifact(path):
@@ -480,6 +516,10 @@ def load_artifact(path):
     # "metric" line shape must never demote the artifact to kind "train"
     if "train_fusion" in doc:
         return "trainstep", doc
+    # offload before the generic "metric" marker: its artifact carries a
+    # metric-shaped stdout echo but streamed_step_ms is the kind marker
+    if "streamed_step_ms" in doc:
+        return "offload", doc
     # mesh before serving: the mesh artifact carries per-shape
     # tokens_per_sec_* keys and must never demote to kind "serving"
     if "sharded_oracle_ok" in doc:
@@ -493,6 +533,7 @@ def load_artifact(path):
         f"'fleet_scaling_2x', 'disagg_ttft_p95_s', 'spilled_hit_ttft_s', "
         f"'chaos_episodes', "
         f"'canary_routed_total', 'decode_pallas_us', 'train_fusion', "
+        f"'streamed_step_ms', "
         f"'sharded_oracle_ok', 'tokens_per_sec' or 'metric' key; "
         f"top-level keys: {sorted(doc)[:8]})")
 
@@ -803,6 +844,44 @@ def check_schema(path):
             problems.append(
                 f"{path}: 'reduce_buckets' is {nb}, below the "
                 f"{TRAINSTEP_MIN_BUCKETS}-bucket acceptance floor")
+    elif kind == "offload":
+        if doc.get("complete") is not True:
+            problems.append(f"{path}: 'complete' is not true — a partial "
+                            f"offload bench run must not be committed as a "
+                            f"baseline")
+        if doc.get("parity_ok") is not True:
+            problems.append(
+                f"{path}: 'parity_ok' is not true — a streamed offload step "
+                f"whose losses/params diverge from the sequential host path "
+                f"must never become a baseline")
+        if doc.get("master_parity_ok") is not True:
+            problems.append(
+                f"{path}: 'master_parity_ok' is not true — the ping-pong "
+                f"host master must stay bitwise-equal to the in-place "
+                f"sequential master")
+        if doc.get("one_compile") is not True:
+            problems.append(
+                f"{path}: 'one_compile' is not true — streaming the host "
+                f"optimizer must not retrace the train step")
+        seq_ms = doc.get("seq_step_ms")
+        str_ms = doc.get("streamed_step_ms")
+        for key, v in (("seq_step_ms", seq_ms),
+                       ("streamed_step_ms", str_ms)):
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v <= 0:
+                problems.append(f"{path}: '{key}' must be > 0, got {v}")
+        if all(isinstance(v, (int, float)) and not isinstance(v, bool)
+               for v in (seq_ms, str_ms)) and str_ms >= seq_ms:
+            problems.append(
+                f"{path}: 'streamed_step_ms' ({str_ms}) is not below "
+                f"'seq_step_ms' ({seq_ms}) — a streamed step that doesn't "
+                f"beat the sequential host path it replaces proves nothing")
+        nb = doc.get("stream_buckets")
+        if isinstance(nb, int) and not isinstance(nb, bool) \
+                and nb < OFFLOAD_MIN_BUCKETS:
+            problems.append(
+                f"{path}: 'stream_buckets' is {nb}, below the "
+                f"{OFFLOAD_MIN_BUCKETS}-bucket acceptance floor")
     elif kind == "kernels":
         if doc.get("complete") is not True:
             problems.append(f"{path}: 'complete' is not true — a partial "
@@ -945,7 +1024,8 @@ def main(argv=None):
                              "+ CHAOS_BENCH_CPU.json + ROLLOUT_BENCH_CPU."
                              "json + DISAGG_BENCH_CPU.json + "
                              "MEMTIER_BENCH_CPU.json + TRAIN_BENCH_CPU.json"
-                             " + MESH_BENCH_CPU.json")
+                             " + MESH_BENCH_CPU.json + "
+                             "OFFLOAD_BENCH_CPU.json")
     parser.add_argument("mode", nargs="?", choices=["compare"],
                         help="compare FRESH BASELINE under tolerance bands")
     parser.add_argument("fresh", nargs="?", help="fresh bench JSON")

@@ -254,6 +254,13 @@ HOT_LOOPS = (
     # construction runs per (M, S, V) change, inside the step path
     ("deepspeed_tpu/runtime/pipe/engine.py",
      "_MergedInterleavedSchedule.__init__"),
+    # bucket-streamed ZeRO-Offload: the three-stage host-optimizer
+    # pipeline runs once per step on the training thread plus its two
+    # workers; any untracked sync or transfer here serializes the step
+    ("deepspeed_tpu/runtime/zero/sharded_optimizer.py",
+     "ZeroShardedOptimizer._update_host_streamed"),
+    ("deepspeed_tpu/runtime/zero/sharded_optimizer.py",
+     "_offload_stage_loop"),
 )
 
 HOT_MARKER = "jaxlint: hot"
